@@ -1,0 +1,114 @@
+"""mlrun-tpu — a TPU-native MLOps orchestration framework.
+
+Re-creation of the capabilities of mlrun/mlrun (reference mounted at
+/root/reference) designed for Cloud TPU: a ``tpujob`` runtime over GKE JobSet
+pod-slices instead of MPIJob/Horovod/NCCL, a JAX/Flax auto-trainer sharded
+with pjit/shard_map over ICI/DCN meshes, XLA-compiled serving steps, and an
+aiohttp+SQLite metadata service.
+
+Reference analog for this module: /root/reference/mlrun/__init__.py
+(set_environment :90, set_env_from_file :187).
+"""
+
+__version__ = "0.1.0"
+
+from .config import mlconf  # noqa: F401
+from .datastore import DataItem, store_manager  # noqa: F401
+from .db import get_run_db  # noqa: F401
+from .execution import MLClientCtx  # noqa: F401
+from .model import (  # noqa: F401
+    HyperParamOptions,
+    Notification,
+    RunObject,
+    RunTemplate,
+    new_task,
+)
+from .run import (  # noqa: F401
+    code_to_function,
+    function_to_module,
+    get_or_create_ctx,
+    import_function,
+    new_function,
+    run_local,
+    wait_for_pipeline_completion,
+)
+
+import os as _os
+
+
+def set_environment(api_path: str | None = None, artifact_path: str = "",
+                    project: str = "", access_key: str | None = None,
+                    username: str | None = None, env_file: str | None = None,
+                    mock_functions: str | None = None):
+    """Set global api/artifact config (reference mlrun/__init__.py:90)."""
+    if env_file:
+        set_env_from_file(env_file)
+    if api_path:
+        mlconf.dbpath = api_path
+        _os.environ["MLT_DBPATH"] = api_path
+    if artifact_path:
+        mlconf.artifact_path = artifact_path
+    if project:
+        mlconf.default_project = project
+    if access_key:
+        _os.environ["MLT_ACCESS_KEY"] = access_key
+    return mlconf.default_project, mlconf.get("artifact_path") or None
+
+
+def set_env_from_file(env_file: str, return_dict: bool = False):
+    """Load KEY=VALUE lines into the environment (reference :187)."""
+    env_vars = {}
+    with open(_os.path.expanduser(env_file)) as fp:
+        for line in fp:
+            line = line.strip()
+            if line and not line.startswith("#") and "=" in line:
+                key, value = line.split("=", 1)
+                env_vars[key.strip()] = value.strip()
+    for key, value in env_vars.items():
+        _os.environ[key] = value
+    mlconf.reload()
+    if return_dict:
+        return env_vars
+
+
+def get_version() -> str:
+    return __version__
+
+
+# projects API is imported lazily to avoid heavy import cost at package load;
+# these are re-exported here for parity with the reference's top-level API
+def new_project(*args, **kwargs):
+    from .projects import new_project as _new_project
+
+    return _new_project(*args, **kwargs)
+
+
+def load_project(*args, **kwargs):
+    from .projects import load_project as _load_project
+
+    return _load_project(*args, **kwargs)
+
+
+def get_or_create_project(*args, **kwargs):
+    from .projects import get_or_create_project as _get_or_create_project
+
+    return _get_or_create_project(*args, **kwargs)
+
+
+def get_current_project(silent: bool = False):
+    from .projects import get_current_project as _get_current_project
+
+    return _get_current_project(silent)
+
+
+def handler(labels: dict | None = None, outputs: list | None = None,
+            inputs: bool = True):
+    """Decorator marking a function as an mlrun-tpu handler with packaging
+    hints (reference mlrun/handler decorator)."""
+
+    def decorator(func):
+        setattr(func, "_mlt_handler", {
+            "labels": labels, "outputs": outputs, "inputs": inputs})
+        return func
+
+    return decorator

@@ -1,0 +1,10 @@
+from .base import Artifact, ArtifactMetadata, ArtifactSpec, LinkArtifact  # noqa: F401
+from .dataset import DatasetArtifact, update_dataset_meta  # noqa: F401
+from .manager import (  # noqa: F401
+    ArtifactManager,
+    ArtifactProducer,
+    artifact_types,
+    dict_to_artifact,
+)
+from .model import ModelArtifact, get_model  # noqa: F401
+from .plots import ChartArtifact, PlotArtifact, TableArtifact  # noqa: F401

@@ -1,0 +1,118 @@
+"""Runtime/run-state constants (reference analog: mlrun/common/runtimes/constants.py).
+
+The reference's MPIJob CRD constants are replaced by TPU JobSet constants.
+"""
+
+from __future__ import annotations
+
+
+class RunStates:
+    created = "created"
+    pending = "pending"
+    running = "running"
+    completed = "completed"
+    error = "error"
+    aborting = "aborting"
+    aborted = "aborted"
+    skipped = "skipped"
+    unknown = "unknown"
+
+    @staticmethod
+    def all() -> list[str]:
+        return [
+            RunStates.created, RunStates.pending, RunStates.running,
+            RunStates.completed, RunStates.error, RunStates.aborting,
+            RunStates.aborted, RunStates.skipped, RunStates.unknown,
+        ]
+
+    @staticmethod
+    def terminal_states() -> list[str]:
+        return [RunStates.completed, RunStates.error, RunStates.aborted,
+                RunStates.skipped]
+
+    @staticmethod
+    def error_states() -> list[str]:
+        return [RunStates.error, RunStates.aborted]
+
+    @staticmethod
+    def abortable_states() -> list[str]:
+        return [RunStates.created, RunStates.pending, RunStates.running,
+                RunStates.unknown]
+
+
+class RuntimeKinds:
+    local = "local"
+    handler = "handler"
+    job = "job"
+    tpujob = "tpujob"
+    dask = "dask"
+    serving = "serving"
+    remote = "remote"  # generic http-triggered function (nuclio analog)
+    application = "application"
+
+    @staticmethod
+    def all() -> list[str]:
+        return [
+            RuntimeKinds.local, RuntimeKinds.handler, RuntimeKinds.job,
+            RuntimeKinds.tpujob, RuntimeKinds.dask, RuntimeKinds.serving,
+            RuntimeKinds.remote, RuntimeKinds.application,
+        ]
+
+    @staticmethod
+    def remote_kinds() -> list[str]:
+        return [RuntimeKinds.job, RuntimeKinds.tpujob, RuntimeKinds.dask,
+                RuntimeKinds.serving, RuntimeKinds.remote,
+                RuntimeKinds.application]
+
+    @staticmethod
+    def pod_creating_kinds() -> list[str]:
+        return [RuntimeKinds.job, RuntimeKinds.tpujob, RuntimeKinds.dask]
+
+
+class PodPhases:
+    pending = "Pending"
+    running = "Running"
+    succeeded = "Succeeded"
+    failed = "Failed"
+    unknown = "Unknown"
+
+    @staticmethod
+    def to_run_state(phase: str) -> str:
+        return {
+            PodPhases.pending: RunStates.pending,
+            PodPhases.running: RunStates.running,
+            PodPhases.succeeded: RunStates.completed,
+            PodPhases.failed: RunStates.error,
+        }.get(phase, RunStates.unknown)
+
+
+class JobSetConditions:
+    """GKE JobSet condition types the tpujob handler reconciles
+    (replacing the reference's MPIJob CRD condition mapping,
+    server/api/runtime_handlers/mpijob/v1.py:244-287)."""
+
+    startup_policy_completed = "StartupPolicyCompleted"
+    completed = "Completed"
+    failed = "Failed"
+    suspended = "Suspended"
+
+    @staticmethod
+    def to_run_state(conditions: list[dict]) -> str:
+        by_type = {
+            c.get("type"): c for c in conditions or []
+            if c.get("status") in (True, "True")
+        }
+        if JobSetConditions.completed in by_type:
+            return RunStates.completed
+        if JobSetConditions.failed in by_type:
+            return RunStates.error
+        if JobSetConditions.suspended in by_type:
+            return RunStates.pending
+        return RunStates.running
+
+
+class ThresholdStates:
+    pending_scheduled = "pending_scheduled"
+    pending_not_scheduled = "pending_not_scheduled"
+    image_pull_backoff = "image_pull_backoff"
+    executing = "executing"

@@ -1,0 +1,22 @@
+"""Run API schemas (reference analog: mlrun/common/schemas/runs.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pydantic
+
+
+class RunIdentifier(pydantic.BaseModel):
+    kind: str = "run"
+    uid: Optional[str] = None
+    iter: Optional[int] = None
+
+
+class RunRecord(pydantic.BaseModel):
+    kind: str = "run"
+    metadata: dict = pydantic.Field(default_factory=dict)
+    spec: dict = pydantic.Field(default_factory=dict)
+    status: dict = pydantic.Field(default_factory=dict)
+
+    model_config = pydantic.ConfigDict(extra="allow")

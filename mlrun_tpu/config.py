@@ -1,0 +1,219 @@
+"""Layered configuration for mlrun-tpu.
+
+Design mirrors the reference's config system (cf. /root/reference/mlrun/config.py:52
+``default_config`` dict, :1379 ``read_env``, :763 lazy ``Config``) but is a fresh,
+smaller implementation: a nested default dict, overridden by an optional yaml file
+(``MLT_CONFIG_FILE``), overridden by environment variables with the ``MLT_`` prefix
+where ``__`` nests keys and values are parsed as JSON when possible
+(``MLT_HTTPDB__PORT=8787``).  A server may push ``client_spec`` overrides on connect,
+mirroring reference mlrun/config.py client_spec handling.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+from typing import Any
+
+ENV_PREFIX = "MLT_"
+ENV_FILE_KEY = "MLT_CONFIG_FILE"
+
+default_config: dict[str, Any] = {
+    # namespace / identity
+    "namespace": "mlrun-tpu",
+    "default_project": "default",
+    "log_level": "INFO",
+    "log_format": "human",  # human | json
+    # where run/artifact metadata lives when no remote service is configured
+    "dbpath": "",  # e.g. "http://localhost:8787" for the remote service
+    "local_db_path": "",  # sqlite file; default resolved to ~/.mlrun-tpu/db.sqlite
+    "artifact_path": "",  # default resolved under ~/.mlrun-tpu/artifacts/{project}
+    "api_base_path": "/api/v1",
+    # the in-pod execution contract (reference: MLRUN_EXEC_CONFIG / MLRUN_EXEC_CODE,
+    # mlrun/model.py:1451)
+    "exec_config_env": "MLT_EXEC_CONFIG",
+    "exec_code_env": "MLT_EXEC_CODE",
+    "httpdb": {
+        "port": 8787,
+        "host": "0.0.0.0",
+        "retries": 3,
+        "retry_backoff": 0.5,
+        "timeout": 45,
+        "user": "",
+        "token": "",
+        "logs_poll_interval": 2.0,
+    },
+    "runs": {
+        "monitoring_interval": 30.0,
+        # per-state stuck thresholds in seconds (reference: state_thresholds,
+        # mlrun/config.py function.spec.state_thresholds)
+        "state_thresholds": {
+            "pending_scheduled": 3600,
+            "pending_not_scheduled": -1,  # -1 = unlimited
+            "image_pull_backoff": 3600,
+            "executing": 24 * 3600 * 7,
+        },
+    },
+    "function": {
+        "default_image": "mlrun-tpu/base:latest",
+        "tpu_image": "mlrun-tpu/tpu:latest",
+    },
+    "tpu": {
+        # TPU pod-slice defaults used by the tpujob runtime (replaces the reference's
+        # nvidia.com/gpu resource requests, mlrun/runtimes/pod.py:458-476)
+        "resource_name": "google.com/tpu",
+        "topology_node_selector": "cloud.google.com/gke-tpu-topology",
+        "accelerator_node_selector": "cloud.google.com/gke-tpu-accelerator",
+        "default_accelerator": "tpu-v5-lite-podslice",
+        "default_topology": "2x4",
+        "chips_per_host": 4,
+        "coordinator_port": 8476,
+        "mesh": {
+            # default logical mesh axes for the auto-trainer
+            "axis_names": ["data", "fsdp", "tensor"],
+            "ici_axes": ["fsdp", "tensor"],
+            "dcn_axes": ["data"],
+        },
+    },
+    "scheduler": {"min_allowed_interval_seconds": 60, "tick_seconds": 5.0},
+    "serving": {
+        "default_batching_timeout_ms": 5,
+        "max_batch_size": 8,
+        "stream_kind": "inmem",  # inmem | file
+    },
+    "model_monitoring": {
+        "window_seconds": 60,
+        "store": "sqlite",
+    },
+    "packagers": {"enabled": True},
+    "background_tasks": {"default_timeout": 600},
+}
+
+
+def _deep_update(base: dict, override: dict) -> dict:
+    for key, value in override.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            _deep_update(base[key], value)
+        else:
+            base[key] = value
+    return base
+
+
+def read_env(env: dict | None = None, prefix: str = ENV_PREFIX) -> dict:
+    """Convert MLT_A__B=json-ish env vars into a nested override dict."""
+    env = os.environ if env is None else env
+    out: dict[str, Any] = {}
+    for key, value in env.items():
+        if not key.startswith(prefix) or key in (ENV_FILE_KEY,):
+            continue
+        path = key[len(prefix):].lower().split("__")
+        try:
+            parsed = json.loads(value)
+        except (ValueError, TypeError):
+            parsed = value
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = parsed
+    return out
+
+
+class Config:
+    """Attribute-style access over a nested dict, with lazy env reload."""
+
+    _load_lock = threading.Lock()
+
+    def __init__(self, cfg: dict | None = None, root: "Config | None" = None):
+        object.__setattr__(self, "_cfg", cfg if cfg is not None else {})
+        object.__setattr__(self, "_root", root)
+        object.__setattr__(self, "_loaded", root is not None)
+
+    # -- loading -----------------------------------------------------------
+    def _ensure_loaded(self):
+        if object.__getattribute__(self, "_loaded"):
+            return
+        with Config._load_lock:
+            if object.__getattribute__(self, "_loaded"):
+                return
+            self._do_load()
+
+    def _do_load(self):
+        cfg = copy.deepcopy(default_config)
+        config_file = os.environ.get(ENV_FILE_KEY)
+        if config_file and os.path.isfile(config_file):
+            import yaml
+
+            with open(config_file) as fp:
+                data = yaml.safe_load(fp) or {}
+            _deep_update(cfg, data)
+        _deep_update(cfg, read_env())
+        object.__setattr__(self, "_cfg", cfg)
+        object.__setattr__(self, "_loaded", True)
+
+    def reload(self):
+        """Force re-read of defaults + file + env (used by tests)."""
+        object.__setattr__(self, "_loaded", False)
+        self._ensure_loaded()
+
+    # -- access ------------------------------------------------------------
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._ensure_loaded()
+        cfg = object.__getattribute__(self, "_cfg")
+        if name not in cfg:
+            raise AttributeError(f"config has no key '{name}'")
+        value = cfg[name]
+        if isinstance(value, dict):
+            return Config(value, root=self)
+        return value
+
+    def __setattr__(self, name: str, value: Any):
+        self._ensure_loaded()
+        object.__getattribute__(self, "_cfg")[name] = value
+
+    def get(self, name: str, default: Any = None):
+        try:
+            return getattr(self, name)
+        except AttributeError:
+            return default
+
+    def to_dict(self) -> dict:
+        self._ensure_loaded()
+        return copy.deepcopy(object.__getattribute__(self, "_cfg"))
+
+    def update(self, overrides: dict):
+        """Apply server-pushed client_spec style overrides."""
+        self._ensure_loaded()
+        _deep_update(object.__getattribute__(self, "_cfg"), overrides)
+
+    # -- resolved paths ----------------------------------------------------
+    @property
+    def home_dir(self) -> str:
+        base = os.environ.get("MLT_HOME", os.path.expanduser("~/.mlrun-tpu"))
+        os.makedirs(base, exist_ok=True)
+        return base
+
+    def resolve_local_db_path(self) -> str:
+        self._ensure_loaded()
+        path = self.get("local_db_path") or os.path.join(self.home_dir, "db.sqlite")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return path
+
+    def resolve_artifact_path(self, project: str = "") -> str:
+        self._ensure_loaded()
+        path = self.get("artifact_path") or os.path.join(
+            self.home_dir, "artifacts", "{project}"
+        )
+        if "{project}" in path:
+            path = path.replace("{project}", project or self.get("default_project"))
+        return path
+
+    @property
+    def is_remote(self) -> bool:
+        return bool(self.get("dbpath"))
+
+
+mlconf = Config()

@@ -1,0 +1,11 @@
+from .base import DataItem, DataStore, FileStats, parse_url  # noqa: F401
+from .datastore import StoreManager, register_store, schema_to_store, store_manager  # noqa: F401
+from .stores import FileStore, FsspecStore, HttpStore, InMemoryStore  # noqa: F401
+
+
+def get_store_resource(url: str, db=None, secrets: dict | None = None,
+                       project: str = ""):
+    """Resolve a store:// uri into a DataItem (reference analog:
+    mlrun/datastore/store_resources.py get_store_resource)."""
+    manager = store_manager if db is None else StoreManager(secrets, db)
+    return manager.object(url=url, project=project, secrets=secrets)

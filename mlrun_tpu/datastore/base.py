@@ -1,0 +1,217 @@
+"""Datastore base classes (reference analog: mlrun/datastore/base.py:48 DataStore,
+:424 DataItem — fresh implementation).
+
+A ``DataStore`` is a scheme-keyed backend (file, memory, gcs, s3, ...); a
+``DataItem`` is the lazy handle users receive for run inputs and artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+
+class FileStats:
+    def __init__(self, size: int | None = None, modified: float | None = None,
+                 content_type: str | None = None):
+        self.size = size
+        self.modified = modified
+        self.content_type = content_type
+
+    def __repr__(self):
+        return f"FileStats(size={self.size}, modified={self.modified})"
+
+
+class DataStore:
+    """Abstract storage backend keyed by url scheme."""
+
+    kind = "base"
+    using_bucket = False
+
+    def __init__(self, parent, name: str, kind: str, endpoint: str = "",
+                 secrets: dict | None = None):
+        self._parent = parent
+        self.name = name
+        self.kind = kind
+        self.endpoint = endpoint
+        self._secrets = secrets or {}
+
+    def _get_secret_or_env(self, key: str, default: str = "") -> str:
+        return self._secrets.get(key) or os.environ.get(key, default)
+
+    # -- required backend api ---------------------------------------------
+    def get(self, key: str, size: int | None = None, offset: int = 0) -> bytes:
+        raise NotImplementedError
+
+    def put(self, key: str, data: bytes | str, append: bool = False):
+        raise NotImplementedError
+
+    def stat(self, key: str) -> FileStats:
+        raise NotImplementedError
+
+    def listdir(self, key: str) -> list[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.stat(key)
+            return True
+        except (FileNotFoundError, KeyError):
+            return False
+
+    # -- derived helpers ---------------------------------------------------
+    def upload(self, key: str, src_path: str):
+        with open(src_path, "rb") as fp:
+            self.put(key, fp.read())
+
+    def download(self, key: str, target_path: str):
+        data = self.get(key)
+        os.makedirs(os.path.dirname(target_path) or ".", exist_ok=True)
+        with open(target_path, "wb") as fp:
+            fp.write(data if isinstance(data, bytes) else data.encode())
+
+    def url(self, key: str) -> str:
+        if self.kind == "file":
+            return key
+        return f"{self.kind}://{self.endpoint}{key}"
+
+    def as_df(self, key: str, columns=None, df_module=None, format: str = "",
+              **kwargs):
+        """Load an object into a dataframe (csv/parquet/json by suffix)."""
+        import pandas as pd
+
+        df_module = df_module or pd
+        fmt = format or key.rsplit(".", 1)[-1].lower()
+        import io
+
+        raw = self.get(key)
+        buf = io.BytesIO(raw if isinstance(raw, bytes) else raw.encode())
+        if fmt in ("csv",):
+            df = df_module.read_csv(buf, **kwargs)
+        elif fmt in ("parquet", "pq"):
+            df = df_module.read_parquet(buf, **kwargs)
+        elif fmt == "json":
+            df = df_module.read_json(buf, **kwargs)
+        else:
+            raise ValueError(f"cannot load dataframe from format '{fmt}'")
+        if columns:
+            df = df[columns]
+        return df
+
+    def rm(self, path: str, recursive: bool = False):
+        self.delete(path)
+
+
+class DataItem:
+    """Lazy data handle passed to handlers (reference base.py:424)."""
+
+    def __init__(self, key: str, store: DataStore, subpath: str, url: str = "",
+                 meta: dict | None = None, artifact_url: str = ""):
+        self._key = key
+        self._store = store
+        self._path = subpath
+        self._url = url
+        self._meta = meta or {}
+        self._artifact_url = artifact_url
+        self._local_path = ""
+
+    @property
+    def key(self) -> str:
+        return self._key
+
+    @property
+    def kind(self) -> str:
+        return self._store.kind
+
+    @property
+    def meta(self) -> dict:
+        return self._meta
+
+    @property
+    def artifact_url(self) -> str:
+        return self._artifact_url or self._url
+
+    @property
+    def url(self) -> str:
+        return self._url
+
+    @property
+    def suffix(self) -> str:
+        _, ext = os.path.splitext(self._path)
+        return ext
+
+    def get(self, size=None, offset=0, encoding: str | None = None) -> Any:
+        body = self._store.get(self._path, size=size, offset=offset)
+        if encoding and isinstance(body, bytes):
+            body = body.decode(encoding)
+        return body
+
+    def put(self, data, append: bool = False):
+        self._store.put(self._path, data, append=append)
+
+    def delete(self):
+        self._store.delete(self._path)
+
+    def download(self, target_path: str):
+        self._store.download(self._path, target_path)
+
+    def stat(self) -> FileStats:
+        return self._store.stat(self._path)
+
+    def exists(self) -> bool:
+        return self._store.exists(self._path)
+
+    def listdir(self) -> list[str]:
+        return self._store.listdir(self._path)
+
+    def local(self) -> str:
+        """Materialize to a local file path and return it."""
+        if self._store.kind == "file":
+            return self._path
+        if self._local_path:
+            return self._local_path
+        suffix = self.suffix or ".tmp"
+        temp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+        temp.close()
+        self.download(temp.name)
+        self._local_path = temp.name
+        return self._local_path
+
+    def as_df(self, columns=None, df_module=None, format: str = "", **kwargs):
+        return self._store.as_df(self._path, columns=columns,
+                                 df_module=df_module, format=format, **kwargs)
+
+    def show(self):
+        from ..utils import logger
+
+        logger.info("data item", url=self._url, kind=self.kind)
+
+    def __str__(self):
+        return self._url
+
+    def __repr__(self):
+        return f"DataItem({self._url})"
+
+
+def parse_url(url: str) -> tuple[str, str, str]:
+    """Return (scheme, endpoint, path)."""
+    parsed = urlparse(url)
+    scheme = parsed.scheme or "file"
+    endpoint = parsed.netloc
+    path = parsed.path
+    if scheme == "file" and endpoint:
+        path = endpoint + path
+        endpoint = ""
+    return scheme, endpoint, path
+
+
+def basic_auth_header(user, password):
+    import base64
+
+    token = base64.b64encode(f"{user}:{password}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}

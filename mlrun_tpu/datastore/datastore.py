@@ -1,0 +1,114 @@
+"""Store registry + manager (reference analog: mlrun/datastore/datastore.py:56
+``schema_to_store``, :118 ``StoreManager`` — fresh implementation).
+
+Also resolves ``store://`` artifact URIs against the run DB
+(reference analog: mlrun/datastore/store_resources.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import DataItem, DataStore, parse_url
+from .stores import FileStore, FsspecStore, HttpStore, InMemoryStore
+
+schema_to_store: dict[str, type] = {
+    "file": FileStore,
+    "": FileStore,
+    "memory": InMemoryStore,
+    "gs": FsspecStore,
+    "gcs": FsspecStore,
+    "s3": FsspecStore,
+    "az": FsspecStore,
+    "abfs": FsspecStore,
+    "hdfs": FsspecStore,
+    "http": HttpStore,
+    "https": HttpStore,
+}
+
+
+def register_store(scheme: str, cls: type):
+    schema_to_store[scheme] = cls
+
+
+class StoreManager:
+    """Caches DataStore instances per (scheme, endpoint) and mints DataItems."""
+
+    def __init__(self, secrets: dict | None = None, db=None):
+        self._stores: dict[str, DataStore] = {}
+        self._secrets = secrets or {}
+        self._db = db
+
+    def set(self, secrets: dict | None = None, db=None) -> "StoreManager":
+        if secrets:
+            self._secrets.update(secrets)
+        if db is not None:
+            self._db = db
+        return self
+
+    def _get_db(self):
+        if self._db is None:
+            from ..db import get_run_db
+
+            self._db = get_run_db()
+        return self._db
+
+    def get_or_create_store(self, url: str,
+                            secrets: dict | None = None) -> tuple[DataStore, str]:
+        scheme, endpoint, path = parse_url(url)
+        store_key = f"{scheme}://{endpoint}"
+        if store_key not in self._stores or secrets:
+            cls = schema_to_store.get(scheme)
+            if cls is None:
+                raise ValueError(f"unsupported url scheme '{scheme}' ({url})")
+            merged = dict(self._secrets)
+            merged.update(secrets or {})
+            store = cls(self, store_key, scheme, endpoint, secrets=merged)
+            if secrets:
+                return store, path  # don't cache credentialed stores
+            self._stores[store_key] = store
+        return self._stores[store_key], path
+
+    def object(self, url: str, key: str = "", project: str = "",
+               secrets: dict | None = None, allow_empty_resources=None) -> DataItem:
+        meta = {}
+        artifact_url = ""
+        if url.startswith("store://"):
+            artifact_url = url
+            resource = self._resolve_store_resource(url, project)
+            meta = resource or {}
+            target = (
+                meta.get("spec", {}).get("target_path")
+                or meta.get("target_path")
+            )
+            if not target:
+                raise ValueError(f"artifact {url} has no target_path")
+            key = key or meta.get("metadata", {}).get("key", "")
+            url = target
+        store, path = self.get_or_create_store(url, secrets=secrets)
+        return DataItem(key or path, store, path, url=url, meta=meta,
+                        artifact_url=artifact_url)
+
+    def _resolve_store_resource(self, url: str, project: str = "") -> Optional[dict]:
+        """store://artifacts/<project>/<key>[:tag][@uid] or store://<project>/<key>."""
+        body = url[len("store://"):]
+        for prefix in ("artifacts/", "datasets/", "models/"):
+            if body.startswith(prefix) and body.count("/") >= 2:
+                body = body[len(prefix):]
+                break
+        tree = None
+        if "@" in body:
+            body, tree = body.rsplit("@", 1)
+        tag = None
+        if ":" in body:
+            body, tag = body.rsplit(":", 1)
+        parts = body.split("/", 1)
+        if len(parts) == 2:
+            project, key = parts
+        else:
+            key = parts[0]
+        db = self._get_db()
+        return db.read_artifact(key, tag=tag, project=project or None, tree=tree)
+
+
+store_manager = StoreManager()

@@ -1,0 +1,191 @@
+"""Concrete datastores (reference analogs: mlrun/datastore/filestore.py:25,
+inmem.py:24, google_cloud_storage.py:31, s3.py:26 — fresh implementations).
+
+``FileStore`` and ``InMemoryStore`` are dependency-free; cloud stores (gs/s3/az)
+ride a generic fsspec-backed store so that any installed fsspec protocol works —
+on TPU the native object store is GCS.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+import time
+
+from .base import DataStore, FileStats
+
+
+class FileStore(DataStore):
+    kind = "file"
+
+    def _abs(self, key: str) -> str:
+        return os.path.abspath(os.path.expanduser(key))
+
+    def get(self, key, size=None, offset=0) -> bytes:
+        with open(self._abs(key), "rb") as fp:
+            if offset:
+                fp.seek(offset)
+            return fp.read(size) if size else fp.read()
+
+    def put(self, key, data, append=False):
+        path = self._abs(key)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        mode = "a" if append else "w"
+        if isinstance(data, bytes):
+            mode += "b"
+        with open(path, mode) as fp:
+            fp.write(data)
+
+    def stat(self, key) -> FileStats:
+        st = os.stat(self._abs(key))
+        return FileStats(size=st.st_size, modified=st.st_mtime)
+
+    def listdir(self, key) -> list[str]:
+        path = self._abs(key)
+        if os.path.isdir(path):
+            out = []
+            for root, _, files in os.walk(path):
+                rel = os.path.relpath(root, path)
+                for f in files:
+                    out.append(f if rel == "." else os.path.join(rel, f))
+            return out
+        return [os.path.basename(p) for p in globlib.glob(path)]
+
+    def delete(self, key):
+        path = self._abs(key)
+        if os.path.isfile(path):
+            os.remove(path)
+
+    def exists(self, key) -> bool:
+        return os.path.exists(self._abs(key))
+
+
+class InMemoryStore(DataStore):
+    """memory:// store for tests and serving-graph queues."""
+
+    kind = "memory"
+    _items: dict[str, bytes] = {}
+
+    def get(self, key, size=None, offset=0):
+        data = self._items[key]
+        if offset:
+            data = data[offset:]
+        if size:
+            data = data[:size]
+        return data
+
+    def put(self, key, data, append=False):
+        if isinstance(data, str):
+            data = data.encode()
+        if append and key in self._items:
+            self._items[key] += data
+        else:
+            self._items[key] = data
+
+    def stat(self, key):
+        if key not in self._items:
+            raise FileNotFoundError(key)
+        return FileStats(size=len(self._items[key]), modified=time.time())
+
+    def listdir(self, key):
+        prefix = key.rstrip("/") + "/" if key else ""
+        return [k[len(prefix):] for k in self._items if k.startswith(prefix)]
+
+    def delete(self, key):
+        self._items.pop(key, None)
+
+    def exists(self, key):
+        return key in self._items
+
+
+class FsspecStore(DataStore):
+    """Generic fsspec-protocol store: gs/gcs, s3, az/abfs, http(s), hdfs...
+
+    On TPU deployments GCS is the primary object store (artifacts, orbax
+    checkpoints); credentials flow via standard env (GOOGLE_APPLICATION_CREDENTIALS,
+    AWS_ACCESS_KEY_ID...) or per-store secrets, like the reference's per-store
+    secret plumbing (mlrun/datastore/base.py _get_secret_or_env).
+    """
+
+    def __init__(self, parent, name, kind, endpoint="", secrets=None):
+        super().__init__(parent, name, kind, endpoint, secrets)
+        self._fs = None
+
+    @property
+    def filesystem(self):
+        if self._fs is None:
+            import fsspec
+
+            protocol = {"gs": "gcs", "az": "abfs"}.get(self.kind, self.kind)
+            storage_options = {}
+            if self.kind == "s3":
+                key = self._get_secret_or_env("AWS_ACCESS_KEY_ID")
+                secret = self._get_secret_or_env("AWS_SECRET_ACCESS_KEY")
+                if key:
+                    storage_options = {"key": key, "secret": secret}
+            self._fs = fsspec.filesystem(protocol, **storage_options)
+        return self._fs
+
+    def _full(self, key: str) -> str:
+        return f"{self.endpoint}{key}" if self.endpoint else key.lstrip("/")
+
+    def get(self, key, size=None, offset=0):
+        end = offset + size if size else None
+        return self.filesystem.cat_file(self._full(key), start=offset or None,
+                                        end=end)
+
+    def put(self, key, data, append=False):
+        if append:
+            raise ValueError(f"append is not supported on {self.kind} store")
+        if isinstance(data, str):
+            data = data.encode()
+        with self.filesystem.open(self._full(key), "wb") as fp:
+            fp.write(data)
+
+    def stat(self, key):
+        info = self.filesystem.info(self._full(key))
+        return FileStats(size=info.get("size"),
+                         modified=info.get("mtime") or info.get("LastModified"))
+
+    def listdir(self, key):
+        full = self._full(key).rstrip("/")
+        return [p[len(full):].lstrip("/") for p in self.filesystem.ls(full)]
+
+    def delete(self, key):
+        self.filesystem.rm(self._full(key))
+
+    def exists(self, key):
+        return self.filesystem.exists(self._full(key))
+
+
+class HttpStore(DataStore):
+    """Read-only http(s):// store."""
+
+    def __init__(self, parent, name, kind, endpoint="", secrets=None):
+        super().__init__(parent, name, kind, endpoint, secrets)
+
+    def get(self, key, size=None, offset=0):
+        import requests
+
+        url = f"{self.kind}://{self.endpoint}{key}"
+        resp = requests.get(url, timeout=30)
+        resp.raise_for_status()
+        data = resp.content
+        if offset:
+            data = data[offset:]
+        if size:
+            data = data[:size]
+        return data
+
+    def put(self, key, data, append=False):
+        raise ValueError("http store is read-only")
+
+    def stat(self, key):
+        data = self.get(key)
+        return FileStats(size=len(data))
+
+    def listdir(self, key):
+        raise ValueError("http store does not support listdir")
+
+    def delete(self, key):
+        raise ValueError("http store is read-only")

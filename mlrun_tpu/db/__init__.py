@@ -1,0 +1,40 @@
+"""Run-DB factory (reference analog: mlrun/db/__init__.py get_run_db)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..config import mlconf
+from .base import RunDBError, RunDBInterface  # noqa: F401
+from .nopdb import NopDB  # noqa: F401
+from .sqlitedb import SQLiteRunDB  # noqa: F401
+
+_run_db = None
+_lock = threading.Lock()
+
+
+def get_run_db(url: str = "", secrets: dict | None = None,
+               force_reconnect: bool = False) -> RunDBInterface:
+    """Return the process-wide run DB: HTTP client if a dbpath is configured,
+    otherwise the embedded sqlite DB."""
+    global _run_db
+    url = url or mlconf.get("dbpath", "")
+    with _lock:
+        if _run_db is not None and not force_reconnect:
+            return _run_db
+        if url.startswith("http"):
+            from .httpdb import HTTPRunDB
+
+            _run_db = HTTPRunDB(url).connect(secrets)
+        elif url == "nop":
+            _run_db = NopDB()
+        else:
+            _run_db = SQLiteRunDB(url if url.endswith(".sqlite") else "")
+        return _run_db
+
+
+def set_run_db(db: RunDBInterface):
+    """Inject a DB instance (tests use this to install RunDBMock)."""
+    global _run_db
+    with _lock:
+        _run_db = db

@@ -1,0 +1,402 @@
+"""HTTP run-DB client (reference analog: mlrun/db/httpdb.py:78 HTTPRunDB —
+retrying session :366, full REST surface :685+).
+
+Talks to the aiohttp service (mlrun_tpu/service). Paths mirror the reference's
+``/api/v1`` REST contract.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+from urllib.parse import quote
+
+import requests
+import requests.adapters
+
+from ..config import mlconf
+from ..utils import logger
+from .base import RunDBError, RunDBInterface
+
+
+class HTTPRunDB(RunDBInterface):
+    kind = "http"
+
+    def __init__(self, url: str):
+        self.base_url = url.rstrip("/")
+        self.user = mlconf.httpdb.user
+        self.token = mlconf.httpdb.token
+        self._session: Optional[requests.Session] = None
+        self.server_version = ""
+
+    def __repr__(self):
+        return f"HTTPRunDB({self.base_url})"
+
+    # -- plumbing ----------------------------------------------------------
+    @property
+    def session(self) -> requests.Session:
+        if self._session is None:
+            session = requests.Session()
+            retry = requests.adapters.Retry(
+                total=mlconf.httpdb.retries,
+                backoff_factor=mlconf.httpdb.retry_backoff,
+                status_forcelist=[500, 502, 503, 504],
+                allowed_methods=["GET", "PUT", "DELETE", "POST"],
+            )
+            adapter = requests.adapters.HTTPAdapter(max_retries=retry)
+            session.mount("http://", adapter)
+            session.mount("https://", adapter)
+            self._session = session
+        return self._session
+
+    def api_call(self, method: str, path: str, error: str | None = None,
+                 params: dict | None = None, body=None, json_body=None,
+                 timeout: float | None = None, json: dict | None = None):
+        url = f"{self.base_url}{mlconf.api_base_path}/{path.lstrip('/')}"
+        headers = {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        try:
+            resp = self.session.request(
+                method, url, params=params, data=body,
+                json=json_body if json_body is not None else json,
+                headers=headers, timeout=timeout or mlconf.httpdb.timeout)
+        except requests.RequestException as exc:
+            raise RunDBError(
+                f"{error or 'api call failed'}: {method} {url}: {exc}") from exc
+        if not resp.ok:
+            detail = ""
+            try:
+                detail = resp.json().get("detail", resp.text)
+            except ValueError:
+                detail = resp.text
+            raise RunDBError(
+                f"{error or 'api call failed'}: {method} {url} "
+                f"[{resp.status_code}]: {detail}")
+        if resp.content:
+            try:
+                return resp.json()
+            except ValueError:
+                return resp.content
+        return {}
+
+    def connect(self, secrets=None):
+        try:
+            resp = self.api_call("GET", "client-spec", "connect failed")
+            spec = resp or {}
+            self.server_version = spec.get("version", "")
+            overrides = spec.get("config_overrides") or {}
+            if overrides:
+                mlconf.update(overrides)
+        except RunDBError as exc:
+            logger.warning("could not fetch client spec", error=str(exc))
+        return self
+
+    @staticmethod
+    def _path(project: str, kind: str, *parts) -> str:
+        project = project or mlconf.default_project
+        tail = "/".join(quote(str(p), safe="") for p in parts if p is not None)
+        return f"projects/{project}/{kind}" + (f"/{tail}" if tail else "")
+
+    # -- runs --------------------------------------------------------------
+    def store_run(self, struct, uid, project="", iter=0):
+        self.api_call("POST", self._path(project, "runs", uid),
+                      "store run", params={"iter": iter}, json_body=struct)
+
+    def update_run(self, updates, uid, project="", iter=0):
+        self.api_call("PATCH", self._path(project, "runs", uid),
+                      "update run", params={"iter": iter}, json_body=updates)
+
+    def read_run(self, uid, project="", iter=0):
+        resp = self.api_call("GET", self._path(project, "runs", uid),
+                             "read run", params={"iter": iter})
+        return resp.get("data")
+
+    def list_runs(self, name="", uid=None, project="", labels=None, state="",
+                  sort=True, last=0, iter=False, start_time_from=None,
+                  start_time_to=None):
+        params = {"name": name, "state": state, "last": last,
+                  "iter": int(iter)}
+        if uid:
+            params["uid"] = uid
+        if labels:
+            params["label"] = labels if isinstance(labels, list) else [
+                f"{k}={v}" for k, v in labels.items()]
+        resp = self.api_call("GET", self._path(project, "runs"), "list runs",
+                             params=params)
+        return resp.get("runs", [])
+
+    def del_run(self, uid, project="", iter=0):
+        self.api_call("DELETE", self._path(project, "runs", uid), "del run",
+                      params={"iter": iter})
+
+    def abort_run(self, uid, project="", iter=0, status_text=""):
+        self.api_call("POST", self._path(project, "runs", uid) + "/abort",
+                      "abort run", json_body={"status_text": status_text})
+
+    # -- logs --------------------------------------------------------------
+    def store_log(self, uid, project="", body=b"", append=True):
+        if isinstance(body, str):
+            body = body.encode()
+        self.api_call("POST", self._path(project, "logs", uid), "store log",
+                      params={"append": int(append)}, body=body)
+
+    def get_log(self, uid, project="", offset=0, size=-1):
+        url = f"{self.base_url}{mlconf.api_base_path}/" + self._path(
+            project, "logs", uid)
+        resp = self.session.get(
+            url, params={"offset": offset, "size": size},
+            timeout=mlconf.httpdb.timeout)
+        if not resp.ok:
+            raise RunDBError(f"get log failed [{resp.status_code}]")
+        state = resp.headers.get("x-mlt-run-state", "unknown")
+        return state, resp.content
+
+    # -- artifacts ---------------------------------------------------------
+    def store_artifact(self, key, artifact, uid=None, iter=None, tag="",
+                       project="", tree=None):
+        self.api_call(
+            "POST", self._path(project, "artifacts", key), "store artifact",
+            params={"uid": uid, "iter": iter, "tag": tag, "tree": tree},
+            json_body=artifact)
+
+    def read_artifact(self, key, tag=None, iter=None, project="", tree=None,
+                      uid=None):
+        resp = self.api_call(
+            "GET", self._path(project, "artifacts", key), "read artifact",
+            params={"tag": tag, "iter": iter, "tree": tree, "uid": uid})
+        return resp.get("data")
+
+    def list_artifacts(self, name="", project="", tag=None, labels=None,
+                       since=None, until=None, kind=None, category=None,
+                       tree=None):
+        params = {"name": name, "tag": tag, "kind": kind, "tree": tree}
+        if labels:
+            params["label"] = labels if isinstance(labels, list) else [
+                f"{k}={v}" for k, v in labels.items()]
+        resp = self.api_call("GET", self._path(project, "artifacts"),
+                             "list artifacts", params=params)
+        return resp.get("artifacts", [])
+
+    def del_artifact(self, key, tag=None, project="", uid=None):
+        self.api_call("DELETE", self._path(project, "artifacts", key),
+                      "del artifact", params={"tag": tag, "uid": uid})
+
+    # -- functions ---------------------------------------------------------
+    def store_function(self, function, name, project="", tag="",
+                       versioned=False):
+        resp = self.api_call(
+            "POST", self._path(project, "functions", name), "store function",
+            params={"tag": tag, "versioned": int(versioned)},
+            json_body=function)
+        return resp.get("hash_key", "")
+
+    def get_function(self, name, project="", tag="", hash_key=""):
+        resp = self.api_call(
+            "GET", self._path(project, "functions", name), "get function",
+            params={"tag": tag, "hash_key": hash_key})
+        return resp.get("func")
+
+    def list_functions(self, name="", project="", tag="", labels=None):
+        params = {"name": name, "tag": tag}
+        if labels:
+            params["label"] = labels if isinstance(labels, list) else [
+                f"{k}={v}" for k, v in labels.items()]
+        resp = self.api_call("GET", self._path(project, "functions"),
+                             "list functions", params=params)
+        return resp.get("funcs", [])
+
+    def delete_function(self, name, project=""):
+        self.api_call("DELETE", self._path(project, "functions", name),
+                      "delete function")
+
+    # -- projects ----------------------------------------------------------
+    def store_project(self, name, project):
+        resp = self.api_call("POST", f"projects/{name}", "store project",
+                             json_body=project)
+        return resp.get("data", project)
+
+    def get_project(self, name):
+        try:
+            resp = self.api_call("GET", f"projects/{name}", "get project")
+        except RunDBError as exc:
+            if "[404]" in str(exc):
+                return None
+            raise
+        return resp.get("data")
+
+    def list_projects(self, owner=None, labels=None, state=None):
+        resp = self.api_call("GET", "projects", "list projects",
+                             params={"state": state})
+        return resp.get("projects", [])
+
+    def delete_project(self, name, deletion_strategy="restricted"):
+        self.api_call("DELETE", f"projects/{name}", "delete project",
+                      params={"deletion_strategy": deletion_strategy})
+
+    # -- schedules ---------------------------------------------------------
+    def store_schedule(self, project, name, schedule):
+        self.api_call("POST", self._path(project, "schedules", name),
+                      "store schedule", json_body=schedule)
+
+    def get_schedule(self, project, name):
+        resp = self.api_call("GET", self._path(project, "schedules", name),
+                             "get schedule")
+        return resp.get("data")
+
+    def list_schedules(self, project=""):
+        resp = self.api_call("GET", self._path(project, "schedules"),
+                             "list schedules")
+        return resp.get("schedules", [])
+
+    def delete_schedule(self, project, name):
+        self.api_call("DELETE", self._path(project, "schedules", name),
+                      "delete schedule")
+
+    # -- feature store ------------------------------------------------------
+    def store_feature_set(self, feature_set, name=None, project="", tag=None,
+                          uid=None, versioned=True):
+        name = name or feature_set.get("metadata", {}).get("name")
+        resp = self.api_call(
+            "POST", self._path(project, "feature-sets", name),
+            "store feature set", params={"tag": tag, "uid": uid},
+            json_body=feature_set)
+        return resp.get("uid", "")
+
+    def get_feature_set(self, name, project="", tag=None, uid=None):
+        resp = self.api_call(
+            "GET", self._path(project, "feature-sets", name),
+            "get feature set", params={"tag": tag, "uid": uid})
+        return resp.get("data")
+
+    def list_feature_sets(self, project="", name="", tag=None, labels=None):
+        resp = self.api_call("GET", self._path(project, "feature-sets"),
+                             "list feature sets",
+                             params={"name": name, "tag": tag})
+        return resp.get("feature_sets", [])
+
+    def delete_feature_set(self, name, project="", tag=None, uid=None):
+        self.api_call("DELETE", self._path(project, "feature-sets", name),
+                      "delete feature set")
+
+    def store_feature_vector(self, feature_vector, name=None, project="",
+                             tag=None, uid=None, versioned=True):
+        name = name or feature_vector.get("metadata", {}).get("name")
+        resp = self.api_call(
+            "POST", self._path(project, "feature-vectors", name),
+            "store feature vector", params={"tag": tag, "uid": uid},
+            json_body=feature_vector)
+        return resp.get("uid", "")
+
+    def get_feature_vector(self, name, project="", tag=None, uid=None):
+        resp = self.api_call(
+            "GET", self._path(project, "feature-vectors", name),
+            "get feature vector", params={"tag": tag, "uid": uid})
+        return resp.get("data")
+
+    def list_feature_vectors(self, project="", name="", tag=None, labels=None):
+        resp = self.api_call("GET", self._path(project, "feature-vectors"),
+                             "list feature vectors",
+                             params={"name": name, "tag": tag})
+        return resp.get("feature_vectors", [])
+
+    def delete_feature_vector(self, name, project="", tag=None, uid=None):
+        self.api_call("DELETE", self._path(project, "feature-vectors", name),
+                      "delete feature vector")
+
+    # -- model endpoints ----------------------------------------------------
+    def store_model_endpoint(self, project, endpoint_id, endpoint):
+        self.api_call("POST",
+                      self._path(project, "model-endpoints", endpoint_id),
+                      "store model endpoint", json_body=endpoint)
+
+    def get_model_endpoint(self, project, endpoint_id):
+        resp = self.api_call(
+            "GET", self._path(project, "model-endpoints", endpoint_id),
+            "get model endpoint")
+        return resp.get("data")
+
+    def list_model_endpoints(self, project="", model="", function="", state=""):
+        resp = self.api_call(
+            "GET", self._path(project, "model-endpoints"),
+            "list model endpoints",
+            params={"model": model, "function": function, "state": state})
+        return resp.get("endpoints", [])
+
+    def delete_model_endpoint(self, project, endpoint_id):
+        self.api_call("DELETE",
+                      self._path(project, "model-endpoints", endpoint_id),
+                      "delete model endpoint")
+
+    # -- alerts -------------------------------------------------------------
+    def store_alert_config(self, name, config, project=""):
+        self.api_call("POST", self._path(project, "alerts", name),
+                      "store alert", json_body=config)
+
+    def get_alert_config(self, name, project=""):
+        resp = self.api_call("GET", self._path(project, "alerts", name),
+                             "get alert")
+        return resp.get("data")
+
+    def list_alert_configs(self, project=""):
+        resp = self.api_call("GET", self._path(project, "alerts"),
+                             "list alerts")
+        return resp.get("alerts", [])
+
+    def delete_alert_config(self, name, project=""):
+        self.api_call("DELETE", self._path(project, "alerts", name),
+                      "delete alert")
+
+    def emit_event(self, kind, event, project=""):
+        self.api_call("POST", self._path(project, "events", kind),
+                      "emit event", json_body=event)
+
+    # -- submit / build -----------------------------------------------------
+    def submit_job(self, runspec: dict, schedule=None) -> dict:
+        body = dict(runspec)
+        if schedule:
+            body["schedule"] = schedule
+        return self.api_call("POST", "submit_job", "submit job",
+                             json_body=body,
+                             timeout=max(mlconf.httpdb.timeout, 120))
+
+    def submit_pipeline(self, project, pipeline, arguments=None,
+                        experiment=None, run=None, namespace=None,
+                        artifact_path=None, ops=None) -> str:
+        resp = self.api_call(
+            "POST", self._path(project, "workflows") + "/submit",
+            "submit pipeline",
+            json_body={"pipeline": pipeline, "arguments": arguments or {},
+                       "artifact_path": artifact_path})
+        return resp.get("id", "")
+
+    def remote_builder(self, func, with_tpu: bool = False) -> dict:
+        return self.api_call(
+            "POST", "build/function", "remote build",
+            json_body={"function": func.to_dict(), "with_tpu": with_tpu})
+
+    def get_builder_status(self, func, offset=0, logs=True):
+        return self.api_call(
+            "GET", "build/status", "build status",
+            params={"name": func.metadata.name,
+                    "project": func.metadata.project, "offset": offset})
+
+    def get_background_task(self, name: str, project: str = ""):
+        resp = self.api_call("GET",
+                             self._path(project, "background-tasks", name),
+                             "get background task")
+        return resp.get("data")
+
+    def trigger_migrations(self):
+        return self.api_call("POST", "operations/migrations",
+                             "trigger migrations")
+
+    def get_log_size(self, uid, project=""):
+        resp = self.api_call("GET",
+                             self._path(project, "logs", uid) + "/size",
+                             "get log size")
+        return resp.get("size", 0)
+
+    def verify_authorization(self, *args, **kwargs):
+        return True

@@ -1,0 +1,657 @@
+"""Embedded SQLite run DB.
+
+Reference analog: server/api/db/sqldb (SQLAlchemy models+query layer,
+server/api/db/sqldb/models.py:195-700, db.py). Fresh implementation on stdlib
+``sqlite3`` with JSON bodies — the same class backs both the client's local mode
+(no service configured) and the aiohttp service, mirroring how the reference's
+SQLDB is shared by the api layer.
+
+Logs are stored as files under ``<home>/logs/<project>/<uid>`` like the
+reference's file-target log collection (server/log-collector streams pod logs
+into files; server.go:731).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Optional
+
+from ..common.runtimes_constants import RunStates
+from ..config import mlconf
+from ..utils import generate_uid, get_in, now_iso, update_in
+from .base import RunDBError, RunDBInterface
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    project TEXT NOT NULL, uid TEXT NOT NULL, iteration INTEGER NOT NULL DEFAULT 0,
+    name TEXT, state TEXT, start_time TEXT, last_update TEXT, body TEXT,
+    PRIMARY KEY (project, uid, iteration)
+);
+CREATE TABLE IF NOT EXISTS artifacts (
+    project TEXT NOT NULL, key TEXT NOT NULL, uid TEXT NOT NULL,
+    tree TEXT, iteration INTEGER DEFAULT 0, tag TEXT, kind TEXT,
+    updated TEXT, body TEXT,
+    PRIMARY KEY (project, key, uid)
+);
+CREATE TABLE IF NOT EXISTS functions (
+    project TEXT NOT NULL, name TEXT NOT NULL, tag TEXT NOT NULL DEFAULT 'latest',
+    hash_key TEXT, updated TEXT, body TEXT,
+    PRIMARY KEY (project, name, tag)
+);
+CREATE TABLE IF NOT EXISTS function_versions (
+    project TEXT NOT NULL, name TEXT NOT NULL, hash_key TEXT NOT NULL,
+    updated TEXT, body TEXT,
+    PRIMARY KEY (project, name, hash_key)
+);
+CREATE TABLE IF NOT EXISTS projects (
+    name TEXT PRIMARY KEY, state TEXT, created TEXT, body TEXT
+);
+CREATE TABLE IF NOT EXISTS schedules (
+    project TEXT NOT NULL, name TEXT NOT NULL, kind TEXT,
+    cron TEXT, next_run_time TEXT, body TEXT,
+    PRIMARY KEY (project, name)
+);
+CREATE TABLE IF NOT EXISTS feature_sets (
+    project TEXT NOT NULL, name TEXT NOT NULL, tag TEXT NOT NULL DEFAULT 'latest',
+    uid TEXT, updated TEXT, body TEXT,
+    PRIMARY KEY (project, name, tag)
+);
+CREATE TABLE IF NOT EXISTS feature_vectors (
+    project TEXT NOT NULL, name TEXT NOT NULL, tag TEXT NOT NULL DEFAULT 'latest',
+    uid TEXT, updated TEXT, body TEXT,
+    PRIMARY KEY (project, name, tag)
+);
+CREATE TABLE IF NOT EXISTS model_endpoints (
+    project TEXT NOT NULL, uid TEXT NOT NULL, model TEXT, function TEXT,
+    state TEXT, updated TEXT, body TEXT,
+    PRIMARY KEY (project, uid)
+);
+CREATE TABLE IF NOT EXISTS background_tasks (
+    project TEXT NOT NULL DEFAULT '', name TEXT NOT NULL, state TEXT,
+    created TEXT, updated TEXT, body TEXT,
+    PRIMARY KEY (project, name)
+);
+CREATE TABLE IF NOT EXISTS alert_configs (
+    project TEXT NOT NULL, name TEXT NOT NULL, body TEXT,
+    PRIMARY KEY (project, name)
+);
+CREATE TABLE IF NOT EXISTS events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    project TEXT, kind TEXT, created TEXT, body TEXT
+);
+CREATE TABLE IF NOT EXISTS hub_sources (
+    name TEXT PRIMARY KEY, idx INTEGER, body TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
+CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
+"""
+
+
+def _labels_match(body: dict, labels) -> bool:
+    if not labels:
+        return True
+    have = get_in(body, "metadata.labels", {}) or {}
+    items = labels.items() if isinstance(labels, dict) else [
+        tuple(lbl.split("=", 1)) if "=" in lbl else (lbl, None) for lbl in labels
+    ]
+    for key, value in items:
+        if key not in have:
+            return False
+        if value is not None and str(have[key]) != str(value):
+            return False
+    return True
+
+
+class SQLiteRunDB(RunDBInterface):
+    kind = "sqlite"
+
+    def __init__(self, dsn: str = "", logs_dir: str = ""):
+        self.dsn = dsn or mlconf.resolve_local_db_path()
+        self.logs_dir = logs_dir or os.path.join(mlconf.home_dir, "logs")
+        self._local = threading.local()
+        self._init_schema()
+
+    # -- plumbing ----------------------------------------------------------
+    @property
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.dsn, timeout=30)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA busy_timeout=30000")
+            self._local.conn = conn
+        return conn
+
+    def _init_schema(self):
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    def _execute(self, sql: str, params: tuple = ()):
+        cur = self._conn.execute(sql, params)
+        self._conn.commit()
+        return cur
+
+    def _query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
+        return self._conn.execute(sql, params).fetchall()
+
+    @staticmethod
+    def _project_or_default(project: str) -> str:
+        return project or mlconf.default_project
+
+    # -- runs --------------------------------------------------------------
+    def store_run(self, struct: dict, uid: str, project: str = "", iter: int = 0):
+        project = self._project_or_default(project)
+        self._execute(
+            "INSERT OR REPLACE INTO runs "
+            "(project, uid, iteration, name, state, start_time, last_update, body) "
+            "VALUES (?,?,?,?,?,?,?,?)",
+            (
+                project, uid, iter,
+                get_in(struct, "metadata.name", ""),
+                get_in(struct, "status.state", RunStates.created),
+                get_in(struct, "status.start_time", now_iso()),
+                now_iso(), json.dumps(struct, default=str),
+            ),
+        )
+
+    def update_run(self, updates: dict, uid: str, project: str = "", iter: int = 0):
+        project = self._project_or_default(project)
+        run = self.read_run(uid, project, iter)
+        if run is None:
+            raise RunDBError(f"run {project}/{uid} not found")
+        for key, value in updates.items():
+            update_in(run, key, value)
+        update_in(run, "status.last_update", now_iso())
+        self.store_run(run, uid, project, iter)
+        return run
+
+    def read_run(self, uid: str, project: str = "", iter: int = 0) -> Optional[dict]:
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT body FROM runs WHERE project=? AND uid=? AND iteration=?",
+            (project, uid, iter),
+        )
+        if not rows:
+            return None
+        return json.loads(rows[0]["body"])
+
+    def list_runs(self, name="", uid=None, project="", labels=None, state="",
+                  sort=True, last=0, iter=False, start_time_from=None,
+                  start_time_to=None) -> list:
+        project = self._project_or_default(project)
+        sql = "SELECT body FROM runs WHERE project=?"
+        params: list = [project]
+        if name:
+            sql += " AND name LIKE ?"
+            params.append(f"%{name}%")
+        if uid:
+            uids = uid if isinstance(uid, (list, tuple)) else [uid]
+            sql += f" AND uid IN ({','.join('?' * len(uids))})"
+            params.extend(uids)
+        if state:
+            sql += " AND state=?"
+            params.append(state)
+        if not iter:
+            sql += " AND iteration=0"
+        if start_time_from:
+            sql += " AND start_time>=?"
+            params.append(str(start_time_from))
+        if start_time_to:
+            sql += " AND start_time<=?"
+            params.append(str(start_time_to))
+        if sort:
+            sql += " ORDER BY start_time DESC"
+        if last:
+            sql += f" LIMIT {int(last)}"
+        rows = self._query(sql, tuple(params))
+        out = [json.loads(r["body"]) for r in rows]
+        return [r for r in out if _labels_match(r, labels)]
+
+    def del_run(self, uid: str, project: str = "", iter: int = 0):
+        project = self._project_or_default(project)
+        self._execute("DELETE FROM runs WHERE project=? AND uid=? AND iteration=?",
+                      (project, uid, iter))
+
+    def del_runs(self, name="", project="", labels=None, state="", days_ago=0):
+        for run in self.list_runs(name=name, project=project, labels=labels,
+                                  state=state, iter=True):
+            self.del_run(get_in(run, "metadata.uid"), project,
+                         get_in(run, "metadata.iteration", 0))
+
+    # -- logs --------------------------------------------------------------
+    def _log_path(self, project: str, uid: str) -> str:
+        path = os.path.join(self.logs_dir, self._project_or_default(project), uid)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        return path
+
+    def store_log(self, uid: str, project: str = "", body: bytes = b"",
+                  append: bool = True):
+        mode = "ab" if append else "wb"
+        if isinstance(body, str):
+            body = body.encode()
+        with open(self._log_path(project, uid), mode) as fp:
+            fp.write(body)
+
+    def get_log(self, uid: str, project: str = "", offset: int = 0,
+                size: int = -1) -> tuple[str, bytes]:
+        run = self.read_run(uid, project)
+        state = get_in(run or {}, "status.state", RunStates.unknown)
+        path = self._log_path(project, uid)
+        if not os.path.isfile(path):
+            return state, b""
+        with open(path, "rb") as fp:
+            fp.seek(offset)
+            data = fp.read(size if size > 0 else -1)
+        return state, data
+
+    def get_log_size(self, uid: str, project: str = "") -> int:
+        path = self._log_path(project, uid)
+        return os.path.getsize(path) if os.path.isfile(path) else 0
+
+    # -- artifacts ---------------------------------------------------------
+    def store_artifact(self, key, artifact: dict, uid=None, iter=None, tag="",
+                       project="", tree=None):
+        project = self._project_or_default(project)
+        uid = uid or get_in(artifact, "metadata.uid") or generate_uid()
+        tag = tag or get_in(artifact, "metadata.tag") or "latest"
+        update_in(artifact, "metadata.tag", tag)
+        update_in(artifact, "metadata.uid", uid)
+        update_in(artifact, "metadata.project", project)
+        # only one uid per (project,key) may own a tag
+        self._execute(
+            "UPDATE artifacts SET tag='' WHERE project=? AND key=? AND tag=?",
+            (project, key, tag),
+        )
+        self._execute(
+            "INSERT OR REPLACE INTO artifacts "
+            "(project, key, uid, tree, iteration, tag, kind, updated, body) "
+            "VALUES (?,?,?,?,?,?,?,?,?)",
+            (
+                project, key, uid, tree or get_in(artifact, "metadata.tree"),
+                iter or get_in(artifact, "metadata.iter", 0), tag,
+                artifact.get("kind", "artifact"), now_iso(),
+                json.dumps(artifact, default=str),
+            ),
+        )
+
+    def read_artifact(self, key, tag=None, iter=None, project="", tree=None,
+                      uid=None) -> Optional[dict]:
+        project = self._project_or_default(project)
+        sql = "SELECT body FROM artifacts WHERE project=? AND key=?"
+        params: list = [project, key]
+        if uid:
+            sql += " AND uid=?"
+            params.append(uid)
+        elif tree:
+            sql += " AND tree=?"
+            params.append(tree)
+            if iter is not None:
+                sql += " AND iteration=?"
+                params.append(iter)
+        else:
+            sql += " AND tag=?"
+            params.append(tag or "latest")
+        sql += " ORDER BY updated DESC LIMIT 1"
+        rows = self._query(sql, tuple(params))
+        if not rows:
+            raise RunDBError(f"artifact {project}/{key} (tag={tag}) not found")
+        return json.loads(rows[0]["body"])
+
+    def list_artifacts(self, name="", project="", tag=None, labels=None,
+                       since=None, until=None, kind=None, category=None,
+                       tree=None) -> list:
+        project = self._project_or_default(project)
+        sql = "SELECT body FROM artifacts WHERE project=?"
+        params: list = [project]
+        if name:
+            sql += " AND key LIKE ?"
+            params.append(f"%{name}%")
+        if tag and tag != "*":
+            sql += " AND tag=?"
+            params.append(tag)
+        if kind:
+            sql += " AND kind=?"
+            params.append(kind)
+        if tree:
+            sql += " AND tree=?"
+            params.append(tree)
+        sql += " ORDER BY updated DESC"
+        rows = self._query(sql, tuple(params))
+        out = [json.loads(r["body"]) for r in rows]
+        return [a for a in out if _labels_match(a, labels)]
+
+    def del_artifact(self, key, tag=None, project="", uid=None):
+        project = self._project_or_default(project)
+        sql = "DELETE FROM artifacts WHERE project=? AND key=?"
+        params: list = [project, key]
+        if uid:
+            sql += " AND uid=?"
+            params.append(uid)
+        elif tag:
+            sql += " AND tag=?"
+            params.append(tag)
+        self._execute(sql, tuple(params))
+
+    # -- functions ---------------------------------------------------------
+    def store_function(self, function: dict, name, project="", tag="",
+                       versioned=False) -> str:
+        import hashlib
+
+        project = self._project_or_default(project)
+        tag = tag or get_in(function, "metadata.tag") or "latest"
+        body = json.dumps(function, default=str)
+        hash_key = hashlib.sha1(body.encode()).hexdigest()
+        update_in(function, "metadata.hash", hash_key)
+        update_in(function, "metadata.project", project)
+        body = json.dumps(function, default=str)
+        self._execute(
+            "INSERT OR REPLACE INTO functions "
+            "(project, name, tag, hash_key, updated, body) VALUES (?,?,?,?,?,?)",
+            (project, name, tag, hash_key, now_iso(), body),
+        )
+        if versioned:
+            self._execute(
+                "INSERT OR REPLACE INTO function_versions "
+                "(project, name, hash_key, updated, body) VALUES (?,?,?,?,?)",
+                (project, name, hash_key, now_iso(), body),
+            )
+        return hash_key
+
+    def get_function(self, name, project="", tag="", hash_key="") -> dict:
+        project = self._project_or_default(project)
+        if hash_key:
+            rows = self._query(
+                "SELECT body FROM function_versions WHERE project=? AND name=? "
+                "AND hash_key=?", (project, name, hash_key))
+        else:
+            rows = self._query(
+                "SELECT body FROM functions WHERE project=? AND name=? AND tag=?",
+                (project, name, tag or "latest"))
+        if not rows:
+            raise RunDBError(f"function {project}/{name}:{tag or hash_key} not found")
+        return json.loads(rows[0]["body"])
+
+    def list_functions(self, name="", project="", tag="", labels=None) -> list:
+        project = self._project_or_default(project)
+        sql = "SELECT body FROM functions WHERE project=?"
+        params: list = [project]
+        if name:
+            sql += " AND name LIKE ?"
+            params.append(f"%{name}%")
+        if tag:
+            sql += " AND tag=?"
+            params.append(tag)
+        rows = self._query(sql, tuple(params))
+        out = [json.loads(r["body"]) for r in rows]
+        return [f for f in out if _labels_match(f, labels)]
+
+    def delete_function(self, name, project=""):
+        project = self._project_or_default(project)
+        self._execute("DELETE FROM functions WHERE project=? AND name=?",
+                      (project, name))
+        self._execute("DELETE FROM function_versions WHERE project=? AND name=?",
+                      (project, name))
+
+    # -- projects ----------------------------------------------------------
+    def store_project(self, name: str, project: dict) -> dict:
+        update_in(project, "metadata.name", name)
+        state = get_in(project, "status.state", "online")
+        created = get_in(project, "metadata.created", now_iso())
+        update_in(project, "metadata.created", created)
+        self._execute(
+            "INSERT OR REPLACE INTO projects (name, state, created, body) "
+            "VALUES (?,?,?,?)",
+            (name, state, created, json.dumps(project, default=str)),
+        )
+        return project
+
+    def get_project(self, name: str) -> Optional[dict]:
+        rows = self._query("SELECT body FROM projects WHERE name=?", (name,))
+        return json.loads(rows[0]["body"]) if rows else None
+
+    def list_projects(self, owner=None, labels=None, state=None) -> list:
+        sql = "SELECT body FROM projects"
+        params: tuple = ()
+        if state:
+            sql += " WHERE state=?"
+            params = (state,)
+        rows = self._query(sql, params)
+        out = [json.loads(r["body"]) for r in rows]
+        return [p for p in out if _labels_match(p, labels)]
+
+    def delete_project(self, name: str, deletion_strategy: str = "restricted"):
+        if deletion_strategy == "restricted":
+            runs = self._query(
+                "SELECT COUNT(*) AS c FROM runs WHERE project=?", (name,))
+            if runs[0]["c"]:
+                raise RunDBError(
+                    f"project {name} has runs; use deletion_strategy='cascade'")
+        for table in ("runs", "artifacts", "functions", "function_versions",
+                      "schedules", "feature_sets", "feature_vectors",
+                      "model_endpoints", "alert_configs"):
+            self._execute(f"DELETE FROM {table} WHERE project=?", (name,))
+        self._execute("DELETE FROM projects WHERE name=?", (name,))
+
+    # -- schedules ---------------------------------------------------------
+    def store_schedule(self, project: str, name: str, schedule: dict):
+        project = self._project_or_default(project)
+        self._execute(
+            "INSERT OR REPLACE INTO schedules "
+            "(project, name, kind, cron, next_run_time, body) VALUES (?,?,?,?,?,?)",
+            (project, name, schedule.get("kind", "job"),
+             schedule.get("cron_trigger", ""), schedule.get("next_run_time"),
+             json.dumps(schedule, default=str)),
+        )
+
+    def get_schedule(self, project: str, name: str) -> Optional[dict]:
+        rows = self._query(
+            "SELECT body FROM schedules WHERE project=? AND name=?",
+            (self._project_or_default(project), name))
+        if not rows:
+            raise RunDBError(f"schedule {project}/{name} not found")
+        return json.loads(rows[0]["body"])
+
+    def list_schedules(self, project: str = "") -> list:
+        if project and project != "*":
+            rows = self._query("SELECT body FROM schedules WHERE project=?",
+                               (self._project_or_default(project),))
+        else:
+            rows = self._query("SELECT body FROM schedules")
+        return [json.loads(r["body"]) for r in rows]
+
+    def delete_schedule(self, project: str, name: str):
+        self._execute("DELETE FROM schedules WHERE project=? AND name=?",
+                      (self._project_or_default(project), name))
+
+    # -- feature store ------------------------------------------------------
+    def _store_versioned(self, table: str, obj: dict, name, project, tag, uid):
+        project = self._project_or_default(project)
+        name = name or get_in(obj, "metadata.name")
+        tag = tag or get_in(obj, "metadata.tag") or "latest"
+        uid = uid or get_in(obj, "metadata.uid") or generate_uid()
+        update_in(obj, "metadata.uid", uid)
+        update_in(obj, "metadata.project", project)
+        self._execute(
+            f"INSERT OR REPLACE INTO {table} "
+            "(project, name, tag, uid, updated, body) VALUES (?,?,?,?,?,?)",
+            (project, name, tag, uid, now_iso(), json.dumps(obj, default=str)),
+        )
+        return uid
+
+    def _get_versioned(self, table: str, name, project, tag, uid):
+        project = self._project_or_default(project)
+        if uid:
+            rows = self._query(
+                f"SELECT body FROM {table} WHERE project=? AND name=? AND uid=?",
+                (project, name, uid))
+        else:
+            rows = self._query(
+                f"SELECT body FROM {table} WHERE project=? AND name=? AND tag=?",
+                (project, name, tag or "latest"))
+        if not rows:
+            raise RunDBError(f"{table} {project}/{name} not found")
+        return json.loads(rows[0]["body"])
+
+    def _list_versioned(self, table: str, project, name, tag, labels):
+        project = self._project_or_default(project)
+        sql = f"SELECT body FROM {table} WHERE project=?"
+        params: list = [project]
+        if name:
+            sql += " AND name LIKE ?"
+            params.append(f"%{name}%")
+        if tag:
+            sql += " AND tag=?"
+            params.append(tag)
+        rows = self._query(sql, tuple(params))
+        out = [json.loads(r["body"]) for r in rows]
+        return [o for o in out if _labels_match(o, labels)]
+
+    def store_feature_set(self, feature_set, name=None, project="", tag=None,
+                          uid=None, versioned=True):
+        return self._store_versioned("feature_sets", feature_set, name, project,
+                                     tag, uid)
+
+    def get_feature_set(self, name, project="", tag=None, uid=None):
+        return self._get_versioned("feature_sets", name, project, tag, uid)
+
+    def list_feature_sets(self, project="", name="", tag=None, labels=None):
+        return self._list_versioned("feature_sets", project, name, tag, labels)
+
+    def delete_feature_set(self, name, project="", tag=None, uid=None):
+        self._execute("DELETE FROM feature_sets WHERE project=? AND name=?",
+                      (self._project_or_default(project), name))
+
+    def store_feature_vector(self, feature_vector, name=None, project="",
+                             tag=None, uid=None, versioned=True):
+        return self._store_versioned("feature_vectors", feature_vector, name,
+                                     project, tag, uid)
+
+    def get_feature_vector(self, name, project="", tag=None, uid=None):
+        return self._get_versioned("feature_vectors", name, project, tag, uid)
+
+    def list_feature_vectors(self, project="", name="", tag=None, labels=None):
+        return self._list_versioned("feature_vectors", project, name, tag, labels)
+
+    def delete_feature_vector(self, name, project="", tag=None, uid=None):
+        self._execute("DELETE FROM feature_vectors WHERE project=? AND name=?",
+                      (self._project_or_default(project), name))
+
+    # -- model endpoints ----------------------------------------------------
+    def store_model_endpoint(self, project, endpoint_id, endpoint: dict):
+        project = self._project_or_default(project)
+        self._execute(
+            "INSERT OR REPLACE INTO model_endpoints "
+            "(project, uid, model, function, state, updated, body) "
+            "VALUES (?,?,?,?,?,?,?)",
+            (project, endpoint_id, endpoint.get("model_uri", ""),
+             endpoint.get("function_uri", ""), endpoint.get("state", "ready"),
+             now_iso(), json.dumps(endpoint, default=str)),
+        )
+
+    def get_model_endpoint(self, project, endpoint_id) -> dict:
+        rows = self._query(
+            "SELECT body FROM model_endpoints WHERE project=? AND uid=?",
+            (self._project_or_default(project), endpoint_id))
+        if not rows:
+            raise RunDBError(f"model endpoint {endpoint_id} not found")
+        return json.loads(rows[0]["body"])
+
+    def list_model_endpoints(self, project="", model="", function="",
+                             state="") -> list:
+        project = self._project_or_default(project)
+        sql = "SELECT body FROM model_endpoints WHERE project=?"
+        params: list = [project]
+        if model:
+            sql += " AND model LIKE ?"
+            params.append(f"%{model}%")
+        if function:
+            sql += " AND function LIKE ?"
+            params.append(f"%{function}%")
+        if state:
+            sql += " AND state=?"
+            params.append(state)
+        rows = self._query(sql, tuple(params))
+        return [json.loads(r["body"]) for r in rows]
+
+    def delete_model_endpoint(self, project, endpoint_id):
+        self._execute("DELETE FROM model_endpoints WHERE project=? AND uid=?",
+                      (self._project_or_default(project), endpoint_id))
+
+    # -- background tasks ---------------------------------------------------
+    def store_background_task(self, name: str, state: str, project: str = "",
+                              body: dict | None = None):
+        self._execute(
+            "INSERT OR REPLACE INTO background_tasks "
+            "(project, name, state, created, updated, body) VALUES (?,?,?,?,?,?)",
+            (project, name, state, now_iso(), now_iso(),
+             json.dumps(body or {}, default=str)),
+        )
+
+    def get_background_task(self, name: str, project: str = "") -> Optional[dict]:
+        rows = self._query(
+            "SELECT state, body FROM background_tasks WHERE project=? AND name=?",
+            (project, name))
+        if not rows:
+            return None
+        out = json.loads(rows[0]["body"])
+        out["state"] = rows[0]["state"]
+        out["name"] = name
+        return out
+
+    # -- alerts / events ----------------------------------------------------
+    def store_alert_config(self, name, config: dict, project=""):
+        self._execute(
+            "INSERT OR REPLACE INTO alert_configs (project, name, body) "
+            "VALUES (?,?,?)",
+            (self._project_or_default(project), name,
+             json.dumps(config, default=str)),
+        )
+
+    def get_alert_config(self, name, project="") -> dict:
+        rows = self._query(
+            "SELECT body FROM alert_configs WHERE project=? AND name=?",
+            (self._project_or_default(project), name))
+        if not rows:
+            raise RunDBError(f"alert config {name} not found")
+        return json.loads(rows[0]["body"])
+
+    def list_alert_configs(self, project="") -> list:
+        rows = self._query("SELECT body FROM alert_configs WHERE project=?",
+                           (self._project_or_default(project),))
+        return [json.loads(r["body"]) for r in rows]
+
+    def delete_alert_config(self, name, project=""):
+        self._execute("DELETE FROM alert_configs WHERE project=? AND name=?",
+                      (self._project_or_default(project), name))
+
+    def emit_event(self, kind: str, event: dict, project: str = ""):
+        self._execute(
+            "INSERT INTO events (project, kind, created, body) VALUES (?,?,?,?)",
+            (self._project_or_default(project), kind, now_iso(),
+             json.dumps(event, default=str)),
+        )
+
+    def list_events(self, project: str = "", kind: str = "", since=None) -> list:
+        sql = "SELECT kind, created, body FROM events WHERE project=?"
+        params: list = [self._project_or_default(project)]
+        if kind:
+            sql += " AND kind=?"
+            params.append(kind)
+        if since:
+            sql += " AND created>=?"
+            params.append(str(since))
+        rows = self._query(sql + " ORDER BY id", tuple(params))
+        return [
+            {"kind": r["kind"], "created": r["created"], **json.loads(r["body"])}
+            for r in rows
+        ]
+
+    # -- submit (local mode: run in-process) --------------------------------
+    def submit_job(self, runspec, schedule=None) -> dict:
+        raise RunDBError(
+            "submit_job requires a remote service (set MLT_DBPATH); in local "
+            "mode runs execute in-process via the local launcher")

@@ -1,0 +1,385 @@
+"""Run-side execution context (reference analog: mlrun/execution.py:51 MLClientCtx).
+
+``MLClientCtx`` is the object handed to user handlers: parameters, inputs,
+secrets, result/artifact logging, state transitions. TPU-specific addition:
+``is_logging_worker`` keys on ``jax.process_index() == 0`` (replacing the
+reference's MPI-rank check, mlrun/execution.py:1040-1061) so SPMD multi-host
+runs log exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+from typing import Any, Optional
+
+from .artifacts import ArtifactManager, ArtifactProducer, DatasetArtifact, ModelArtifact
+from .common.runtimes_constants import RunStates
+from .config import mlconf
+from .model import ModelObj, RunObject
+from .secrets import SecretsStore
+from .utils import generate_uid, logger, now_date, now_iso, template_artifact_path
+
+
+class MLClientCtx:
+    """Client context for a single run/iteration."""
+
+    def __init__(self, autocommit: bool = False, tmp: str = "", log_stream=None):
+        self._uid = None
+        self.name = ""
+        self.project = ""
+        self.iteration = 0
+        self.kind = "run"
+        self.parameters: dict = {}
+        self.labels: dict = {}
+        self.annotations: dict = {}
+        self._inputs: dict = {}
+        self._outputs: list = []
+        self._results: dict = {}
+        self._state = RunStates.created
+        self._error = None
+        self._commit_text = ""
+        self._secrets_manager = SecretsStore()
+        self._autocommit = autocommit
+        self._artifacts_manager: Optional[ArtifactManager] = None
+        self._db = None
+        self.artifact_path = ""
+        self.in_path = ""
+        self._function_uri = ""
+        self._host = None
+        self._start_time = now_date()
+        self._last_update = now_date()
+        self._iteration_results = None
+        self._state_thresholds = {}
+        self._notifications = []
+        self._logger = logger
+        self._log_stream = log_stream
+        self._updates_blocked = False
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_dict(cls, attrs: dict, rundb=None, autocommit: bool = False,
+                  tmp: str = "", host: str | None = None,
+                  log_stream=None, is_api: bool = False,
+                  store_run: bool = True) -> "MLClientCtx":
+        ctx = cls(autocommit=autocommit, tmp=tmp, log_stream=log_stream)
+        meta = attrs.get("metadata", {})
+        spec = attrs.get("spec", {})
+        ctx._uid = meta.get("uid") or generate_uid()
+        ctx.name = meta.get("name", "")
+        ctx.project = meta.get("project") or mlconf.default_project
+        ctx.iteration = meta.get("iteration", 0)
+        ctx.labels = meta.get("labels", {})
+        ctx.annotations = meta.get("annotations", {})
+        ctx.parameters = spec.get("parameters", {})
+        ctx._inputs = spec.get("inputs", {})
+        ctx._outputs = spec.get("outputs", [])
+        ctx.in_path = spec.get("input_path", "")
+        ctx._function_uri = spec.get("function", "")
+        ctx._state_thresholds = spec.get("state_thresholds", {})
+        ctx._notifications = spec.get("notifications", [])
+        ctx._secrets_manager = SecretsStore.from_list(spec.get("secret_sources"))
+        ctx.artifact_path = template_artifact_path(
+            spec.get("output_path", ""), ctx.project, ctx._uid)
+        ctx._host = host
+        if rundb is not None:
+            ctx._db = rundb
+        else:
+            from .db import get_run_db
+
+            ctx._db = get_run_db()
+        ctx._artifacts_manager = ArtifactManager(db=ctx._db)
+        if store_run and ctx.is_logging_worker():
+            ctx._state = RunStates.running
+            ctx._start_time = now_date()
+            ctx.commit()
+        return ctx
+
+    # -- identity / info ---------------------------------------------------
+    @property
+    def uid(self) -> str:
+        if self.iteration:
+            return f"{self._uid}-{self.iteration}"
+        return self._uid
+
+    @property
+    def tag(self) -> str:
+        return self._uid
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def results(self) -> dict:
+        return dict(self._results)
+
+    @property
+    def logger(self):
+        return self._logger
+
+    @property
+    def inputs(self) -> dict:
+        return {k: self.get_input(k) for k in self._inputs}
+
+    def get_meta(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "uri": self._function_uri,
+            "owner": self.labels.get("owner"),
+            "workflow": self.labels.get("workflow"),
+        }
+
+    def is_logging_worker(self) -> bool:
+        """True on exactly one worker of a multi-host SPMD run.
+
+        Reference analog: mlrun/execution.py:1040 keyed on MPI rank; here the
+        equivalent is the JAX process index (process 0 of the pod-slice), with
+        env fallbacks so the check is cheap before jax.distributed init.
+        """
+        for env in ("JAX_PROCESS_INDEX", "TPU_WORKER_ID", "MLT_WORKER_RANK"):
+            if env in os.environ:
+                return os.environ[env].split(":")[0] in ("0", "")
+        try:
+            import jax
+
+            # only consult jax if it's already initialized/initializable cheaply
+            return jax.process_index() == 0
+        except Exception:  # noqa: BLE001 - any backend issue → single process
+            return True
+
+    # -- params / inputs / secrets ----------------------------------------
+    def get_param(self, key: str, default: Any = None) -> Any:
+        if key in self.parameters:
+            return self.parameters[key]
+        self.parameters[key] = default
+        return default
+
+    def get_secret(self, key: str, default: Any = None) -> Any:
+        return self._secrets_manager.get(key, default)
+
+    def get_input(self, key: str, url: str = ""):
+        url = url or self._inputs.get(key, "")
+        if not url:
+            return None
+        if self.in_path and "://" not in url and not url.startswith("/"):
+            url = os.path.join(self.in_path, url)
+        from .datastore import store_manager
+
+        return store_manager.object(url=url, key=key, project=self.project)
+
+    def get_store_resource(self, url: str):
+        from .datastore import store_manager
+
+        return store_manager.object(url=url, project=self.project)
+
+    def get_cached_artifact(self, key: str):
+        return self._artifacts_manager.artifacts.get(key)
+
+    def get_dataitem(self, url: str):
+        return self.get_store_resource(url)
+
+    # -- labels / state ----------------------------------------------------
+    def set_label(self, key: str, value, replace: bool = True):
+        if replace or key not in self.labels:
+            self.labels[key] = str(value)
+
+    def set_annotation(self, key: str, value, replace: bool = True):
+        if replace or key not in self.annotations:
+            self.annotations[key] = str(value)
+
+    def set_state(self, execution_state: str | None = None, error: str | None = None,
+                  commit: bool = True):
+        if error is not None:
+            self._state = RunStates.error
+            self._error = str(error)
+        elif execution_state:
+            self._state = execution_state
+        self._last_update = now_date()
+        if commit:
+            self.commit()
+
+    def set_hostname(self, host: str):
+        self._host = host
+
+    # -- results / artifacts ----------------------------------------------
+    def log_result(self, key: str, value, commit: bool = False):
+        self._results[key] = _cast_result(value)
+        if commit or self._autocommit:
+            self.commit()
+
+    def log_results(self, results: dict, commit: bool = False):
+        for key, value in results.items():
+            self._results[key] = _cast_result(value)
+        if commit or self._autocommit:
+            self.commit()
+
+    def log_metrics(self, metrics: dict, step: int | None = None):
+        """Log per-step training metrics as results (flat, last-value-wins) and
+        append to the metrics stream artifact."""
+        for key, value in metrics.items():
+            self._results[key] = _cast_result(value)
+
+    def log_iteration_results(self, best: int, summary: list, task: dict,
+                              commit: bool = False):
+        self._results["best_iteration"] = best
+        self._iteration_results = summary
+        if commit or self._autocommit:
+            self.commit()
+
+    def _producer(self) -> ArtifactProducer:
+        return ArtifactProducer(
+            "run", self.project, self.name, tag=self.tag,
+            owner=self.labels.get("owner"), uid=self._uid)
+
+    def log_artifact(self, item, body=None, local_path: str = "",
+                     artifact_path: str = "", tag: str = "", viewer: str = "",
+                     target_path: str = "", format: str | None = None,
+                     upload: bool | None = None, labels: dict | None = None,
+                     db_key: str | None = None, **kwargs):
+        artifact = self._artifacts_manager.log_artifact(
+            self._producer(), item, body=body, local_path=local_path,
+            artifact_path=artifact_path or self.artifact_path, tag=tag,
+            viewer=viewer, target_path=target_path, format=format,
+            upload=upload, labels=labels, db_key=db_key, **kwargs)
+        self._update_db()
+        return artifact
+
+    def log_dataset(self, key: str, df, tag: str = "", local_path: str = "",
+                    artifact_path: str = "", upload: bool | None = None,
+                    labels: dict | None = None, format: str = "parquet",
+                    preview=None, stats=None, target_path: str = "", **kwargs):
+        ds = DatasetArtifact(key, df=df, preview=preview, format=format,
+                             stats=stats, target_path=target_path)
+        artifact = self._artifacts_manager.log_artifact(
+            self._producer(), ds, local_path=local_path,
+            artifact_path=artifact_path or self.artifact_path, tag=tag,
+            upload=upload, labels=labels, **kwargs)
+        self._update_db()
+        return artifact
+
+    def log_model(self, key: str, body=None, framework: str = "",
+                  tag: str = "", model_dir: str = "", model_file: str = "",
+                  algorithm: str = "", metrics: dict | None = None,
+                  parameters: dict | None = None, artifact_path: str = "",
+                  upload: bool | None = None, labels: dict | None = None,
+                  inputs: list | None = None, outputs: list | None = None,
+                  feature_vector: str | None = None,
+                  feature_weights: list | None = None,
+                  training_set=None, label_column: str | None = None,
+                  extra_data: dict | None = None, db_key: str | None = None,
+                  **kwargs):
+        if training_set is not None and inputs is None:
+            inputs = [
+                {"name": c, "value_type": str(training_set[c].dtype)}
+                for c in training_set.columns if c != label_column
+            ]
+            if label_column and outputs is None:
+                outputs = [{
+                    "name": label_column,
+                    "value_type": str(training_set[label_column].dtype),
+                }]
+        model = ModelArtifact(
+            key, body=body, model_file=model_file, model_dir=model_dir,
+            metrics=metrics, parameters=parameters, inputs=inputs,
+            outputs=outputs, framework=framework, algorithm=algorithm,
+            feature_vector=feature_vector, feature_weights=feature_weights,
+            extra_data=extra_data)
+        artifact = self._artifacts_manager.log_artifact(
+            self._producer(), model, artifact_path=artifact_path or self.artifact_path,
+            tag=tag, upload=upload, labels=labels, db_key=db_key, **kwargs)
+        self._update_db()
+        return artifact
+
+    # -- persistence -------------------------------------------------------
+    def to_dict(self) -> dict:
+        struct = {
+            "kind": "run",
+            "metadata": {
+                "name": self.name, "uid": self._uid, "iteration": self.iteration,
+                "project": self.project, "labels": self.labels,
+                "annotations": self.annotations,
+            },
+            "spec": {
+                "function": self._function_uri,
+                "parameters": self.parameters,
+                "inputs": self._inputs,
+                "outputs": self._outputs,
+                "output_path": self.artifact_path,
+                "input_path": self.in_path,
+                "state_thresholds": self._state_thresholds,
+                "notifications": self._notifications,
+                "secret_sources": self._secrets_manager.to_serial(),
+            },
+            "status": {
+                "state": self._state,
+                "results": self._results,
+                "start_time": str(self._start_time),
+                "last_update": str(self._last_update),
+                "artifacts": self._artifacts_manager.artifact_list(full=True)
+                if self._artifacts_manager else [],
+                "artifact_uris": dict(self._artifacts_manager.artifact_uris)
+                if self._artifacts_manager else {},
+            },
+        }
+        if self._error:
+            struct["status"]["error"] = self._error
+        if self._host:
+            struct["status"]["host"] = self._host
+        if self._iteration_results is not None:
+            struct["status"]["iterations"] = self._iteration_results
+        return struct
+
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(self.to_dict(), default=str)
+
+    def _update_db(self):
+        if self._autocommit:
+            self.commit()
+
+    def commit(self, message: str = "", completed: bool = False):
+        if message:
+            self._commit_text = message
+        if completed:
+            self._state = RunStates.completed
+        self._last_update = now_date()
+        if self._db and self.is_logging_worker():
+            self._db.store_run(self.to_dict(), self._uid, self.project,
+                               iter=self.iteration)
+
+    def commit_results(self):
+        self.commit()
+
+    def mark_as_best(self):
+        self.set_label("best_iteration", "true")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, exc_traceback):
+        if exc_value is not None:
+            self.set_state(error=f"{exc_value}\n"
+                           + "".join(traceback.format_exception(
+                               exc_type, exc_value, exc_traceback))[-2000:])
+        else:
+            self.commit(completed=True)
+        return False
+
+
+def _cast_result(value):
+    import numpy as np
+
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+        # jax/torch 0-d arrays
+        try:
+            return value.item()
+        except Exception:  # noqa: BLE001
+            return str(value)
+    return value

@@ -1,0 +1,14 @@
+from .api import (  # noqa: F401
+    OfflineVectorResponse,
+    OnlineVectorService,
+    get_offline_features,
+    get_online_feature_service,
+    ingest,
+    preview,
+)
+from .feature_set import (  # noqa: F401
+    Entity,
+    Feature,
+    FeatureSet,
+    FeatureVector,
+)

@@ -1,0 +1,247 @@
+"""Feature-store operations (reference analog: mlrun/feature_store/api.py —
+get_offline_features :99, get_online_feature_service :296, ingest :450;
+merge engine analog: retrieval/local_merger.py BaseMerger/LocalFeatureMerger).
+
+Round-1 engine: pandas (the reference's "local" engine). Storey/spark engines
+are orchestration-level and out of the TPU hot path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Union
+
+import pandas as pd
+
+from ..config import mlconf
+from ..utils import logger, now_iso
+from .feature_set import FeatureSet, FeatureVector
+
+
+def _resolve_feature_set(ref: Union[str, FeatureSet],
+                         project: str = "") -> FeatureSet:
+    if isinstance(ref, FeatureSet):
+        return ref
+    from ..db import get_run_db
+
+    name = ref
+    if ref.startswith("store://feature-sets/"):
+        body = ref[len("store://feature-sets/"):]
+        project, _, name = body.partition("/")
+    struct = get_run_db().get_feature_set(name, project=project)
+    return FeatureSet.from_dict(struct)
+
+
+def ingest(featureset: Union[FeatureSet, str], source,
+           targets: list | None = None, namespace=None,
+           return_df: bool = True, infer_options=None,
+           overwrite: bool | None = None) -> pd.DataFrame:
+    """Ingest a source into the feature set's offline target (parquet) and
+    register stats/schema (reference api.py:450, pandas engine)."""
+    fset = _resolve_feature_set(featureset)
+    if isinstance(source, str):
+        from ..datastore import store_manager
+
+        source = store_manager.object(url=source).as_df()
+    if not isinstance(source, pd.DataFrame):
+        raise ValueError("pandas-engine ingest expects a DataFrame or url")
+
+    entities = fset.entity_names
+    for entity in entities:
+        if entity not in source.columns and source.index.name != entity:
+            raise ValueError(f"entity column '{entity}' missing from source")
+
+    # schema inference
+    if not fset.spec.features:
+        fset.spec.features = [
+            {"name": c, "value_type": str(source[c].dtype)}
+            for c in source.columns if c not in entities
+        ]
+    # stats
+    try:
+        fset.status.stats = {
+            c: {
+                "count": int(source[c].count()),
+                "mean": float(source[c].mean())
+                if source[c].dtype.kind in "if" else None,
+                "min": source[c].min() if source[c].dtype.kind in "if" else None,
+                "max": source[c].max() if source[c].dtype.kind in "if" else None,
+            }
+            for c in source.columns
+        }
+    except Exception:  # noqa: BLE001 - stats are best-effort
+        pass
+
+    path = fset._target_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if overwrite is False and os.path.isfile(path):
+        existing = pd.read_parquet(path)
+        source = pd.concat([existing, source], ignore_index=True)
+        if entities:
+            source = source.drop_duplicates(subset=entities, keep="last")
+    source.to_parquet(path, index=False)
+    fset.status.targets = [{"name": "parquet", "kind": "parquet",
+                            "path": path, "updated": now_iso()}]
+    fset.status.state = "ready"
+    fset.save()
+    logger.info("ingested feature set", name=fset.name, rows=len(source),
+                path=path)
+    return source if return_df else None
+
+
+def preview(featureset: Union[FeatureSet, str], source, limit: int = 20):
+    fset = _resolve_feature_set(featureset)
+    if isinstance(source, str):
+        from ..datastore import store_manager
+
+        source = store_manager.object(url=source).as_df()
+    return source.head(limit)
+
+
+class OfflineVectorResponse:
+    """Result of get_offline_features (reference api.py OfflineVectorResponse)."""
+
+    def __init__(self, df: pd.DataFrame, vector: FeatureVector):
+        self._df = df
+        self.vector = vector
+        self.status = "completed"
+
+    def to_dataframe(self) -> pd.DataFrame:
+        return self._df
+
+    def to_parquet(self, target_path: str, **kw):
+        os.makedirs(os.path.dirname(target_path) or ".", exist_ok=True)
+        self._df.to_parquet(target_path, **kw)
+        return target_path
+
+    def to_csv(self, target_path: str, **kw):
+        os.makedirs(os.path.dirname(target_path) or ".", exist_ok=True)
+        self._df.to_csv(target_path, index=False, **kw)
+        return target_path
+
+
+def _resolve_vector(vector: Union[str, FeatureVector],
+                    project: str = "") -> FeatureVector:
+    if isinstance(vector, FeatureVector):
+        return vector
+    from ..db import get_run_db
+
+    name = vector
+    if vector.startswith("store://feature-vectors/"):
+        body = vector[len("store://feature-vectors/"):]
+        project, _, name = body.partition("/")
+    struct = get_run_db().get_feature_vector(name, project=project)
+    return FeatureVector.from_dict(struct)
+
+
+def get_offline_features(feature_vector: Union[str, FeatureVector],
+                         entity_rows: pd.DataFrame | None = None,
+                         target=None, drop_columns: list | None = None,
+                         with_indexes: bool = False,
+                         engine: str = "local") -> OfflineVectorResponse:
+    """Join the vector's feature sets into one offline dataframe
+    (reference api.py:99; merger analog retrieval/base.py:30)."""
+    vector = _resolve_vector(feature_vector)
+    project = getattr(vector.metadata, "project", "") or ""
+    merged: pd.DataFrame | None = entity_rows
+    for set_name, feature in vector.parse_features():
+        fset = _resolve_feature_set(set_name, project=project)
+        df = fset.to_dataframe()
+        entities = fset.entity_names
+        if feature != "*":
+            df = df[entities + [feature]]
+        if merged is None:
+            merged = df
+        else:
+            join_keys = [c for c in entities if c in merged.columns]
+            if not join_keys:
+                raise ValueError(
+                    f"no common entity columns to join feature set "
+                    f"'{set_name}' (entities={entities})")
+            merged = merged.merge(df, on=join_keys, how="left")
+    if merged is None:
+        raise ValueError("feature vector has no features")
+    if vector.spec.label_feature:
+        set_name, feature = vector.spec.label_feature.rsplit(".", 1)
+        fset = _resolve_feature_set(set_name, project=project)
+        df = fset.to_dataframe()[fset.entity_names + [feature]]
+        join_keys = [c for c in fset.entity_names if c in merged.columns]
+        merged = merged.merge(df, on=join_keys, how="left")
+    if drop_columns:
+        merged = merged.drop(columns=[c for c in drop_columns
+                                      if c in merged.columns])
+    if not (with_indexes or vector.spec.with_indexes):
+        entity_cols = set()
+        for set_name, _ in vector.parse_features():
+            entity_cols.update(
+                _resolve_feature_set(set_name, project=project).entity_names)
+        merged = merged.drop(
+            columns=[c for c in entity_cols if c in merged.columns])
+    response = OfflineVectorResponse(merged, vector)
+    if target:
+        path = target if isinstance(target, str) else getattr(
+            target, "path", "")
+        if path:
+            response.to_parquet(path)
+    return response
+
+
+class OnlineVectorService:
+    """Key→features lookup service (reference feature_vector.py:910)."""
+
+    def __init__(self, vector: FeatureVector, impute_policy: dict | None = None):
+        self.vector = vector
+        self.impute_policy = impute_policy or {}
+        self._tables: list[tuple[list[str], pd.DataFrame]] = []
+        self._initialize()
+
+    def _initialize(self):
+        project = getattr(self.vector.metadata, "project", "") or ""
+        for set_name, feature in self.vector.parse_features():
+            fset = _resolve_feature_set(set_name, project=project)
+            df = fset.to_dataframe()
+            entities = fset.entity_names
+            if feature != "*":
+                df = df[entities + [feature]]
+            self._tables.append((entities, df.set_index(entities)))
+
+    @property
+    def status(self):
+        return "ready"
+
+    def get(self, entity_rows: list[dict], as_list: bool = False):
+        """entity_rows: [{entity: value, ...}] → feature dicts (or lists)."""
+        out = []
+        for row in entity_rows:
+            features: dict = {}
+            for entities, table in self._tables:
+                try:
+                    key = tuple(row[e] for e in entities)
+                    if len(key) == 1:
+                        key = key[0]
+                    record = table.loc[key]
+                    if isinstance(record, pd.DataFrame):
+                        record = record.iloc[-1]
+                    features.update(record.to_dict())
+                except (KeyError, TypeError):
+                    continue
+            # imputation
+            for key, value in list(features.items()):
+                if pd.isna(value):
+                    policy = self.impute_policy.get(
+                        key, self.impute_policy.get("*"))
+                    if policy is not None:
+                        features[key] = policy
+            out.append(list(features.values()) if as_list else features)
+        return out
+
+    def close(self):
+        self._tables = []
+
+
+def get_online_feature_service(feature_vector: Union[str, FeatureVector],
+                               impute_policy: dict | None = None,
+                               **kwargs) -> OnlineVectorService:
+    """Create an online lookup service (reference api.py:296)."""
+    vector = _resolve_vector(feature_vector)
+    return OnlineVectorService(vector, impute_policy=impute_policy)

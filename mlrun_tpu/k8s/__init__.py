@@ -1,0 +1,1 @@
+from .jobset import build_jobset, parse_topology  # noqa: F401

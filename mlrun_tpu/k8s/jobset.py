@@ -1,0 +1,129 @@
+"""GKE JobSet spec builder for TPU pod-slices.
+
+This replaces the reference's MPIJob CRD generation
+(server/api/runtime_handlers/mpijob/v1.py:49 `_generate_mpi_job`,
+:198-217 `apiVersion kubeflow.org/v1`): instead of a launcher pod running
+``mpirun`` plus worker pods, a TPU run is a **JobSet** (jobset.x-k8s.io) of
+``num_slices`` replicated indexed Jobs — one Job per TPU slice, one pod per
+TPU host — where every pod runs the *same* SPMD program and JAX initializes
+the collective runtime from the GKE-injected TPU environment (no launcher,
+no ssh). Rank-0-only logging is enforced in the ctx layer
+(mlrun_tpu/execution.py is_logging_worker).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..config import mlconf
+
+JOBSET_API_VERSION = "jobset.x-k8s.io/v1alpha2"
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """'2x4' -> (2, 4); '4x4x4' -> (4, 4, 4)."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError as exc:
+        raise ValueError(f"bad TPU topology '{topology}'") from exc
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad TPU topology '{topology}'")
+    return dims
+
+
+def chips_in_topology(topology: str) -> int:
+    out = 1
+    for dim in parse_topology(topology):
+        out *= dim
+    return out
+
+
+def hosts_for_topology(topology: str, chips_per_host: int | None = None) -> int:
+    chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+    return max(1, math.ceil(chips_in_topology(topology) / chips_per_host))
+
+
+def build_jobset(name: str, namespace: str, pod_spec: dict, *,
+                 accelerator: str, topology: str, num_slices: int = 1,
+                 chips_per_host: int | None = None, max_restarts: int = 0,
+                 labels: dict | None = None, annotations: dict | None = None,
+                 suspend: bool = False) -> dict:
+    """Build the JobSet dict for a TPU run.
+
+    One replicated Job named 'slice' with ``num_slices`` replicas; each Job is
+    Indexed with parallelism=completions=hosts-per-slice; every pod requests
+    ``chips_per_host`` TPU chips and carries the GKE TPU node selectors. For
+    multi-slice (num_slices>1) the MEGASCALE coordinator env is injected so
+    XLA runs DCN collectives across slices.
+    """
+    chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+    hosts = hosts_for_topology(topology, chips_per_host)
+    labels = dict(labels or {})
+    labels.setdefault("app.kubernetes.io/managed-by", "mlrun-tpu")
+
+    pod_spec = dict(pod_spec)
+    pod_spec["subdomain"] = name  # headless service for host discovery
+    node_selector = pod_spec.setdefault("nodeSelector", {})
+    node_selector[mlconf.tpu.accelerator_node_selector] = accelerator
+    node_selector[mlconf.tpu.topology_node_selector] = topology
+
+    containers = pod_spec.get("containers", [])
+    if containers:
+        main = containers[0]
+        limits = main.setdefault("resources", {}).setdefault("limits", {})
+        limits[mlconf.tpu.resource_name] = chips_per_host
+        ports = main.setdefault("ports", [])
+        ports.append({"containerPort": mlconf.tpu.coordinator_port,
+                      "name": "coordinator"})
+        env = main.setdefault("env", [])
+        if num_slices > 1:
+            env.extend([
+                {"name": "MEGASCALE_NUM_SLICES", "value": str(num_slices)},
+                {
+                    "name": "MEGASCALE_SLICE_ID",
+                    "valueFrom": {"fieldRef": {"fieldPath": (
+                        "metadata.annotations"
+                        "['jobset.sigs.k8s.io/job-index']")}},
+                },
+                {"name": "MEGASCALE_COORDINATOR_ADDRESS",
+                 "value": f"{name}-slice-0-0.{name}"},
+            ])
+        # worker identity for rank-0-only logging before jax init
+        env.append({
+            "name": "TPU_WORKER_ID",
+            "valueFrom": {"fieldRef": {"fieldPath": (
+                "metadata.annotations"
+                "['batch.kubernetes.io/job-completion-index']")}},
+        })
+
+    job_template = {
+        "spec": {
+            "parallelism": hosts,
+            "completions": hosts,
+            "backoffLimit": 0,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": pod_spec,
+            },
+        }
+    }
+
+    return {
+        "apiVersion": JOBSET_API_VERSION,
+        "kind": "JobSet",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels,
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "suspend": suspend,
+            "failurePolicy": {"maxRestarts": max_restarts},
+            "replicatedJobs": [
+                {"name": "slice", "replicas": num_slices,
+                 "template": job_template}
+            ],
+        },
+    }

@@ -1,0 +1,4 @@
+from .base import BaseLauncher  # noqa: F401
+from .factory import LauncherFactory  # noqa: F401
+from .local import ClientLocalLauncher  # noqa: F401
+from .remote import ClientRemoteLauncher  # noqa: F401

@@ -1,0 +1,5 @@
+from .stream_processing import (  # noqa: F401
+    EventStreamProcessor,
+    get_monitoring_parquet_dir,
+    get_monitoring_stream,
+)

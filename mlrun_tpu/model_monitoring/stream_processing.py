@@ -1,0 +1,139 @@
+"""Monitoring event-stream processing (reference analog:
+mlrun/model_monitoring/stream_processing.py:45 EventStreamProcessor — the
+storey job parsing serving events into stats + parquet).
+
+Here the stream is the built-in file/in-memory stream (serving pushes via
+_ModelLogPusher); the processor drains it, aggregates per-endpoint statistics
+windows, writes parquet, and updates model-endpoint records in the run DB.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Optional
+
+from ..config import mlconf
+from ..utils import logger, now_iso
+
+
+def get_monitoring_stream(project: str):
+    """The stream serving events are pushed to for a project."""
+    from ..serving.streams import get_stream_pusher
+
+    kind = mlconf.serving.stream_kind
+    if kind == "inmem":
+        return get_stream_pusher(f"memory://monitoring-{project}")
+    path = os.path.join(mlconf.home_dir, "monitoring", project, "events.jsonl")
+    return get_stream_pusher(f"file://{path}")
+
+
+def get_monitoring_parquet_dir(project: str) -> str:
+    return os.path.join(mlconf.home_dir, "monitoring", project, "parquet")
+
+
+class EventStreamProcessor:
+    """Drain monitoring events → per-endpoint windowed stats + parquet."""
+
+    def __init__(self, project: str, db=None):
+        self.project = project
+        self.stream = get_monitoring_stream(project)
+        if db is None:
+            from ..db import get_run_db
+
+            db = get_run_db()
+        self.db = db
+        self._offset = 0
+
+    def _pull(self, max_items: int = 10000) -> list[dict]:
+        if hasattr(self.stream, "pull"):
+            try:
+                result = self.stream.pull(max_items)
+            except TypeError:
+                result, self._offset = self.stream.pull(self._offset)
+            return result or []
+        return []
+
+    def run_once(self) -> int:
+        """Process pending events; returns the number processed."""
+        import pandas as pd
+
+        events = self._pull()
+        if not events:
+            return 0
+        by_endpoint: dict[str, list[dict]] = defaultdict(list)
+        for event in events:
+            endpoint_id = self._endpoint_id(event)
+            by_endpoint[endpoint_id].append(event)
+
+        parquet_dir = get_monitoring_parquet_dir(self.project)
+        os.makedirs(parquet_dir, exist_ok=True)
+        for endpoint_id, endpoint_events in by_endpoint.items():
+            rows = []
+            latencies = []
+            errors = 0
+            for event in endpoint_events:
+                if event.get("error"):
+                    errors += 1
+                    continue
+                latencies.append(event.get("microsec", 0))
+                inputs = event.get("request", {}).get("inputs")
+                outputs = event.get("resp", {}).get("outputs")
+                rows.append({
+                    "when": event.get("when"),
+                    "model": event.get("model"),
+                    "inputs": json.dumps(inputs, default=str),
+                    "outputs": json.dumps(outputs, default=str),
+                    "microsec": event.get("microsec", 0),
+                })
+            if rows:
+                df = pd.DataFrame(rows)
+                path = os.path.join(parquet_dir, f"{endpoint_id}.parquet")
+                if os.path.isfile(path):
+                    df = pd.concat([pd.read_parquet(path), df],
+                                   ignore_index=True)
+                df.to_parquet(path, index=False)
+            self._update_endpoint(endpoint_id, endpoint_events, latencies,
+                                  errors)
+        return len(events)
+
+    @staticmethod
+    def _endpoint_id(event: dict) -> str:
+        fn = event.get("function_uri", "").replace("/", "-") or "unknown"
+        return f"{fn}.{event.get('model', 'model')}"
+
+    def _update_endpoint(self, endpoint_id: str, events: list, latencies: list,
+                         errors: int):
+        try:
+            try:
+                record = self.db.get_model_endpoint(self.project, endpoint_id)
+            except Exception:  # noqa: BLE001 - create on first event
+                first = events[0]
+                record = {
+                    "uid": endpoint_id,
+                    "project": self.project,
+                    "name": first.get("model", ""),
+                    "function_uri": first.get("function_uri", ""),
+                    "model_class": first.get("class", ""),
+                    "state": "ready",
+                    "first_request": first.get("when"),
+                    "metrics": {},
+                    "error_count": 0,
+                }
+            metrics = record.setdefault("metrics", {})
+            count = metrics.get("requests", 0) + len(latencies)
+            metrics["requests"] = count
+            if latencies:
+                prev_avg = metrics.get("avg_latency_microsec", 0)
+                prev_n = count - len(latencies)
+                metrics["avg_latency_microsec"] = (
+                    (prev_avg * prev_n + sum(latencies)) / max(count, 1))
+                metrics["max_latency_microsec"] = max(
+                    metrics.get("max_latency_microsec", 0), max(latencies))
+            record["error_count"] = record.get("error_count", 0) + errors
+            record["last_request"] = events[-1].get("when", now_iso())
+            self.db.store_model_endpoint(self.project, endpoint_id, record)
+        except Exception as exc:  # noqa: BLE001 - monitoring is best-effort
+            logger.warning("failed to update model endpoint",
+                           endpoint=endpoint_id, error=str(exc))

@@ -1,0 +1,2 @@
+from .context_handler import ContextHandler  # noqa: F401
+from .packagers_manager import Packager, PackagersManager  # noqa: F401

@@ -1,0 +1,238 @@
+"""Type → artifact codec system (reference analog:
+mlrun/package/packagers_manager.py:37 and mlrun/package/packagers/).
+
+``pack`` routes a returned python object to log_result / log_dataset /
+log_artifact / log_model by type; ``unpack`` converts a DataItem to the type
+hinted on the handler parameter. JAX pytrees and numpy arrays are first-class.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import tempfile
+from typing import Any, Optional
+
+
+class Packager:
+    """One type family's pack/unpack logic."""
+
+    handled_types: tuple = ()
+    artifact_type = "artifact"
+
+    def can_pack(self, obj: Any) -> bool:
+        return isinstance(obj, self.handled_types)
+
+    def can_unpack(self, hint) -> bool:
+        return hint in self.handled_types
+
+    def pack(self, context, obj, key: str, **cfg):
+        raise NotImplementedError
+
+    def unpack(self, data_item, hint):
+        raise NotImplementedError
+
+
+class PrimitivePackager(Packager):
+    handled_types = (int, float, str, bool, bytes)
+
+    def pack(self, context, obj, key, **cfg):
+        if isinstance(obj, bytes):
+            context.log_artifact(key, body=obj)
+        else:
+            context.log_result(key, obj)
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        if hint is bytes:
+            return raw
+        text = raw.decode() if isinstance(raw, bytes) else raw
+        if hint is str:
+            return text
+        return hint(text)
+
+
+class CollectionPackager(Packager):
+    handled_types = (dict, list, tuple, set)
+
+    def pack(self, context, obj, key, **cfg):
+        if isinstance(obj, (set, tuple)):
+            obj = list(obj)
+        # small collections → results; big → json artifact
+        blob = json.dumps(obj, default=str)
+        if len(blob) <= 1024:
+            context.log_result(key, obj)
+        else:
+            context.log_artifact(key, body=blob, format="json")
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        text = raw.decode() if isinstance(raw, bytes) else raw
+        obj = json.loads(text)
+        if hint in (tuple, set):
+            return hint(obj)
+        return obj
+
+
+class NumpyPackager(Packager):
+    artifact_type = "artifact"
+
+    def can_pack(self, obj):
+        import numpy as np
+
+        return isinstance(obj, np.ndarray)
+
+    def can_unpack(self, hint):
+        import numpy as np
+
+        return hint is np.ndarray
+
+    def pack(self, context, obj, key, **cfg):
+        if obj.ndim == 0:
+            context.log_result(key, obj.item())
+            return
+        import numpy as np
+
+        tmp = tempfile.NamedTemporaryFile(suffix=".npy", delete=False)
+        np.save(tmp.name, obj)
+        context.log_artifact(key, local_path=tmp.name, format="npy")
+
+    def unpack(self, data_item, hint):
+        import numpy as np
+
+        return np.load(data_item.local())
+
+
+class JaxPackager(Packager):
+    """JAX arrays/pytrees — device arrays land as npy artifacts, scalars as
+    results (TPU-native addition; no reference analog)."""
+
+    def can_pack(self, obj):
+        try:
+            import jax
+
+            return isinstance(obj, jax.Array)
+        except Exception:  # noqa: BLE001
+            return False
+
+    def can_unpack(self, hint):
+        try:
+            import jax
+
+            return hint is jax.Array
+        except Exception:  # noqa: BLE001
+            return False
+
+    def pack(self, context, obj, key, **cfg):
+        import numpy as np
+
+        host = np.asarray(obj)
+        if host.ndim == 0:
+            context.log_result(key, host.item())
+            return
+        tmp = tempfile.NamedTemporaryFile(suffix=".npy", delete=False)
+        np.save(tmp.name, host)
+        context.log_artifact(key, local_path=tmp.name, format="npy")
+
+    def unpack(self, data_item, hint):
+        import jax.numpy as jnp
+        import numpy as np
+
+        return jnp.asarray(np.load(data_item.local()))
+
+
+class PandasPackager(Packager):
+    artifact_type = "dataset"
+
+    def can_pack(self, obj):
+        import pandas as pd
+
+        return isinstance(obj, (pd.DataFrame, pd.Series))
+
+    def can_unpack(self, hint):
+        import pandas as pd
+
+        return hint in (pd.DataFrame, pd.Series)
+
+    def pack(self, context, obj, key, **cfg):
+        import pandas as pd
+
+        if isinstance(obj, pd.Series):
+            obj = obj.to_frame()
+        context.log_dataset(key, df=obj, format=cfg.get("file_format", "parquet"))
+
+    def unpack(self, data_item, hint):
+        import pandas as pd
+
+        df = data_item.as_df()
+        if hint is pd.Series:
+            return df.iloc[:, 0]
+        return df
+
+
+class PathPackager(Packager):
+    def can_pack(self, obj):
+        return isinstance(obj, pathlib.Path)
+
+    def can_unpack(self, hint):
+        return hint in (pathlib.Path,)
+
+    def pack(self, context, obj, key, **cfg):
+        context.log_artifact(key, local_path=str(obj))
+
+    def unpack(self, data_item, hint):
+        return pathlib.Path(data_item.local())
+
+
+class PackagersManager:
+    def __init__(self):
+        self._packagers: list[Packager] = [
+            PandasPackager(), NumpyPackager(), JaxPackager(),
+            PrimitivePackager(), CollectionPackager(), PathPackager(),
+        ]
+
+    def register(self, packager: Packager, first: bool = True):
+        if first:
+            self._packagers.insert(0, packager)
+        else:
+            self._packagers.append(packager)
+
+    def pack(self, context, obj: Any, log_hint: dict):
+        key = log_hint.get("key", "return")
+        artifact_type = log_hint.get("artifact_type")
+        if artifact_type == "result":
+            context.log_result(key, obj)
+            return
+        if artifact_type == "model":
+            context.log_model(key, body=obj if isinstance(obj, (bytes, str)) else None)
+            return
+        for packager in self._packagers:
+            try:
+                if packager.can_pack(obj):
+                    packager.pack(context, obj, key, **{
+                        k: v for k, v in log_hint.items()
+                        if k not in ("key", "artifact_type")})
+                    return
+            except ImportError:
+                continue
+        # fallback: stringify into a result
+        context.log_result(key, str(obj))
+
+    def unpack(self, data_item, hint):
+        if hint is None or hint is Any:
+            return data_item
+        from ..datastore.base import DataItem
+
+        if hint is DataItem:
+            return data_item
+        if hint in (str,) and data_item.kind == "file":
+            # mirror the reference convention: str hint on an input = local path
+            return data_item.local()
+        for packager in self._packagers:
+            try:
+                if packager.can_unpack(hint):
+                    return packager.unpack(data_item, hint)
+            except ImportError:
+                continue
+        return data_item

@@ -1,0 +1,325 @@
+"""Workflow engines (reference analog: mlrun/projects/pipelines.py —
+_KFPRunner :542, _LocalRunner :673, _RemoteRunner :756, load_and_run :987).
+
+The local engine executes the step DAG in-process in topological order; the
+remote engine submits the workflow to the service, which runs it in a runner
+job (reference server/api/crud/workflows.py:31). A KFP adapter can compile the
+same DAG when kfp is importable.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..common.runtimes_constants import RunStates
+from ..config import mlconf
+from ..model import RunObject
+from ..utils import generate_uid, logger, now_iso
+
+
+class PipelineStep:
+    """A deferred function invocation inside a workflow (fn.as_step)."""
+
+    def __init__(self, function=None, runspec=None, handler=None, name="",
+                 project="", params=None, inputs=None, outputs=None,
+                 artifact_path="", image="", returns=None, **kwargs):
+        self.function = function
+        self.runspec = runspec
+        self.handler = handler
+        self.name = name or (function.metadata.name if function else "step")
+        self.project = project
+        self.params = params or {}
+        self.inputs = inputs or {}
+        self.outputs = outputs or []
+        self.returns = returns
+        self.artifact_path = artifact_path
+        self.image = image
+        self.kwargs = kwargs
+        self.after_steps: list["PipelineStep"] = []
+        self._run: Optional[RunObject] = None
+
+    def after(self, *steps: "PipelineStep") -> "PipelineStep":
+        self.after_steps.extend(steps)
+        return self
+
+    @property
+    def outputs_resolved(self) -> dict:
+        if self._run is None:
+            return {}
+        return self._run.outputs
+
+    def output(self, key: str):
+        """Reference a named output of this step (resolved lazily when the
+        local engine executes)."""
+        return _StepOutput(self, key)
+
+    def run(self, context: "PipelineContext") -> RunObject:
+        params = {
+            key: (value.resolve() if isinstance(value, _StepOutput) else value)
+            for key, value in self.params.items()
+        }
+        inputs = {
+            key: (value.resolve() if isinstance(value, _StepOutput) else value)
+            for key, value in self.inputs.items()
+        }
+        function = self.function
+        if self.image:
+            function.spec.image = self.image
+        run = function.run(
+            self.runspec, handler=self.handler, name=self.name,
+            project=self.project or context.project_name, params=params,
+            inputs=inputs, artifact_path=self.artifact_path
+            or context.artifact_path, local=context.local,
+            watch=context.watch, returns=self.returns, **self.kwargs)
+        self._run = run
+        return run
+
+
+class _StepOutput:
+    def __init__(self, step: PipelineStep, key: str):
+        self.step = step
+        self.key = key
+
+    def resolve(self):
+        if self.step._run is None:
+            raise RuntimeError(
+                f"step '{self.step.name}' has not executed yet")
+        value = self.step._run.output(self.key)
+        if value is None:
+            raise KeyError(
+                f"step '{self.step.name}' has no output '{self.key}'")
+        return value
+
+
+class PipelineContext:
+    """State for one workflow execution."""
+
+    def __init__(self, project=None, workflow_name: str = "", local=True,
+                 watch=False, artifact_path: str = "", args: dict | None = None):
+        self.project = project
+        self.project_name = project.name if project is not None else ""
+        self.workflow_name = workflow_name
+        self.local = local
+        self.watch = watch
+        self.artifact_path = artifact_path
+        self.args = args or {}
+        self.workflow_id = uuid.uuid4().hex
+        self.runs: list[RunObject] = []
+        self.state = RunStates.running
+        self.error: Optional[str] = None
+
+
+# module-level pipeline context used by workflow python files
+_current_context: Optional[PipelineContext] = None
+_context_lock = threading.Lock()
+
+
+def pipeline_context() -> Optional[PipelineContext]:
+    return _current_context
+
+
+class _PipelineRunStatus:
+    """Returned by project.run() (reference pipelines.py _PipelineRunStatus)."""
+
+    def __init__(self, run_id: str, engine: "_PipelineRunner", project,
+                 workflow=None, state: str = ""):
+        self.run_id = run_id
+        self._engine = engine
+        self.project = project
+        self.workflow = workflow
+        self._state = state
+        self.runs: list[RunObject] = []
+        self.error: Optional[str] = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def wait_for_completion(self, timeout=3600, expected_statuses=None):
+        return self._engine.wait_for_completion(
+            self, timeout=timeout, expected_statuses=expected_statuses)
+
+    def __str__(self):
+        return self.run_id
+
+
+class _PipelineRunner:
+    engine = "base"
+
+    @classmethod
+    def run(cls, project, workflow_spec, name="", workflow_handler=None,
+            secrets=None, artifact_path=None, namespace=None, source=None,
+            args=None, local=True, watch=False) -> _PipelineRunStatus:
+        raise NotImplementedError
+
+    @classmethod
+    def wait_for_completion(cls, run_status, timeout=3600,
+                            expected_statuses=None):
+        return run_status.state
+
+
+class _LocalRunner(_PipelineRunner):
+    """Execute workflow steps inline (reference pipelines.py:673)."""
+
+    engine = "local"
+
+    @classmethod
+    def run(cls, project, workflow_spec, name="", workflow_handler=None,
+            secrets=None, artifact_path=None, namespace=None, source=None,
+            args=None, local=True, watch=False) -> _PipelineRunStatus:
+        global _current_context
+
+        handler = workflow_handler or _load_workflow_handler(
+            workflow_spec, project)
+        context = PipelineContext(
+            project=project, workflow_name=name, local=local, watch=watch,
+            artifact_path=artifact_path or project.spec.artifact_path,
+            args=args)
+        with _context_lock:
+            _current_context = context
+        status = _PipelineRunStatus(context.workflow_id, cls, project,
+                                    workflow=workflow_spec)
+        try:
+            handler(**(args or {}))
+            context.state = RunStates.completed
+        except Exception as exc:  # noqa: BLE001 - workflow error → status
+            context.state = RunStates.error
+            context.error = str(exc)
+            logger.error("workflow failed", name=name, error=str(exc))
+        finally:
+            with _context_lock:
+                _current_context = None
+        status._state = context.state
+        status.runs = context.runs
+        status.error = context.error
+        if context.state == RunStates.error:
+            raise RuntimeError(f"workflow {name} failed: {context.error}")
+        return status
+
+
+class _RemoteRunner(_PipelineRunner):
+    """Submit the workflow to the service (reference pipelines.py:756)."""
+
+    engine = "remote"
+
+    @classmethod
+    def run(cls, project, workflow_spec, name="", workflow_handler=None,
+            secrets=None, artifact_path=None, namespace=None, source=None,
+            args=None, local=False, watch=False) -> _PipelineRunStatus:
+        from ..db import get_run_db
+
+        db = get_run_db()
+        run_id = db.submit_pipeline(
+            project.name, workflow_spec if isinstance(workflow_spec, dict)
+            else workflow_spec.to_dict(),
+            arguments=args, artifact_path=artifact_path)
+        return _PipelineRunStatus(run_id, cls, project, workflow=workflow_spec,
+                                  state=RunStates.running)
+
+    @classmethod
+    def wait_for_completion(cls, run_status, timeout=3600,
+                            expected_statuses=None):
+        return wait_for_run_completion(
+            run_status.run_id, timeout=timeout,
+            project=run_status.project.name,
+            expected_statuses=expected_statuses)
+
+
+class _KFPRunner(_PipelineRunner):
+    """Compile the workflow to Kubeflow Pipelines when kfp is available
+    (reference pipelines.py:542)."""
+
+    engine = "kfp"
+
+    @classmethod
+    def run(cls, project, workflow_spec, name="", workflow_handler=None,
+            secrets=None, artifact_path=None, namespace=None, source=None,
+            args=None, local=False, watch=False) -> _PipelineRunStatus:
+        try:
+            import kfp  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "the kfp engine requires the 'kfp' package; use "
+                "engine='local' or engine='remote' instead") from exc
+        raise NotImplementedError(
+            "kfp compilation is not wired yet; use engine='local'/'remote'")
+
+
+def get_workflow_engine(engine: str = "", local: bool = False):
+    if local or engine in ("", "local"):
+        return _LocalRunner
+    if engine == "remote":
+        return _RemoteRunner
+    if engine == "kfp":
+        return _KFPRunner
+    raise ValueError(f"unsupported workflow engine '{engine}'")
+
+
+def _load_workflow_handler(workflow_spec, project) -> Callable:
+    path = workflow_spec.get("path") if isinstance(workflow_spec, dict) \
+        else getattr(workflow_spec, "path", "")
+    handler_name = (workflow_spec.get("handler")
+                    if isinstance(workflow_spec, dict)
+                    else getattr(workflow_spec, "handler", "")) or "pipeline"
+    if not path:
+        raise ValueError("workflow has no code path")
+    if project is not None and project.spec.context and not os.path.isabs(path):
+        path = os.path.join(project.spec.context, path)
+    module_name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(module_name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    if not hasattr(module, handler_name):
+        # fall back to any function decorated or named main
+        for candidate in ("main", "kfpipeline", "workflow"):
+            if hasattr(module, candidate):
+                handler_name = candidate
+                break
+        else:
+            raise ValueError(
+                f"workflow handler '{handler_name}' not found in {path}")
+    return getattr(module, handler_name)
+
+
+def load_and_run(context, url: str = "", project_name: str = "",
+                 workflow_name: str = "", workflow_path: str = "",
+                 workflow_arguments: dict | None = None,
+                 artifact_path: str = ""):
+    """Entry used by the server's workflow-runner job
+    (reference pipelines.py:987)."""
+    from . import load_project
+
+    project = load_project(context="./", url=url, name=project_name)
+    return project.run(
+        name=workflow_name, workflow_path=workflow_path,
+        arguments=workflow_arguments, artifact_path=artifact_path,
+        engine="local")
+
+
+def wait_for_run_completion(run_id, timeout: float = 3600, project: str = "",
+                            expected_statuses: list | None = None) -> str:
+    """Poll the service for a workflow run's state."""
+    from ..db import get_run_db
+
+    db = get_run_db()
+    deadline = time.monotonic() + timeout
+    state = RunStates.running
+    while time.monotonic() < deadline:
+        try:
+            resp = db.api_call(
+                "GET", f"projects/{project or mlconf.default_project}/"
+                f"workflows/{run_id}")
+            state = resp.get("state", RunStates.running)
+        except Exception:  # noqa: BLE001 - transient api errors tolerated
+            pass
+        if state in RunStates.terminal_states():
+            break
+        time.sleep(2)
+    if expected_statuses and state not in expected_statuses:
+        raise RuntimeError(f"workflow {run_id} ended in state {state}")
+    return state

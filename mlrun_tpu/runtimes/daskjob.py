@@ -1,0 +1,68 @@
+"""Dask runtime (reference analog: mlrun/runtimes/daskjob.py:186 DaskCluster).
+
+Client-side ephemeral dask cluster for dataframe-parallel work and as a
+hyper-param parallel engine. On TPU deployments this remains an
+orchestration-level (CPU) engine; tensor work belongs to tpujob.
+"""
+
+from __future__ import annotations
+
+from ..common.runtimes_constants import RuntimeKinds
+from ..model import RunObject
+from ..utils import logger
+from .pod import KubeResource, KubeResourceSpec
+
+
+class DaskSpec(KubeResourceSpec):
+    _dict_fields = KubeResourceSpec._dict_fields + [
+        "min_replicas", "max_replicas", "scheduler_timeout",
+    ]
+
+    def __init__(self, min_replicas=None, max_replicas=None,
+                 scheduler_timeout=None, **kwargs):
+        super().__init__(**kwargs)
+        self.min_replicas = min_replicas or 0
+        self.max_replicas = max_replicas or 4
+        self.scheduler_timeout = scheduler_timeout or "60 minutes"
+
+
+class DaskRuntime(KubeResource):
+    kind = RuntimeKinds.dask
+    _is_remote = False  # the cluster is remote, but run() drives it client-side
+    _nested_fields = {**KubeResource._nested_fields, "spec": DaskSpec}
+
+    def __init__(self, metadata=None, spec=None, status=None):
+        super().__init__(metadata, spec, status)
+        if not isinstance(self.spec, DaskSpec):
+            self.spec = DaskSpec.from_dict(self.spec.to_dict())
+        self._cluster = None
+
+    @property
+    def client(self):
+        """Return a dask client — local cluster if dask is importable."""
+        try:
+            from dask.distributed import Client, LocalCluster
+        except ImportError as exc:
+            raise ImportError(
+                "dask is not installed in this environment") from exc
+        if self._cluster is None:
+            self._cluster = LocalCluster(
+                n_workers=max(1, self.spec.min_replicas or 1),
+                threads_per_worker=2)
+        return Client(self._cluster)
+
+    def close(self):
+        if self._cluster is not None:
+            self._cluster.close()
+            self._cluster = None
+
+    def _run(self, runobj: RunObject, execution) -> dict:
+        from .local import exec_from_params, load_module
+
+        handler = runobj.spec.handler
+        if not callable(handler):
+            command = self.spec.command
+            if not command:
+                raise ValueError("dask runtime needs a handler or command")
+            handler = load_module(command, runobj.spec.handler_name or "handler")
+        return exec_from_params(handler, runobj, execution)

@@ -1,0 +1,59 @@
+"""Kubernetes job runtime (reference analog: mlrun/runtimes/kubejob.py:27
+KubejobRuntime — client side; the pod is created by the service's runtime
+handler, reference server/api/runtime_handlers/kubejob.py:45)."""
+
+from __future__ import annotations
+
+from ..common.runtimes_constants import RuntimeKinds
+from ..model import RunObject
+from ..utils import logger
+from .pod import KubeResource
+
+
+class KubejobRuntime(KubeResource):
+    kind = RuntimeKinds.job
+    _is_remote = True
+
+    @property
+    def is_deployed(self) -> bool:
+        """True when the function image exists (reference kubejob.py:115)."""
+        if self.spec.image:
+            return True
+        build = self.spec.build
+        return not (build and (build.source or build.commands
+                               or build.requirements))
+
+    def build_config(self, image: str = "", base_image: str = "",
+                     commands: list | None = None, requirements: list | None = None,
+                     source: str = ""):
+        build = self.spec.build
+        build.image = image or build.image
+        build.base_image = base_image or build.base_image
+        if commands:
+            build.commands = (build.commands or []) + list(commands)
+        if requirements:
+            build.requirements = (build.requirements or []) + list(requirements)
+        build.source = source or build.source
+        return self
+
+    def deploy(self, watch: bool = True, with_tpu: bool = False,
+               skip_deployed: bool = False) -> bool:
+        """Request a remote image build from the service
+        (reference kubejob.py:144; Kaniko analog server-side)."""
+        if skip_deployed and self.is_deployed:
+            return True
+        db = self._get_db()
+        resp = db.remote_builder(self, with_tpu=with_tpu)
+        status = resp.get("data", {}).get("status", {})
+        self.spec.image = status.get("image") or self.spec.image
+        state = status.get("state", "ready")
+        logger.info("function build finished", image=self.spec.image,
+                    state=state)
+        return state == "ready"
+
+    def _run(self, runobj: RunObject, execution) -> dict:
+        # runs happen server-side; reaching here means misconfiguration
+        # (reference kubejob.py:214 raises the same way)
+        raise RuntimeError(
+            "the job runtime executes on the cluster — configure MLT_DBPATH "
+            "to point at the service, or pass local=True to run in-process")

@@ -1,0 +1,141 @@
+"""Kubernetes pod-based resource spec (reference analog: mlrun/runtimes/pod.py
+KubeResource/KubeResourceSpec; with_limits gpu_type='nvidia.com/gpu' at
+pod.py:458-476 is replaced by ``google.com/tpu`` chip requests + GKE TPU node
+selectors)."""
+
+from __future__ import annotations
+
+from ..config import mlconf
+from ..model import ModelObj
+from .base import BaseRuntime, FunctionSpec
+
+
+class KubeResourceSpec(FunctionSpec):
+    _dict_fields = FunctionSpec._dict_fields + [
+        "volumes", "volume_mounts", "affinity", "tolerations",
+        "security_context",
+    ]
+
+    def __init__(self, volumes=None, volume_mounts=None, affinity=None,
+                 tolerations=None, security_context=None, **kwargs):
+        super().__init__(**kwargs)
+        self.volumes = volumes or []
+        self.volume_mounts = volume_mounts or []
+        self.affinity = affinity
+        self.tolerations = tolerations or []
+        self.security_context = security_context
+
+
+class KubeResource(BaseRuntime):
+    """Base for all pod-creating runtimes."""
+
+    kind = "pod"
+    _is_remote = True
+    _nested_fields = {**BaseRuntime._nested_fields, "spec": KubeResourceSpec}
+
+    def __init__(self, metadata=None, spec=None, status=None):
+        super().__init__(metadata, spec, status)
+        if not isinstance(self.spec, KubeResourceSpec):
+            self.spec = KubeResourceSpec.from_dict(
+                self.spec.to_dict() if isinstance(self.spec, ModelObj)
+                else (self.spec or {}))
+
+    # -- resources ---------------------------------------------------------
+    def with_requests(self, mem: str | None = None, cpu: str | None = None):
+        requests = self.spec.resources.setdefault("requests", {})
+        if mem:
+            requests["memory"] = mem
+        if cpu:
+            requests["cpu"] = cpu
+        return self
+
+    def with_limits(self, mem: str | None = None, cpu: str | None = None,
+                    tpus: int | None = None,
+                    tpu_type: str | None = None):
+        """Set container limits. ``tpus`` requests TPU chips via
+        ``google.com/tpu`` (replacing nvidia.com/gpu in the reference)."""
+        limits = self.spec.resources.setdefault("limits", {})
+        if mem:
+            limits["memory"] = mem
+        if cpu:
+            limits["cpu"] = cpu
+        if tpus is not None:
+            limits[tpu_type or mlconf.tpu.resource_name] = tpus
+        return self
+
+    def with_tpu(self, chips: int = 4, accelerator: str | None = None,
+                 topology: str | None = None):
+        """Request TPU chips + GKE node selectors for accelerator/topology."""
+        self.with_limits(tpus=chips)
+        self.spec.node_selector[mlconf.tpu.accelerator_node_selector] = (
+            accelerator or mlconf.tpu.default_accelerator)
+        self.spec.node_selector[mlconf.tpu.topology_node_selector] = (
+            topology or mlconf.tpu.default_topology)
+        return self
+
+    def with_node_selection(self, node_selector: dict | None = None,
+                            affinity=None, tolerations=None):
+        if node_selector:
+            self.spec.node_selector.update(node_selector)
+        if affinity is not None:
+            self.spec.affinity = affinity
+        if tolerations is not None:
+            self.spec.tolerations = tolerations
+        return self
+
+    def with_priority_class(self, name: str):
+        self.spec.priority_class_name = name
+        return self
+
+    def with_preemption_mode(self, mode: str):
+        # allow | constrain | prevent — on GKE TPU this maps to spot/reserved
+        self.spec.preemption_mode = mode
+        return self
+
+    def apply(self, modifier):
+        """Apply a pod modifier (mount decorators, reference platforms/)."""
+        modifier(self)
+        return self
+
+    def set_state_thresholds(self, thresholds: dict):
+        self.spec.state_thresholds.update(thresholds)
+        return self
+
+    # -- pod building (used by server-side runtime handlers & tests) -------
+    def _container_env(self, extra_env: dict | None = None) -> list[dict]:
+        env = [dict(e) for e in self.spec.env]
+        for key, value in (extra_env or {}).items():
+            env.append({"name": key, "value": str(value)})
+        return env
+
+    def to_pod_spec(self, command: list[str] | None = None,
+                    extra_env: dict | None = None) -> dict:
+        container = {
+            "name": "main",
+            "image": self.full_image_path(),
+            "env": self._container_env(extra_env),
+            "resources": self.spec.resources,
+        }
+        if command:
+            container["command"] = command
+        if self.spec.args:
+            container["args"] = list(self.spec.args)
+        if self.spec.workdir:
+            container["workingDir"] = self.spec.workdir
+        if self.spec.volume_mounts:
+            container["volumeMounts"] = self.spec.volume_mounts
+        pod_spec = {
+            "containers": [container],
+            "restartPolicy": "Never",
+        }
+        if self.spec.volumes:
+            pod_spec["volumes"] = self.spec.volumes
+        if self.spec.node_selector:
+            pod_spec["nodeSelector"] = dict(self.spec.node_selector)
+        if self.spec.tolerations:
+            pod_spec["tolerations"] = self.spec.tolerations
+        if self.spec.service_account:
+            pod_spec["serviceAccountName"] = self.spec.service_account
+        if self.spec.priority_class_name:
+            pod_spec["priorityClassName"] = self.spec.priority_class_name
+        return pod_spec

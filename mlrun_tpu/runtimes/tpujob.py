@@ -1,0 +1,135 @@
+"""TPU pod-slice runtime — the distributed-training centerpiece.
+
+Replaces the reference's MPI runtime (mlrun/runtimes/mpijob/abstract.py:23
+MPIResourceSpec with NCCL env defaults :89-96, AbstractMPIJobRuntime :98,
+MpiRuntimeV1 v1.py:88). Instead of launcher+worker MPIJob CRDs and Horovod,
+a ``tpujob`` provisions a GKE JobSet of identical SPMD pods over one or more
+TPU slices (see mlrun_tpu/k8s/jobset.py); JAX's collective runtime replaces
+mpirun/NCCL, and shardings are declared on the train step via
+``mlrun_tpu.parallel`` (XLA emits the ICI/DCN collectives).
+"""
+
+from __future__ import annotations
+
+from ..common.runtimes_constants import RuntimeKinds
+from ..config import mlconf
+from ..k8s.jobset import chips_in_topology, hosts_for_topology
+from ..model import RunObject
+from ..utils import logger
+from .pod import KubeResource, KubeResourceSpec
+
+
+class TpuJobSpec(KubeResourceSpec):
+    _dict_fields = KubeResourceSpec._dict_fields + [
+        "accelerator_type", "topology", "num_slices", "chips_per_host",
+        "max_restarts", "mesh_shape", "mesh_axes",
+    ]
+
+    def __init__(self, accelerator_type=None, topology=None, num_slices=None,
+                 chips_per_host=None, max_restarts=None, mesh_shape=None,
+                 mesh_axes=None, **kwargs):
+        super().__init__(**kwargs)
+        self.accelerator_type = accelerator_type or mlconf.tpu.default_accelerator
+        self.topology = topology or mlconf.tpu.default_topology
+        self.num_slices = num_slices or 1
+        self.chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+        # restart the whole JobSet on preemption; checkpoint-resume picks up
+        self.max_restarts = max_restarts if max_restarts is not None else 3
+        self.mesh_shape = mesh_shape
+        self.mesh_axes = mesh_axes
+
+
+class TpuJobRuntime(KubeResource):
+    kind = RuntimeKinds.tpujob
+    _is_remote = True
+    _nested_fields = {**KubeResource._nested_fields, "spec": TpuJobSpec}
+
+    def __init__(self, metadata=None, spec=None, status=None):
+        super().__init__(metadata, spec, status)
+        if not isinstance(self.spec, TpuJobSpec):
+            self.spec = TpuJobSpec.from_dict(self.spec.to_dict())
+
+    # -- TPU topology ------------------------------------------------------
+    def with_tpu_topology(self, accelerator: str | None = None,
+                          topology: str | None = None, num_slices: int = 1,
+                          chips_per_host: int | None = None):
+        """Declare the slice shape, e.g.
+        ``fn.with_tpu_topology("tpu-v5-lite-podslice", "8x8")`` for a v5e-64.
+        """
+        if accelerator:
+            self.spec.accelerator_type = accelerator
+        if topology:
+            self.spec.topology = topology
+        self.spec.num_slices = num_slices
+        if chips_per_host:
+            self.spec.chips_per_host = chips_per_host
+        return self
+
+    def with_mesh(self, shape: dict | None = None, axes: list | None = None):
+        """Declare the default logical mesh for the auto-trainer, e.g.
+        ``with_mesh({"data": 1, "fsdp": 16, "tensor": 4})``."""
+        if shape:
+            self.spec.mesh_shape = dict(shape)
+        if axes:
+            self.spec.mesh_axes = list(axes)
+        return self
+
+    def with_preemptible(self, spot: bool = True):
+        if spot:
+            self.spec.node_selector["cloud.google.com/gke-spot"] = "true"
+        else:
+            self.spec.node_selector.pop("cloud.google.com/gke-spot", None)
+        return self
+
+    @property
+    def total_chips(self) -> int:
+        return chips_in_topology(self.spec.topology) * self.spec.num_slices
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return hosts_for_topology(self.spec.topology, self.spec.chips_per_host)
+
+    def full_image_path(self, image: str | None = None) -> str:
+        return image or self.spec.image or mlconf.function.tpu_image
+
+    # -- execution ---------------------------------------------------------
+    def _run(self, runobj: RunObject, execution) -> dict:
+        raise RuntimeError(
+            "the tpujob runtime provisions TPU slices via the service — "
+            "configure MLT_DBPATH, or pass local=True to execute the handler "
+            "in-process on locally visible devices")
+
+    def generate_jobset(self, runobj: RunObject, extra_env: dict | None = None,
+                        command: list[str] | None = None) -> dict:
+        """Build the JobSet resource for this run (used by the server-side
+        runtime handler and asserted by control-plane tests, mirroring the
+        reference's MPIJob handler tests)."""
+        import json
+
+        from ..k8s.jobset import build_jobset
+
+        env = {
+            mlconf.exec_config_env: json.dumps(runobj.to_dict(), default=str),
+            "MLT_DBPATH": mlconf.get("dbpath", ""),
+        }
+        env.update(extra_env or {})
+        pod_spec = self.to_pod_spec(
+            command=command or ["mlrun-tpu", "run", "--from-env"],
+            extra_env=env)
+        name = f"{runobj.metadata.name}-{runobj.metadata.uid[:8]}"
+        return build_jobset(
+            name=name,
+            namespace=mlconf.namespace,
+            pod_spec=pod_spec,
+            accelerator=self.spec.accelerator_type,
+            topology=self.spec.topology,
+            num_slices=self.spec.num_slices,
+            chips_per_host=self.spec.chips_per_host,
+            max_restarts=self.spec.max_restarts,
+            labels={
+                "mlrun-tpu/project": runobj.metadata.project,
+                "mlrun-tpu/uid": runobj.metadata.uid,
+                "mlrun-tpu/name": runobj.metadata.name,
+                "mlrun-tpu/class": self.kind,
+            },
+        )

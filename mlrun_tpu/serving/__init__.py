@@ -1,0 +1,27 @@
+from .remote import BatchHttpRequests, RemoteStep  # noqa: F401
+from .routers import (  # noqa: F401
+    EnrichmentModelRouter,
+    EnrichmentVotingEnsemble,
+    ModelRouter,
+    ParallelRun,
+    VotingEnsemble,
+)
+from .server import (  # noqa: F401
+    GraphContext,
+    GraphServer,
+    MockEvent,
+    MockTrigger,
+    Response,
+    create_graph_server,
+    v2_serving_handler,
+    v2_serving_init,
+)
+from .states import (  # noqa: F401
+    BaseStep,
+    FlowStep,
+    QueueStep,
+    RootFlowStep,
+    RouterStep,
+    TaskStep,
+)
+from .v2_serving import TpuModelServer, V2ModelServer  # noqa: F401

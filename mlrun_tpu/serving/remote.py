@@ -1,0 +1,97 @@
+"""Remote http steps (reference analog: mlrun/serving/remote.py:39 RemoteStep,
+:241 BatchHttpRequests)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+from typing import Optional
+
+from ..utils import logger
+
+
+class RemoteStep:
+    """Call an external http endpoint as a graph step."""
+
+    def __init__(self, context=None, name: str | None = None, url: str = "",
+                 subpath: str = "", method: str = "POST",
+                 headers: dict | None = None, return_json: bool = True,
+                 timeout: int = 30, retries: int = 2, url_expression: str = "",
+                 body_expression: str = "", **kwargs):
+        self.context = context
+        self.name = name
+        self.url = url
+        self.subpath = subpath
+        self.method = method
+        self.headers = headers or {}
+        self.return_json = return_json
+        self.timeout = timeout
+        self.retries = retries
+        self.url_expression = url_expression
+        self.body_expression = body_expression
+
+    def post_init(self, mode: str = "sync"):
+        pass
+
+    def _resolve_url(self, event) -> str:
+        if self.url_expression:
+            return eval(self.url_expression, {"__builtins__": {}},
+                        {"event": event})
+        url = self.url.rstrip("/")
+        if self.subpath:
+            url += "/" + self.subpath.lstrip("/")
+        return url
+
+    def do_event(self, event):
+        import requests
+
+        url = self._resolve_url(event)
+        body = event.body
+        if self.body_expression:
+            body = eval(self.body_expression, {"__builtins__": {}},
+                        {"event": event})
+        kwargs = {}
+        if self.method.upper() != "GET" and body is not None:
+            if isinstance(body, (dict, list)):
+                kwargs["json"] = body
+            else:
+                kwargs["data"] = body
+        last_exc = None
+        for _ in range(self.retries + 1):
+            try:
+                resp = requests.request(
+                    self.method.upper(), url, headers=self.headers,
+                    timeout=self.timeout, **kwargs)
+                resp.raise_for_status()
+                event.body = resp.json() if self.return_json else resp.content
+                return event
+            except Exception as exc:  # noqa: BLE001 - retried
+                last_exc = exc
+        raise RuntimeError(f"remote step {self.name} failed: {last_exc}")
+
+
+class BatchHttpRequests(RemoteStep):
+    """Issue one request per list item concurrently (reference remote.py:241)."""
+
+    def __init__(self, *args, max_in_flight: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.max_in_flight = max_in_flight
+
+    def do_event(self, event):
+        import requests
+
+        items = event.body if isinstance(event.body, list) else [event.body]
+        url = self._resolve_url(event)
+
+        def call(item):
+            resp = requests.request(
+                self.method.upper(), url, headers=self.headers,
+                timeout=self.timeout,
+                json=item if isinstance(item, (dict, list)) else None)
+            resp.raise_for_status()
+            return resp.json() if self.return_json else resp.content
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.max_in_flight) as pool:
+            event.body = list(pool.map(call, items))
+        return event

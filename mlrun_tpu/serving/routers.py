@@ -1,0 +1,216 @@
+"""Routers & ensembles (reference analog: mlrun/serving/routers.py:167
+ModelRouter, :245 ParallelRun, :480 VotingEnsemble)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import copy
+from typing import Optional, Union
+
+import numpy as np
+
+from ..utils import logger
+
+
+class BaseModelRouter:
+    """Route events to child model steps by url path or body field."""
+
+    def __init__(self, context=None, name: str | None = None,
+                 routes: dict | None = None, protocol: str = "v2",
+                 url_prefix: str | None = None, health_prefix: str | None = None,
+                 **kwargs):
+        self.context = context
+        self.name = name or "router"
+        self.routes = routes or {}
+        self.protocol = protocol
+        self.url_prefix = url_prefix or f"/{self.protocol}/models"
+        self.health_prefix = health_prefix or f"/{self.protocol}/health"
+        self.inputs_key = "inputs"
+        self._kwargs = kwargs
+
+    def post_init(self, mode: str = "sync"):
+        pass
+
+    def parse_event(self, event):
+        """Normalize body: allow raw lists as {'inputs': [...]}."""
+        body = event.body
+        if isinstance(body, (list, np.ndarray)):
+            event.body = {self.inputs_key: body}
+        return event
+
+    def _resolve_route(self, event) -> tuple[str, str]:
+        """Return (model_name, op) parsed from the path or body."""
+        path = getattr(event, "path", "/") or "/"
+        if path.startswith(self.url_prefix):
+            rest = path[len(self.url_prefix):].strip("/")
+            parts = rest.split("/") if rest else []
+            model = parts[0] if parts else ""
+            op = parts[1] if len(parts) > 1 else "infer"
+            return model, op
+        body = event.body
+        if isinstance(body, dict):
+            return body.get("model", ""), body.get("operation", "infer")
+        return "", "infer"
+
+    def do_event(self, event, *args, **kwargs):
+        event = self.parse_event(event)
+        path = getattr(event, "path", "/") or "/"
+        if path.startswith(self.health_prefix) or path in ("/", ""):
+            if getattr(event, "method", "GET") == "GET" and not isinstance(
+                    event.body, dict):
+                event.body = {
+                    "models": list(self.routes.keys()),
+                    "router": self.name,
+                }
+                return event
+        model, op = self._resolve_route(event)
+        if not model:
+            if len(self.routes) == 1:
+                model = next(iter(self.routes))
+            else:
+                event.body = {"models": list(self.routes.keys())}
+                return event
+        if model not in self.routes:
+            raise ValueError(
+                f"model '{model}' not found in routes {list(self.routes)}")
+        return self.routes[model].run(event)
+
+
+class ModelRouter(BaseModelRouter):
+    """Default router (reference routers.py:167)."""
+
+
+class ParallelRun(BaseModelRouter):
+    """Fan an event to all routes in parallel and merge results
+    (reference routers.py:245; thread pool executor)."""
+
+    def __init__(self, *args, extend_event=None, executor_type: str = "thread",
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.executor_type = executor_type
+        self.extend_event = extend_event
+
+    def merger(self, body: dict, results: dict) -> dict:
+        for result in results.values():
+            if isinstance(result, dict):
+                body.update(result)
+        return body
+
+    def do_event(self, event, *args, **kwargs):
+        event = self.parse_event(event)
+        results = {}
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, len(self.routes))) as pool:
+            futures = {
+                name: pool.submit(step.run, copy.copy(event))
+                for name, step in self.routes.items()
+            }
+            for name, future in futures.items():
+                out = future.result()
+                results[name] = out.body if hasattr(out, "body") else out
+        body = event.body if isinstance(event.body, dict) else {}
+        event.body = self.merger(body, results)
+        return event
+
+
+class VotingTypes:
+    classification = "classification"
+    regression = "regression"
+
+
+class VotingEnsemble(BaseModelRouter):
+    """Send the event to all models and vote/average
+    (reference routers.py:480)."""
+
+    def __init__(self, *args, vote_type: str | None = None,
+                 weights: dict | None = None, prediction_col_name: str = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.vote_type = vote_type
+        self.weights = weights or {}
+        self.prediction_col_name = prediction_col_name or "prediction"
+
+    def _vote(self, predictions: dict[str, list]) -> list:
+        names = list(predictions.keys())
+        arrays = [np.asarray(predictions[n], dtype=float) for n in names]
+        stacked = np.stack(arrays)  # [models, batch, ...]
+        weights = np.asarray(
+            [self.weights.get(n, 1.0) for n in names], dtype=float)
+        weights = weights / weights.sum()
+        vote_type = self.vote_type or (
+            VotingTypes.classification
+            if np.allclose(stacked, np.round(stacked))
+            else VotingTypes.regression)
+        if vote_type == VotingTypes.regression:
+            return np.tensordot(weights, stacked, axes=1).tolist()
+        # weighted majority per sample
+        out = []
+        flat = stacked.reshape(stacked.shape[0], -1)
+        for col in range(flat.shape[1]):
+            votes: dict = {}
+            for m, w in enumerate(weights):
+                votes[flat[m, col]] = votes.get(flat[m, col], 0.0) + w
+            out.append(max(votes.items(), key=lambda kv: kv[1])[0])
+        return np.asarray(out).reshape(stacked.shape[1:]).tolist()
+
+    def do_event(self, event, *args, **kwargs):
+        event = self.parse_event(event)
+        path = getattr(event, "path", "/") or "/"
+        model, op = self._resolve_route(event)
+        if model and model in self.routes:
+            # direct route to a specific member model
+            return self.routes[model].run(event)
+        if op in ("metrics", "ready") or (
+                getattr(event, "method", "POST") == "GET"):
+            event.body = {"models": list(self.routes.keys()),
+                          "router": self.name}
+            return event
+        predictions = {}
+        for name, step in self.routes.items():
+            sub = copy.copy(event)
+            sub.body = copy.deepcopy(event.body)
+            out = step.run(sub)
+            body = out.body if hasattr(out, "body") else out
+            predictions[name] = body.get("outputs") if isinstance(body, dict) \
+                else body
+        voted = self._vote(predictions)
+        event.body = {
+            "id": getattr(event, "id", None),
+            "model_name": self.name,
+            "outputs": voted,
+            "model_version": "v1",
+        }
+        return event
+
+
+class EnrichmentModelRouter(ModelRouter):
+    """Router that enriches the event with feature-store features before
+    routing (reference routers.py:1118)."""
+
+    def __init__(self, *args, feature_vector_uri: str = "",
+                 impute_policy: dict | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.feature_vector_uri = feature_vector_uri
+        self.impute_policy = impute_policy or {}
+        self._service = None
+
+    def post_init(self, mode: str = "sync"):
+        if self.feature_vector_uri:
+            from ..feature_store import get_online_feature_service
+
+            self._service = get_online_feature_service(
+                self.feature_vector_uri, impute_policy=self.impute_policy)
+
+    def parse_event(self, event):
+        event = super().parse_event(event)
+        if self._service is not None and isinstance(event.body, dict):
+            entities = event.body.get(self.inputs_key, [])
+            enriched = self._service.get(
+                [e if isinstance(e, dict) else {"id": e} for e in entities],
+                as_list=True)
+            event.body[self.inputs_key] = enriched
+        return event
+
+
+class EnrichmentVotingEnsemble(VotingEnsemble, EnrichmentModelRouter):
+    """Voting ensemble with feature enrichment (reference routers.py:1199)."""

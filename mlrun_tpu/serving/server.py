@@ -1,0 +1,266 @@
+"""Graph server (reference analog: mlrun/serving/server.py:86 GraphServer,
+:252 run, :315 v2_serving_init, :387 v2_serving_handler, :437 MockEvent,
+:493 GraphContext — fresh implementation).
+
+The server hosts a serving graph in-process. Online deployments wrap it in the
+ASGI app (``mlrun_tpu.serving.asgi``) instead of Nuclio; offline tests call
+``server.test(...)`` exactly like the reference's offline-testing flow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import traceback
+import uuid
+from typing import Any, Optional, Union
+
+from ..config import mlconf
+from ..model import ModelObj
+from ..secrets import SecretsStore
+from ..utils import logger, now_iso
+from .states import FlowStep, RootFlowStep, RouterStep, graph_root_setter
+
+
+class MockEvent:
+    """Event object used offline and by the ASGI adapter (server.py:437)."""
+
+    def __init__(self, body=None, content_type=None, headers=None, method=None,
+                 path=None, event_id=None, trigger=None, error=None):
+        self.id = event_id or uuid.uuid4().hex
+        self.key = ""
+        self.body = body
+        self.time = now_iso()
+        self.content_type = content_type
+        self.headers = headers or {}
+        self.method = method or ("POST" if body is not None else "GET")
+        self.path = path or "/"
+        self.trigger = trigger
+        self.error = error
+
+    def __str__(self):
+        return f"Event(id={self.id}, path={self.path}, body={self.body})"
+
+
+Event = MockEvent
+
+
+class MockTrigger:
+    def __init__(self, kind: str = "", name: str = ""):
+        self.kind = kind
+        self.name = name
+
+
+class Response:
+    def __init__(self, headers=None, body=None, content_type=None,
+                 status_code=200):
+        self.headers = headers or {}
+        self.body = body
+        self.content_type = content_type or "text/plain"
+        self.status_code = status_code
+
+
+class GraphContext:
+    """Context passed to graph step classes (server.py:493)."""
+
+    def __init__(self, level="info", logger_=None, server=None):
+        self.state = None
+        self.logger = logger_ or logger
+        self.worker_id = 0
+        self.server = server
+        self.project = ""
+        self.current_function = ""
+        self.stream = None
+        self.root = None
+        self._secrets = SecretsStore()
+        self.is_mock = False
+        self.monitoring_stream = None
+
+    def get_param(self, key: str, default=None):
+        if self.server and self.server.parameters:
+            return self.server.parameters.get(key, default)
+        return default
+
+    def get_secret(self, key: str, default=None):
+        return self._secrets.get(key, default)
+
+    def get_store_resource(self, uri: str):
+        from ..datastore import store_manager
+
+        return store_manager.object(url=uri)
+
+    def get_remote_endpoint(self, name: str, external: bool = True) -> str:
+        db = None
+        try:
+            from ..db import get_run_db
+
+            db = get_run_db()
+            function = db.get_function(name, self.project)
+            return function.get("status", {}).get("address", "")
+        except Exception:  # noqa: BLE001
+            return ""
+
+    def push_error(self, event, message: str, source=None, **kwargs):
+        self.logger.error(
+            "graph error", error=message, source=source, event_id=getattr(
+                event, "id", None))
+
+
+class GraphServer(ModelObj):
+    kind = "server"
+    _dict_fields = ["graph", "parameters", "verbose", "load_mode",
+                    "function_uri", "graph_initializer", "error_stream",
+                    "track_models", "secret_sources", "default_content_type"]
+
+    def __init__(self, graph=None, parameters=None, load_mode=None,
+                 function_uri=None, verbose=False, version=None,
+                 functions=None, graph_initializer=None, error_stream=None,
+                 track_models=None, secret_sources=None,
+                 default_content_type=None):
+        self._graph = None
+        self.graph = graph
+        self.function_uri = function_uri
+        self.parameters = parameters or {}
+        self.verbose = verbose
+        self.load_mode = load_mode or "sync"
+        self.version = version or "v2"
+        self.context = None
+        self.graph_initializer = graph_initializer
+        self.error_stream = error_stream
+        self.track_models = track_models
+        self.secret_sources = secret_sources or []
+        self.default_content_type = default_content_type
+        self._namespace = {}
+        self._current_function = None
+
+    @property
+    def graph(self) -> Union[RootFlowStep, RouterStep]:
+        return self._graph
+
+    @graph.setter
+    def graph(self, graph):
+        if graph is None:
+            self._graph = None
+            return
+        self._graph = graph_root_setter(self, graph)
+
+    def set_current_function(self, function):
+        self._current_function = function
+
+    def init_states(self, context, namespace: dict | None = None,
+                    logger_=None, is_mock: bool = False,
+                    monitoring_mode: str | None = None):
+        """Initialize graph steps (reference server.py:150 init_states)."""
+        self.context = context or GraphContext(server=self)
+        if isinstance(self.context, GraphContext):
+            self.context.server = self
+            self.context.is_mock = is_mock
+            if self.function_uri:
+                self.context.project = self.function_uri.split("/")[0]
+        if self.secret_sources:
+            self.context._secrets = SecretsStore.from_list(self.secret_sources)
+        if self.graph_initializer:
+            initializer = self.graph_initializer
+            if isinstance(initializer, str):
+                from .states import get_function
+
+                initializer = get_function(initializer, namespace or {})
+            initializer(self)
+        if self.track_models and isinstance(self.context, GraphContext):
+            from ..model_monitoring.stream_processing import get_monitoring_stream
+
+            self.context.monitoring_stream = get_monitoring_stream(
+                self.context.project or mlconf.default_project)
+        self._namespace = namespace or {}
+        self.graph.init_object(self.context, self._namespace, self.load_mode)
+        return self
+
+    def init_object(self, namespace: dict | None = None):
+        self.graph.init_object(self.context, namespace or self._namespace,
+                               self.load_mode)
+
+    def run(self, event: MockEvent, context=None, get_body: bool = False):
+        """Process one event through the graph (reference server.py:252)."""
+        server_context = self.context
+        try:
+            response = self.graph.run(event)
+        except Exception as exc:  # noqa: BLE001
+            message = f"{exc}\n{traceback.format_exc()}"
+            if server_context:
+                server_context.push_error(event, message, source="graph")
+            if self.error_stream:
+                from .streams import get_stream_pusher
+
+                get_stream_pusher(self.error_stream).push(
+                    {"error": str(exc), "event": str(event.body)})
+            return Response(body={"error": str(exc)}, status_code=500)
+        if isinstance(response, MockEvent):
+            body = response.body
+            if get_body:
+                return body
+            return response
+        return response
+
+    def test(self, path: str = "/", body=None, method: str = "",
+             headers: dict | None = None, content_type: str | None = None,
+             silent: bool = False, get_body: bool = True,
+             event_id: str | None = None, trigger: MockTrigger | None = None):
+        """Offline graph test entry (reference server.py:196)."""
+        if not self.graph:
+            raise ValueError("no graph topology was set")
+        event = MockEvent(body=body, path=path, method=method,
+                          content_type=content_type, headers=headers,
+                          event_id=event_id, trigger=trigger)
+        result = self.run(event, get_body=get_body)
+        if isinstance(result, Response) and result.status_code >= 400 \
+                and not silent:
+            raise RuntimeError(f"error invoking graph: {result.body}")
+        return result
+
+    def wait_for_completion(self):
+        """Drain async branches (flow engine)."""
+        if self.graph and hasattr(self.graph, "_flush"):
+            self.graph._flush()
+
+
+def create_graph_server(parameters=None, load_mode=None, graph=None,
+                        verbose=False, current_function=None,
+                        **kwargs) -> GraphServer:
+    """Create a standalone graph server for testing/embedding
+    (reference server.py create_graph_server)."""
+    server = GraphServer(graph=graph, parameters=parameters,
+                         load_mode=load_mode, verbose=verbose, **kwargs)
+    server.set_current_function(
+        current_function or os.environ.get("SERVING_CURRENT_FUNCTION", ""))
+    return server
+
+
+def v2_serving_init(context, namespace: dict | None = None):
+    """Process-start entrypoint: build the server from the serialized spec env
+    (reference server.py:315; SERVING_SPEC_ENV contract)."""
+    spec_env = os.environ.get("SERVING_SPEC_ENV", "")
+    if not spec_env:
+        raise ValueError("SERVING_SPEC_ENV is not set")
+    spec = json.loads(spec_env)
+    server = GraphServer.from_dict(spec)
+    server.init_states(context, namespace or get_caller_globals())
+    setattr(context, "mlrun_handler", v2_serving_handler)
+    setattr(context, "_server", server)
+    return server
+
+
+def v2_serving_handler(context, event, get_body: bool = False):
+    """Per-event entrypoint (reference server.py:387)."""
+    server: GraphServer = getattr(context, "_server")
+    return server.run(event, context, get_body=get_body)
+
+
+def get_caller_globals(stack_depth: int = 2) -> dict:
+    import inspect
+
+    try:
+        frame = inspect.stack()[stack_depth][0]
+        return frame.f_globals
+    except Exception:  # noqa: BLE001
+        return {}

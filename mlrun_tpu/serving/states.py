@@ -1,0 +1,500 @@
+"""Serving-graph step DAG (reference analog: mlrun/serving/states.py:102
+BaseStep, :398 TaskStep, :671 RouterStep, :801 QueueStep, :892 FlowStep,
+:1405 RootFlowStep — fresh implementation).
+
+The reference builds a storey async flow (states.py:1622); here the graph runs
+on a built-in engine (``mlrun_tpu.serving.flow_engine``): sync in-process for
+request/response topologies, asyncio for queue-decoupled flows. Model steps
+run XLA-compiled callables — see ``mlrun_tpu.serving.v2_serving``.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib
+import inspect
+import traceback
+from typing import Any, Callable, Optional, Union
+
+from ..model import ModelObj
+from ..utils import get_in, logger, update_in
+
+callable_prefix = "_"
+path_splitter = "/"
+
+
+class GraphError(Exception):
+    pass
+
+
+def get_class(class_name: str, namespace: dict | None = None):
+    """Resolve 'module.sub.Class' or a bare name from the namespace."""
+    if isinstance(class_name, type):
+        return class_name
+    namespace = namespace or {}
+    if class_name in namespace:
+        return namespace[class_name]
+    if "." in class_name:
+        module_path, name = class_name.rsplit(".", 1)
+        module = importlib.import_module(module_path)
+        return getattr(module, name)
+    # well-known serving classes
+    from . import routers, v2_serving
+
+    for module in (v2_serving, routers):
+        if hasattr(module, class_name):
+            return getattr(module, class_name)
+    raise GraphError(f"class '{class_name}' not found in namespace")
+
+
+def get_function(handler: Union[str, Callable], namespace: dict | None = None):
+    if callable(handler):
+        return handler
+    namespace = namespace or {}
+    if handler in namespace:
+        return namespace[handler]
+    if "." in handler:
+        module_path, name = handler.rsplit(".", 1)
+        module = importlib.import_module(module_path)
+        return getattr(module, name)
+    raise GraphError(f"handler '{handler}' not found in namespace")
+
+
+class BaseStep(ModelObj):
+    kind = "BaseStep"
+    _dict_fields = ["kind", "name", "class_name", "class_args", "handler",
+                    "after", "function", "comment", "shape", "full_event",
+                    "input_path", "result_path", "on_error", "responder"]
+
+    def __init__(self, name: str | None = None, after: list | None = None,
+                 shape: str | None = None):
+        self.name = name
+        self.after = after or []
+        self.shape = shape
+        self.comment = None
+        self.class_name = None
+        self.class_args = {}
+        self.handler = None
+        self.function = None
+        self.full_event = False
+        self.input_path = None
+        self.result_path = None
+        self.on_error = None
+        self.responder = False
+        self._parent: Optional["FlowStep"] = None
+        self._next: list[str] = []
+
+    @property
+    def next_steps(self) -> list[str]:
+        return self._next
+
+    def set_parent(self, parent: "FlowStep"):
+        self._parent = parent
+
+    def after_step(self, *after):
+        self.after = [a if isinstance(a, str) else a.name for a in after]
+        return self
+
+    def error_handler(self, name: str):
+        self.on_error = name
+        return self
+
+    def respond(self):
+        self.responder = True
+        return self
+
+    def to(self, class_name=None, name: str | None = None, handler=None,
+           model_path: str | None = None, function: str | None = None,
+           full_event: bool | None = None, input_path: str | None = None,
+           result_path: str | None = None, **class_args) -> "BaseStep":
+        """Chain a new downstream step and return it."""
+        if self._parent is None:
+            raise GraphError(
+                f"step '{self.name}' is not attached to a flow graph")
+        step = self._parent.add_step(
+            class_name=class_name, name=name, handler=handler,
+            model_path=model_path, function=function, after=[self.name],
+            full_event=full_event, input_path=input_path,
+            result_path=result_path, **class_args)
+        self._next.append(step.name)
+        return step
+
+    def init_object(self, context, namespace: dict, mode: str = "sync"):
+        pass
+
+    def run(self, event, *args, **kwargs):
+        return event
+
+    def _extract_input(self, event):
+        if self.full_event:
+            return event
+        if self.input_path:
+            return get_in(event.body, self.input_path)
+        return event.body
+
+    def _apply_result(self, event, result):
+        if self.full_event:
+            return result if result is not None else event
+        if self.result_path:
+            if not isinstance(event.body, dict):
+                raise GraphError(
+                    f"step '{self.name}' has result_path="
+                    f"'{self.result_path}' but the event body is "
+                    f"{type(event.body).__name__}, not a dict")
+            update_in(event.body, self.result_path, result)
+        else:
+            event.body = result
+        return event
+
+
+class TaskStep(BaseStep):
+    """A step running a class instance or a handler fn (states.py:398)."""
+
+    kind = "task"
+
+    def __init__(self, class_name=None, class_args: dict | None = None,
+                 handler=None, name: str | None = None, after: list | None = None,
+                 full_event: bool | None = None, function=None,
+                 input_path: str | None = None, result_path: str | None = None):
+        super().__init__(name, after)
+        self.class_name = (
+            class_name if isinstance(class_name, (str, type(None)))
+            else class_name.__name__)
+        self._class_object = class_name if isinstance(class_name, type) else None
+        self.class_args = class_args or {}
+        self.handler = handler
+        self.function = function
+        self.full_event = bool(full_event)
+        self.input_path = input_path
+        self.result_path = result_path
+        self._object = None
+        self._handler_fn: Optional[Callable] = None
+        self.context = None
+
+    def init_object(self, context, namespace: dict, mode: str = "sync"):
+        self.context = context
+        if self.class_name or self._class_object:
+            cls = self._class_object or get_class(self.class_name, namespace)
+            # NOTE: no deepcopy — routers receive live route step objects
+            args = dict(self.class_args)
+            init_sig = inspect.signature(cls.__init__)
+            kwargs = {}
+            if "context" in init_sig.parameters:
+                kwargs["context"] = context
+            if "name" in init_sig.parameters:
+                kwargs["name"] = self.name
+            self._object = cls(**kwargs, **args)
+            if hasattr(self._object, "post_init"):
+                self._object.post_init(mode)
+            handler_name = self.handler or "do"
+            if not hasattr(self._object, handler_name) and hasattr(
+                    self._object, "do_event"):
+                handler_name = "do_event"
+            self._handler_fn = getattr(self._object, handler_name)
+        elif self.handler:
+            self._handler_fn = get_function(self.handler, namespace)
+        else:
+            self._handler_fn = lambda x: x
+
+    @property
+    def object(self):
+        return self._object
+
+    def run(self, event, *args, **kwargs):
+        if self._handler_fn is None:
+            raise GraphError(f"step '{self.name}' was not initialized")
+        needs_event = self.full_event or getattr(
+            self._object, "_needs_event", False) or (
+            self._object is not None
+            and getattr(self._handler_fn, "__name__", "") in ("do_event",))
+        if needs_event:
+            result = self._handler_fn(event)
+            return result if result is not None else event
+        data = self._extract_input(event)
+        result = self._handler_fn(data)
+        return self._apply_result(event, result)
+
+
+class ErrorStep(TaskStep):
+    kind = "error_step"
+
+
+class RouterStep(TaskStep):
+    """Step holding routes and dispatching events to them (states.py:671)."""
+
+    kind = "router"
+    _dict_fields = BaseStep._dict_fields + ["routes"]
+
+    def __init__(self, class_name=None, class_args=None, handler=None,
+                 name=None, after=None, routes: dict | None = None):
+        super().__init__(class_name or "ModelRouter", class_args, handler,
+                         name, after)
+        self.routes: dict[str, TaskStep] = routes or {}
+
+    def add_route(self, key: str, route: "TaskStep | None" = None,
+                  class_name=None, handler=None, function=None,
+                  **class_args) -> TaskStep:
+        if route is None:
+            route = TaskStep(class_name, class_args, handler, name=key,
+                             function=function)
+        route.name = key
+        route.set_parent(self._parent)
+        self.routes[key] = route
+        return route
+
+    def clear_children(self, routes: list[str] | None = None):
+        if routes is None:
+            self.routes = {}
+        else:
+            for key in routes:
+                self.routes.pop(key, None)
+
+    def init_object(self, context, namespace: dict, mode: str = "sync"):
+        self.class_args = dict(self.class_args)
+        self.class_args["routes"] = self.routes
+        super().init_object(context, namespace, mode)
+        for route in self.routes.values():
+            route.init_object(context, namespace, mode)
+
+    def run(self, event, *args, **kwargs):
+        result = self._handler_fn(event)
+        return result if result is not None else event
+
+
+class QueueStep(BaseStep):
+    """Stream/queue boundary (states.py:801). With a stream path the event is
+    pushed to the stream (monitoring pipeline); downstream steps in the same
+    process consume asynchronously via the flow engine."""
+
+    kind = "queue"
+    _dict_fields = BaseStep._dict_fields + ["path", "shards", "retention_in_hours"]
+
+    def __init__(self, name=None, path: str = "", after=None, shards=None,
+                 retention_in_hours=None, **options):
+        super().__init__(name, after)
+        self.path = path
+        self.shards = shards
+        self.retention_in_hours = retention_in_hours
+        self.options = options
+        self._stream = None
+
+    def init_object(self, context, namespace, mode="sync"):
+        if self.path:
+            from .streams import get_stream_pusher
+
+            self._stream = get_stream_pusher(self.path, **self.options)
+
+    def run(self, event, *args, **kwargs):
+        if self._stream is not None:
+            body = event.body if not self.full_event else event.__dict__
+            self._stream.push(body)
+        return event
+
+
+class FlowStep(BaseStep):
+    """A container of steps forming a DAG (states.py:892)."""
+
+    kind = "flow"
+    _dict_fields = BaseStep._dict_fields + ["steps", "engine"]
+
+    def __init__(self, name=None, steps: dict | None = None, after=None,
+                 engine: str | None = None):
+        super().__init__(name, after)
+        self._steps: dict[str, BaseStep] = {}
+        self.engine = engine or "sync"
+        self._start_steps: list[BaseStep] = []
+        self.context = None
+        if steps:
+            for step_name, step in steps.items():
+                self._add_existing(step_name, step)
+
+    # -- construction ------------------------------------------------------
+    @property
+    def steps(self) -> dict:
+        return self._steps
+
+    @steps.setter
+    def steps(self, steps: dict):
+        self._steps = {}
+        for name, step in (steps or {}).items():
+            self._add_existing(name, step)
+
+    def _add_existing(self, name: str, step):
+        if isinstance(step, dict):
+            step = step_from_dict(step)
+        step.name = name
+        step.set_parent(self)
+        self._steps[name] = step
+
+    def add_step(self, class_name=None, name=None, handler=None,
+                 model_path: str | None = None, after=None, function=None,
+                 full_event=None, input_path=None, result_path=None,
+                 graph_shape=None, **class_args) -> BaseStep:
+        if class_name == "$queue" or (isinstance(class_name, str)
+                                      and class_name == "queue"):
+            step = QueueStep(name=name, path=class_args.pop("path", ""),
+                             **class_args)
+        elif isinstance(class_name, str) and class_name == "$router":
+            step = RouterStep(name=name, class_args=class_args)
+        elif isinstance(class_name, RouterStep):
+            step = class_name
+            step.name = name or step.name
+        else:
+            if model_path is not None:
+                class_args["model_path"] = model_path
+            step = TaskStep(class_name, class_args, handler, name=name,
+                            function=function, full_event=full_event,
+                            input_path=input_path, result_path=result_path)
+        step.name = step.name or f"step{len(self._steps)}"
+        if after:
+            step.after = [a if isinstance(a, str) else a.name for a in after]
+        step.set_parent(self)
+        self._steps[step.name] = step
+        return step
+
+    def to(self, class_name=None, name=None, handler=None, model_path=None,
+           function=None, full_event=None, input_path=None, result_path=None,
+           **class_args) -> BaseStep:
+        """First step in the flow (or chain from the flow itself)."""
+        return self.add_step(
+            class_name=class_name, name=name, handler=handler,
+            model_path=model_path, function=function, after=[],
+            full_event=full_event, input_path=input_path,
+            result_path=result_path, **class_args)
+
+    def add_route(self, *args, **kwargs):
+        raise GraphError("add_route is valid on router topology graphs only")
+
+    # -- init / run --------------------------------------------------------
+    def init_object(self, context, namespace, mode="sync"):
+        self.context = context
+        for step in self._steps.values():
+            step.init_object(context, namespace, mode)
+        self._start_steps = [
+            s for s in self._steps.values() if not s.after
+        ] or list(self._steps.values())[:1]
+        self.check_and_process_graph()
+
+    def check_and_process_graph(self, allow_empty: bool = False):
+        """Validate the DAG: unknown after-references and cycles."""
+        for step in self._steps.values():
+            for parent in step.after or []:
+                if parent not in self._steps:
+                    raise GraphError(
+                        f"step '{step.name}' is after unknown step '{parent}'")
+        # cycle check via DFS
+        visiting: set[str] = set()
+        done: set[str] = set()
+
+        def visit(name: str):
+            if name in done:
+                return
+            if name in visiting:
+                raise GraphError(f"graph has a cycle through '{name}'")
+            visiting.add(name)
+            for child in self._children(name):
+                visit(child.name)
+            visiting.discard(name)
+            done.add(name)
+
+        for step in self._start_steps:
+            visit(step.name)
+
+    def _children(self, name: str) -> list[BaseStep]:
+        return [s for s in self._steps.values() if name in (s.after or [])]
+
+    def run(self, event, *args, **kwargs):
+        """Execute the DAG synchronously: follow after-links from the start
+        steps; the responder step's (or last) result becomes the response."""
+        response = None
+        queue: list[tuple[BaseStep, Any]] = [
+            (step, event) for step in self._start_steps]
+        while queue:
+            step, current = queue.pop(0)
+            try:
+                result = step.run(current)
+            except Exception as exc:  # noqa: BLE001 - route to error handler
+                if step.on_error and step.on_error in self._steps:
+                    error_event = copy.copy(current)
+                    error_event.error = str(exc)
+                    result = self._steps[step.on_error].run(error_event)
+                else:
+                    raise
+            if getattr(step, "responder", False):
+                response = result
+            children = self._children(step.name)
+            if not children and response is None:
+                response = result
+            for index, child in enumerate(children):
+                # fan-out: siblings beyond the first get their own event copy
+                # so one branch's output never leaks into another
+                queue.append(
+                    (child, result if index == 0 else copy.deepcopy(result)))
+        return response
+
+    def plot(self, filename=None, format=None, **kw):
+        """Render the graph as mermaid text (graphviz-free)."""
+        lines = ["graph LR"]
+        for step in self._steps.values():
+            for parent in step.after or []:
+                lines.append(f"  {parent} --> {step.name}")
+            if isinstance(step, RouterStep):
+                for route in step.routes:
+                    lines.append(f"  {step.name} -.-> {route}")
+        text = "\n".join(lines)
+        if filename:
+            with open(filename, "w") as fp:
+                fp.write(text)
+        return text
+
+    def to_dict(self, exclude=None):
+        out = super().to_dict(exclude=["steps"])
+        out["steps"] = {name: step.to_dict()
+                        for name, step in self._steps.items()}
+        return out
+
+
+class RootFlowStep(FlowStep):
+    """Top-level graph (states.py:1405)."""
+
+    kind = "flow"
+
+
+def step_from_dict(struct: dict) -> BaseStep:
+    kind = struct.get("kind", "task")
+    cls = {"task": TaskStep, "router": RouterStep, "queue": QueueStep,
+           "flow": FlowStep, "error_step": ErrorStep}.get(kind, TaskStep)
+    step = cls.from_dict(struct)
+    if kind == "router" and isinstance(step.routes, dict):
+        step.routes = {
+            key: (step_from_dict(r) if isinstance(r, dict) else r)
+            for key, r in step.routes.items()
+        }
+    if kind == "flow":
+        inner = struct.get("steps", {})
+        step._steps = {}
+        for name, sub in inner.items():
+            step._add_existing(name, sub)
+    return step
+
+
+def graph_root_setter(server, graph):
+    """Set a graph object or build one from a topology string/dict."""
+    if isinstance(graph, dict):
+        graph = step_from_dict(graph)
+    if isinstance(graph, str):
+        if graph == "router":
+            graph = RouterStep()
+        elif graph == "flow":
+            graph = RootFlowStep()
+        else:
+            raise GraphError(f"unsupported topology '{graph}'")
+    if isinstance(graph, RouterStep):
+        root = RootFlowStep()
+        graph.name = graph.name or "router"
+        root._add_existing(graph.name, graph)
+        root._router = graph
+        return root
+    if not isinstance(graph, FlowStep):
+        raise GraphError("graph must be a router or flow step")
+    return graph

@@ -1,0 +1,288 @@
+"""V2 model server (reference analog: mlrun/serving/v2_serving.py:32
+V2ModelServer — do_event :228 op dispatch, load/predict/explain/validate/
+preprocess/postprocess hooks :204-391, _ModelLogPusher :429).
+
+TPU twist: ``TpuModelServer`` below compiles the model's forward with
+``jax.jit`` at load time and runs warmup so first-request latency excludes
+XLA compilation (the <200ms TTFT budget in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, Optional, Union
+
+from ..utils import logger, now_iso
+
+
+class V2ModelServer:
+    """Base model-serving class — subclass and implement load() + predict()."""
+
+    def __init__(self, context=None, name: str | None = None,
+                 model_path: str | None = None, model=None,
+                 protocol: str | None = None, input_path: str | None = None,
+                 result_path: str | None = None, **class_args):
+        self.name = name
+        self.version = ""
+        if name and ":" in name:
+            self.name, self.version = name.split(":", 1)
+        self.context = context
+        self.ready = False
+        self.error = ""
+        self.protocol = protocol or "v2"
+        self.model_path = model_path
+        self.model_spec = None
+        self.model = model
+        self.class_args = class_args
+        self.input_path = input_path
+        self.result_path = result_path
+        self._model_logger = None
+        self.metrics: dict = {}
+        self.labels: dict = {}
+        self._lock = threading.Lock()
+        self._load_time = 0.0
+
+    def post_init(self, mode: str = "sync"):
+        """Called by the graph after construction: load + announce."""
+        if self.model is None:
+            started = time.monotonic()
+            try:
+                self.load()
+            except Exception as exc:  # noqa: BLE001 - keep serving other models
+                self.error = str(exc)
+                if self.context:
+                    self.context.logger.error(
+                        "model load failed", model=self.name, error=str(exc))
+                return
+            self._load_time = time.monotonic() - started
+        self.ready = True
+        if self.context and getattr(self.context, "monitoring_stream", None) \
+                is not None:
+            self._model_logger = _ModelLogPusher(self, self.context)
+        if self.context:
+            self.context.logger.info(
+                "model loaded", model=self.name,
+                load_time_s=round(self._load_time, 3))
+
+    # -- model lifecycle hooks (override) ----------------------------------
+    def load(self):
+        """Load the model; use get_model() to fetch from the registry."""
+
+    def get_model(self, suffix: str = ""):
+        """Fetch the model artifact → (local_path, model_spec, extra_data)."""
+        from ..artifacts.model import get_model
+
+        local_path, model_spec, extra_data = get_model(self.model_path, suffix)
+        self.model_spec = model_spec
+        return local_path, extra_data
+
+    def predict(self, request: dict) -> Any:
+        raise NotImplementedError("implement predict() in your model class")
+
+    def explain(self, request: dict) -> Any:
+        raise NotImplementedError(f"model {self.name} has no explain method")
+
+    def validate(self, request: dict, operation: str) -> dict:
+        if self.protocol == "v2" and operation in ("infer", "predict"):
+            if not isinstance(request, dict) or "inputs" not in request:
+                raise ValueError("request must contain an 'inputs' field")
+        return request
+
+    def preprocess(self, request: dict, operation: str) -> dict:
+        return request
+
+    def postprocess(self, request: dict) -> dict:
+        return request
+
+    def logged_results(self, request: dict, response: dict, op: str):
+        """Hook to shape what gets pushed to monitoring."""
+        return request.get("inputs"), response.get("outputs")
+
+    def set_metric(self, name: str, value):
+        self.metrics[name] = value
+
+    # -- event dispatch ----------------------------------------------------
+    def do_event(self, event, *args, **kwargs):
+        """Dispatch infer/predict/explain/metrics/ready ops (v2_serving.py:228)."""
+        event_body = event.body if hasattr(event, "body") else event
+        path = getattr(event, "path", "/") or "/"
+        op = self._extract_op(event_body, path)
+
+        if op == "ready":
+            if not self.ready:
+                raise RuntimeError(
+                    f"model {self.name} is not ready: {self.error}")
+            event.body = {"name": self.name, "ready": True}
+            return event
+        if op == "metrics":
+            event.body = {"name": self.name, "metrics": dict(self.metrics)}
+            return event
+        if op == "explain" or op in ("infer", "predict", ""):
+            request = event_body if isinstance(event_body, dict) else {
+                "inputs": event_body}
+            if not self.ready:
+                with self._lock:
+                    if not self.ready:
+                        self.post_init()
+                if not self.ready:
+                    raise RuntimeError(
+                        f"model {self.name} failed to load: {self.error}")
+            start = time.monotonic()
+            try:
+                request = self.preprocess(request, op)
+                request = self.validate(request, op or "infer")
+                if op == "explain":
+                    outputs = self.explain(request)
+                else:
+                    outputs = self.predict(request)
+                response = {
+                    "id": request.get("id") or getattr(event, "id", None)
+                    or uuid.uuid4().hex,
+                    "model_name": self.name,
+                    "outputs": _to_serializable(outputs),
+                }
+                if self.version:
+                    response["model_version"] = self.version
+                response = self.postprocess(response)
+            except Exception as exc:  # noqa: BLE001
+                if self._model_logger:
+                    self._model_logger.push_error(request, str(exc))
+                raise
+            microsec = int((time.monotonic() - start) * 1e6)
+            self.metrics["latency_microsec"] = microsec
+            self.metrics["requests"] = self.metrics.get("requests", 0) + 1
+            if self._model_logger:
+                self._model_logger.push(request, response, op or "infer",
+                                        microsec)
+            event.body = response
+            return event
+        raise ValueError(f"unsupported operation '{op}'")
+
+    @staticmethod
+    def _extract_op(body, path: str) -> str:
+        parts = [p for p in path.split("/") if p]
+        # v2 path convention: /v2/models/<name>/<op>
+        if parts and parts[-1] in ("infer", "predict", "explain", "metrics",
+                                   "ready"):
+            return parts[-1]
+        if isinstance(body, dict) and "operation" in body:
+            return body["operation"]
+        return "infer"
+
+
+class TpuModelServer(V2ModelServer):
+    """A V2ModelServer whose forward is an XLA-compiled JAX callable.
+
+    Subclasses implement ``build_forward() -> (fn, params)`` or pass
+    ``forward_fn``/``params`` as class args; inputs are batched to device and
+    the compiled fn runs on the TPU. ``warmup_shapes`` are compiled at load
+    time so serving never pays XLA compile latency on-path.
+    """
+
+    def __init__(self, *args, forward_fn=None, params=None,
+                 warmup_shapes: list | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._forward = forward_fn
+        self._params = params
+        self._warmup_shapes = warmup_shapes or []
+
+    def build_forward(self):
+        """Override: return (forward_fn(params, inputs), params)."""
+        if self._forward is None:
+            raise NotImplementedError(
+                "pass forward_fn/params or override build_forward()")
+        return self._forward, self._params
+
+    def load(self):
+        import jax
+        import jax.numpy as jnp
+
+        forward, params = self.build_forward()
+        self._jitted = jax.jit(forward)
+        self._params = params
+        for shape in self._warmup_shapes:
+            dummy = jnp.zeros(shape, dtype=jnp.float32)
+            _ = jax.block_until_ready(self._jitted(self._params, dummy))
+        self.model = self._jitted
+
+    def predict(self, request: dict):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        inputs = jnp.asarray(np.asarray(request["inputs"]))
+        outputs = jax.block_until_ready(self._jitted(self._params, inputs))
+        return np.asarray(outputs)
+
+
+class _ModelLogPusher:
+    """Streams inference events to the monitoring pipeline
+    (reference v2_serving.py:429)."""
+
+    def __init__(self, model: V2ModelServer, context):
+        self.model = model
+        self.context = context
+        self.stream = getattr(context, "monitoring_stream", None)
+        self.hostname = ""
+        self.function_uri = getattr(
+            getattr(context, "server", None), "function_uri", "") or ""
+
+    def base_data(self) -> dict:
+        return {
+            "class": self.model.__class__.__name__,
+            "model": self.model.name,
+            "version": self.model.version,
+            "function_uri": self.function_uri,
+            "when": now_iso(),
+            "labels": self.model.labels,
+        }
+
+    def push(self, request, response, op: str, microsec: int):
+        if self.stream is None:
+            return
+        inputs, outputs = self.model.logged_results(request, response, op)
+        data = self.base_data()
+        data.update({
+            "request": {"inputs": _to_serializable(inputs),
+                        "id": response.get("id")},
+            "resp": {"outputs": _to_serializable(outputs)},
+            "op": op,
+            "microsec": microsec,
+            "metrics": dict(self.model.metrics),
+        })
+        try:
+            self.stream.push(data)
+        except Exception as exc:  # noqa: BLE001 - monitoring must not break serving
+            logger.warning("failed to push monitoring event", error=str(exc))
+
+    def push_error(self, request, error: str):
+        if self.stream is None:
+            return
+        data = self.base_data()
+        data.update({"error": error, "request": _to_serializable(request)})
+        try:
+            self.stream.push(data)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _to_serializable(obj):
+    import numpy as np
+
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_serializable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "tolist"):
+        try:
+            return obj.tolist()
+        except Exception:  # noqa: BLE001
+            return str(obj)
+    return str(obj)

@@ -1,0 +1,2 @@
+from .tracker import Tracker  # noqa: F401
+from .tracker_manager import TrackerManager, tracker_manager  # noqa: F401

@@ -1,0 +1,9 @@
+from .notification import (  # noqa: F401
+    ConsoleNotification,
+    MailNotification,
+    NotificationBase,
+    SlackNotification,
+    WebhookNotification,
+    notification_types,
+)
+from .pusher import NotificationPusher  # noqa: F401

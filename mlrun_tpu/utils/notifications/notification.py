@@ -1,0 +1,114 @@
+"""Notification backends (reference analog:
+mlrun/utils/notifications/notification/*.py — console/slack/webhook/mail)."""
+
+from __future__ import annotations
+
+import json
+
+from ..helpers import logger, now_iso
+
+
+class NotificationBase:
+    kind = "base"
+
+    def __init__(self, name: str = "", params: dict | None = None):
+        self.name = name
+        self.params = params or {}
+
+    def push(self, message: str, severity: str = "info",
+             runs: list | None = None):
+        raise NotImplementedError
+
+    @staticmethod
+    def _runs_summary(runs: list | None) -> str:
+        lines = []
+        for run in runs or []:
+            meta = run.get("metadata", {})
+            status = run.get("status", {})
+            lines.append(
+                f"- {meta.get('project')}/{meta.get('name')} "
+                f"[{status.get('state')}] results={status.get('results')}")
+        return "\n".join(lines)
+
+
+class ConsoleNotification(NotificationBase):
+    kind = "console"
+
+    def push(self, message, severity="info", runs=None):
+        print(f"[{severity}] {message}")
+        summary = self._runs_summary(runs)
+        if summary:
+            print(summary)
+
+
+class SlackNotification(NotificationBase):
+    kind = "slack"
+
+    def push(self, message, severity="info", runs=None):
+        import requests
+
+        webhook = self.params.get("webhook")
+        if not webhook:
+            raise ValueError("slack notification requires a 'webhook' param")
+        blocks = [{"type": "section",
+                   "text": {"type": "mrkdwn",
+                            "text": f"*{severity}*: {message}"}}]
+        summary = self._runs_summary(runs)
+        if summary:
+            blocks.append({"type": "section",
+                           "text": {"type": "mrkdwn", "text": summary}})
+        requests.post(webhook, json={"blocks": blocks}, timeout=10)
+
+
+class WebhookNotification(NotificationBase):
+    kind = "webhook"
+
+    def push(self, message, severity="info", runs=None):
+        import requests
+
+        url = self.params.get("url")
+        if not url:
+            raise ValueError("webhook notification requires a 'url' param")
+        requests.request(
+            self.params.get("method", "POST").upper(), url,
+            json={"message": message, "severity": severity, "runs": runs or []},
+            headers=self.params.get("headers", {}), timeout=10)
+
+
+class MailNotification(NotificationBase):
+    kind = "mail"
+
+    def push(self, message, severity="info", runs=None):
+        import smtplib
+        from email.message import EmailMessage
+
+        msg = EmailMessage()
+        msg["Subject"] = f"[mlrun-tpu][{severity}] {message}"
+        msg["From"] = self.params.get("from", "mlrun-tpu@localhost")
+        msg["To"] = self.params.get("to", "")
+        msg.set_content(self._runs_summary(runs) or message)
+        host = self.params.get("server_host", "localhost")
+        port = int(self.params.get("server_port", 25))
+        with smtplib.SMTP(host, port, timeout=10) as server:
+            server.send_message(msg)
+
+
+class IPythonNotification(NotificationBase):
+    kind = "ipython"
+
+    def push(self, message, severity="info", runs=None):
+        try:
+            from IPython.display import display_markdown
+
+            display_markdown(f"**{severity}**: {message}", raw=True)
+        except ImportError:
+            print(f"[{severity}] {message}")
+
+
+notification_types: dict[str, type] = {
+    "console": ConsoleNotification,
+    "slack": SlackNotification,
+    "webhook": WebhookNotification,
+    "mail": MailNotification,
+    "ipython": IPythonNotification,
+}

@@ -1,0 +1,45 @@
+"""Notification pusher (reference analog:
+server/api/utils/notification_pusher.py:33 RunNotificationPusher — here shared
+client/server-side)."""
+
+from __future__ import annotations
+
+from ..helpers import logger, now_iso
+from .notification import notification_types
+
+
+class NotificationPusher:
+    def __init__(self, runs: list):
+        self._runs = runs
+
+    def push(self):
+        for run in self._runs:
+            run_dict = run.to_dict() if hasattr(run, "to_dict") else run
+            state = run_dict.get("status", {}).get("state")
+            for spec in run_dict.get("spec", {}).get("notifications", []):
+                if isinstance(spec, dict):
+                    when = spec.get("when") or ["completed", "error"]
+                    if state not in when:
+                        continue
+                    self._push_one(spec, run_dict, state)
+
+    @staticmethod
+    def _push_one(spec: dict, run_dict: dict, state: str):
+        kind = spec.get("kind", "console")
+        cls = notification_types.get(kind)
+        if cls is None:
+            logger.warning("unknown notification kind", kind=kind)
+            return
+        meta = run_dict.get("metadata", {})
+        message = spec.get("message") or (
+            f"run {meta.get('project')}/{meta.get('name')} finished: {state}")
+        severity = spec.get("severity", "info")
+        try:
+            cls(spec.get("name", ""), spec.get("params", {})).push(
+                message, severity, [run_dict])
+            spec["status"] = "sent"
+            spec["sent_time"] = now_iso()
+        except Exception as exc:  # noqa: BLE001 - notification failure non-fatal
+            spec["status"] = "error"
+            logger.warning("failed to push notification", kind=kind,
+                           error=str(exc))
