@@ -1,0 +1,3 @@
+import mlrun_tpu
+def handler(context, x: int = 1):
+    context.log_result('doubled', x * 2)

@@ -112,7 +112,22 @@ class SQLiteRunDB(RunDBInterface):
         self.dsn = dsn or mlconf.resolve_local_db_path()
         self.logs_dir = logs_dir or os.path.join(mlconf.home_dir, "logs")
         self._local = threading.local()
+        self._log_collector = None
+        self._log_collector_checked = False
         self._init_schema()
+
+    def _get_log_collector(self):
+        """Native mlt-logd client when MLT_LOG_COLLECTOR is configured
+        (falls back to direct file IO)."""
+        if not self._log_collector_checked:
+            self._log_collector_checked = True
+            if os.environ.get("MLT_LOG_COLLECTOR"):
+                from ..utils.log_collector import LogCollectorClient
+
+                client = LogCollectorClient()
+                if client.ping():
+                    self._log_collector = client
+        return self._log_collector
 
     # -- plumbing ----------------------------------------------------------
     @property
@@ -230,9 +245,16 @@ class SQLiteRunDB(RunDBInterface):
 
     def store_log(self, uid: str, project: str = "", body: bytes = b"",
                   append: bool = True):
-        mode = "ab" if append else "wb"
         if isinstance(body, str):
             body = body.encode()
+        collector = self._get_log_collector()
+        if collector is not None and append:
+            try:
+                collector.append(self._project_or_default(project), uid, body)
+                return
+            except (OSError, RuntimeError):
+                self._log_collector = None
+        mode = "ab" if append else "wb"
         with open(self._log_path(project, uid), mode) as fp:
             fp.write(body)
 
@@ -240,6 +262,13 @@ class SQLiteRunDB(RunDBInterface):
                 size: int = -1) -> tuple[str, bytes]:
         run = self.read_run(uid, project)
         state = get_in(run or {}, "status.state", RunStates.unknown)
+        collector = self._get_log_collector()
+        if collector is not None:
+            try:
+                return state, collector.get_log(
+                    self._project_or_default(project), uid, offset, size)
+            except (OSError, RuntimeError):
+                self._log_collector = None
         path = self._log_path(project, uid)
         if not os.path.isfile(path):
             return state, b""
@@ -249,6 +278,13 @@ class SQLiteRunDB(RunDBInterface):
         return state, data
 
     def get_log_size(self, uid: str, project: str = "") -> int:
+        collector = self._get_log_collector()
+        if collector is not None:
+            try:
+                return collector.get_log_size(
+                    self._project_or_default(project), uid)
+            except (OSError, RuntimeError):
+                self._log_collector = None
         path = self._log_path(project, uid)
         return os.path.getsize(path) if os.path.isfile(path) else 0
 

@@ -335,8 +335,9 @@ class MLClientCtx:
         return json.dumps(self.to_dict(), default=str)
 
     def _update_db(self):
-        if self._autocommit:
-            self.commit()
+        # artifact logs always round-trip the run doc to the DB (reference
+        # execution.py:599 behavior — the run DB is the source of truth)
+        self.commit()
 
     def commit(self, message: str = "", completed: bool = False):
         if message:

@@ -224,7 +224,10 @@ class OnlineVectorService:
                         record = record.iloc[-1]
                     features.update(record.to_dict())
                 except (KeyError, TypeError):
-                    continue
+                    # entity missing from this table → NaN placeholders so
+                    # the impute policy can fill them
+                    for col in table.columns:
+                        features.setdefault(col, float("nan"))
             # imputation
             for key, value in list(features.items()):
                 if pd.isna(value):

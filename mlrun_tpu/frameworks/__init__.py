@@ -1,0 +1,21 @@
+"""Framework adapters (reference analog: mlrun/frameworks/ —
+``apply_mlrun`` per framework; the PyTorch/Horovod trainer is replaced by
+the JAX auto-trainer in frameworks/jax)."""
+
+from __future__ import annotations
+
+
+def auto_mlrun(model=None, context=None, **kwargs):
+    """Auto-detect the framework and apply tracking
+    (reference analog: mlrun/frameworks/auto_mlrun/)."""
+    module = type(model).__module__ if model is not None else ""
+    if module.startswith("sklearn") or module.startswith("xgboost") \
+            or module.startswith("lightgbm"):
+        from .sklearn import apply_mlrun as apply
+
+        return apply(model=model, context=context, **kwargs)
+    if module.startswith(("flax", "jax")) or model is None:
+        from .jax import apply_mlrun as apply
+
+        return apply(model=model, context=context, **kwargs)
+    raise ValueError(f"cannot auto-detect framework for {type(model)}")

@@ -1,0 +1,111 @@
+"""HuggingFace adapter (reference analog: mlrun/frameworks/huggingface/
+model_server.py:24 HuggingFaceModelServer).
+
+TPU twist: ``load_hf_weights_into_llama`` maps HF Llama checkpoints into the
+stacked-parameter pytree the TPU model uses, so fine-tunes start from real
+weights; the model server runs tokenization on host and the generate loop on
+TPU via mlrun_tpu.serving.llm.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...utils import logger
+
+
+def load_hf_weights_into_llama(model_name_or_path: str, config=None,
+                               dtype=None):
+    """Load an HF Llama-family torch checkpoint into (LlamaConfig, params).
+
+    Weights come via transformers (torch CPU) and are re-laid-out into the
+    stacked [n_layers, ...] tree. Big models stream layer by layer.
+    """
+    import jax.numpy as jnp
+    import torch
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    from ...models.llama import LlamaConfig
+
+    hf_config = AutoConfig.from_pretrained(model_name_or_path)
+    config = config or LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layers=hf_config.num_hidden_layers,
+        embed_dim=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim",
+                         hf_config.hidden_size
+                         // hf_config.num_attention_heads),
+        mlp_dim=hf_config.intermediate_size,
+        rope_theta=getattr(hf_config, "rope_theta", 500000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    dtype = dtype or config.dtype
+
+    model = AutoModelForCausalLM.from_pretrained(
+        model_name_or_path, torch_dtype=torch.float32)
+    sd = model.state_dict()
+
+    def get(name):
+        return np.asarray(sd[name].numpy())
+
+    def stack(fmt, transpose=True):
+        mats = [get(fmt.format(i)) for i in range(config.n_layers)]
+        arr = np.stack(mats)
+        if transpose:
+            arr = arr.transpose(0, 2, 1)  # torch [out,in] -> ours [in,out]
+        return jnp.asarray(arr, dtype)
+
+    params = {
+        "embedding": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm_scale": jnp.asarray(np.stack(
+                [get(f"model.layers.{i}.input_layernorm.weight")
+                 for i in range(config.n_layers)]), dtype),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm_scale": jnp.asarray(np.stack(
+                [get(f"model.layers.{i}.post_attention_layernorm.weight")
+                 for i in range(config.n_layers)]), dtype),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm_scale": jnp.asarray(get("model.norm.weight"), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = jnp.asarray(
+            get("lm_head.weight").transpose(1, 0), dtype)
+    return config, params
+
+
+class HuggingFaceModelServer:
+    """Serving-graph step wrapping an HF pipeline on host CPU (parity with
+    reference huggingface/model_server.py) — use LLMModelServer from
+    mlrun_tpu.serving.llm for TPU-compiled generation."""
+
+    def __new__(cls, *args, **kwargs):
+        from ...serving.v2_serving import V2ModelServer
+
+        class _Server(V2ModelServer):
+            def __init__(self, *a, task: str = "text-classification",
+                         model_name: str | None = None, **kw):
+                super().__init__(*a, **kw)
+                self.task = task
+                self.hf_model_name = model_name
+
+            def load(self):
+                from transformers import pipeline
+
+                self.model = pipeline(
+                    self.task, model=self.hf_model_name or None)
+
+            def predict(self, request):
+                return [self.model(item) for item in request["inputs"]]
+
+        return _Server(*args, **kwargs)

@@ -1,0 +1,1 @@
+from .auto_trainer import JaxTrainerInterface, apply_mlrun, train  # noqa: F401

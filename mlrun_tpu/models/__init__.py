@@ -1,0 +1,12 @@
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    forward,
+    init_params,
+    llama3_1b,
+    llama3_8b,
+    llama3_70b,
+    loss_fn,
+    param_shapes,
+    tiny_llama,
+)
+from .lora import init_lora, lora_param_count, merge_lora  # noqa: F401

@@ -1,0 +1,264 @@
+"""Llama-family transformer — pure functional JAX, TPU-first.
+
+Design choices (not a port — the reference has no model code at all):
+- parameters are a flat pytree of **stacked** per-layer arrays
+  ``[n_layers, ...]`` so the decoder is a single ``lax.scan`` over layers:
+  one compiled layer body (fast XLA compile), natural pjit sharding along
+  the non-layer dims (see parallel/sharding.py DEFAULT_RULES).
+- bf16 activations/weights by default; f32 for norms' accumulation, softmax,
+  and the final logits matmul (preferred_element_type).
+- GQA attention via ops.attention (pallas flash on TPU), RoPE, SwiGLU.
+- ``jax.checkpoint`` (remat) around each layer body for long-context training.
+
+Presets cover the Llama-3 family; ``llama3_8b`` is the benchmark target
+(BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rope, rope_table
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    n_layers: int = 32
+    embed_dim: int = 4096
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    remat: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.embed_dim
+        per_layer = (
+            self.embed_dim * self.qkv_dim          # wq
+            + 2 * self.embed_dim * self.kv_dim     # wk, wv
+            + self.qkv_dim * self.embed_dim        # wo
+            + 3 * self.embed_dim * self.mlp_dim    # gate, up, down
+            + 2 * self.embed_dim                   # norms
+        )
+        head = 0 if self.tie_embeddings else self.vocab_size * self.embed_dim
+        return embed + self.n_layers * per_layer + self.embed_dim + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6·N_matmul + attention term)."""
+        matmul_params = self.param_count() - self.vocab_size * self.embed_dim \
+            * (1 if self.tie_embeddings else 2) - self.embed_dim \
+            - 2 * self.embed_dim * self.n_layers
+        # embedding lookup is free; lm_head matmul counts
+        matmul_params += self.vocab_size * self.embed_dim
+        attn = 2 * self.n_layers * seq_len * self.qkv_dim  # qk^T + pv per token
+        return 6.0 * matmul_params + 6.0 * attn
+
+
+# -- presets ---------------------------------------------------------------
+
+def llama3_8b(**overrides) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(), **overrides)
+
+
+def llama3_70b(**overrides) -> LlamaConfig:
+    return dataclasses.replace(LlamaConfig(
+        n_layers=80, embed_dim=8192, n_heads=64, n_kv_heads=8,
+        mlp_dim=28672), **overrides)
+
+
+def llama3_1b(**overrides) -> LlamaConfig:
+    """~1.2B config (llama3.2-1B-like) — fits one v5e chip for benching."""
+    return dataclasses.replace(LlamaConfig(
+        vocab_size=128256, n_layers=16, embed_dim=2048, n_heads=32,
+        n_kv_heads=8, head_dim=64, mlp_dim=8192, tie_embeddings=True),
+        **overrides)
+
+
+def tiny_llama(**overrides) -> LlamaConfig:
+    """Tiny config for tests / dryruns."""
+    return dataclasses.replace(LlamaConfig(
+        vocab_size=512, n_layers=2, embed_dim=128, n_heads=4, n_kv_heads=2,
+        head_dim=32, mlp_dim=256, tie_embeddings=True, remat=False),
+        **overrides)
+
+
+# -- init -------------------------------------------------------------------
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """Initialize the stacked-parameter pytree."""
+    keys = jax.random.split(key, 8)
+    dtype = config.dtype
+    e, h, kv, m, L = (config.embed_dim, config.qkv_dim, config.kv_dim,
+                      config.mlp_dim, config.n_layers)
+
+    def norm_init(fan_in, shape, k):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embedding": norm_init(e, (config.vocab_size, e), keys[0]),
+        "layers": {
+            "attn_norm_scale": jnp.ones((L, e), dtype),
+            "wq": norm_init(e, (L, e, h), keys[1]),
+            "wk": norm_init(e, (L, e, kv), keys[2]),
+            "wv": norm_init(e, (L, e, kv), keys[3]),
+            "wo": norm_init(h, (L, h, e), keys[4]),
+            "mlp_norm_scale": jnp.ones((L, e), dtype),
+            "w_gate": norm_init(e, (L, e, m), keys[5]),
+            "w_up": norm_init(e, (L, e, m), keys[6]),
+            "w_down": norm_init(m, (L, m, e), keys[7]),
+        },
+        "final_norm_scale": jnp.ones((e,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = norm_init(
+            e, (e, config.vocab_size), jax.random.fold_in(key, 99))
+    return params
+
+
+def param_shapes(config: LlamaConfig) -> Params:
+    """Shape/dtype skeleton without allocating (for eval_shape / sharding)."""
+    return jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))
+
+
+# -- forward ----------------------------------------------------------------
+
+def _layer_body(config: LlamaConfig, x, layer_params, cos, sin,
+                lora: Optional[dict] = None):
+    """One decoder layer. x: [B, S, E]."""
+    b, s, e = x.shape
+    lp = layer_params
+
+    def proj(h_in, w, lora_key):
+        out = jnp.einsum("bse,eh->bsh", h_in, w,
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        if lora is not None and lora_key in lora:
+            a, bb, scaling = (lora[lora_key]["lora_a"],
+                              lora[lora_key]["lora_b"],
+                              lora[lora_key]["scaling"])
+            delta = jnp.einsum("bse,er->bsr", h_in, a.astype(x.dtype))
+            delta = jnp.einsum("bsr,rh->bsh", delta, bb.astype(x.dtype))
+            out = (out + scaling.astype(x.dtype) * delta).astype(x.dtype)
+        return out
+
+    # attention block
+    h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
+    q = proj(h, lp["wq"], "wq").reshape(b, s, config.n_heads, config.head_dim)
+    k = proj(h, lp["wk"], "wk").reshape(b, s, config.n_kv_heads,
+                                        config.head_dim)
+    v = proj(h, lp["wv"], "wv").reshape(b, s, config.n_kv_heads,
+                                        config.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(q, k, v, causal=True, impl=config.attention_impl)
+    attn = attn.reshape(b, s, config.qkv_dim)
+    x = x + proj(attn, lp["wo"], "wo")
+
+    # mlp block (SwiGLU)
+    h = rms_norm(x, lp["mlp_norm_scale"], config.norm_eps)
+    gate = proj(h, lp["w_gate"], "w_gate")
+    up = proj(h, lp["w_up"], "w_up")
+    x = x + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
+    return x
+
+
+def forward(config: LlamaConfig, params: Params, tokens: jax.Array,
+            positions: jax.Array | None = None,
+            lora: Optional[Params] = None,
+            act_spec=None) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, vocab] (f32).
+
+    ``act_spec`` is an optional PartitionSpec for [batch, seq, embed]
+    activations — required under jit when the embedding table is sharded
+    (the gather's output sharding is ambiguous otherwise).
+    """
+    b, s = tokens.shape
+    if act_spec is not None:
+        x = params["embedding"].at[tokens].get(
+            out_sharding=act_spec).astype(config.dtype)
+    else:
+        x = params["embedding"][tokens].astype(config.dtype)
+    if positions is None:
+        positions = jnp.arange(s)
+    cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
+
+    body = functools.partial(_layer_body, config)
+    if config.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable,
+            static_argnums=())
+
+    if lora is not None:
+        def scan_fn(carry, scanned):
+            layer_params, layer_lora = scanned
+            return body(carry, layer_params, cos, sin, layer_lora), None
+
+        x, _ = jax.lax.scan(scan_fn, x, (params["layers"], lora))
+    else:
+        def scan_fn(carry, layer_params):
+            return body(carry, layer_params, cos, sin, None), None
+
+        x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def loss_fn(config: LlamaConfig, params: Params, tokens: jax.Array,
+            targets: jax.Array, mask: jax.Array | None = None,
+            lora: Optional[Params] = None,
+            act_spec=None) -> tuple[jax.Array, dict]:
+    """Next-token cross-entropy; returns (loss, metrics)."""
+    logits = forward(config, params, tokens, lora=lora, act_spec=act_spec)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    if act_spec is not None:
+        from jax.sharding import NamedSharding as _NS
+        from jax.sharding import PartitionSpec as _P
+
+        spec = act_spec.spec if isinstance(act_spec, _NS) else act_spec
+        gather_spec = _P(*(tuple(spec)[:2] + (None,)))
+        if isinstance(act_spec, _NS):
+            gather_spec = _NS(act_spec.mesh, gather_spec)
+        nll = -jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1,
+            out_sharding=gather_spec)[..., 0]
+    else:
+        nll = -jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(nll * mask) / total
+    accuracy = jnp.sum(
+        (jnp.argmax(logits, axis=-1) == targets) * mask) / total
+    return loss, {"loss": loss, "accuracy": accuracy,
+                  "tokens": total}

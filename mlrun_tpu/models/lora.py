@@ -1,0 +1,73 @@
+"""LoRA adapters for the stacked-layer llama parameter tree.
+
+LoRA params mirror the layer stack: for each adapted projection
+``{"lora_a": [L, in, r], "lora_b": [L, r, out], "scaling": alpha/r}``.
+Training shards lora_a on fsdp (in-dim) and lora_b on tensor (out-dim) via
+parallel/sharding.py rules; base params stay frozen (no optimizer state),
+which is what makes 8B LoRA fit small slices.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, Params
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+_PROJ_DIMS = {
+    "wq": lambda c: (c.embed_dim, c.qkv_dim),
+    "wk": lambda c: (c.embed_dim, c.kv_dim),
+    "wv": lambda c: (c.embed_dim, c.kv_dim),
+    "wo": lambda c: (c.qkv_dim, c.embed_dim),
+    "w_gate": lambda c: (c.embed_dim, c.mlp_dim),
+    "w_up": lambda c: (c.embed_dim, c.mlp_dim),
+    "w_down": lambda c: (c.mlp_dim, c.embed_dim),
+}
+
+
+def init_lora(config: LlamaConfig, key: jax.Array, rank: int = 16,
+              alpha: float = 32.0,
+              targets: Sequence[str] = DEFAULT_TARGETS) -> Params:
+    """Initialize LoRA adapters (A ~ normal/sqrt(in), B = 0)."""
+    lora: Params = {}
+    for i, target in enumerate(targets):
+        if target not in _PROJ_DIMS:
+            raise ValueError(f"unknown lora target '{target}'")
+        d_in, d_out = _PROJ_DIMS[target](config)
+        k = jax.random.fold_in(key, i)
+        lora[target] = {
+            "lora_a": (jax.random.normal(
+                k, (config.n_layers, d_in, rank), jnp.float32)
+                * (d_in ** -0.5)).astype(jnp.float32),
+            "lora_b": jnp.zeros((config.n_layers, rank, d_out), jnp.float32),
+            # per-layer so the tree scans over the layer axis with the stack
+            "scaling": jnp.full((config.n_layers,), alpha / rank,
+                                jnp.float32),
+        }
+    return lora
+
+
+def lora_param_count(config: LlamaConfig, rank: int = 16,
+                     targets: Sequence[str] = DEFAULT_TARGETS) -> int:
+    total = 0
+    for target in targets:
+        d_in, d_out = _PROJ_DIMS[target](config)
+        total += config.n_layers * rank * (d_in + d_out)
+    return total
+
+
+def merge_lora(params: Params, lora: Params) -> Params:
+    """Fold adapters into the base weights (for serving without lora math)."""
+    merged = jax.tree_util.tree_map(lambda x: x, params)  # shallow-ish copy
+    layers = dict(merged["layers"])
+    for target, adapter in lora.items():
+        base = layers[target]
+        delta = jnp.einsum("lir,lro->lio", adapter["lora_a"],
+                           adapter["lora_b"]) \
+            * adapter["scaling"][:, None, None]
+        layers[target] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+    merged["layers"] = layers
+    return merged

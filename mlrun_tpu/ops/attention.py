@@ -1,0 +1,301 @@
+"""Attention ops: reference, fused pallas flash kernel, and dispatch.
+
+The MXU wants large fused matmuls; the HBM wants O(S) memory — flash-style
+blockwise softmax delivers both. Three implementations:
+
+- ``attention_reference``: pure jnp (einsum), GQA, causal — differentiable
+  everywhere (CPU mesh tests, small shapes, fallback).
+- ``flash_attention_mlt``: our pallas TPU kernel (forward) with a custom-vjp
+  blockwise backward (lax.scan recompute, O(S·D) residual memory).
+- ``attention``: dispatcher — on TPU training paths prefers the jax pallas
+  library kernels (which include tuned fwd+bwd), otherwise reference.
+
+No reference-repo analog: the reference has no attention code at all
+(SURVEY.md §5.7) — this capability is TPU-native new work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0**30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: repeat kv heads to match q heads. [B, S, Hkv, D] -> [B, S, Hkv*n, D]."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)
+                            ).reshape(b, s, h * n_rep, d)
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        positions_q: jax.Array | None = None,
+                        positions_k: jax.Array | None = None,
+                        softmax_scale: float | None = None) -> jax.Array:
+    """[B, Sq, Hq, D] x [B, Sk, Hkv, D] -> [B, Sq, Hq, D]; f32 softmax."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = softmax_scale or (q.shape[-1] ** -0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        if positions_q is None:
+            positions_q = jnp.arange(q.shape[1])
+        if positions_k is None:
+            positions_k = jnp.arange(k.shape[1])
+        mask = positions_q[:, None] >= positions_k[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+# ---------------------------------------------------------------------------
+# our pallas flash kernel (forward), causal, MHA/GQA via pre-repeated kv
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k: int, seq_k: int, kv_len: int, scale: float,
+                      causal: bool):
+    # grid: (batch*heads, q_blocks); refs (leading block dim of 1 retained):
+    #   q: [1, block_q, d], k/v: [1, seq_k, d] (full kv in VMEM per program)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len != seq_k:  # mask padded kv tail
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_blk,
+                                    preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    if causal:
+        # only blocks with k_start <= q_end contribute
+        last_kb = jnp.minimum(((qi + 1) * block_q - 1) // block_k + 1, num_kb)
+    else:
+        last_kb = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+try:  # pallas imports kept lazy-safe for docs tooling
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # noqa: BLE001
+    _PALLAS_OK = False
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd(q, k, v, causal=True, block_q=256, block_k=256,
+               interpret=None):
+    """q,k,v: [B, S, H, D] (kv already repeated to H heads). Returns (o, lse)."""
+    if interpret is None:
+        interpret = not _on_tpu()  # CPU backend only supports interpret mode
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # pad seq dims to block multiples; padded k rows are masked out by
+    # position (causal) or an explicit kv-length bound in the kernel
+    orig_sq, orig_sk = sq, sk
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        sq += pad_q
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        sk += pad_k
+    scale = d ** -0.5
+    # layout: fold batch*heads, move seq to row dim
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    grid = (b * h, pl.cdiv(sq, block_q))
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_k=sk, kv_len=orig_sk,
+        scale=scale, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq)
+    if pad_q:
+        o = o[:, :orig_sq]
+        lse = lse[:, :, :orig_sq]
+    return o, lse
+
+
+def _blockwise_bwd(q, k, v, o, lse, g, causal: bool, block: int = 512):
+    """Memory-efficient backward: recompute attention blockwise over k."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1)  # [B, Sq, H]
+
+    orig_sk = sk
+    pad_k = (-sk) % min(block, sk)
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        sk += pad_k
+    num_kb = max(1, sk // min(block, sk))
+    kb_size = sk // num_kb
+
+    def body(carry, kb):
+        dq = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, kb * kb_size, kb_size, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(vf, kb * kb_size, kb_size, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = kb * kb_size + jnp.arange(kb_size)[None, :]
+        if causal:
+            q_pos = jnp.arange(sq)[:, None]
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(k_pos[None, None] < orig_sk, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, :, None])  # [B,H,Sq,Kb]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vs)
+        ds = p * (dp - delta.transpose(0, 2, 1)[:, :, :, None]) * scale
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(num_kb))
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, sk, h, d)
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, sk, h, d)
+    if pad_k:
+        dk = dk[:, :orig_sk]
+        dv = dv[:, :orig_sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_mlt(q, k, v, causal: bool = True):
+    """Our pallas flash attention (kv must already match q heads)."""
+    o, _ = _flash_fwd(q, k, v, causal=causal)
+    return o
+
+
+def _flash_mlt_fwd(q, k, v, causal):
+    o, lse = _flash_fwd(q, k, v, causal=causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_mlt_bwd(causal, residuals, g):
+    q, k, v, o, lse = residuals
+    return _blockwise_bwd(q, k, v, o, lse, g, causal)
+
+
+flash_attention_mlt.defvjp(_flash_mlt_fwd, _flash_mlt_bwd)
+
+
+# ---------------------------------------------------------------------------
+# library pallas kernels (tuned fwd+bwd) and the dispatcher
+# ---------------------------------------------------------------------------
+
+def _jax_flash(q, k, v, causal: bool):
+    """jax pallas library flash attention: expects [B, H, S, D]."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _fa,
+    )
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=q.shape[-1] ** -0.5)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+              impl: str = "auto") -> jax.Array:
+    """Dispatching attention: [B, S, H|Hkv, D] in, [B, S, H, D] out."""
+    n_rep = q.shape[2] // k.shape[2]
+    if impl == "reference":
+        return attention_reference(q, k, v, causal=causal)
+    if impl == "auto":
+        min_dim = 128
+        use_kernel = (
+            _PALLAS_OK and _on_tpu()
+            and q.shape[1] >= min_dim and k.shape[1] >= min_dim
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+        )
+        impl = "flash" if use_kernel else "reference"
+    if impl == "reference":
+        return attention_reference(q, k, v, causal=causal)
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if impl == "flash":
+        return _jax_flash(q, k, v, causal)
+    if impl == "mlt_flash":
+        return flash_attention_mlt(q, k, v, causal)
+    raise ValueError(f"unknown attention impl '{impl}'")

@@ -1,0 +1,110 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has NO long-context capability (SURVEY.md §5.7); this introduces
+it TPU-natively: q/k/v are sharded along the sequence on a mesh axis, each
+device computes blockwise attention against its local kv shard, then rotates
+the kv shard around the ring with ``jax.lax.ppermute`` (XLA lowers to ICI
+neighbor transfers that overlap with compute). Online-softmax accumulation
+makes the result exact; causal masking uses global positions derived from the
+ring index.
+
+Use under ``jax.shard_map`` with q/k/v sharded as P(batch_axes, seq_axis):
+``ring_attention(q, k, v, axis_name="seq")``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, _repeat_kv
+
+
+def _block_attn(q, k, v, q_offset, k_offset, causal: bool, scale: float):
+    """One q-shard x kv-shard blockwise attention with global positions.
+
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]. Returns (numerator [B,Sq,H,D] f32,
+    max [B,Sq,H] f32, denom [B,Sq,H] f32).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                         # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                          # [B,H,Sq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return num, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq", causal: bool = True) -> jax.Array:
+    """Exact attention over a sequence-sharded axis (inside shard_map).
+
+    q,k,v: local shards [B, S_local, H(q/kv), D]. The kv shard rotates
+    ``axis_size`` times around the ring; accumulation is online-softmax so
+    memory stays O(S_local).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    s_local = q.shape[1]
+    q_offset = my_idx * s_local
+
+    b, sq, h, d = q.shape
+    num0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, sq, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, sq, h), jnp.float32)
+
+    # ring: at step t we hold the kv shard originally from device (my_idx - t)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(carry, t):
+        num, m, l, k_cur, v_cur = carry
+        src_idx = (my_idx - t) % axis_size
+        k_offset = src_idx * k_cur.shape[1]
+        bnum, bm, bl = _block_attn(q, k_cur, v_cur, q_offset, k_offset,
+                                   causal, scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        num = num * alpha[..., None] + bnum * beta[..., None]
+        l = l * alpha + bl * beta
+        # rotate kv to the next device (skip after the last step)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (num, m_new, l, k_nxt, v_nxt), None
+
+    (num, m, l, _, _), _ = jax.lax.scan(
+        body, (num0, m0, l0, k, v), jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-30)
+    return (num / l[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh, seq_axis: str = "seq", causal: bool = True):
+    """Wrap ring_attention in shard_map over the given mesh.
+
+    Returns fn(q, k, v) taking fully-addressable arrays sharded
+    P(('data','fsdp'), seq_axis, ...) along batch/seq.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+                       and mesh.shape[a] > 1) or None
+    spec = P(batch_axes, seq_axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q, k, v):
+        return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+
+    return _ring

@@ -1,0 +1,156 @@
+"""Sharding rules: logical parameter axes → mesh axes.
+
+t5x-style logical-axis rules (cf. SNIPPETS.md §1 public t5x partitioning
+pattern): every parameter pytree leaf is matched by path against a rule list
+and gets a PartitionSpec. XLA then inserts all ICI/DCN collectives — there is
+no hand-written allreduce anywhere in the framework.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# default rules for the transformer parameter tree produced by
+# mlrun_tpu.models.llama (path → spec); first match wins.
+# Conventions: embed dim sharded on "tensor" for attention/mlp in/out,
+# fsdp shards the other (large) dim so every big matrix is fully sharded.
+DEFAULT_RULES: list[tuple[str, tuple]] = [
+    # lora adapters [layers, in, rank] / [layers, rank, out] — MUST precede
+    # the projection rules (paths look like "wq/lora_a"); rank stays
+    # unsharded so any rank works on any mesh
+    (r".*lora_a.*", (None, "fsdp", None)),
+    (r".*lora_b.*", (None, None, "tensor")),
+    (r".*scaling.*", ()),
+    # token embedding [vocab, embed] — shard vocab on fsdp, embed on tensor
+    (r".*embedding.*", ("fsdp", "tensor")),
+    # attention projections, stacked over layers: [layers, embed, heads*head_dim]
+    (r".*(wq|wk|wv).*", (None, "fsdp", "tensor")),
+    # attention output [layers, heads*head_dim, embed]
+    (r".*wo.*", (None, "tensor", "fsdp")),
+    # mlp in/gate [layers, embed, mlp]
+    (r".*(w_gate|w_up).*", (None, "fsdp", "tensor")),
+    # mlp out [layers, mlp, embed]
+    (r".*w_down.*", (None, "tensor", "fsdp")),
+    # norms / scales / biases — replicated
+    (r".*(norm|scale|bias).*", ()),
+    # final head [embed, vocab]
+    (r".*lm_head.*", ("tensor", "fsdp")),
+]
+
+
+def path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: Sequence[tuple] | None = None,
+                  ndim: int | None = None) -> PartitionSpec:
+    rules = rules if rules is not None else DEFAULT_RULES
+    for pattern, spec in rules:
+        if re.match(pattern, path, flags=re.IGNORECASE):
+            spec = tuple(spec)
+            if ndim is not None:
+                if len(spec) > ndim:
+                    # drop leading axes that don't exist (unstacked params)
+                    spec = spec[len(spec) - ndim:]
+                elif len(spec) < ndim:
+                    spec = spec + (None,) * (ndim - len(spec))
+            return PartitionSpec(*spec)
+    return PartitionSpec()  # replicate by default
+
+
+def _filter_spec_to_mesh(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop axis names the mesh doesn't have (e.g. no 'tensor' on a pure-fsdp
+    mesh) so the same rules work on any mesh."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names
+                         and mesh.shape[a] > 1)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.axis_names
+                       and mesh.shape[entry] > 1 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def tree_shardings(tree: Any, mesh: Mesh,
+                   rules: Sequence[tuple] | None = None) -> Any:
+    """Map a pytree to NamedShardings using the rules."""
+
+    def leaf_sharding(path, leaf):
+        ndim = getattr(leaf, "ndim", None)
+        spec = spec_for_path(path_str(path), rules, ndim=ndim)
+        spec = _filter_spec_to_mesh(spec, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def tree_pspecs(tree: Any, mesh: Mesh,
+                rules: Sequence[tuple] | None = None) -> Any:
+    def leaf_spec(path, leaf):
+        ndim = getattr(leaf, "ndim", None)
+        spec = spec_for_path(path_str(path), rules, ndim=ndim)
+        return _filter_spec_to_mesh(spec, mesh)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def shard_pytree(tree: Any, mesh: Mesh,
+                 rules: Sequence[tuple] | None = None) -> Any:
+    """Place a host pytree onto the mesh with rule-derived shardings."""
+    shardings = tree_shardings(tree, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def batch_spec(mesh: Mesh, seq_axis: str | None = None) -> PartitionSpec:
+    """Sharding for [batch, seq, ...] data: batch over all data-ish axes,
+    optionally sequence over the seq axis (context parallelism)."""
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+                      and mesh.shape[a] > 1)
+    batch_axes = data_axes if data_axes else None
+    if seq_axis and seq_axis in mesh.axis_names and mesh.shape[seq_axis] > 1:
+        return PartitionSpec(batch_axes, seq_axis)
+    return PartitionSpec(batch_axes)
+
+
+def batch_sharding(mesh: Mesh, seq_axis: str | None = None) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, seq_axis))
+
+
+class ShardingRules:
+    """User-extensible rule table attached to a trainer."""
+
+    def __init__(self, rules: Sequence[tuple] | None = None):
+        self.rules = list(rules if rules is not None else DEFAULT_RULES)
+
+    def add(self, pattern: str, spec: tuple, first: bool = True):
+        if first:
+            self.rules.insert(0, (pattern, spec))
+        else:
+            self.rules.append((pattern, spec))
+        return self
+
+    def shardings(self, tree, mesh: Mesh):
+        return tree_shardings(tree, mesh, self.rules)
+
+    def pspecs(self, tree, mesh: Mesh):
+        return tree_pspecs(tree, mesh, self.rules)

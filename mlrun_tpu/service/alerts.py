@@ -1,0 +1,58 @@
+"""Alert/event processing (reference analog: server/api/crud/{alerts,events}.py
++ alert_states in sqldb/models.py)."""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+
+from ..utils import logger, now_iso
+
+
+def process_event(db, project: str, event_kind: str, event: dict) -> list:
+    """Evaluate alert configs against an incoming event; fire notifications
+    when criteria (count within period) are met. Returns fired alert names."""
+    fired = []
+    for config in db.list_alert_configs(project):
+        if event_kind not in (config.get("trigger_events") or [event_kind]):
+            continue
+        entity = config.get("entity_id", "*")
+        if entity not in ("*", event.get("entity_id", "*")):
+            continue
+        criteria = config.get("criteria") or {}
+        required = int(criteria.get("count", 1))
+        period = float(criteria.get("period_seconds", 3600))
+        since = datetime.now(timezone.utc) - timedelta(seconds=period)
+        events = db.list_events(project, kind=event_kind,
+                                since=since.isoformat())
+        if len(events) >= required:
+            if config.get("state") == "active" and \
+                    config.get("reset_policy", "auto") == "manual":
+                continue
+            config["state"] = "active"
+            config["count"] = config.get("count", 0) + 1
+            config["last_fired"] = now_iso()
+            db.store_alert_config(config.get("name"), config, project)
+            _notify(config, event)
+            fired.append(config.get("name"))
+        elif config.get("reset_policy", "auto") == "auto" and \
+                config.get("state") == "active":
+            config["state"] = "inactive"
+            db.store_alert_config(config.get("name"), config, project)
+    return fired
+
+
+def _notify(config: dict, event: dict):
+    from ..utils.notifications.notification import notification_types
+
+    for spec in config.get("notifications") or [{"kind": "console"}]:
+        kind = spec.get("kind", "console")
+        cls = notification_types.get(kind)
+        if cls is None:
+            continue
+        try:
+            cls(spec.get("name", ""), spec.get("params", {})).push(
+                f"alert '{config.get('name')}' fired: "
+                f"{config.get('summary', '')}",
+                severity=config.get("severity", "medium"))
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("alert notification failed", error=str(exc))

@@ -1,0 +1,644 @@
+"""The metadata/orchestration service — aiohttp REST app.
+
+Reference analog: server/api/main.py:93 FastAPI `app` + the 37 routers in
+server/api/api/api.py, reduced to the same REST contract the SDK's HTTPRunDB
+speaks. FastAPI/SQLAlchemy are replaced by aiohttp + the embedded SQLite DB.
+Periodic tasks mirror main.py:608 (runs monitoring) and the APScheduler-based
+Scheduler (utils/scheduler.py) is replaced by service/cron.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Optional
+
+from aiohttp import web
+
+from .. import __version__
+from ..common.runtimes_constants import RunStates
+from ..config import mlconf
+from ..db.sqlitedb import SQLiteRunDB
+from ..model import RunObject
+from ..utils import generate_uid, get_in, logger, now_iso, update_in
+from .cron import CronSchedule
+from .launcher import ServerSideLauncher, rebuild_function
+from .runtime_handlers import LocalProcessProvider
+
+API = mlconf.api_base_path.rstrip("/")
+
+
+def json_response(data, status: int = 200):
+    return web.json_response(data, status=status, dumps=lambda d: json.dumps(
+        d, default=str))
+
+
+def error_response(message: str, status: int = 400):
+    return web.json_response({"detail": message}, status=status)
+
+
+class ServiceState:
+    def __init__(self, db: SQLiteRunDB | None = None, provider=None):
+        self.db = db or SQLiteRunDB()
+        self.provider = provider or LocalProcessProvider(self.db)
+        self.launcher = ServerSideLauncher(self.db, self.provider)
+        self.background_tasks: dict[str, dict] = {}
+        self.workflows: dict[str, dict] = {}
+        self.started = time.time()
+
+
+def build_app(state: ServiceState | None = None) -> web.Application:
+    state = state or ServiceState()
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["state"] = state
+
+    r = web.RouteTableDef()
+
+    # -- health / spec ------------------------------------------------------
+    @r.get(f"{API}/healthz")
+    async def healthz(request):
+        return json_response({"status": "ok", "version": __version__})
+
+    @r.get(f"{API}/client-spec")
+    async def client_spec(request):
+        return json_response({
+            "version": __version__,
+            "namespace": mlconf.namespace,
+            "default_project": mlconf.default_project,
+            "tpu_defaults": mlconf.tpu.to_dict(),
+            "config_overrides": {},
+        })
+
+    # -- runs ----------------------------------------------------------------
+    @r.post(API + "/projects/{project}/runs/{uid}")
+    async def store_run(request):
+        body = await request.json()
+        state.db.store_run(body, request.match_info["uid"],
+                           request.match_info["project"],
+                           iter=int(request.query.get("iter", 0)))
+        return json_response({"ok": True})
+
+    @r.patch(API + "/projects/{project}/runs/{uid}")
+    async def update_run(request):
+        body = await request.json()
+        state.db.update_run(body, request.match_info["uid"],
+                            request.match_info["project"],
+                            iter=int(request.query.get("iter", 0)))
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/runs/{uid}")
+    async def read_run(request):
+        run = state.db.read_run(request.match_info["uid"],
+                                request.match_info["project"],
+                                iter=int(request.query.get("iter", 0)))
+        if run is None:
+            return error_response("run not found", 404)
+        return json_response({"data": run})
+
+    @r.get(API + "/projects/{project}/runs")
+    async def list_runs(request):
+        q = request.query
+        runs = state.db.list_runs(
+            name=q.get("name", ""), project=request.match_info["project"],
+            state=q.get("state", ""), labels=q.getall("label", None),
+            last=int(q.get("last", 0)), iter=bool(int(q.get("iter", 0))),
+            uid=q.getall("uid", None))
+        return json_response({"runs": runs})
+
+    @r.delete(API + "/projects/{project}/runs/{uid}")
+    async def del_run(request):
+        state.db.del_run(request.match_info["uid"],
+                         request.match_info["project"],
+                         iter=int(request.query.get("iter", 0)))
+        return json_response({"ok": True})
+
+    @r.post(API + "/projects/{project}/runs/{uid}/abort")
+    async def abort_run(request):
+        uid = request.match_info["uid"]
+        project = request.match_info["project"]
+        run = state.db.read_run(uid, project)
+        if run is None:
+            return error_response("run not found", 404)
+        kind = get_in(run, "metadata.labels.kind", "job")
+        try:
+            handler = state.launcher.handler_for(kind)
+            handler.abort_run(uid, project)
+        except ValueError:
+            state.db.abort_run(uid, project)
+        state.db.emit_event("run_aborted", {"uid": uid}, project)
+        return json_response({"ok": True})
+
+    # -- logs ----------------------------------------------------------------
+    @r.post(API + "/projects/{project}/logs/{uid}")
+    async def store_log(request):
+        body = await request.read()
+        state.db.store_log(request.match_info["uid"],
+                           request.match_info["project"], body,
+                           append=bool(int(request.query.get("append", 1))))
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/logs/{uid}")
+    async def get_log(request):
+        log_state, data = state.db.get_log(
+            request.match_info["uid"], request.match_info["project"],
+            offset=int(request.query.get("offset", 0)),
+            size=int(request.query.get("size", -1)))
+        return web.Response(body=data, headers={
+            "x-mlt-run-state": log_state or "unknown"})
+
+    @r.get(API + "/projects/{project}/logs/{uid}/size")
+    async def get_log_size(request):
+        size = state.db.get_log_size(request.match_info["uid"],
+                                     request.match_info["project"])
+        return json_response({"size": size})
+
+    # -- artifacts ------------------------------------------------------------
+    @r.post(API + "/projects/{project}/artifacts/{key}")
+    async def store_artifact(request):
+        body = await request.json()
+        q = request.query
+        state.db.store_artifact(
+            request.match_info["key"], body, uid=q.get("uid"),
+            iter=int(q.get("iter") or 0), tag=q.get("tag", ""),
+            project=request.match_info["project"], tree=q.get("tree"))
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/artifacts/{key}")
+    async def read_artifact(request):
+        from ..db.base import RunDBError
+
+        q = request.query
+        try:
+            artifact = state.db.read_artifact(
+                request.match_info["key"], tag=q.get("tag"),
+                iter=int(q.get("iter") or 0) if q.get("iter") else None,
+                project=request.match_info["project"], tree=q.get("tree"),
+                uid=q.get("uid"))
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": artifact})
+
+    @r.get(API + "/projects/{project}/artifacts")
+    async def list_artifacts(request):
+        q = request.query
+        artifacts = state.db.list_artifacts(
+            name=q.get("name", ""), project=request.match_info["project"],
+            tag=q.get("tag"), labels=q.getall("label", None),
+            kind=q.get("kind"), tree=q.get("tree"))
+        return json_response({"artifacts": artifacts})
+
+    @r.delete(API + "/projects/{project}/artifacts/{key}")
+    async def del_artifact(request):
+        state.db.del_artifact(
+            request.match_info["key"], tag=request.query.get("tag"),
+            project=request.match_info["project"],
+            uid=request.query.get("uid"))
+        return json_response({"ok": True})
+
+    # -- functions -------------------------------------------------------------
+    @r.post(API + "/projects/{project}/functions/{name}")
+    async def store_function(request):
+        body = await request.json()
+        hash_key = state.db.store_function(
+            body, request.match_info["name"], request.match_info["project"],
+            tag=request.query.get("tag", ""),
+            versioned=bool(int(request.query.get("versioned", 0))))
+        return json_response({"hash_key": hash_key})
+
+    @r.get(API + "/projects/{project}/functions/{name}")
+    async def get_function(request):
+        from ..db.base import RunDBError
+
+        try:
+            func = state.db.get_function(
+                request.match_info["name"], request.match_info["project"],
+                tag=request.query.get("tag", ""),
+                hash_key=request.query.get("hash_key", ""))
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"func": func})
+
+    @r.get(API + "/projects/{project}/functions")
+    async def list_functions(request):
+        funcs = state.db.list_functions(
+            name=request.query.get("name", ""),
+            project=request.match_info["project"],
+            tag=request.query.get("tag", ""),
+            labels=request.query.getall("label", None))
+        return json_response({"funcs": funcs})
+
+    @r.delete(API + "/projects/{project}/functions/{name}")
+    async def delete_function(request):
+        state.db.delete_function(request.match_info["name"],
+                                 request.match_info["project"])
+        return json_response({"ok": True})
+
+    @r.post(API + "/projects/{project}/functions/{name}/deploy")
+    async def deploy_function(request):
+        # Nuclio replaced: deploys of serving/remote kinds mark ready; a
+        # real gateway process is started by `mlrun-tpu serve` (asgi module)
+        body = await request.json()
+        function = body.get("function", {})
+        update_in(function, "status.state", "ready")
+        address = function.get("status", {}).get("address", "")
+        state.db.store_function(
+            function, request.match_info["name"],
+            request.match_info["project"],
+            tag=function.get("metadata", {}).get("tag", "latest"))
+        return json_response({"data": {"state": "ready",
+                                       "address": address}})
+
+    # -- build ------------------------------------------------------------------
+    @r.post(API + "/build/function")
+    async def build_function(request):
+        """Image-build analog (reference Kaniko builder,
+        server/api/utils/builder.py): with prebuilt TPU images + code-in-env
+        there is nothing to bake — resolve the image and mark ready."""
+        body = await request.json()
+        function = body.get("function", {})
+        with_tpu = body.get("with_tpu", False)
+        image = get_in(function, "spec.image", "") or (
+            mlconf.function.tpu_image if with_tpu
+            else mlconf.function.default_image)
+        update_in(function, "spec.image", image)
+        update_in(function, "status.state", "ready")
+        name = get_in(function, "metadata.name", "fn")
+        project = get_in(function, "metadata.project",
+                         mlconf.default_project)
+        state.db.store_function(function, name, project,
+                                tag=get_in(function, "metadata.tag",
+                                           "latest"))
+        return json_response({"data": {"status": {"state": "ready",
+                                                  "image": image}}})
+
+    # -- submit ------------------------------------------------------------------
+    @r.post(API + "/submit_job")
+    async def submit_job(request):
+        """The core submission path (reference endpoints/submit.py:40 →
+        api/utils.py:207 submit_run)."""
+        body = await request.json()
+        function_dict = body.get("function")
+        task = body.get("task") or {"metadata": body.get("metadata", {}),
+                                    "spec": body.get("spec", {})}
+        schedule = body.get("schedule")
+        if not function_dict:
+            # resolve from the db via task.spec.function uri
+            uri = get_in(task, "spec.function", "")
+            if not uri:
+                return error_response("missing function")
+            project_part, _, rest = uri.partition("/")
+            name, _, tag = rest.partition(":")
+            tag, _, hash_key = tag.partition("@")
+            function_dict = state.db.get_function(
+                name, project_part, tag=tag or "latest")
+
+        run = RunObject.from_dict(
+            {"metadata": task.get("metadata", {}),
+             "spec": task.get("spec", {})})
+        run.metadata.uid = run.metadata.uid or generate_uid()
+        run.metadata.project = (run.metadata.project
+                                or mlconf.default_project)
+        runtime = rebuild_function(function_dict)
+        run.metadata.labels.setdefault("kind", runtime.kind)
+
+        if schedule:
+            record = {
+                "name": run.metadata.name, "project": run.metadata.project,
+                "kind": "job", "cron_trigger": schedule,
+                "scheduled_object": {"function": function_dict,
+                                     "task": run.to_dict()},
+                "creation_time": now_iso(),
+            }
+            try:
+                cron = CronSchedule(schedule)
+            except ValueError as exc:
+                return error_response(f"bad schedule: {exc}")
+            if cron.min_interval_seconds() < \
+                    mlconf.scheduler.min_allowed_interval_seconds:
+                return error_response("schedule interval below minimum")
+            record["next_run_time"] = str(
+                cron.next_after(datetime.now(timezone.utc)))
+            state.db.store_schedule(run.metadata.project, run.metadata.name,
+                                    record)
+            return json_response({"data": {"schedule": schedule,
+                                           "metadata":
+                                           run.to_dict()["metadata"]}})
+
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: state.launcher.launch(runtime, run))
+        except Exception as exc:  # noqa: BLE001
+            return error_response(f"launch failed: {exc}", 500)
+        return json_response({"data": run.to_dict()})
+
+    # -- schedules -----------------------------------------------------------------
+    @r.post(API + "/projects/{project}/schedules/{name}")
+    async def store_schedule(request):
+        body = await request.json()
+        try:
+            CronSchedule(body.get("cron_trigger", ""))
+        except ValueError as exc:
+            return error_response(f"bad cron: {exc}")
+        state.db.store_schedule(request.match_info["project"],
+                                request.match_info["name"], body)
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/schedules/{name}")
+    async def get_schedule(request):
+        from ..db.base import RunDBError
+
+        try:
+            schedule = state.db.get_schedule(request.match_info["project"],
+                                             request.match_info["name"])
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": schedule})
+
+    @r.get(API + "/projects/{project}/schedules")
+    async def list_schedules(request):
+        return json_response({"schedules": state.db.list_schedules(
+            request.match_info["project"])})
+
+    @r.delete(API + "/projects/{project}/schedules/{name}")
+    async def delete_schedule(request):
+        state.db.delete_schedule(request.match_info["project"],
+                                 request.match_info["name"])
+        return json_response({"ok": True})
+
+    # -- projects ---------------------------------------------------------------------
+    @r.post(API + "/projects/{name}")
+    async def store_project(request):
+        body = await request.json()
+        stored = state.db.store_project(request.match_info["name"], body)
+        return json_response({"data": stored})
+
+    @r.get(API + "/projects/{name}")
+    async def get_project(request):
+        project = state.db.get_project(request.match_info["name"])
+        if project is None:
+            return error_response("project not found", 404)
+        return json_response({"data": project})
+
+    @r.get(API + "/projects")
+    async def list_projects(request):
+        return json_response({"projects": state.db.list_projects(
+            state=request.query.get("state"))})
+
+    @r.delete(API + "/projects/{name}")
+    async def delete_project(request):
+        from ..db.base import RunDBError
+
+        try:
+            state.db.delete_project(
+                request.match_info["name"],
+                deletion_strategy=request.query.get(
+                    "deletion_strategy", "restricted"))
+        except RunDBError as exc:
+            return error_response(str(exc), 412)
+        return json_response({"ok": True})
+
+    # -- feature store -------------------------------------------------------------------
+    def _fs_routes(kind: str, store, get, list_, delete):
+        @r.post(API + "/projects/{project}/" + kind + "/{name}")
+        async def _store(request):
+            body = await request.json()
+            uid = store(body, name=request.match_info["name"],
+                        project=request.match_info["project"],
+                        tag=request.query.get("tag"),
+                        uid=request.query.get("uid"))
+            return json_response({"uid": uid})
+
+        @r.get(API + "/projects/{project}/" + kind + "/{name}")
+        async def _get(request):
+            from ..db.base import RunDBError
+
+            try:
+                obj = get(request.match_info["name"],
+                          project=request.match_info["project"],
+                          tag=request.query.get("tag"),
+                          uid=request.query.get("uid"))
+            except RunDBError as exc:
+                return error_response(str(exc), 404)
+            return json_response({"data": obj})
+
+        @r.get(API + "/projects/{project}/" + kind)
+        async def _list(request):
+            objs = list_(project=request.match_info["project"],
+                         name=request.query.get("name", ""),
+                         tag=request.query.get("tag"))
+            return json_response({kind.replace("-", "_"): objs})
+
+        @r.delete(API + "/projects/{project}/" + kind + "/{name}")
+        async def _delete(request):
+            delete(request.match_info["name"],
+                   project=request.match_info["project"])
+            return json_response({"ok": True})
+
+    _fs_routes("feature-sets", state.db.store_feature_set,
+               state.db.get_feature_set, state.db.list_feature_sets,
+               state.db.delete_feature_set)
+    _fs_routes("feature-vectors", state.db.store_feature_vector,
+               state.db.get_feature_vector, state.db.list_feature_vectors,
+               state.db.delete_feature_vector)
+
+    # -- model endpoints --------------------------------------------------------------------
+    @r.post(API + "/projects/{project}/model-endpoints/{uid}")
+    async def store_endpoint(request):
+        body = await request.json()
+        state.db.store_model_endpoint(request.match_info["project"],
+                                      request.match_info["uid"], body)
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/model-endpoints/{uid}")
+    async def get_endpoint(request):
+        from ..db.base import RunDBError
+
+        try:
+            endpoint = state.db.get_model_endpoint(
+                request.match_info["project"], request.match_info["uid"])
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": endpoint})
+
+    @r.get(API + "/projects/{project}/model-endpoints")
+    async def list_endpoints(request):
+        endpoints = state.db.list_model_endpoints(
+            request.match_info["project"],
+            model=request.query.get("model", ""),
+            function=request.query.get("function", ""),
+            state=request.query.get("state", ""))
+        return json_response({"endpoints": endpoints})
+
+    @r.delete(API + "/projects/{project}/model-endpoints/{uid}")
+    async def delete_endpoint(request):
+        state.db.delete_model_endpoint(request.match_info["project"],
+                                       request.match_info["uid"])
+        return json_response({"ok": True})
+
+    # -- alerts / events -------------------------------------------------------------------
+    @r.post(API + "/projects/{project}/alerts/{name}")
+    async def store_alert(request):
+        body = await request.json()
+        state.db.store_alert_config(request.match_info["name"], body,
+                                    request.match_info["project"])
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/alerts/{name}")
+    async def get_alert(request):
+        from ..db.base import RunDBError
+
+        try:
+            alert = state.db.get_alert_config(request.match_info["name"],
+                                              request.match_info["project"])
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": alert})
+
+    @r.get(API + "/projects/{project}/alerts")
+    async def list_alerts(request):
+        return json_response({"alerts": state.db.list_alert_configs(
+            request.match_info["project"])})
+
+    @r.delete(API + "/projects/{project}/alerts/{name}")
+    async def delete_alert(request):
+        state.db.delete_alert_config(request.match_info["name"],
+                                     request.match_info["project"])
+        return json_response({"ok": True})
+
+    @r.post(API + "/projects/{project}/events/{kind}")
+    async def emit_event(request):
+        body = await request.json()
+        project = request.match_info["project"]
+        kind = request.match_info["kind"]
+        state.db.emit_event(kind, body, project)
+        from .alerts import process_event
+
+        fired = process_event(state.db, project, kind, body)
+        return json_response({"ok": True, "alerts_fired": fired})
+
+    # -- workflows -----------------------------------------------------------------------
+    @r.post(API + "/projects/{project}/workflows/submit")
+    async def submit_workflow(request):
+        body = await request.json()
+        workflow_id = generate_uid()
+        project = request.match_info["project"]
+        state.workflows[workflow_id] = {
+            "id": workflow_id, "project": project,
+            "state": RunStates.running, "spec": body, "started": now_iso(),
+        }
+
+        def run_workflow():
+            try:
+                from ..projects.pipelines import load_and_run
+
+                # workflow spec carries the project source + workflow path
+                pipeline = body.get("pipeline", {})
+                from ..projects import load_project
+
+                proj = load_project(
+                    context=pipeline.get("context", "./"),
+                    name=project, save=False)
+                status = proj.run(
+                    name=pipeline.get("name", ""),
+                    workflow_path=pipeline.get("path", ""),
+                    arguments=body.get("arguments"),
+                    artifact_path=body.get("artifact_path", ""),
+                    engine="local")
+                state.workflows[workflow_id]["state"] = status.state
+            except Exception as exc:  # noqa: BLE001
+                state.workflows[workflow_id]["state"] = RunStates.error
+                state.workflows[workflow_id]["error"] = str(exc)
+
+        threading.Thread(target=run_workflow, daemon=True).start()
+        return json_response({"id": workflow_id})
+
+    @r.get(API + "/projects/{project}/workflows/{workflow_id}")
+    async def workflow_status(request):
+        workflow = state.workflows.get(request.match_info["workflow_id"])
+        if workflow is None:
+            return error_response("workflow not found", 404)
+        return json_response({"state": workflow["state"],
+                              "error": workflow.get("error")})
+
+    # -- background tasks --------------------------------------------------------------------
+    @r.get(API + "/projects/{project}/background-tasks/{name}")
+    async def get_background_task(request):
+        task = state.db.get_background_task(
+            request.match_info["name"], request.match_info["project"])
+        if task is None:
+            return error_response("background task not found", 404)
+        return json_response({"data": task})
+
+    app.add_routes(r)
+    app.on_startup.append(_start_periodic)
+    app.on_cleanup.append(_stop_periodic)
+    return app
+
+
+async def _start_periodic(app: web.Application):
+    state: ServiceState = app["state"]
+
+    async def monitor_loop():
+        while True:
+            await asyncio.sleep(
+                min(float(mlconf.runs.monitoring_interval), 5.0))
+            await asyncio.get_event_loop().run_in_executor(
+                None, state.launcher.monitor_all)
+
+    async def scheduler_loop():
+        fired: dict[tuple, str] = {}
+        while True:
+            await asyncio.sleep(float(mlconf.scheduler.tick_seconds))
+            now = datetime.now(timezone.utc)
+            minute_key = now.strftime("%Y%m%d%H%M")
+            for schedule in state.db.list_schedules("*"):
+                try:
+                    cron = CronSchedule(schedule.get("cron_trigger", ""))
+                except ValueError:
+                    continue
+                key = (schedule.get("project"), schedule.get("name"))
+                if cron.matches(now) and fired.get(key) != minute_key:
+                    fired[key] = minute_key
+                    await _fire_schedule(state, schedule)
+
+    app["_periodic"] = [
+        asyncio.create_task(monitor_loop()),
+        asyncio.create_task(scheduler_loop()),
+    ]
+
+
+async def _fire_schedule(state: ServiceState, schedule: dict):
+    """reference analog: scheduler.py:991 submit_run_wrapper."""
+    try:
+        obj = schedule.get("scheduled_object", {})
+        runtime = rebuild_function(obj.get("function", {}))
+        task = RunObject.from_dict(obj.get("task", {}))
+        task.metadata.uid = generate_uid()
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            None, lambda: state.launcher.launch(runtime, task))
+        schedule["last_run_uri"] = (
+            f"{task.metadata.project}/{task.metadata.uid}")
+        state.db.store_schedule(schedule.get("project", ""),
+                                schedule.get("name", ""), schedule)
+        logger.info("schedule fired", name=schedule.get("name"))
+    except Exception as exc:  # noqa: BLE001
+        logger.error("schedule firing failed", name=schedule.get("name"),
+                     error=str(exc))
+
+
+async def _stop_periodic(app: web.Application):
+    for task in app.get("_periodic", []):
+        task.cancel()
+
+
+def run_app(host: str = "0.0.0.0", port: int = 8787):
+    # make the advertised port consistent for spawned run resources
+    mlconf.httpdb.port = port
+    logger.info("starting mlrun-tpu service", host=host, port=port,
+                version=__version__)
+    web.run_app(build_app(), host=host, port=port, print=None)

@@ -1,0 +1,108 @@
+"""HTTP gateway hosting a serving graph — the Nuclio-replica replacement.
+
+Reference analog: Nuclio wraps GraphServer via v2_serving_init/handler
+(mlrun/serving/server.py:315,387). Here an aiohttp app does the same: the
+graph is built from SERVING_SPEC_ENV (or a passed spec/function), events run
+through GraphServer.run; TPU model steps execute XLA-compiled callables in a
+dedicated executor thread so the event loop stays responsive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import os
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..config import mlconf
+from ..utils import logger
+from .server import GraphContext, GraphServer, MockEvent, Response
+
+
+def build_serving_app(server: GraphServer) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    # single executor thread: TPU compute serializes anyway; keeps
+    # compiled-fn calls off the event loop
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    app["server"] = server
+    app["latencies"] = []
+
+    async def handle(request: web.Request):
+        started = time.perf_counter()
+        body = None
+        if request.can_read_body:
+            raw = await request.read()
+            content_type = request.headers.get("Content-Type", "")
+            if "json" in content_type or (raw[:1] in (b"{", b"[")):
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    body = raw
+            else:
+                body = raw
+        event = MockEvent(body=body, path=request.path,
+                          method=request.method,
+                          headers=dict(request.headers))
+        loop = asyncio.get_event_loop()
+        result = await loop.run_in_executor(
+            executor, lambda: server.run(event, get_body=True))
+        elapsed = time.perf_counter() - started
+        app["latencies"].append(elapsed)
+        if len(app["latencies"]) > 10000:
+            del app["latencies"][:5000]
+        if isinstance(result, Response):
+            payload = result.body
+            status = result.status_code
+        else:
+            payload = result
+            status = 200
+        if isinstance(payload, (bytes, str)):
+            return web.Response(
+                body=payload if isinstance(payload, bytes)
+                else payload.encode(), status=status)
+        return web.json_response(payload, status=status,
+                                 dumps=lambda d: json.dumps(d, default=str))
+
+    async def stats(request):
+        lat = sorted(app["latencies"])
+        n = len(lat)
+        return web.json_response({
+            "requests": n,
+            "p50_ms": round(lat[n // 2] * 1000, 2) if n else None,
+            "p99_ms": round(lat[int(n * 0.99)] * 1000, 2) if n else None,
+        })
+
+    app.router.add_get("/__stats__", stats)
+    app.router.add_route("*", "/{tail:.*}", handle)
+    return app
+
+
+def server_from_env(namespace: dict | None = None) -> GraphServer:
+    spec_env = os.environ.get("SERVING_SPEC_ENV", "")
+    if not spec_env:
+        raise ValueError("SERVING_SPEC_ENV is not set")
+    spec = json.loads(spec_env)
+    server = GraphServer.from_dict(spec)
+    context = GraphContext(server=server)
+    server.init_states(context, namespace or {})
+    return server
+
+
+def serve(function=None, spec: dict | None = None, host: str = "0.0.0.0",
+          port: int = 8080, namespace: dict | None = None):
+    """Start the gateway for a ServingRuntime object, a serialized spec, or
+    the SERVING_SPEC_ENV contract."""
+    if function is not None:
+        server = function.to_mock_server(namespace=namespace)
+        server.context.is_mock = False
+    elif spec is not None:
+        server = GraphServer.from_dict(spec)
+        server.init_states(GraphContext(server=server), namespace or {})
+    else:
+        server = server_from_env(namespace)
+    logger.info("serving graph gateway starting", host=host, port=port)
+    web.run_app(build_serving_app(server), host=host, port=port, print=None)

@@ -1,0 +1,291 @@
+"""TPU LLM inference engine: XLA-compiled prefill + decode with a KV cache.
+
+This is the serving-side counterpart of models/llama.py, built for the
+<200ms p50 TTFT target (BASELINE.md): weight-resident params, compile-cache
+warmup at load, prefill bucketed to power-of-two lengths (bounded compile
+count), decode as a jitted single-token step with donated cache. The
+reference has no model inference engine at all — its V2ModelServer calls
+user predict() (mlrun/serving/v2_serving.py); here predict() runs this
+engine on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig, Params
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rope, rope_table
+from ..utils import logger
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> dict:
+    dtype = dtype or config.dtype
+    shape = (config.n_layers, batch, max_len, config.n_kv_heads,
+             config.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
+    """q: [B, S, H, D]; caches: [B, M, HKV, D]. Causal over positions."""
+    n_rep = config.n_heads // config.n_kv_heads
+    b, m = k_cache.shape[0], k_cache.shape[1]
+    if n_rep > 1:
+        k_cache = jnp.repeat(k_cache, n_rep, axis=2)
+        v_cache = jnp.repeat(v_cache, n_rep, axis=2)
+    scale = config.head_dim ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(m)[None, :]  # [1, M]
+    mask = (k_pos[None] <= q_positions[:, :, None])  # [B, S, M]
+    logits = jnp.where(mask[:, None], logits, -2.0**30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v_cache)
+
+
+def _forward_with_cache(config: LlamaConfig, params: Params,
+                        tokens: jax.Array, cache: dict,
+                        lora: Optional[Params] = None):
+    """Run tokens starting at cache['pos']; returns (logits_last, new_cache)."""
+    b, s = tokens.shape
+    max_len = cache["k"].shape[2]
+    start = cache["pos"]  # [B]
+    positions = start[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    x = params["embedding"][tokens].astype(config.dtype)
+    # rope per batch row (positions differ per row only after mixed prefill;
+    # keep a single table using row 0 — engine keeps pos uniform per batch)
+    cos, sin = rope_table(positions[0], config.head_dim, config.rope_theta)
+
+    def body(x_in, layer_idx_and_params):
+        layer, lp = layer_idx_and_params
+        h = rms_norm(x_in, lp["attn_norm_scale"], config.norm_eps)
+
+        def proj(h_in, w):
+            return jnp.einsum("bse,eh->bsh", h_in, w,
+                              preferred_element_type=jnp.float32
+                              ).astype(x_in.dtype)
+
+        q = proj(h, lp["wq"]).reshape(b, s, config.n_heads, config.head_dim)
+        k = proj(h, lp["wk"]).reshape(b, s, config.n_kv_heads,
+                                      config.head_dim)
+        v = proj(h, lp["wv"]).reshape(b, s, config.n_kv_heads,
+                                      config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write k,v into the cache at start..start+s (uniform start)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"][layer], k.astype(cache["k"].dtype),
+            (0, start[0], 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"][layer], v.astype(cache["v"].dtype),
+            (0, start[0], 0, 0))
+        attn = _cached_attention(config, q, k_cache, v_cache, positions,
+                                 max_len)
+        attn = attn.reshape(b, s, config.qkv_dim)
+        x_mid = x_in + proj(attn, lp["wo"])
+        h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
+        gate = proj(h2, lp["w_gate"])
+        up = proj(h2, lp["w_up"])
+        out = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
+        return out, (k_cache, v_cache)
+
+    # python loop over layers: compiled once per bucket; exposes per-layer
+    # cache updates without scan-carry gymnastics
+    new_k, new_v = [], []
+    for layer in range(config.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        x, (k_cache, v_cache) = body(x, (layer, lp))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x[:, -1:], head,
+                        preferred_element_type=jnp.float32)
+    new_cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "pos": cache["pos"] + s,
+    }
+    return logits[:, 0], new_cache
+
+
+class LLMEngine:
+    """Compiled prefill/decode around a Llama param tree."""
+
+    def __init__(self, config: LlamaConfig, params: Params,
+                 max_len: int = 2048, batch: int = 1,
+                 prefill_buckets: tuple = (128, 512, 1024),
+                 temperature: float = 0.0):
+        self.config = config
+        self.params = params
+        self.max_len = max_len
+        self.batch = batch
+        self.temperature = temperature
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
+
+        self._prefill = jax.jit(
+            functools.partial(_forward_with_cache, config))
+        self._decode = jax.jit(
+            functools.partial(_forward_with_cache, config),
+            donate_argnums=(2,))
+
+    def warmup(self):
+        """Compile every prefill bucket + the decode step ahead of traffic."""
+        started = time.perf_counter()
+        for bucket in self.prefill_buckets:
+            cache = init_kv_cache(self.config, self.batch, self.max_len)
+            tokens = jnp.zeros((self.batch, bucket), jnp.int32)
+            logits, cache = self._prefill(self.params, tokens, cache)
+            step_tok = jnp.zeros((self.batch, 1), jnp.int32)
+            logits, cache = self._decode(self.params, step_tok, cache)
+            jax.block_until_ready(logits)
+        logger.info("llm engine warm", buckets=list(self.prefill_buckets),
+                    warmup_s=round(time.perf_counter() - started, 2))
+
+    def _bucket_for(self, length: int) -> int:
+        for bucket in self.prefill_buckets:
+            if length <= bucket:
+                return bucket
+        return self.max_len
+
+    def generate(self, prompt_tokens, max_new_tokens: int = 64,
+                 eos_id: int | None = None) -> tuple[list[int], dict]:
+        """Greedy/temperature generation for a single prompt (batch=1 row
+        replicated); returns (tokens, timing stats)."""
+        import numpy as np
+
+        prompt = np.asarray(prompt_tokens, dtype=np.int32).reshape(1, -1)
+        prompt_len = prompt.shape[1]
+        bucket = self._bucket_for(prompt_len)
+        padded = np.zeros((self.batch, bucket), np.int32)
+        padded[:, :prompt_len] = prompt
+
+        t0 = time.perf_counter()
+        cache = init_kv_cache(self.config, self.batch, self.max_len)
+        logits, cache = self._prefill(self.params, jnp.asarray(padded), cache)
+        # bucket padding advanced pos past prompt; rewind to prompt_len
+        cache["pos"] = jnp.full((self.batch,), prompt_len, jnp.int32)
+        # logits at the last *real* prompt position were computed only if
+        # prompt_len == bucket; otherwise take them from a 1-token replay of
+        # the last prompt token (cheap decode step)
+        if prompt_len != bucket:
+            cache["pos"] = jnp.full((self.batch,), prompt_len - 1, jnp.int32)
+            last = jnp.asarray(prompt[:, -1:].repeat(self.batch, 0))
+            logits, cache = self._decode(self.params, last, cache)
+        next_token = self._sample(logits)
+        jax.block_until_ready(next_token)
+        ttft = time.perf_counter() - t0
+
+        out_tokens = [int(np.asarray(next_token)[0])]
+        t1 = time.perf_counter()
+        for _ in range(max_new_tokens - 1):
+            if eos_id is not None and out_tokens[-1] == eos_id:
+                break
+            step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
+            logits, cache = self._decode(self.params, step, cache)
+            next_token = self._sample(logits)
+            out_tokens.append(int(jax.block_until_ready(next_token)[0]))
+        decode_time = time.perf_counter() - t1
+        stats = {
+            "ttft_s": ttft,
+            "decode_tokens_per_sec": (len(out_tokens) - 1) / decode_time
+            if decode_time > 0 and len(out_tokens) > 1 else 0.0,
+            "prompt_len": prompt_len,
+            "generated": len(out_tokens),
+        }
+        return out_tokens, stats
+
+    def _sample(self, logits):
+        if self.temperature and self.temperature > 0:
+            key = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+            return jax.random.categorical(
+                key, logits / self.temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+
+class LLMModelServer:
+    """Serving-graph step: tokenization on host, generation on TPU.
+
+    class args: model_preset|model_path, tokenizer, max_len, warmup...
+    """
+
+    def __new__(cls, *args, **kwargs):
+        from .v2_serving import V2ModelServer
+
+        class _Server(V2ModelServer):
+            def __init__(self, *a, model_preset: str = "tiny",
+                         tokenizer: str | None = None, max_len: int = 1024,
+                         max_new_tokens: int = 64, hf_model: str | None = None,
+                         temperature: float = 0.0, warmup: bool = True, **kw):
+                super().__init__(*a, **kw)
+                self.model_preset = model_preset
+                self.tokenizer_id = tokenizer
+                self.max_len = max_len
+                self.max_new_tokens = max_new_tokens
+                self.hf_model = hf_model
+                self.temperature = temperature
+                self._warmup = warmup
+                self._tokenizer = None
+                self.engine: LLMEngine | None = None
+
+            def load(self):
+                from ..frameworks.jax.auto_trainer import MODEL_PRESETS
+                from ..models import init_params
+
+                if self.hf_model:
+                    from ..frameworks.huggingface import (
+                        load_hf_weights_into_llama,
+                    )
+
+                    config, params = load_hf_weights_into_llama(self.hf_model)
+                else:
+                    config = MODEL_PRESETS[self.model_preset]()
+                    params = init_params(config, jax.random.PRNGKey(0))
+                if self.tokenizer_id:
+                    from transformers import AutoTokenizer
+
+                    self._tokenizer = AutoTokenizer.from_pretrained(
+                        self.tokenizer_id)
+                self.engine = LLMEngine(
+                    config, params, max_len=self.max_len,
+                    temperature=self.temperature)
+                if self._warmup:
+                    self.engine.warmup()
+                self.model = self.engine
+
+            def predict(self, request):
+                outputs = []
+                for item in request["inputs"]:
+                    if isinstance(item, str):
+                        if self._tokenizer is None:
+                            raise ValueError(
+                                "string inputs need a tokenizer= class arg")
+                        ids = self._tokenizer(item)["input_ids"]
+                    else:
+                        ids = list(item)
+                    tokens, stats = self.engine.generate(
+                        ids, max_new_tokens=self.max_new_tokens)
+                    self.set_metric("ttft_s", stats["ttft_s"])
+                    self.set_metric("decode_tps",
+                                    stats["decode_tokens_per_sec"])
+                    if self._tokenizer is not None and isinstance(item, str):
+                        outputs.append(self._tokenizer.decode(tokens))
+                    else:
+                        outputs.append(tokens)
+                return outputs
+
+        return _Server(*args, **kwargs)

@@ -64,6 +64,11 @@ class BaseModelRouter:
                 }
                 return event
         model, op = self._resolve_route(event)
+        if event.body is None and getattr(event, "method", "POST") == "GET" \
+                and (not model or model not in self.routes):
+            event.body = {"models": list(self.routes.keys()),
+                          "router": self.name}
+            return event
         if not model:
             if len(self.routes) == 1:
                 model = next(iter(self.routes))

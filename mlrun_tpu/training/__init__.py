@@ -1,0 +1,16 @@
+from .checkpoint import CheckpointManager, save_checkpoint_artifact  # noqa: F401
+from .data import (  # noqa: F401
+    array_token_stream,
+    per_process_batch,
+    synthetic_token_stream,
+    text_file_stream,
+)
+from .mfu import chip_peak_flops, mfu  # noqa: F401
+from .train import (  # noqa: F401
+    TrainConfig,
+    Trainer,
+    TrainState,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)

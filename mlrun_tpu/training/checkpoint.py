@@ -1,0 +1,88 @@
+"""Orbax-based checkpoint/resume.
+
+The reference has no step-level checkpointing (SURVEY.md §5.4) — this is the
+TPU-native addition demanded by preemptible slices: async orbax saves of the
+sharded train state into the artifact store layer, registered as model
+artifacts so resume rides the same registry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from ..utils import logger
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 0):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps or 1,
+            enable_async_checkpointing=True)
+        self._manager = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        import orbax.checkpoint as ocp
+
+        saved = self._manager.save(
+            step, args=ocp.args.StandardSave(_to_pytree(state)), force=force)
+        return bool(saved)
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        target = _to_pytree(state_like)
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(target))
+        return _from_pytree(state_like, restored)
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def wait(self):
+        self._manager.wait_until_finished()
+
+    def close(self):
+        self._manager.close()
+
+
+def _to_pytree(state):
+    from .train import TrainState
+
+    if isinstance(state, TrainState):
+        tree = {"params": state.params, "opt_state": state.opt_state,
+                "step": state.step}
+        if state.lora is not None:
+            tree["lora"] = state.lora
+        return tree
+    return state
+
+
+def _from_pytree(state_like, restored):
+    from .train import TrainState
+
+    if isinstance(state_like, TrainState):
+        return TrainState(
+            restored["params"], restored["opt_state"], restored["step"],
+            restored.get("lora"))
+    return restored
+
+
+def save_checkpoint_artifact(context, key: str, manager: CheckpointManager,
+                             framework: str = "jax", **kwargs):
+    """Register the checkpoint dir as a model artifact on the run."""
+    manager.wait()
+    return context.log_model(
+        key, model_dir=manager.directory, framework=framework,
+        upload=False, target_path=manager.directory, **kwargs)

@@ -1,0 +1,60 @@
+"""Training data pipelines.
+
+Replaces the reference's DistributedSampler+DataLoader role
+(frameworks/pytorch/mlrun_interface.py:903): batches are produced on host as
+full global arrays and placed with a sharded NamedSharding — each host only
+materializes what it feeds its local devices in multi-host (via
+jax.make_array_from_process_local_data when running SPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def synthetic_token_stream(batch_size: int, seq_len: int, vocab_size: int,
+                           seed: int = 0) -> Iterator[tuple]:
+    """Deterministic synthetic LM batches: (tokens, targets)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        tokens = rng.integers(0, vocab_size, (batch_size, seq_len + 1),
+                              dtype=np.int32)
+        yield tokens[:, :-1], tokens[:, 1:]
+
+
+def array_token_stream(token_array: np.ndarray, batch_size: int, seq_len: int,
+                       shuffle: bool = True, seed: int = 0,
+                       drop_last: bool = True) -> Iterator[tuple]:
+    """Chunk a flat token array into LM batches, looping forever."""
+    tokens = np.asarray(token_array, dtype=np.int32).reshape(-1)
+    n_chunks = (len(tokens) - 1) // seq_len
+    if n_chunks < 1:
+        raise ValueError("token array shorter than one sequence")
+    inputs = tokens[: n_chunks * seq_len].reshape(n_chunks, seq_len)
+    targets = tokens[1: n_chunks * seq_len + 1].reshape(n_chunks, seq_len)
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(n_chunks) if shuffle else np.arange(n_chunks)
+        for start in range(0, n_chunks - batch_size + 1, batch_size):
+            idx = order[start: start + batch_size]
+            yield inputs[idx], targets[idx]
+
+
+def text_file_stream(path: str, tokenizer, batch_size: int, seq_len: int,
+                     **kwargs) -> Iterator[tuple]:
+    """Tokenize a text file (HF tokenizer) into an LM stream."""
+    with open(path) as fp:
+        text = fp.read()
+    ids = np.asarray(tokenizer(text)["input_ids"], dtype=np.int32)
+    return array_token_stream(ids, batch_size, seq_len, **kwargs)
+
+
+def per_process_batch(global_batch: np.ndarray, sharding):
+    """Multi-host: build a global jax.Array from this process's slice."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(global_batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, global_batch)

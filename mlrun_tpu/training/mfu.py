@@ -1,0 +1,35 @@
+"""MFU accounting — model FLOPs utilization vs chip peak."""
+
+from __future__ import annotations
+
+import jax
+
+# peak dense bf16 TFLOP/s per chip
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5": 459e12,       # v5p
+    "v5p": 459e12,
+    "v6 lite": 918e12,  # trillium / v6e
+    "v6e": 918e12,
+    "cpu": 1e12,        # nominal, keeps the math defined on CPU meshes
+}
+
+
+def chip_peak_flops(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for name, peak in PEAK_FLOPS.items():
+        if name in kind:
+            return peak
+    if device.platform in ("tpu", "axon"):
+        return 197e12  # conservative default: v5e
+    return PEAK_FLOPS["cpu"]
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        n_chips: int | None = None, device=None) -> float:
+    n_chips = n_chips or jax.device_count()
+    peak = chip_peak_flops(device) * n_chips
+    return (tokens_per_sec * flops_per_token) / peak

@@ -1,0 +1,359 @@
+// mlt-logd — native log collector service.
+//
+// Reference analog: the Go log-collector (server/log-collector/pkg/services/
+// logcollector/server.go — StartLog :205 spawns a goroutine streaming pod
+// logs to files :731/:880; GetLogs :333 streams chunks back; file state
+// store; monitorLogCollection :1087 resumes after restart). Re-designed in
+// C++ (Go is not a target in this build): a thread-per-connection TCP
+// server with a line-oriented protocol, tailer threads that follow source
+// files (pod log files / pipes) into a durable store directory, and a file
+// state record so collection resumes after restart.
+//
+// Protocol (text header lines, binary payloads):
+//   START <project> <uid> <src_path>\n          -> OK\n
+//   APPEND <project> <uid> <nbytes>\n<bytes>    -> OK\n
+//   GET <project> <uid> <offset> <max>\n        -> OK <n>\n<bytes>
+//   SIZE <project> <uid>\n                      -> OK <n>\n
+//   STOP <project> <uid>\n                      -> OK\n
+//   LIST\n                                      -> OK <k>\n<project>/<uid>\n...
+//   PING\n                                      -> OK\n
+// Errors: ERR <message>\n
+
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::string g_store_dir = "/tmp/mlt-logs";
+std::atomic<bool> g_running{true};
+
+struct Tailer {
+  std::string project, uid, src;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+};
+
+std::mutex g_tailers_mu;
+std::map<std::string, Tailer*> g_tailers;  // key: project/uid
+// stopped tailers park here until exit — their detached threads may still
+// read the stop flag, so they must outlive the map entry
+std::vector<Tailer*> g_stopped;
+
+std::string key_of(const std::string& project, const std::string& uid) {
+  return project + "/" + uid;
+}
+
+bool valid_component(const std::string& s) {
+  if (s.empty() || s.size() > 256) return false;
+  for (char c : s) {
+    if (!(isalnum(static_cast<unsigned char>(c)) || c == '-' || c == '_' ||
+          c == '.'))
+      return false;
+    }
+  if (s == "." || s == "..") return false;
+  return true;
+}
+
+std::string dest_path(const std::string& project, const std::string& uid) {
+  return g_store_dir + "/" + project + "/" + uid;
+}
+
+void ensure_parent(const std::string& path) {
+  std::string dir = path.substr(0, path.rfind('/'));
+  std::string part;
+  std::stringstream ss(dir);
+  std::string cur;
+  for (size_t i = 0; i < dir.size(); ++i) {
+    cur += dir[i];
+    if (dir[i] == '/' || i == dir.size() - 1) {
+      if (cur != "/") mkdir(cur.c_str(), 0755);
+    }
+  }
+}
+
+// state store: one record file per active tail so restart resumes
+// (reference: statestore/file)
+std::string state_path(const std::string& project, const std::string& uid) {
+  return g_store_dir + "/.state/" + project + "__" + uid;
+}
+
+void write_state(const std::string& project, const std::string& uid,
+                 const std::string& src) {
+  std::string path = state_path(project, uid);
+  ensure_parent(path);
+  FILE* f = fopen(path.c_str(), "w");
+  if (f) {
+    fprintf(f, "%s\n%s\n%s\n", project.c_str(), uid.c_str(), src.c_str());
+    fclose(f);
+  }
+}
+
+void remove_state(const std::string& project, const std::string& uid) {
+  unlink(state_path(project, uid).c_str());
+}
+
+void tail_loop(Tailer* t) {
+  std::string dest = dest_path(t->project, t->uid);
+  ensure_parent(dest);
+  FILE* out = fopen(dest.c_str(), "ab");
+  if (!out) return;
+  // resume from how much we already copied
+  long copied = ftell(out);
+  char buf[64 * 1024];
+  int idle_ms = 0;
+  while (!t->stop.load() && g_running.load()) {
+    FILE* in = fopen(t->src.c_str(), "rb");
+    if (!in) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      idle_ms += 200;
+      if (idle_ms > 60 * 60 * 1000) break;  // source never appeared
+      continue;
+    }
+    fseek(in, copied, SEEK_SET);
+    size_t n = fread(buf, 1, sizeof(buf), in);
+    fclose(in);
+    if (n > 0) {
+      fwrite(buf, 1, n, out);
+      fflush(out);
+      copied += static_cast<long>(n);
+      idle_ms = 0;
+    } else {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      idle_ms += 100;
+    }
+  }
+  fclose(out);
+}
+
+void start_tail(const std::string& project, const std::string& uid,
+                const std::string& src, bool persist_state) {
+  std::lock_guard<std::mutex> lock(g_tailers_mu);
+  std::string key = key_of(project, uid);
+  if (g_tailers.count(key)) return;
+  Tailer* t = new Tailer();
+  t->project = project;
+  t->uid = uid;
+  t->src = src;
+  t->thread = std::thread(tail_loop, t);
+  g_tailers[key] = t;
+  if (persist_state) write_state(project, uid, src);
+}
+
+void resume_from_state() {
+  std::string dir = g_store_dir + "/.state";
+  DIR* d = opendir(dir.c_str());
+  if (!d) return;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (e->d_name[0] == '.') continue;
+    FILE* f = fopen((dir + "/" + e->d_name).c_str(), "r");
+    if (!f) continue;
+    char project[512], uid[512], src[4096];
+    if (fgets(project, sizeof(project), f) && fgets(uid, sizeof(uid), f) &&
+        fgets(src, sizeof(src), f)) {
+      auto strip = [](char* s) {
+        size_t len = strlen(s);
+        while (len && (s[len - 1] == '\n' || s[len - 1] == '\r'))
+          s[--len] = 0;
+      };
+      strip(project);
+      strip(uid);
+      strip(src);
+      start_tail(project, uid, src, false);
+      fprintf(stderr, "resumed log collection %s/%s <- %s\n", project, uid,
+              src);
+    }
+    fclose(f);
+  }
+  closedir(d);
+}
+
+bool read_line(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    *line += c;
+    if (line->size() > 16384) return false;
+  }
+}
+
+bool read_exact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void send_all(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return;
+    sent += static_cast<size_t>(r);
+  }
+}
+
+void send_str(int fd, const std::string& s) { send_all(fd, s.data(), s.size()); }
+
+void handle_conn(int fd) {
+  std::string line;
+  while (read_line(fd, &line)) {
+    std::istringstream iss(line);
+    std::string cmd;
+    iss >> cmd;
+    if (cmd == "PING") {
+      send_str(fd, "OK\n");
+    } else if (cmd == "START") {
+      std::string project, uid, src;
+      iss >> project >> uid >> src;
+      if (!valid_component(project) || !valid_component(uid) || src.empty()) {
+        send_str(fd, "ERR bad arguments\n");
+        continue;
+      }
+      start_tail(project, uid, src, true);
+      send_str(fd, "OK\n");
+    } else if (cmd == "APPEND") {
+      std::string project, uid;
+      long nbytes = 0;
+      iss >> project >> uid >> nbytes;
+      if (!valid_component(project) || !valid_component(uid) || nbytes < 0 ||
+          nbytes > (64L << 20)) {
+        send_str(fd, "ERR bad arguments\n");
+        continue;
+      }
+      std::vector<char> buf(static_cast<size_t>(nbytes));
+      if (nbytes && !read_exact(fd, buf.data(), buf.size())) break;
+      std::string dest = dest_path(project, uid);
+      ensure_parent(dest);
+      FILE* out = fopen(dest.c_str(), "ab");
+      if (!out) {
+        send_str(fd, "ERR open failed\n");
+        continue;
+      }
+      fwrite(buf.data(), 1, buf.size(), out);
+      fclose(out);
+      send_str(fd, "OK\n");
+    } else if (cmd == "GET") {
+      std::string project, uid;
+      long offset = 0, max = -1;
+      iss >> project >> uid >> offset >> max;
+      if (!valid_component(project) || !valid_component(uid)) {
+        send_str(fd, "ERR bad arguments\n");
+        continue;
+      }
+      FILE* in = fopen(dest_path(project, uid).c_str(), "rb");
+      if (!in) {
+        send_str(fd, "OK 0\n");
+        continue;
+      }
+      fseek(in, 0, SEEK_END);
+      long size = ftell(in);
+      if (offset > size) offset = size;
+      long n = size - offset;
+      if (max >= 0 && n > max) n = max;
+      std::vector<char> buf(static_cast<size_t>(n));
+      fseek(in, offset, SEEK_SET);
+      size_t got = fread(buf.data(), 1, buf.size(), in);
+      fclose(in);
+      char header[64];
+      snprintf(header, sizeof(header), "OK %zu\n", got);
+      send_str(fd, header);
+      send_all(fd, buf.data(), got);
+    } else if (cmd == "SIZE") {
+      std::string project, uid;
+      iss >> project >> uid;
+      struct stat st;
+      long size = 0;
+      if (valid_component(project) && valid_component(uid) &&
+          stat(dest_path(project, uid).c_str(), &st) == 0)
+        size = st.st_size;
+      char header[64];
+      snprintf(header, sizeof(header), "OK %ld\n", size);
+      send_str(fd, header);
+    } else if (cmd == "STOP") {
+      std::string project, uid;
+      iss >> project >> uid;
+      {
+        std::lock_guard<std::mutex> lock(g_tailers_mu);
+        auto it = g_tailers.find(key_of(project, uid));
+        if (it != g_tailers.end()) {
+          it->second->stop.store(true);
+          it->second->thread.detach();
+          g_stopped.push_back(it->second);
+          g_tailers.erase(it);
+        }
+      }
+      remove_state(project, uid);
+      send_str(fd, "OK\n");
+    } else if (cmd == "LIST") {
+      std::lock_guard<std::mutex> lock(g_tailers_mu);
+      char header[64];
+      snprintf(header, sizeof(header), "OK %zu\n", g_tailers.size());
+      send_str(fd, header);
+      for (auto& kv : g_tailers) send_str(fd, kv.first + "\n");
+    } else {
+      send_str(fd, "ERR unknown command\n");
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 8766;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--port" && i + 1 < argc) port = atoi(argv[++i]);
+    if (arg == "--store-dir" && i + 1 < argc) g_store_dir = argv[++i];
+  }
+  signal(SIGPIPE, SIG_IGN);
+  ensure_parent(g_store_dir + "/x");
+  resume_from_state();
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    fprintf(stderr, "bind failed on port %d: %s\n", port, strerror(errno));
+    return 1;
+  }
+  listen(srv, 64);
+  fprintf(stderr, "mlt-logd listening on 127.0.0.1:%d store=%s\n", port,
+          g_store_dir.c_str());
+  while (g_running.load()) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(handle_conn, fd).detach();
+  }
+  return 0;
+}

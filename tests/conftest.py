@@ -1,0 +1,66 @@
+"""Test fixtures (reference analog: tests/common_fixtures.py — config reset
+:58, RunDBMock :241).
+
+Tests run on a virtual 8-device CPU mesh so distributed step functions are
+unit-testable without TPUs (SURVEY.md §4 implication).
+"""
+
+import os
+import sys
+import tempfile
+
+# must happen before the first jax backend init. The host env pins
+# JAX_PLATFORMS=axon via a sitecustomize that already imported jax, so both
+# the env AND jax.config need updating (config read the env at jax import).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def isolated_home(monkeypatch, tmp_path):
+    """Fresh MLT_HOME + fresh config + fresh run DB per test."""
+    monkeypatch.setenv("MLT_HOME", str(tmp_path / "mlt-home"))
+    monkeypatch.delenv("MLT_DBPATH", raising=False)
+
+    from mlrun_tpu.config import mlconf
+
+    mlconf.reload()
+
+    import mlrun_tpu.db as db_mod
+    from mlrun_tpu.datastore import store_manager
+
+    db_mod.set_run_db(None)
+    db_mod._run_db = None
+    store_manager._db = None
+    yield
+    db_mod._run_db = None
+    store_manager._db = None
+
+
+@pytest.fixture()
+def rundb_mock():
+    """In-memory RunDB mock capturing calls (reference RunDBMock analog)."""
+    from tests.mocks import RunDBMock
+
+    import mlrun_tpu.db as db_mod
+
+    mock = RunDBMock()
+    db_mod.set_run_db(mock)
+    yield mock
+    db_mod._run_db = None
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh8():
+    from mlrun_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"data": 2, "fsdp": 2, "tensor": 2})

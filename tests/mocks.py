@@ -1,0 +1,135 @@
+"""Test doubles (reference analog: tests/common_fixtures.py:241 RunDBMock)."""
+
+from __future__ import annotations
+
+from mlrun_tpu.db.base import RunDBError, RunDBInterface
+
+
+class RunDBMock(RunDBInterface):
+    kind = "mock"
+
+    def __init__(self):
+        self.runs: dict = {}
+        self.artifacts: dict = {}
+        self.functions: dict = {}
+        self.projects: dict = {}
+        self.logs: dict = {}
+        self.schedules: dict = {}
+        self.submitted: list = []
+        self.calls: list = []
+
+    def _record(self, _call, **kwargs):
+        self.calls.append((_call, kwargs))
+
+    # runs
+    def store_run(self, struct, uid, project="", iter=0):
+        self._record("store_run", uid=uid, project=project, iter=iter)
+        self.runs[(project, uid, iter)] = struct
+
+    def update_run(self, updates, uid, project="", iter=0):
+        from mlrun_tpu.utils import update_in
+
+        run = self.runs.get((project, uid, iter), {})
+        for key, value in updates.items():
+            update_in(run, key, value)
+        self.runs[(project, uid, iter)] = run
+
+    def read_run(self, uid, project="", iter=0):
+        return self.runs.get((project, uid, iter))
+
+    def list_runs(self, name="", uid=None, project="", labels=None, state="",
+                  sort=True, last=0, iter=False, start_time_from=None,
+                  start_time_to=None):
+        return [r for (p, _, it), r in self.runs.items()
+                if p == project and (iter or it == 0)]
+
+    def del_run(self, uid, project="", iter=0):
+        self.runs.pop((project, uid, iter), None)
+
+    # logs
+    def store_log(self, uid, project="", body=b"", append=True):
+        key = (project, uid)
+        if isinstance(body, str):
+            body = body.encode()
+        self.logs[key] = (self.logs.get(key, b"") + body) if append else body
+
+    def get_log(self, uid, project="", offset=0, size=-1):
+        data = self.logs.get((project, uid), b"")[offset:]
+        state = (self.runs.get((project, uid, 0), {})
+                 .get("status", {}).get("state", "completed"))
+        return state, data
+
+    # artifacts
+    def store_artifact(self, key, artifact, uid=None, iter=None, tag="",
+                       project="", tree=None):
+        self._record("store_artifact", key=key, project=project, tag=tag)
+        self.artifacts[(project, key, tag or "latest")] = artifact
+
+    def read_artifact(self, key, tag=None, iter=None, project="", tree=None,
+                      uid=None):
+        item = self.artifacts.get((project, key, tag or "latest"))
+        if item is None:
+            raise RunDBError(f"artifact {key} not found")
+        return item
+
+    def list_artifacts(self, name="", project="", tag=None, labels=None,
+                       since=None, until=None, kind=None, category=None,
+                       tree=None):
+        return [a for (p, k, t), a in self.artifacts.items() if p == project]
+
+    def del_artifact(self, key, tag=None, project="", uid=None):
+        self.artifacts.pop((project, key, tag or "latest"), None)
+
+    # functions
+    def store_function(self, function, name, project="", tag="",
+                       versioned=False):
+        self._record("store_function", name=name, project=project, tag=tag)
+        self.functions[(project, name, tag or "latest")] = function
+        return "mock-hash"
+
+    def get_function(self, name, project="", tag="", hash_key=""):
+        func = self.functions.get((project, name, tag or "latest"))
+        if func is None:
+            raise RunDBError(f"function {name} not found")
+        return func
+
+    def list_functions(self, name="", project="", tag="", labels=None):
+        return [f for (p, n, t), f in self.functions.items() if p == project]
+
+    def delete_function(self, name, project=""):
+        self.functions = {k: v for k, v in self.functions.items()
+                          if k[1] != name}
+
+    # projects
+    def store_project(self, name, project):
+        self.projects[name] = project
+        return project
+
+    def get_project(self, name):
+        return self.projects.get(name)
+
+    def list_projects(self, owner=None, labels=None, state=None):
+        return list(self.projects.values())
+
+    def delete_project(self, name, deletion_strategy="restricted"):
+        self.projects.pop(name, None)
+
+    # schedules
+    def store_schedule(self, project, name, schedule):
+        self.schedules[(project, name)] = schedule
+
+    def get_schedule(self, project, name):
+        return self.schedules[(project, name)]
+
+    def list_schedules(self, project=""):
+        return [s for (p, _), s in self.schedules.items()
+                if not project or p == project]
+
+    def delete_schedule(self, project, name):
+        self.schedules.pop((project, name), None)
+
+    # submit
+    def submit_job(self, runspec, schedule=None):
+        self._record("submit_job", schedule=schedule)
+        self.submitted.append({"runspec": runspec, "schedule": schedule})
+        return {"data": runspec}

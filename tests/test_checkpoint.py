@@ -1,0 +1,37 @@
+"""Orbax checkpoint/resume tests (TPU addition, SURVEY.md §5.4)."""
+
+import jax
+import numpy as np
+
+from mlrun_tpu.models import tiny_llama
+from mlrun_tpu.parallel.mesh import make_mesh
+from mlrun_tpu.training import (
+    CheckpointManager,
+    TrainConfig,
+    Trainer,
+    synthetic_token_stream,
+)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = tiny_llama(attention_impl="reference")
+    mesh = make_mesh({"fsdp": 2}, devices=jax.devices()[:2])
+    trainer = Trainer(cfg, TrainConfig(), mesh=mesh)
+    trainer.init(0)
+    stream = synthetic_token_stream(4, 32, cfg.vocab_size)
+    trainer.fit(stream, steps=2, log_every=10)
+
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    assert manager.save(int(trainer.state.step), trainer.state, force=True)
+    manager.wait()
+    assert manager.latest_step() == 2
+
+    # restore into a freshly initialized trainer
+    trainer2 = Trainer(cfg, TrainConfig(), mesh=mesh)
+    trainer2.init(1)
+    restored = manager.restore(trainer2.state)
+    for got, want in zip(jax.tree_util.tree_leaves(restored.params),
+                         jax.tree_util.tree_leaves(trainer.state.params)):
+        assert np.allclose(np.asarray(got), np.asarray(want))
+    assert int(restored.step) == 2
+    manager.close()

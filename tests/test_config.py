@@ -1,0 +1,40 @@
+"""Config system tests (reference analog: tests/test_config.py)."""
+
+import json
+import os
+
+
+def test_env_override(monkeypatch):
+    from mlrun_tpu.config import mlconf
+
+    monkeypatch.setenv("MLT_HTTPDB__PORT", "9999")
+    monkeypatch.setenv("MLT_LOG_LEVEL", "DEBUG")
+    monkeypatch.setenv("MLT_TPU__CHIPS_PER_HOST", "8")
+    mlconf.reload()
+    assert mlconf.httpdb.port == 9999
+    assert mlconf.log_level == "DEBUG"
+    assert mlconf.tpu.chips_per_host == 8
+
+
+def test_json_env_values(monkeypatch):
+    from mlrun_tpu.config import mlconf
+
+    monkeypatch.setenv("MLT_RUNS__STATE_THRESHOLDS",
+                       json.dumps({"executing": 5}))
+    mlconf.reload()
+    assert mlconf.runs.state_thresholds.executing == 5
+
+
+def test_update_and_to_dict():
+    from mlrun_tpu.config import mlconf
+
+    mlconf.update({"function": {"default_image": "img:x"}})
+    assert mlconf.function.default_image == "img:x"
+    assert isinstance(mlconf.to_dict(), dict)
+
+
+def test_artifact_path_templating():
+    from mlrun_tpu.config import mlconf
+
+    path = mlconf.resolve_artifact_path("proj-a")
+    assert "proj-a" in path

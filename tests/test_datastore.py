@@ -1,0 +1,47 @@
+"""Datastore tests (reference analog: tests/test_datastores.py)."""
+
+import pandas as pd
+import pytest
+
+from mlrun_tpu.datastore import store_manager
+
+
+def test_file_store_roundtrip(tmp_path):
+    path = str(tmp_path / "a/b/data.txt")
+    item = store_manager.object(url=path)
+    item.put("hello")
+    assert item.get(encoding="utf-8") == "hello"
+    assert item.stat().size == 5
+    assert item.exists()
+
+
+def test_memory_store():
+    item = store_manager.object(url="memory://k1")
+    item.put(b"abc")
+    assert item.get() == b"abc"
+    item.delete()
+    assert not item.exists()
+
+
+def test_as_df(tmp_path):
+    path = str(tmp_path / "d.csv")
+    pd.DataFrame({"a": [1, 2]}).to_csv(path, index=False)
+    df = store_manager.object(url=path).as_df()
+    assert list(df["a"]) == [1, 2]
+
+
+def test_store_uri_resolution(rundb_mock, tmp_path):
+    target = str(tmp_path / "art.txt")
+    with open(target, "w") as f:
+        f.write("body")
+    rundb_mock.store_artifact(
+        "my-art", {"kind": "artifact", "metadata": {"key": "my-art"},
+                   "spec": {"target_path": target}},
+        project="p1", tag="latest")
+    item = store_manager.object(url="store://artifacts/p1/my-art")
+    assert item.get(encoding="utf-8") == "body"
+
+
+def test_unsupported_scheme():
+    with pytest.raises(ValueError):
+        store_manager.object(url="bogus://x/y")

@@ -1,0 +1,70 @@
+"""MLClientCtx tests (reference analog: tests/test_execution.py)."""
+
+import pandas as pd
+
+from mlrun_tpu.execution import MLClientCtx
+
+
+def _ctx(rundb_mock, name="test-run"):
+    return MLClientCtx.from_dict(
+        {"metadata": {"name": name, "project": "p1"},
+         "spec": {"parameters": {"p1": 5}}},
+        rundb=rundb_mock)
+
+
+def test_log_results(rundb_mock):
+    ctx = _ctx(rundb_mock)
+    ctx.log_result("loss", 0.5)
+    ctx.log_results({"a": 1, "b": 2})
+    ctx.commit(completed=True)
+    stored = rundb_mock.runs[("p1", ctx._uid, 0)]
+    assert stored["status"]["results"] == {"loss": 0.5, "a": 1, "b": 2}
+    assert stored["status"]["state"] == "completed"
+
+
+def test_params_and_defaults(rundb_mock):
+    ctx = _ctx(rundb_mock)
+    assert ctx.get_param("p1") == 5
+    assert ctx.get_param("missing", 42) == 42
+    assert ctx.parameters["missing"] == 42
+
+
+def test_log_artifacts(rundb_mock, tmp_path):
+    ctx = _ctx(rundb_mock)
+    ctx.artifact_path = str(tmp_path)
+    ctx.log_artifact("doc", body="hello")
+    ctx.log_dataset("ds", df=pd.DataFrame({"x": [1, 2]}), format="csv")
+    stored = rundb_mock.artifacts
+    assert ("p1", "doc", "latest") in stored
+    assert ("p1", "ds", "latest") in stored
+    uris = rundb_mock.runs[("p1", ctx._uid, 0)]["status"]["artifact_uris"]
+    assert "doc" in uris and "ds" in uris
+
+
+def test_error_state(rundb_mock):
+    ctx = _ctx(rundb_mock)
+    ctx.set_state(error="boom")
+    stored = rundb_mock.runs[("p1", ctx._uid, 0)]
+    assert stored["status"]["state"] == "error"
+    assert "boom" in stored["status"]["error"]
+
+
+def test_numpy_results_cast(rundb_mock):
+    import numpy as np
+
+    ctx = _ctx(rundb_mock)
+    ctx.log_result("np_int", np.int64(3))
+    ctx.log_result("np_float", np.float32(0.5))
+    ctx.commit()
+    results = rundb_mock.runs[("p1", ctx._uid, 0)]["status"]["results"]
+    assert results == {"np_int": 3, "np_float": 0.5}
+    assert type(results["np_int"]) is int
+
+
+def test_is_logging_worker_rank(monkeypatch, rundb_mock):
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    ctx = MLClientCtx.from_dict({"metadata": {"name": "w"}}, rundb=rundb_mock,
+                                store_run=False)
+    assert not ctx.is_logging_worker()
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert ctx.is_logging_worker()

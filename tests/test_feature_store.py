@@ -1,0 +1,59 @@
+"""Feature store tests (reference analog: tests/feature-store/)."""
+
+import pandas as pd
+import pytest
+
+from mlrun_tpu.feature_store import (
+    FeatureSet,
+    FeatureVector,
+    get_offline_features,
+    get_online_feature_service,
+    ingest,
+)
+
+
+@pytest.fixture()
+def stocks(isolated_home):
+    fs = FeatureSet("stocks", entities=["ticker"])
+    fs.metadata.project = "fsproj"
+    ingest(fs, pd.DataFrame({"ticker": ["A", "B", "C"],
+                             "price": [10.0, 20.0, 30.0]}))
+    fs2 = FeatureSet("quotes", entities=["ticker"])
+    fs2.metadata.project = "fsproj"
+    ingest(fs2, pd.DataFrame({"ticker": ["A", "B"],
+                              "vol": [0.1, 0.2]}))
+    return fs, fs2
+
+
+def test_ingest_infers_schema(stocks):
+    fs, _ = stocks
+    assert [f["name"] for f in fs.spec.features] == ["price"]
+    assert fs.status.state == "ready"
+    assert fs.status.stats["price"]["mean"] == 20.0
+
+
+def test_offline_join(stocks):
+    fv = FeatureVector("v1", features=["stocks.price", "quotes.vol"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    df = get_offline_features(fv).to_dataframe()
+    assert list(df.columns) == ["price", "vol"]
+    assert len(df) == 3
+    assert df["vol"].isna().sum() == 1  # C has no quote
+
+
+def test_online_service_with_imputation(stocks):
+    fv = FeatureVector("v2", features=["stocks.price", "quotes.vol"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    svc = get_online_feature_service(fv, impute_policy={"vol": 0.0})
+    rows = svc.get([{"ticker": "A"}, {"ticker": "C"}])
+    assert rows[0]["price"] == 10.0 and rows[0]["vol"] == 0.1
+    assert rows[1]["vol"] == 0.0  # imputed
+    svc.close()
+
+
+def test_entity_validation(isolated_home):
+    fs = FeatureSet("bad", entities=["missing_col"])
+    with pytest.raises(ValueError, match="entity column"):
+        ingest(fs, pd.DataFrame({"x": [1]}))

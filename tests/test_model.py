@@ -1,0 +1,42 @@
+"""Object model tests (reference analog: tests/test_model_obj.py)."""
+
+from mlrun_tpu.model import (
+    HyperParamOptions,
+    Notification,
+    RunObject,
+    RunTemplate,
+    new_task,
+)
+
+
+def test_roundtrip():
+    task = new_task(name="t1", project="p1", params={"a": 1},
+                    inputs={"x": "/data/x.csv"})
+    struct = task.to_dict()
+    again = RunTemplate.from_dict(struct)
+    assert again.metadata.name == "t1"
+    assert again.spec.parameters == {"a": 1}
+    assert again.spec.inputs == {"x": "/data/x.csv"}
+
+
+def test_run_object_outputs():
+    run = RunObject.from_template(new_task(name="x"))
+    run.status.results = {"accuracy": 0.9}
+    run.status.artifact_uris = {"model": "store://models/p/model"}
+    assert run.output("accuracy") == 0.9
+    assert run.output("model") == "store://models/p/model"
+    assert set(run.outputs) == {"accuracy", "model"}
+
+
+def test_hyper_param_options():
+    task = new_task(name="h").with_hyper_params(
+        {"p": [1, 2]}, selector="max.acc", strategy="grid")
+    assert task.spec.hyperparams == {"p": [1, 2]}
+    assert task.spec.hyper_param_options.selector == "max.acc"
+    assert task.spec.is_hyper_job()
+
+
+def test_notification_defaults():
+    n = Notification(kind="slack", name="n1")
+    assert "completed" in n.when
+    assert Notification.from_dict(n.to_dict()).kind == "slack"

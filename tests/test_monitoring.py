@@ -1,0 +1,53 @@
+"""Model-monitoring pipeline tests (reference analog:
+tests/system/model_monitoring — reduced to in-process tier)."""
+
+import mlrun_tpu
+from mlrun_tpu.model_monitoring import EventStreamProcessor
+from mlrun_tpu.serving import V2ModelServer
+
+
+class M(V2ModelServer):
+    def load(self):
+        pass
+
+    def predict(self, request):
+        if request["inputs"] == ["explode"]:
+            raise ValueError("bad")
+        return [sum(request["inputs"])]
+
+
+def _serve_and_process(n_ok=3, n_err=1):
+    fn = mlrun_tpu.new_function("msrv", kind="serving", project="monproj")
+    fn.set_topology("router")
+    fn.add_model("m", class_name=M, model_path="")
+    server = fn.to_mock_server(track_models=True)
+    for _ in range(n_ok):
+        server.test("/v2/models/m/infer", body={"inputs": [1, 2]})
+    for _ in range(n_err):
+        server.test("/v2/models/m/infer", body={"inputs": ["explode"]},
+                    silent=True)
+    proc = EventStreamProcessor("monproj")
+    processed = proc.run_once()
+    return processed
+
+
+def test_stream_to_endpoint_metrics():
+    processed = _serve_and_process()
+    assert processed == 4
+    eps = mlrun_tpu.get_run_db().list_model_endpoints("monproj")
+    assert len(eps) == 1
+    ep = eps[0]
+    assert ep["metrics"]["requests"] == 3
+    assert ep["error_count"] == 1
+    assert ep["metrics"]["avg_latency_microsec"] > 0
+
+
+def test_parquet_written():
+    import os
+
+    from mlrun_tpu.model_monitoring import get_monitoring_parquet_dir
+
+    _serve_and_process(n_ok=2, n_err=0)
+    pq_dir = get_monitoring_parquet_dir("monproj")
+    files = os.listdir(pq_dir)
+    assert any(f.endswith(".parquet") for f in files)

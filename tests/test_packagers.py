@@ -1,0 +1,45 @@
+"""Packager tests (reference analog: tests/package/)."""
+
+import numpy as np
+import pandas as pd
+
+import mlrun_tpu
+
+
+def test_return_packaging_types():
+    def handler(context):
+        return 0.5, {"k": 1}, np.arange(6).reshape(2, 3), pd.DataFrame(
+            {"a": [1, 2]})
+
+    fn = mlrun_tpu.new_function("p", kind="local", handler=handler)
+    run = fn.run(local=True,
+                 returns=["score", "meta", "arr", "frame:dataset"])
+    assert run.status.results["score"] == 0.5
+    assert run.status.results["meta"] == {"k": 1}
+    assert "arr" in run.status.artifact_uris
+    assert "frame" in run.status.artifact_uris
+    assert run.artifact("frame").as_df().shape == (2, 1)
+
+
+def test_input_unpacking(tmp_path):
+    csv = tmp_path / "in.csv"
+    pd.DataFrame({"x": [1, 2, 3]}).to_csv(csv, index=False)
+
+    def handler(context, data: pd.DataFrame):
+        context.log_result("rows", len(data))
+
+    fn = mlrun_tpu.new_function("p", kind="local", handler=handler)
+    run = fn.run(inputs={"data": str(csv)}, local=True)
+    assert run.status.results["rows"] == 3
+
+
+def test_dataitem_passthrough(tmp_path):
+    path = tmp_path / "f.txt"
+    path.write_text("abc")
+
+    def handler(context, data):
+        context.log_result("size", len(data.get()))
+
+    fn = mlrun_tpu.new_function("p", kind="local", handler=handler)
+    run = fn.run(inputs={"data": str(path)}, local=True)
+    assert run.status.results["size"] == 3

@@ -1,0 +1,66 @@
+"""Project + workflow tests (reference analog: tests/projects/)."""
+
+import os
+
+import mlrun_tpu
+
+
+def test_new_and_get_or_create(tmp_path):
+    proj = mlrun_tpu.new_project("proj-x", context=str(tmp_path))
+    assert proj.name == "proj-x"
+    assert os.path.isfile(tmp_path / "project.yaml")
+    again = mlrun_tpu.get_or_create_project("proj-x", context=str(tmp_path))
+    assert again.name == "proj-x"
+
+
+def test_set_and_run_function(tmp_path):
+    proj = mlrun_tpu.new_project("proj-y", context=str(tmp_path))
+
+    def handler(context, v: int = 1):
+        context.log_result("out", v * 3)
+
+    fn = mlrun_tpu.new_function("h", kind="local", handler=handler)
+    proj.set_function(fn, name="h")
+    run = proj.run_function("h", params={"v": 7}, local=True)
+    assert run.status.results["out"] == 21
+
+
+def test_project_artifacts(tmp_path):
+    import pandas as pd
+
+    proj = mlrun_tpu.new_project("proj-z", context=str(tmp_path))
+    proj.log_dataset("d1", df=pd.DataFrame({"a": [1]}), format="csv")
+    arts = proj.list_artifacts()
+    assert any(a["metadata"]["key"] == "d1" for a in arts)
+    art = proj.get_artifact("d1")
+    assert art.kind == "dataset"
+
+
+def test_workflow_local_engine(tmp_path):
+    workflow = tmp_path / "wf.py"
+    workflow.write_text(
+        "import mlrun_tpu\n"
+        "from mlrun_tpu.projects import get_current_project\n"
+        "def pipeline():\n"
+        "    proj = get_current_project()\n"
+        "    r1 = proj.run_function('step1', params={'v': 2}, local=True)\n"
+        "    proj.run_function('step2',\n"
+        "        params={'v': r1.output('a')}, local=True)\n")
+
+    proj = mlrun_tpu.new_project("proj-w", context=str(tmp_path))
+
+    def step1(context, v: int = 0):
+        context.log_result("a", v + 10)
+
+    def step2(context, v: int = 0):
+        context.log_result("b", v * 2)
+
+    proj.set_function(mlrun_tpu.new_function("step1", kind="local",
+                                             handler=step1), name="step1")
+    proj.set_function(mlrun_tpu.new_function("step2", kind="local",
+                                             handler=step2), name="step2")
+    proj.set_workflow("main", str(workflow))
+    status = proj.run("main", engine="local")
+    assert status.state == "completed"
+    assert len(status.runs) == 2
+    assert status.runs[1].status.results["b"] == 24

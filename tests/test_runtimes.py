@@ -1,0 +1,94 @@
+"""Runtime tests (reference analog: tests/runtimes/, tests/run/)."""
+
+import json
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.model import RunObject
+
+
+def test_local_handler_run():
+    def handler(context, x: int = 1):
+        context.log_result("y", x * 2)
+        return x + 1
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(params={"x": 4}, local=True)
+    assert run.state == "completed"
+    assert run.status.results["y"] == 8
+    assert run.output("return") == 5
+
+
+def test_handler_error_surfaces():
+    def handler(context):
+        raise RuntimeError("expected failure")
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "error"
+    assert "expected failure" in (run.status.error or "")
+
+
+def test_hyperparam_grid_and_selector():
+    def handler(context, a: int = 0, b: int = 0):
+        context.log_result("score", a * 10 + b)
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(hyperparams={"a": [1, 2], "b": [3, 4]},
+                 hyper_param_options={"selector": "max.score"}, local=True)
+    assert run.status.results["best_iteration"] == 4
+    assert run.status.results["score"] == 24
+    assert len(run.status.iterations) == 4
+
+
+def test_hyperparam_list_strategy():
+    def handler(context, a: int = 0, b: int = 0):
+        context.log_result("s", a + b)
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(hyperparams={"a": [1, 2], "b": [10, 20]},
+                 hyper_param_options={"strategy": "list",
+                                      "selector": "max.s"}, local=True)
+    assert len(run.status.iterations) == 2
+    assert run.status.results["s"] == 22
+
+
+def test_stop_condition():
+    def handler(context, a: int = 0):
+        context.log_result("v", a)
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(hyperparams={"a": [1, 2, 3, 4]},
+                 hyper_param_options={"stop_condition": "v >= 2",
+                                      "selector": "max.v"}, local=True)
+    assert len(run.status.iterations) == 2
+
+
+def test_remote_kind_requires_service():
+    fn = mlrun_tpu.new_function("j", kind="job", image="img")
+    with pytest.raises(RuntimeError, match="MLT_DBPATH"):
+        fn.run()
+
+
+def test_function_save_and_import(rundb_mock):
+    fn = mlrun_tpu.new_function("f2", kind="job", image="img:1",
+                                project="p1")
+    uri = fn.save()
+    assert uri.startswith("db://")
+    loaded = mlrun_tpu.import_function("db://p1/f2")
+    assert loaded.kind == "job"
+    assert loaded.spec.image == "img:1"
+
+
+def test_code_to_function_embeds_source(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text("def handler(context):\n"
+                      "    \"\"\"docstring\"\"\"\n"
+                      "    context.log_result(\"ok\", 1)\n")
+    fn = mlrun_tpu.code_to_function(
+        name="t", filename=str(script), kind="job", handler="handler")
+    assert fn.spec.build.functionSourceCode
+    assert "handler" in fn.spec.entry_points
+    # embedded code executes locally
+    run = fn.run(local=True, handler="handler")
+    assert run.status.results["ok"] == 1

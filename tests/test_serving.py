@@ -1,0 +1,118 @@
+"""Serving graph tests (reference analog: tests/serving/test_serving.py)."""
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.serving import V2ModelServer
+
+
+class EchoModel(V2ModelServer):
+    def load(self):
+        self.model = True
+
+    def predict(self, request):
+        return [x * 2 for x in request["inputs"]]
+
+
+def test_router_infer():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    fn.set_topology("router")
+    fn.add_model("m1", class_name=EchoModel, model_path="")
+    server = fn.to_mock_server()
+    out = server.test("/v2/models/m1/infer", body={"inputs": [1, 2]})
+    assert out["outputs"] == [2, 4]
+    assert out["model_name"] == "m1"
+
+
+def test_model_ops():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    fn.set_topology("router")
+    fn.add_model("m1", class_name=EchoModel, model_path="")
+    server = fn.to_mock_server()
+    ready = server.test("/v2/models/m1/ready", body=None, method="GET")
+    assert ready["ready"] is True
+    server.test("/v2/models/m1/infer", body={"inputs": [1]})
+    metrics = server.test("/v2/models/m1/metrics", body=None, method="GET")
+    assert metrics["metrics"]["requests"] == 1
+
+
+def test_flow_topology_chaining():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="a", handler=lambda x: x + 1) \
+         .to(name="b", handler=lambda x: x * 2).respond()
+    server = fn.to_mock_server()
+    assert server.test(body=3) == 8
+
+
+def test_flow_branch_isolation():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    root = graph.to(name="src", handler=lambda x: {"v": x})
+    root.to(name="b1", handler=lambda d: {"b1": d["v"] + 1})
+    root.to(name="b2", handler=lambda d: {"b2": d["v"] * 2}).respond()
+    server = fn.to_mock_server()
+    out = server.test(body=5)
+    # b2 must see src output, not b1 output
+    assert out == {"b2": 10}
+
+
+def test_voting_ensemble():
+    class A(EchoModel):
+        def predict(self, request):
+            return [1, 0]
+
+    class B(EchoModel):
+        def predict(self, request):
+            return [1, 1]
+
+    class C(EchoModel):
+        def predict(self, request):
+            return [0, 1]
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    fn.set_topology("router", class_name="VotingEnsemble")
+    for key, cls in [("a", A), ("b", B), ("c", C)]:
+        fn.add_model(key, class_name=cls, model_path="")
+    server = fn.to_mock_server()
+    out = server.test("/v2/models/infer", body={"inputs": [0, 0]})
+    assert out["outputs"] == [1, 1]
+
+
+def test_graph_error_handler():
+    def boom(x):
+        raise ValueError("bad input")
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    step = graph.to(name="boom", handler=boom)
+    graph.add_step(name="catcher", handler=lambda e: {"caught": True},
+                   full_event=True, after=[])
+    step.error_handler("catcher")
+    server = fn.to_mock_server()
+    out = server.test(body=1)
+    assert out == {"caught": True}
+
+
+def test_queue_stream_push():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="pre", handler=lambda x: x + 1) \
+         .to("$queue", name="q", path="memory://test-q") \
+         .to(name="post", handler=lambda x: x).respond()
+    server = fn.to_mock_server()
+    assert server.test(body=1) == 2
+    from mlrun_tpu.serving.streams import get_in_memory_stream
+
+    assert len(get_in_memory_stream("test-q")) == 1
+
+
+def test_graph_cycle_detection():
+    from mlrun_tpu.serving.states import GraphError, RootFlowStep, TaskStep
+
+    graph = RootFlowStep()
+    a = graph.add_step(name="a", handler=lambda x: x)
+    b = graph.add_step(name="b", handler=lambda x: x, after=["a"])
+    a.after = ["b"]
+    with pytest.raises(GraphError, match="cycle"):
+        graph.init_object(None, {})

@@ -1,0 +1,83 @@
+"""SQLite run DB tests (reference analog: tests/api sqldb tests)."""
+
+import pytest
+
+from mlrun_tpu.db.base import RunDBError
+from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+
+
+@pytest.fixture()
+def db(tmp_path):
+    return SQLiteRunDB(str(tmp_path / "db.sqlite"),
+                       logs_dir=str(tmp_path / "logs"))
+
+
+def test_run_crud(db):
+    run = {"metadata": {"name": "r1", "uid": "u1"},
+           "status": {"state": "running"}}
+    db.store_run(run, "u1", "p1")
+    assert db.read_run("u1", "p1")["metadata"]["name"] == "r1"
+    db.update_run({"status.state": "completed"}, "u1", "p1")
+    assert db.read_run("u1", "p1")["status"]["state"] == "completed"
+    runs = db.list_runs(project="p1")
+    assert len(runs) == 1
+    db.del_run("u1", "p1")
+    assert db.read_run("u1", "p1") is None
+
+
+def test_list_runs_filters(db):
+    for i, state in enumerate(["completed", "error", "completed"]):
+        db.store_run({"metadata": {"name": f"r{i}", "uid": f"u{i}",
+                                   "labels": {"kind": "job"}},
+                      "status": {"state": state}}, f"u{i}", "p1")
+    assert len(db.list_runs(project="p1", state="completed")) == 2
+    assert len(db.list_runs(project="p1", labels={"kind": "job"})) == 3
+    assert len(db.list_runs(project="p1", labels={"kind": "x"})) == 0
+
+
+def test_artifact_tagging(db):
+    db.store_artifact("m", {"kind": "model", "metadata": {"key": "m"}},
+                      uid="v1", tag="latest", project="p1")
+    db.store_artifact("m", {"kind": "model", "metadata": {"key": "m"},
+                            "spec": {"v": 2}},
+                      uid="v2", tag="latest", project="p1")
+    latest = db.read_artifact("m", tag="latest", project="p1")
+    assert latest["metadata"]["uid"] == "v2"
+    # old uid still reachable
+    old = db.read_artifact("m", uid="v1", project="p1")
+    assert old["metadata"]["uid"] == "v1"
+
+
+def test_function_versioning(db):
+    h1 = db.store_function({"kind": "job", "metadata": {"name": "f"}},
+                           "f", "p1", versioned=True)
+    fetched = db.get_function("f", "p1", hash_key=h1)
+    assert fetched["metadata"]["name"] == "f"
+
+
+def test_logs(db):
+    db.store_run({"metadata": {"uid": "u9"},
+                  "status": {"state": "completed"}}, "u9", "p1")
+    db.store_log("u9", "p1", b"hello ")
+    db.store_log("u9", "p1", b"world")
+    state, data = db.get_log("u9", "p1")
+    assert data == b"hello world"
+    state, tail = db.get_log("u9", "p1", offset=6)
+    assert tail == b"world"
+
+
+def test_project_cascade(db):
+    db.store_project("p2", {"metadata": {"name": "p2"}})
+    db.store_run({"metadata": {"uid": "u"}}, "u", "p2")
+    with pytest.raises(RunDBError):
+        db.delete_project("p2", deletion_strategy="restricted")
+    db.delete_project("p2", deletion_strategy="cascade")
+    assert db.get_project("p2") is None
+
+
+def test_schedules(db):
+    db.store_schedule("p1", "s1", {"kind": "job", "cron_trigger": "0 * * * *"})
+    assert db.get_schedule("p1", "s1")["cron_trigger"] == "0 * * * *"
+    assert len(db.list_schedules("p1")) == 1
+    db.delete_schedule("p1", "s1")
+    assert db.list_schedules("p1") == []

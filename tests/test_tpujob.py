@@ -1,0 +1,82 @@
+"""tpujob control-plane tests (reference analog:
+tests/api/runtime_handlers mpijob CRD assertions — here JobSet)."""
+
+import json
+
+import mlrun_tpu
+from mlrun_tpu.config import mlconf
+from mlrun_tpu.k8s.jobset import chips_in_topology, hosts_for_topology
+from mlrun_tpu.model import RunObject
+
+
+def _run_obj():
+    run = RunObject()
+    run.metadata.uid = "abcd1234efgh"
+    run.metadata.name = "train"
+    run.metadata.project = "p1"
+    return run
+
+
+def test_topology_math():
+    assert chips_in_topology("2x4") == 8
+    assert chips_in_topology("8x8") == 64
+    assert hosts_for_topology("8x8", 4) == 16
+    assert hosts_for_topology("2x2", 4) == 1
+
+
+def test_jobset_single_slice():
+    fn = mlrun_tpu.new_function("train", kind="tpujob", project="p1")
+    fn.with_tpu_topology("tpu-v5-lite-podslice", "2x4")
+    js = fn.generate_jobset(_run_obj())
+    assert js["apiVersion"] == "jobset.x-k8s.io/v1alpha2"
+    rj = js["spec"]["replicatedJobs"][0]
+    assert rj["replicas"] == 1
+    job = rj["template"]["spec"]
+    assert job["parallelism"] == 2 and job["completions"] == 2
+    assert job["completionMode"] == "Indexed"
+    pod = job["template"]["spec"]
+    sel = pod["nodeSelector"]
+    assert sel[mlconf.tpu.accelerator_node_selector] == "tpu-v5-lite-podslice"
+    assert sel[mlconf.tpu.topology_node_selector] == "2x4"
+    main = pod["containers"][0]
+    assert main["resources"]["limits"]["google.com/tpu"] == 4
+    env_names = [e["name"] for e in main["env"]]
+    assert mlconf.exec_config_env in env_names
+    assert "TPU_WORKER_ID" in env_names
+    assert "MEGASCALE_NUM_SLICES" not in env_names
+
+
+def test_jobset_multislice_megascale():
+    fn = mlrun_tpu.new_function("train", kind="tpujob", project="p1")
+    fn.with_tpu_topology("tpu-v5-lite-podslice", "4x4", num_slices=4)
+    js = fn.generate_jobset(_run_obj())
+    rj = js["spec"]["replicatedJobs"][0]
+    assert rj["replicas"] == 4
+    env = rj["template"]["spec"]["template"]["spec"]["containers"][0]["env"]
+    env_names = [e["name"] for e in env]
+    assert "MEGASCALE_NUM_SLICES" in env_names
+    assert "MEGASCALE_COORDINATOR_ADDRESS" in env_names
+    assert fn.total_chips == 64
+
+
+def test_exec_config_round_trips():
+    fn = mlrun_tpu.new_function("train", kind="tpujob", project="p1")
+    run = _run_obj()
+    run.spec.parameters = {"lr": 0.1}
+    js = fn.generate_jobset(run)
+    env = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+        "spec"]["containers"][0]["env"]
+    cfg = next(e["value"] for e in env if e["name"] == mlconf.exec_config_env)
+    parsed = json.loads(cfg)
+    assert parsed["spec"]["parameters"] == {"lr": 0.1}
+    assert parsed["metadata"]["uid"] == "abcd1234efgh"
+
+
+def test_jobset_condition_mapping():
+    from mlrun_tpu.common.runtimes_constants import JobSetConditions
+
+    assert JobSetConditions.to_run_state(
+        [{"type": "Completed", "status": "True"}]) == "completed"
+    assert JobSetConditions.to_run_state(
+        [{"type": "Failed", "status": "True"}]) == "error"
+    assert JobSetConditions.to_run_state([]) == "running"
