@@ -1,6 +1,27 @@
 from .base import DataItem, DataStore, FileStats, parse_url  # noqa: F401
 from .datastore import StoreManager, register_store, schema_to_store, store_manager  # noqa: F401
+from .sources import (  # noqa: F401
+    BigQuerySource,
+    CSVSource,
+    DataFrameSource,
+    HttpSource,
+    KafkaSource,
+    ParquetSource,
+    SQLSource,
+    StreamSource,
+)
 from .stores import FileStore, FsspecStore, HttpStore, InMemoryStore  # noqa: F401
+from .targets import (  # noqa: F401
+    CSVTarget,
+    DFTarget,
+    KafkaTarget,
+    NoSqlTarget,
+    ParquetTarget,
+    RedisNoSqlTarget,
+    SQLTarget,
+    StreamTarget,
+    TSDBTarget,
+)
 
 
 def get_store_resource(url: str, db=None, secrets: dict | None = None,

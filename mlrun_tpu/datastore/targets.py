@@ -1,0 +1,228 @@
+"""Data targets (reference analog: mlrun/datastore/targets.py —
+ParquetTarget :800, CSVTarget :1082, NoSqlTarget :1409, StreamTarget :1597,
+KafkaTarget :1634, SQLTarget :1895, DFTarget :1834).
+
+The online "NoSql" target is a sqlite-backed KV (replacing V3IO-KV/Redis in
+the reference's default path; Redis/Kafka remain gated on their clients).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+from typing import Optional
+
+from ..config import mlconf
+from ..model import ModelObj
+from ..utils import logger, now_iso
+
+
+class BaseTarget(ModelObj):
+    kind = "base"
+    _dict_fields = ["kind", "name", "path", "attributes", "partitioned",
+                    "key_bucketing_number", "partition_cols", "time_col"]
+    is_online = False
+
+    def __init__(self, name: str = "", path: str = "",
+                 attributes: dict | None = None, partitioned: bool = False,
+                 key_bucketing_number=None, partition_cols=None,
+                 time_col=None):
+        self.name = name or self.kind
+        self.path = path
+        self.attributes = attributes or {}
+        self.partitioned = partitioned
+        self.key_bucketing_number = key_bucketing_number
+        self.partition_cols = partition_cols
+        self.time_col = time_col
+
+    def default_path(self, project: str, feature_set: str) -> str:
+        suffix = {"parquet": ".parquet", "csv": ".csv"}.get(self.kind, "")
+        return os.path.join(mlconf.home_dir, "feature-store", project,
+                            f"{feature_set}-{self.kind}{suffix}")
+
+    def write_dataframe(self, df, key_columns: list | None = None,
+                        timestamp_key: str | None = None) -> str:
+        raise NotImplementedError
+
+    def as_df(self, columns=None):
+        from . import store_manager
+
+        df = store_manager.object(url=self.path).as_df(format=self.kind)
+        return df[columns] if columns else df
+
+    def status_record(self) -> dict:
+        return {"name": self.name, "kind": self.kind, "path": self.path,
+                "updated": now_iso()}
+
+
+class ParquetTarget(BaseTarget):
+    kind = "parquet"
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if self.partitioned and (self.partition_cols or timestamp_key):
+            cols = self.partition_cols or [timestamp_key]
+            df.to_parquet(self.path, partition_cols=cols)
+        else:
+            df.to_parquet(self.path, index=False)
+        return self.path
+
+
+class CSVTarget(BaseTarget):
+    kind = "csv"
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        df.to_csv(self.path, index=False)
+        return self.path
+
+
+class NoSqlTarget(BaseTarget):
+    """Online KV target on sqlite (key → json record)."""
+
+    kind = "nosql"
+    is_online = True
+
+    def _conn(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        conn = sqlite3.connect(self.path)
+        conn.execute("CREATE TABLE IF NOT EXISTS kv "
+                     "(key TEXT PRIMARY KEY, value TEXT)")
+        return conn
+
+    def default_path(self, project: str, feature_set: str) -> str:
+        return os.path.join(mlconf.home_dir, "feature-store", project,
+                            f"{feature_set}-kv.sqlite")
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        if not key_columns:
+            raise ValueError("nosql target requires key columns (entities)")
+        with self._conn() as conn:
+            for _, row in df.iterrows():
+                key = "|".join(str(row[k]) for k in key_columns)
+                conn.execute(
+                    "INSERT OR REPLACE INTO kv (key, value) VALUES (?, ?)",
+                    (key, json.dumps(row.to_dict(), default=str)))
+        return self.path
+
+    def get(self, key_values: list) -> Optional[dict]:
+        key = "|".join(str(v) for v in key_values)
+        with self._conn() as conn:
+            row = conn.execute("SELECT value FROM kv WHERE key=?",
+                               (key,)).fetchone()
+        return json.loads(row[0]) if row else None
+
+
+class RedisNoSqlTarget(NoSqlTarget):
+    kind = "redisnosql"
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        try:
+            import redis  # gated
+        except ImportError as exc:
+            raise ImportError("RedisNoSqlTarget requires redis-py") from exc
+        client = redis.from_url(self.path)
+        for _, row in df.iterrows():
+            key = "|".join(str(row[k]) for k in key_columns or [])
+            client.set(key, json.dumps(row.to_dict(), default=str))
+        return self.path
+
+
+class StreamTarget(BaseTarget):
+    kind = "stream"
+    is_online = True
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        from ..serving.streams import get_stream_pusher
+
+        stream = get_stream_pusher(self.path)
+        stream.push([row.to_dict() for _, row in df.iterrows()])
+        return self.path
+
+
+class KafkaTarget(BaseTarget):
+    kind = "kafka"
+    is_online = True
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        from ..serving.streams import _KafkaStream
+
+        brokers = self.attributes.get("brokers", "")
+        stream = _KafkaStream(brokers, self.path)
+        stream.push([row.to_dict() for _, row in df.iterrows()])
+        return self.path
+
+
+class SQLTarget(BaseTarget):
+    kind = "sql"
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        db_url = self.attributes.get("db_url", "")
+        table = self.attributes.get("table") or self.name
+        if db_url.startswith("sqlite://"):
+            db_url = db_url[len("sqlite://"):]
+        os.makedirs(os.path.dirname(db_url) or ".", exist_ok=True)
+        with sqlite3.connect(db_url) as conn:
+            df.to_sql(table, conn, if_exists=self.attributes.get(
+                "if_exists", "replace"), index=False)
+        return f"sqlite://{db_url}#{table}"
+
+
+class DFTarget(BaseTarget):
+    kind = "dataframe"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._df = None
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        self._df = df
+        return "memory://df"
+
+    def as_df(self, columns=None):
+        return self._df[columns] if columns else self._df
+
+
+class TSDBTarget(BaseTarget):
+    """Time-series metrics target: append-only parquet keyed by time."""
+
+    kind = "tsdb"
+
+    def default_path(self, project: str, feature_set: str) -> str:
+        return os.path.join(mlconf.home_dir, "feature-store", project,
+                            f"{feature_set}-tsdb.parquet")
+
+    def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
+        import pandas as pd
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if os.path.isfile(self.path):
+            df = pd.concat([pd.read_parquet(self.path), df],
+                           ignore_index=True)
+        df.to_parquet(self.path, index=False)
+        return self.path
+
+
+target_kind_to_class = {
+    cls.kind: cls for cls in (
+        ParquetTarget, CSVTarget, NoSqlTarget, RedisNoSqlTarget,
+        StreamTarget, KafkaTarget, SQLTarget, DFTarget, TSDBTarget)
+}
+
+
+def resolve_target(target) -> BaseTarget:
+    if isinstance(target, BaseTarget):
+        return target
+    if isinstance(target, dict):
+        kind = target.get("kind", "parquet")
+        cls = target_kind_to_class.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown target kind '{kind}'")
+        return cls.from_dict(target)
+    if isinstance(target, str):
+        cls = target_kind_to_class.get(target)
+        if cls is None:
+            raise ValueError(f"unknown target kind '{target}'")
+        return cls()
+    raise ValueError(f"unsupported target {type(target)}")

@@ -36,13 +36,14 @@ def ingest(featureset: Union[FeatureSet, str], source,
            targets: list | None = None, namespace=None,
            return_df: bool = True, infer_options=None,
            overwrite: bool | None = None) -> pd.DataFrame:
-    """Ingest a source into the feature set's offline target (parquet) and
-    register stats/schema (reference api.py:450, pandas engine)."""
-    fset = _resolve_feature_set(featureset)
-    if isinstance(source, str):
-        from ..datastore import store_manager
+    """Ingest a source into the feature set's targets and register
+    stats/schema (reference api.py:450, pandas engine). ``source`` may be a
+    DataFrame, url, or a datastore Source object; ``targets`` a list of
+    target objects/kind-names (default: offline parquet)."""
+    from ..datastore.sources import resolve_source
 
-        source = store_manager.object(url=source).as_df()
+    fset = _resolve_feature_set(featureset)
+    source = resolve_source(source).to_dataframe()
     if not isinstance(source, pd.DataFrame):
         raise ValueError("pandas-engine ingest expects a DataFrame or url")
 
@@ -80,8 +81,26 @@ def ingest(featureset: Union[FeatureSet, str], source,
         if entities:
             source = source.drop_duplicates(subset=entities, keep="last")
     source.to_parquet(path, index=False)
-    fset.status.targets = [{"name": "parquet", "kind": "parquet",
-                            "path": path, "updated": now_iso()}]
+    target_records = [{"name": "parquet", "kind": "parquet",
+                       "path": path, "updated": now_iso()}]
+
+    # extra targets (nosql/stream/csv/sql/...) via the targets layer
+    from ..datastore.targets import resolve_target
+
+    project = getattr(fset.metadata, "project", "") or \
+        mlconf.default_project
+    for target in (targets if targets is not None
+                   else fset.spec.targets or []):
+        if isinstance(target, str) and target == "parquet":
+            continue
+        target_obj = resolve_target(target)
+        if not target_obj.path:
+            target_obj.path = target_obj.default_path(project, fset.name)
+        target_obj.write_dataframe(source, key_columns=entities,
+                                   timestamp_key=fset.spec.timestamp_key)
+        target_records.append(target_obj.status_record())
+
+    fset.status.targets = target_records
     fset.status.state = "ready"
     fset.save()
     logger.info("ingested feature set", name=fset.name, rows=len(source),

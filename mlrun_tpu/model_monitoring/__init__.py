@@ -1,3 +1,19 @@
+from .applications import (  # noqa: F401
+    ApplicationResult,
+    HistogramDataDriftApplication,
+    LatencyApplication,
+    ModelMonitoringApplicationBase,
+    MonitoringContext,
+)
+from .controller import (  # noqa: F401
+    ModelMonitoringWriter,
+    MonitoringApplicationController,
+)
+from .metrics import (  # noqa: F401
+    hellinger_distance,
+    kl_divergence,
+    total_variance_distance,
+)
 from .stream_processing import (  # noqa: F401
     EventStreamProcessor,
     get_monitoring_parquet_dir,

@@ -1,0 +1,171 @@
+"""Monitoring controller + writer (reference analogs:
+mlrun/model_monitoring/controller.py:265 MonitoringApplicationController —
+windowed batch driver; writer.py:98 ModelMonitoringWriter — persists app
+results and notifies alerts)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import pandas as pd
+
+from ..config import mlconf
+from ..utils import logger, now_iso
+from .applications import (
+    ApplicationResult,
+    HistogramDataDriftApplication,
+    LatencyApplication,
+    ModelMonitoringApplicationBase,
+    MonitoringContext,
+)
+from .stream_processing import (
+    EventStreamProcessor,
+    get_monitoring_parquet_dir,
+)
+
+
+class ModelMonitoringWriter:
+    """Persist application results onto model-endpoint records + emit
+    events for alerting (reference writer.py:54,98)."""
+
+    def __init__(self, project: str, db=None):
+        self.project = project
+        if db is None:
+            from ..db import get_run_db
+
+            db = get_run_db()
+        self.db = db
+
+    def write(self, endpoint_id: str, results: list[ApplicationResult]):
+        try:
+            record = self.db.get_model_endpoint(self.project, endpoint_id)
+        except Exception:  # noqa: BLE001
+            record = {"uid": endpoint_id, "project": self.project,
+                      "metrics": {}}
+        metrics = record.setdefault("metrics", {})
+        drift_status = record.get("drift_status", "")
+        for result in results:
+            metrics[result.name] = result.value
+            if result.kind == "drift":
+                drift_status = result.status
+                record["drift_measures"] = result.extra.get("per_feature", {})
+            if result.status == "detected":
+                try:
+                    self.db.emit_event(
+                        "model_drift_detected" if result.kind == "drift"
+                        else "model_anomaly",
+                        {"endpoint_id": endpoint_id, "metric": result.name,
+                         "value": result.value}, self.project)
+                except Exception:  # noqa: BLE001
+                    pass
+        record["drift_status"] = drift_status
+        record["last_analyzed"] = now_iso()
+        self.db.store_model_endpoint(self.project, endpoint_id, record)
+
+
+class MonitoringApplicationController:
+    """Drive monitoring apps over windowed inference parquet."""
+
+    def __init__(self, project: str,
+                 applications: list[ModelMonitoringApplicationBase]
+                 | None = None, db=None):
+        self.project = project
+        self.applications = applications or [
+            HistogramDataDriftApplication(), LatencyApplication()]
+        if db is None:
+            from ..db import get_run_db
+
+            db = get_run_db()
+        self.db = db
+        self.processor = EventStreamProcessor(project, db=db)
+        self.writer = ModelMonitoringWriter(project, db=db)
+        self._processed_rows: dict[str, int] = {}
+
+    def _reference_df(self, endpoint: dict) -> Optional[pd.DataFrame]:
+        """Training-set sample from the registered model artifact."""
+        model_uri = endpoint.get("model_uri") or endpoint.get("model", "")
+        if not model_uri:
+            return None
+        try:
+            from ..datastore import store_manager
+
+            item = store_manager.object(url=model_uri)
+            meta = item.meta or {}
+            sample = meta.get("spec", {}).get("sample_set_path")
+            if sample:
+                return store_manager.object(url=sample).as_df()
+        except Exception:  # noqa: BLE001
+            return None
+        return None
+
+    def run_once(self) -> dict:
+        """Drain stream → window per endpoint → run apps → write results."""
+        self.processor.run_once()
+        results_by_endpoint: dict[str, list] = {}
+        parquet_dir = get_monitoring_parquet_dir(self.project)
+        if not os.path.isdir(parquet_dir):
+            return results_by_endpoint
+        for fname in os.listdir(parquet_dir):
+            if not fname.endswith(".parquet"):
+                continue
+            endpoint_id = fname[:-len(".parquet")]
+            df = pd.read_parquet(os.path.join(parquet_dir, fname))
+            start_row = self._processed_rows.get(endpoint_id, 0)
+            window = df.iloc[start_row:]
+            if window.empty:
+                continue
+            self._processed_rows[endpoint_id] = len(df)
+            sample_df = _inputs_frame(window)
+            try:
+                endpoint = self.db.get_model_endpoint(self.project,
+                                                      endpoint_id)
+            except Exception:  # noqa: BLE001
+                endpoint = {}
+            ctx = MonitoringContext(
+                project=self.project, endpoint_id=endpoint_id,
+                model_name=endpoint.get("name", ""),
+                sample_df=sample_df,
+                reference_df=self._reference_df(endpoint),
+                start=str(window["when"].iloc[0]),
+                end=str(window["when"].iloc[-1]),
+                latencies_microsec=list(window["microsec"]),
+                error_count=int(endpoint.get("error_count", 0)))
+            all_results: list[ApplicationResult] = []
+            for app in self.applications:
+                try:
+                    all_results.extend(app.do_tracking(ctx) or [])
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("monitoring app failed", app=app.name,
+                                   error=str(exc))
+            if all_results:
+                self.writer.write(endpoint_id, all_results)
+            results_by_endpoint[endpoint_id] = all_results
+        return results_by_endpoint
+
+
+def _inputs_frame(window: pd.DataFrame) -> pd.DataFrame:
+    """Expand the json-encoded inputs column into a feature dataframe."""
+    rows = []
+    for encoded in window["inputs"]:
+        try:
+            batch = json.loads(encoded)
+        except (TypeError, ValueError):
+            continue
+        if isinstance(batch, list):
+            for item in batch:
+                if isinstance(item, list):
+                    rows.append(item)
+                elif isinstance(item, dict):
+                    rows.append(item)
+                else:
+                    rows.append([item])
+    if not rows:
+        return pd.DataFrame()
+    if isinstance(rows[0], dict):
+        return pd.DataFrame(rows)
+    width = max(len(r) for r in rows)
+    return pd.DataFrame(
+        [r + [None] * (width - len(r)) for r in rows],
+        columns=[f"f{i}" for i in range(width)])

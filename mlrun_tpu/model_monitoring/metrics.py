@@ -1,0 +1,67 @@
+"""Drift metrics (reference analog: mlrun/model_monitoring/metrics/
+histogram_distance.py — TVD / Hellinger / KL over feature histograms)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+EPS = 1e-10
+
+
+def _normalize(hist: np.ndarray) -> np.ndarray:
+    hist = np.asarray(hist, dtype=np.float64)
+    total = hist.sum()
+    if total <= 0:
+        return np.full_like(hist, 1.0 / max(len(hist), 1))
+    return hist / total
+
+
+def total_variance_distance(p, q) -> float:
+    p, q = _normalize(p), _normalize(q)
+    return float(0.5 * np.abs(p - q).sum())
+
+
+def hellinger_distance(p, q) -> float:
+    p, q = _normalize(p), _normalize(q)
+    return float(np.sqrt(max(0.0, 1.0 - np.sum(np.sqrt(p * q)))))
+
+
+def kl_divergence(p, q, symmetric: bool = True) -> float:
+    p, q = _normalize(p) + EPS, _normalize(q) + EPS
+    kl_pq = float(np.sum(p * np.log(p / q)))
+    if not symmetric:
+        return kl_pq
+    kl_qp = float(np.sum(q * np.log(q / p)))
+    return kl_pq + kl_qp
+
+
+def histogram(values, bins: int = 20, range_=None) -> tuple[np.ndarray, np.ndarray]:
+    values = np.asarray(values, dtype=np.float64)
+    values = values[np.isfinite(values)]
+    if values.size == 0:
+        return np.zeros(bins), np.linspace(0, 1, bins + 1)
+    counts, edges = np.histogram(values, bins=bins, range=range_)
+    return counts, edges
+
+
+def drift_per_feature(sample_df, reference_df, bins: int = 20) -> dict:
+    """Compute TVD/Hellinger/KL per shared numeric feature."""
+    out: dict[str, dict] = {}
+    for column in reference_df.columns:
+        if column not in sample_df.columns:
+            continue
+        ref_values = np.asarray(reference_df[column], dtype=np.float64)
+        ref_values = ref_values[np.isfinite(ref_values)]
+        if ref_values.size == 0:
+            continue
+        lo, hi = float(ref_values.min()), float(ref_values.max())
+        if lo == hi:
+            hi = lo + 1.0
+        ref_hist, _ = histogram(ref_values, bins, (lo, hi))
+        cur_hist, _ = histogram(sample_df[column], bins, (lo, hi))
+        out[column] = {
+            "tvd": total_variance_distance(ref_hist, cur_hist),
+            "hellinger": hellinger_distance(ref_hist, cur_hist),
+            "kld": kl_divergence(ref_hist, cur_hist),
+        }
+    return out

@@ -1,0 +1,96 @@
+"""Notebook/HTML rendering of runs & artifacts (reference analog:
+mlrun/render.py — run table HTML, artifact links)."""
+
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+_style = """
+<style>
+.mlt-table { border-collapse: collapse; font-family: monospace; }
+.mlt-table th, .mlt-table td {
+  border: 1px solid #ccc; padding: 4px 8px; text-align: left; }
+.mlt-table th { background: #f0f0f0; }
+.mlt-state-completed { color: #0a7d00; }
+.mlt-state-error { color: #c00000; }
+.mlt-state-running { color: #0050c0; }
+</style>
+"""
+
+
+def _cell(value) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, dict):
+        return html.escape(", ".join(
+            f"{k}={_round(v)}" for k, v in value.items()))
+    return html.escape(str(value))
+
+
+def _round(value):
+    if isinstance(value, float):
+        return round(value, 4)
+    return value
+
+
+def runs_to_html(runs: list[dict], display: bool = True) -> str:
+    """Render a run list to an HTML table."""
+    headers = ["uid", "name", "state", "start", "results", "artifacts"]
+    rows = []
+    for run in runs:
+        meta = run.get("metadata", {})
+        status = run.get("status", {})
+        state = status.get("state", "")
+        rows.append(
+            "<tr>"
+            f"<td>{_cell((meta.get('uid') or '')[:12])}</td>"
+            f"<td>{_cell(meta.get('name'))}</td>"
+            f"<td class='mlt-state-{state}'>{_cell(state)}</td>"
+            f"<td>{_cell(str(status.get('start_time', ''))[:19])}</td>"
+            f"<td>{_cell(status.get('results'))}</td>"
+            f"<td>{_cell(list((status.get('artifact_uris') or {})))}</td>"
+            "</tr>")
+    table = (
+        _style + "<table class='mlt-table'><tr>"
+        + "".join(f"<th>{h}</th>" for h in headers) + "</tr>"
+        + "".join(rows) + "</table>")
+    if display:
+        _display_html(table)
+    return table
+
+
+def artifacts_to_html(artifacts: list[dict], display: bool = True) -> str:
+    headers = ["key", "kind", "tag", "size", "target"]
+    rows = []
+    for artifact in artifacts:
+        meta = artifact.get("metadata", {})
+        spec = artifact.get("spec", {})
+        rows.append(
+            "<tr>"
+            f"<td>{_cell(meta.get('key'))}</td>"
+            f"<td>{_cell(artifact.get('kind'))}</td>"
+            f"<td>{_cell(meta.get('tag'))}</td>"
+            f"<td>{_cell(spec.get('size'))}</td>"
+            f"<td>{_cell(spec.get('target_path'))}</td>"
+            "</tr>")
+    table = (
+        _style + "<table class='mlt-table'><tr>"
+        + "".join(f"<th>{h}</th>" for h in headers) + "</tr>"
+        + "".join(rows) + "</table>")
+    if display:
+        _display_html(table)
+    return table
+
+
+def run_to_html(run: dict, display: bool = True) -> str:
+    return runs_to_html([run], display=display)
+
+
+def _display_html(content: str):
+    try:
+        from IPython.display import HTML, display as ipy_display
+
+        ipy_display(HTML(content))
+    except ImportError:
+        pass

@@ -277,18 +277,68 @@ class QueueStep(BaseStep):
         self.retention_in_hours = retention_in_hours
         self.options = options
         self._stream = None
+        self._queue = None
+        self._workers = None
+        self._pending = 0
+        self._lock = None
 
     def init_object(self, context, namespace, mode="sync"):
         if self.path:
             from .streams import get_stream_pusher
 
             self._stream = get_stream_pusher(self.path, **self.options)
+        if mode == "async" and self._parent is not None:
+            import queue as queue_mod
+            import threading
+
+            self._queue = queue_mod.Queue()
+            self._lock = threading.Lock()
+            self._workers = [
+                threading.Thread(target=self._consume, daemon=True)
+                for _ in range(int(self.shards or 1))
+            ]
+            for worker in self._workers:
+                worker.start()
+
+    def _consume(self):
+        """Worker loop: pop events, run the downstream subgraph
+        (the storey async-flow replacement, reference states.py:1622-1710)."""
+        while True:
+            event = self._queue.get()
+            try:
+                self._parent._run_downstream(self, event)
+            except Exception as exc:  # noqa: BLE001 - async branch errors log
+                from ..utils import logger
+
+                logger.error("async queue branch failed", step=self.name,
+                             error=str(exc))
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                self._queue.task_done()
 
     def run(self, event, *args, **kwargs):
         if self._stream is not None:
             body = event.body if not self.full_event else event.__dict__
             self._stream.push(body)
+        if self._queue is not None:
+            with self._lock:
+                self._pending += 1
+            self._queue.put(copy.deepcopy(event))
+            return None  # downstream continues on a worker thread
         return event
+
+    def wait_empty(self, timeout: float = 30.0):
+        if self._queue is None:
+            return
+        import time as time_mod
+
+        deadline = time_mod.monotonic() + timeout
+        while time_mod.monotonic() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    return
+            time_mod.sleep(0.01)
 
 
 class FlowStep(BaseStep):
@@ -368,6 +418,8 @@ class FlowStep(BaseStep):
     # -- init / run --------------------------------------------------------
     def init_object(self, context, namespace, mode="sync"):
         self.context = context
+        if self.engine == "async":
+            mode = "async"
         for step in self._steps.values():
             step.init_object(context, namespace, mode)
         self._start_steps = [
@@ -420,6 +472,9 @@ class FlowStep(BaseStep):
                     result = self._steps[step.on_error].run(error_event)
                 else:
                     raise
+            if result is None and isinstance(step, QueueStep):
+                # async boundary: downstream continues on worker threads
+                continue
             if getattr(step, "responder", False):
                 response = result
             children = self._children(step.name)
@@ -431,6 +486,32 @@ class FlowStep(BaseStep):
                 queue.append(
                     (child, result if index == 0 else copy.deepcopy(result)))
         return response
+
+    def _run_downstream(self, from_step: BaseStep, event):
+        """Run the subgraph below ``from_step`` (async queue workers)."""
+        queue: list[tuple[BaseStep, Any]] = [
+            (child, event) for child in self._children(from_step.name)]
+        while queue:
+            step, current = queue.pop(0)
+            try:
+                result = step.run(current)
+            except Exception as exc:  # noqa: BLE001
+                if step.on_error and step.on_error in self._steps:
+                    error_event = copy.copy(current)
+                    error_event.error = str(exc)
+                    result = self._steps[step.on_error].run(error_event)
+                else:
+                    raise
+            if result is None and isinstance(step, QueueStep):
+                continue
+            for index, child in enumerate(self._children(step.name)):
+                queue.append(
+                    (child, result if index == 0 else copy.deepcopy(result)))
+
+    def _flush(self, timeout: float = 30.0):
+        for step in self._steps.values():
+            if isinstance(step, QueueStep):
+                step.wait_empty(timeout)
 
     def plot(self, filename=None, format=None, **kw):
         """Render the graph as mermaid text (graphviz-free)."""

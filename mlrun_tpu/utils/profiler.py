@@ -1,0 +1,124 @@
+"""Profiling/tracing utilities — the TPU observability layer.
+
+Reference has no distributed tracer (SURVEY.md §5.1); on TPU the equivalents
+are XLA device traces (jax.profiler → TensorBoard) plus per-step wall-time
+tracking. ``profile_run`` captures a device trace into the run's artifact
+path and registers it; ``StepTimer`` feeds per-step timing into run metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+from .helpers import logger, now_iso
+
+
+@contextlib.contextmanager
+def profile_run(context=None, key: str = "xla-trace",
+                output_dir: str = ""):
+    """Capture a jax/XLA profiler trace around a code block and register it
+    as a run artifact (TensorBoard-compatible)."""
+    import jax
+
+    output_dir = output_dir or os.path.join(
+        (context.artifact_path if context is not None else "/tmp"),
+        "traces", key)
+    os.makedirs(output_dir, exist_ok=True)
+    jax.profiler.start_trace(output_dir)
+    started = time.perf_counter()
+    try:
+        yield output_dir
+    finally:
+        jax.profiler.stop_trace()
+        elapsed = time.perf_counter() - started
+        logger.info("xla trace captured", dir=output_dir,
+                    wall_s=round(elapsed, 3))
+        if context is not None:
+            try:
+                context.log_artifact(
+                    key, target_path=output_dir, upload=False,
+                    labels={"viewer": "tensorboard"})
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("failed to register trace artifact",
+                               error=str(exc))
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device trace (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Rolling per-step wall-time stats for trainer/serving loops."""
+
+    def __init__(self, window: int = 100):
+        self.window = window
+        self._times: list[float] = []
+        self._last: Optional[float] = None
+
+    def start(self):
+        self._last = time.perf_counter()
+
+    def stop(self) -> float:
+        if self._last is None:
+            return 0.0
+        elapsed = time.perf_counter() - self._last
+        self._times.append(elapsed)
+        if len(self._times) > self.window:
+            del self._times[: len(self._times) - self.window]
+        self._last = None
+        return elapsed
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {}
+        ordered = sorted(self._times)
+        n = len(ordered)
+        return {
+            "step_time_mean_s": sum(ordered) / n,
+            "step_time_p50_s": ordered[n // 2],
+            "step_time_p95_s": ordered[min(n - 1, int(n * 0.95))],
+            "steps_measured": n,
+        }
+
+
+def memory_report() -> dict:
+    """Device + host memory snapshot (reference analog: the objgraph memory
+    reports, server/api/utils/memory_reports.py:26 — here device-centric)."""
+    out: dict = {}
+    try:
+        import jax
+
+        for device in jax.local_devices():
+            stats = device.memory_stats() or {}
+            out[str(device)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+    except Exception as exc:  # noqa: BLE001
+        out["error"] = str(exc)
+    try:
+        with open("/proc/self/status") as fp:
+            for line in fp:
+                if line.startswith(("VmRSS", "VmHWM")):
+                    key, _, value = line.partition(":")
+                    out[f"host_{key.lower()}"] = value.strip()
+    except OSError:
+        pass
+    return out

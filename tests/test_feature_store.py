@@ -57,3 +57,49 @@ def test_entity_validation(isolated_home):
     fs = FeatureSet("bad", entities=["missing_col"])
     with pytest.raises(ValueError, match="entity column"):
         ingest(fs, pd.DataFrame({"x": [1]}))
+
+
+def test_sources_and_targets(isolated_home, tmp_path):
+    import sqlite3
+
+    import pandas as pd
+
+    from mlrun_tpu.datastore import CSVSource, NoSqlTarget, SQLTarget
+    from mlrun_tpu.feature_store import FeatureSet, ingest
+
+    csv = tmp_path / "src.csv"
+    pd.DataFrame({"id": ["a", "b"], "v": [1.0, 2.0]}).to_csv(csv, index=False)
+
+    fs = FeatureSet("multi", entities=["id"])
+    fs.metadata.project = "fsproj2"
+    nosql = NoSqlTarget(path=str(tmp_path / "kv.sqlite"))
+    sql = SQLTarget(name="tbl", attributes={
+        "db_url": f"sqlite://{tmp_path}/sql.sqlite", "table": "tbl"})
+    ingest(fs, CSVSource(path=str(csv)), targets=[nosql, sql])
+
+    # offline parquet always written
+    assert fs.to_dataframe().shape == (2, 2)
+    # nosql online lookup
+    assert nosql.get(["a"])["v"] == 1.0
+    # sql target queryable
+    with sqlite3.connect(str(tmp_path / "sql.sqlite")) as conn:
+        rows = conn.execute("SELECT COUNT(*) FROM tbl").fetchone()
+    assert rows[0] == 2
+    assert {t["kind"] for t in fs.status.targets} == \
+        {"parquet", "nosql", "sql"}
+
+
+def test_source_time_filter(isolated_home, tmp_path):
+    import pandas as pd
+
+    from mlrun_tpu.datastore import ParquetSource
+
+    path = tmp_path / "t.parquet"
+    pd.DataFrame({
+        "ts": pd.to_datetime(["2026-01-01", "2026-02-01", "2026-03-01"]),
+        "v": [1, 2, 3],
+    }).to_parquet(path, index=False)
+    source = ParquetSource(path=str(path), time_field="ts",
+                           start_time="2026-01-15", end_time="2026-02-15")
+    df = source.to_dataframe()
+    assert list(df["v"]) == [2]

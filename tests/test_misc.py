@@ -1,0 +1,87 @@
+"""Hub, mounts, render, profiler, notifications tests."""
+
+import mlrun_tpu
+
+
+def test_hub_import_and_run():
+    fn = mlrun_tpu.import_function("hub://iris_trainer")
+    assert fn.kind == "job"
+    assert fn.spec.default_handler == "trainer"
+    run = fn.run(local=True, params={"max_iter": 120})
+    assert run.state == "completed", run.status.error
+    assert run.status.results["accuracy"] > 0.8
+
+
+def test_hub_tpujob_function():
+    fn = mlrun_tpu.import_function("hub://llama_finetune")
+    assert fn.kind == "tpujob"
+    assert fn.spec.topology == "2x4"
+
+
+def test_mount_modifiers():
+    from mlrun_tpu.platforms import mount_gcs_key, mount_pvc, mount_tmpfs
+
+    fn = mlrun_tpu.new_function("m", kind="job", image="x")
+    fn.apply(mount_pvc("my-pvc", volume_mount_path="/data"))
+    fn.apply(mount_gcs_key())
+    fn.apply(mount_tmpfs("2Gi"))
+    volumes = {v["name"] for v in fn.spec.volumes}
+    assert volumes == {"pvc", "gcs-key", "shm"}
+    assert fn.get_env("GOOGLE_APPLICATION_CREDENTIALS") == \
+        "/var/secrets/gcs/key.json"
+    pod = fn.to_pod_spec()
+    assert len(pod["volumes"]) == 3
+    assert len(pod["containers"][0]["volumeMounts"]) == 3
+
+
+def test_render_html():
+    from mlrun_tpu.render import artifacts_to_html, runs_to_html
+
+    runs = [{"metadata": {"uid": "abc123", "name": "r"},
+             "status": {"state": "completed",
+                        "results": {"acc": 0.91234567}}}]
+    html = runs_to_html(runs, display=False)
+    assert "abc123" in html and "completed" in html and "0.9123" in html
+    html2 = artifacts_to_html(
+        [{"kind": "model", "metadata": {"key": "m1", "tag": "v1"},
+          "spec": {"target_path": "/x"}}], display=False)
+    assert "m1" in html2
+
+
+def test_step_timer_and_memory_report():
+    import time
+
+    from mlrun_tpu.utils.profiler import StepTimer, memory_report
+
+    timer = StepTimer()
+    for _ in range(3):
+        with timer.measure():
+            time.sleep(0.01)
+    summary = timer.summary()
+    assert summary["steps_measured"] == 3
+    assert summary["step_time_mean_s"] >= 0.01
+    report = memory_report()
+    assert "host_vmrss" in report
+
+
+def test_console_notification_on_run(capsys):
+    def handler(context):
+        context.log_result("ok", 1)
+
+    fn = mlrun_tpu.new_function("n", kind="local", handler=handler)
+    run = fn.run(local=True, notifications=[
+        {"kind": "console", "when": ["completed"],
+         "message": "run finished fine"}])
+    captured = capsys.readouterr()
+    assert "run finished fine" in captured.out
+    assert run.state == "completed"
+
+
+def test_secrets_store():
+    from mlrun_tpu.secrets import SecretsStore
+
+    store = SecretsStore()
+    store.add_source("inline", {"API_KEY": "s3cret"})
+    assert store.get("API_KEY") == "s3cret"
+    # inline secrets are redacted on serialization
+    assert store.to_serial() == []

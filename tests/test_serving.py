@@ -116,3 +116,35 @@ def test_graph_cycle_detection():
     a.after = ["b"]
     with pytest.raises(GraphError, match="cycle"):
         graph.init_object(None, {})
+
+
+def test_async_flow_engine():
+    """Async (storey-analog) flow: queue decouples; responder before the
+    queue returns immediately; downstream runs on workers
+    (reference tests/serving/test_async_flow.py analog)."""
+    import time
+
+    seen = []
+
+    def slow_sink(x):
+        time.sleep(0.05)
+        seen.append(x)
+        return x
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow", engine="async")
+    pre = graph.to(name="pre", handler=lambda x: x + 1)
+    pre.respond()
+    pre.to("$queue", name="q", path="memory://async-q") \
+       .to(name="sink", handler=slow_sink)
+    server = fn.to_mock_server()
+    t0 = time.monotonic()
+    result = server.test(body=1)
+    elapsed = time.monotonic() - t0
+    assert result == 2          # responder replied without waiting for sink
+    assert elapsed < 0.05, elapsed
+    server.wait_for_completion()
+    assert seen == [2]          # async branch completed after flush
+    from mlrun_tpu.serving.streams import get_in_memory_stream
+
+    assert len(get_in_memory_stream("async-q")) == 1
