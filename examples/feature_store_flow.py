@@ -1,0 +1,38 @@
+"""Feature store flow: ingest -> offline join -> online lookup.
+
+Run: python examples/feature_store_flow.py
+"""
+
+import pandas as pd
+
+from mlrun_tpu.datastore import NoSqlTarget
+from mlrun_tpu.feature_store import (
+    FeatureSet,
+    FeatureVector,
+    get_offline_features,
+    get_online_feature_service,
+    ingest,
+)
+
+if __name__ == "__main__":
+    stocks = FeatureSet("stocks", entities=["ticker"])
+    ingest(stocks, pd.DataFrame({
+        "ticker": ["GOOG", "MSFT", "AAPL"],
+        "price": [190.0, 420.0, 230.0]}),
+        targets=[NoSqlTarget()])
+
+    quotes = FeatureSet("quotes", entities=["ticker"])
+    ingest(quotes, pd.DataFrame({
+        "ticker": ["GOOG", "MSFT"],
+        "volume": [1.2e6, 2.3e6]}))
+
+    vector = FeatureVector("features",
+                           features=["stocks.price", "quotes.volume"])
+    vector.save()
+
+    offline = get_offline_features(vector).to_dataframe()
+    print("offline join:\n", offline)
+
+    service = get_online_feature_service(vector,
+                                         impute_policy={"volume": 0.0})
+    print("online:", service.get([{"ticker": "AAPL"}]))

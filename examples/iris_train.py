@@ -1,0 +1,34 @@
+"""Example 1 (BASELINE configs): sklearn iris trainer via run_function.
+
+Run: python examples/iris_train.py
+"""
+
+import mlrun_tpu
+
+
+def trainer(context, max_iter: int = 200):
+    from sklearn.datasets import load_iris
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import train_test_split
+
+    from mlrun_tpu.frameworks.sklearn import apply_mlrun
+
+    data = load_iris(as_frame=True)
+    X_train, X_test, y_train, y_test = train_test_split(
+        data.data, data.target, test_size=0.3, random_state=0)
+    model = LogisticRegression(max_iter=max_iter)
+    apply_mlrun(model, context, model_name="iris-model",
+                x_test=X_test, y_test=y_test,
+                sample_set=data.data.assign(label=data.target),
+                label_column="label")
+    model.fit(X_train, y_train)
+
+
+if __name__ == "__main__":
+    project = mlrun_tpu.get_or_create_project("examples", save=True)
+    fn = mlrun_tpu.new_function("iris-train", kind="local", handler=trainer)
+    project.set_function(fn, name="iris-train")
+    run = project.run_function("iris-train", params={"max_iter": 300},
+                               local=True)
+    print("results:", run.status.results)
+    print("model uri:", run.status.artifact_uris["iris-model"])

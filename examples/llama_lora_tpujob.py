@@ -1,0 +1,44 @@
+"""Example 4 (BASELINE configs): Llama LoRA fine-tune as a tpujob.
+
+With a service + GKE cluster this submits a JobSet over a v5e-64:
+    MLT_DBPATH=http://api:8787 python examples/llama_lora_tpujob.py
+Without a cluster it runs the same handler locally on visible devices
+(pass --local).
+"""
+
+import sys
+
+import mlrun_tpu
+from mlrun_tpu.frameworks.jax import train
+
+
+def make_function():
+    fn = mlrun_tpu.new_function("llama-lora", kind="tpujob",
+                                handler="train_handler")
+    # v5e-64: 8x8 topology, 16 hosts x 4 chips
+    fn.with_tpu_topology("tpu-v5-lite-podslice", "8x8")
+    fn.with_mesh({"data": 1, "fsdp": 16, "tensor": 4})
+    return fn
+
+
+if __name__ == "__main__":
+    local = "--local" in sys.argv
+    params = {
+        "model": "tiny" if local else "llama3-8b",
+        "model_overrides": {"attention_impl": "reference"} if local else None,
+        "batch_size": 4 if local else 64,
+        "seq_len": 64 if local else 2048,
+        "steps": 3 if local else 1000,
+        "lora_rank": 8 if local else 16,
+        "mesh_shape": {"fsdp": 1} if local else
+        {"data": 1, "fsdp": 16, "tensor": 4},
+        "checkpoint_every": 0 if local else 100,
+    }
+    if local:
+        fn = mlrun_tpu.new_function("llama-lora", kind="local",
+                                    handler=train)
+        run = fn.run(params=params, local=True)
+    else:
+        fn = make_function()
+        run = fn.run(params=params, watch=True)
+    print("results:", run.status.results)

@@ -1,0 +1,39 @@
+"""Example 2 (BASELINE configs): train + serve through a V2 model server
+(the xgb_serving analog on the libraries in this image).
+
+Run: python examples/sklearn_serving.py
+"""
+
+import mlrun_tpu
+from mlrun_tpu.frameworks.sklearn import SKLearnModelServer
+
+
+def train() -> str:
+    def handler(context):
+        from sklearn.datasets import load_iris
+        from sklearn.ensemble import RandomForestClassifier
+
+        from mlrun_tpu.frameworks.sklearn import apply_mlrun
+
+        data = load_iris()
+        model = RandomForestClassifier(n_estimators=20)
+        apply_mlrun(model, context, model_name="rf-model",
+                    x_test=data.data, y_test=data.target)
+        model.fit(data.data, data.target)
+
+    fn = mlrun_tpu.new_function("rf-train", kind="local", handler=handler)
+    run = fn.run(local=True)
+    return run.status.artifact_uris["rf-model"]
+
+
+if __name__ == "__main__":
+    model_uri = train()
+    serving = mlrun_tpu.new_function("rf-serving", kind="serving")
+    serving.set_topology("router")
+    serving.add_model("rf", class_name=SKLearnModelServer,
+                      model_path=model_uri)
+    server = serving.to_mock_server()
+    out = server.test("/v2/models/rf/infer",
+                      body={"inputs": [[5.1, 3.5, 1.4, 0.2]]})
+    print("prediction:", out["outputs"])
+    # online gateway: mlrun_tpu.serving.asgi.serve(function=serving)

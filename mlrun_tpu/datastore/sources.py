@@ -149,12 +149,15 @@ class StreamSource(BaseSource):
     def to_dataframe(self, columns=None, df_module=None, **kwargs):
         import pandas as pd
 
-        from ..serving.streams import get_stream_pusher
+        from ..serving.streams import _FileStream, get_stream_pusher
 
         stream = get_stream_pusher(self.path)
-        items = stream.pull(100000) if hasattr(stream, "pull") else []
-        if items and isinstance(items[0], tuple):
-            items = [i[0] for i in items]
+        if isinstance(stream, _FileStream):
+            items, _ = stream.pull(offset=0, max_items=0)
+        elif hasattr(stream, "pull"):
+            items = stream.pull(1_000_000)
+        else:
+            items = []
         df = pd.DataFrame(items)
         return df[columns] if columns else df
 
@@ -177,6 +180,20 @@ class KafkaSource(BaseSource):
             auto_offset_reset="earliest")
         rows = [json.loads(m.value) for m in consumer]
         df = pd.DataFrame(rows)
+        return df[columns] if columns else df
+
+
+class GenericUrlSource(BaseSource):
+    """Any datastore url; format inferred from the suffix by DataItem.as_df
+    (csv/parquet/json)."""
+
+    kind = "url"
+
+    def to_dataframe(self, columns=None, df_module=None, **kwargs):
+        from . import store_manager
+
+        df = store_manager.object(url=self.path).as_df(**kwargs)
+        df = self.filter_df(df)
         return df[columns] if columns else df
 
 
@@ -213,5 +230,5 @@ def resolve_source(source) -> BaseSource:
             return ParquetSource(path=source)
         if source.startswith(("http://", "https://")):
             return HttpSource(path=source)
-        return CSVSource(path=source)
+        return GenericUrlSource(path=source)
     raise ValueError(f"unsupported source {type(source)}")

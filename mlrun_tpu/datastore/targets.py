@@ -84,12 +84,26 @@ class NoSqlTarget(BaseTarget):
     kind = "nosql"
     is_online = True
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._cached_conn = None
+
     def _conn(self):
+        # one cached connection per target instance — get() sits on the
+        # online-lookup hot path
+        if self._cached_conn is not None:
+            return self._cached_conn
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        conn = sqlite3.connect(self.path)
+        conn = sqlite3.connect(self.path, check_same_thread=False)
         conn.execute("CREATE TABLE IF NOT EXISTS kv "
                      "(key TEXT PRIMARY KEY, value TEXT)")
+        self._cached_conn = conn
         return conn
+
+    def close(self):
+        if self._cached_conn is not None:
+            self._cached_conn.close()
+            self._cached_conn = None
 
     def default_path(self, project: str, feature_set: str) -> str:
         return os.path.join(mlconf.home_dir, "feature-store", project,

@@ -1,0 +1,26 @@
+"""LightGBM auto-logger (reference analog: mlrun/frameworks/lgbm/).
+
+Gated on the lightgbm package; sklearn-API estimators reuse the sklearn
+handler.
+"""
+
+from __future__ import annotations
+
+
+def apply_mlrun(model=None, context=None, model_name: str = "model",
+                tag: str = "", **kwargs):
+    try:
+        import lightgbm  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "lightgbm is not installed in this environment") from exc
+    from ..sklearn import apply_mlrun as sklearn_apply
+
+    return sklearn_apply(model=model, context=context,
+                         model_name=model_name, tag=tag, **kwargs)
+
+
+def LGBMModelServer(*args, **kwargs):
+    from ..sklearn import SKLearnModelServer
+
+    return SKLearnModelServer(*args, **kwargs)

@@ -1,0 +1,39 @@
+"""ONNX adapter (reference analog: mlrun/frameworks/onnx/).
+
+Gated on onnx/onnxruntime. On TPU deployments the preferred path is native
+jax export (the model registry stores orbax/jax trees); onnx remains for
+interop with external serving stacks.
+"""
+
+from __future__ import annotations
+
+
+def to_onnx(model, context=None, model_name: str = "model", **kwargs):
+    raise ImportError(
+        "onnx export requires the onnx package (not in this environment); "
+        "use the jax/orbax model registry path instead")
+
+
+def ONNXModelServer(*args, **kwargs):
+    try:
+        import onnxruntime  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "onnxruntime is not installed in this environment") from exc
+    from ...serving.v2_serving import V2ModelServer
+
+    class _Server(V2ModelServer):
+        def load(self):
+            import onnxruntime as ort
+
+            model_file, _ = self.get_model(".onnx")
+            self.model = ort.InferenceSession(model_file)
+
+        def predict(self, request):
+            import numpy as np
+
+            inputs = np.asarray(request["inputs"], dtype=np.float32)
+            input_name = self.model.get_inputs()[0].name
+            return self.model.run(None, {input_name: inputs})[0].tolist()
+
+    return _Server(*args, **kwargs)

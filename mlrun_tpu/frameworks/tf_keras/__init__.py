@@ -1,0 +1,123 @@
+"""TF/Keras auto-logger (reference analog: mlrun/frameworks/tf_keras/
+mlrun_interface.py — wraps compile/fit with logging callbacks :51-95; the
+Horovod optimizer-wrap + rank-0 callback logic :212-220 is replaced by the
+ctx-layer rank-0 gate, since TPU training in this framework is the JAX
+path — keras here is for existing keras user code, CPU/host-side)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from ...execution import MLClientCtx
+from ...utils import logger
+
+
+def apply_mlrun(model=None, context: MLClientCtx | None = None,
+                model_name: str = "model", tag: str = "",
+                x_test=None, y_test=None, log_model: bool = True, **kwargs):
+    """Patch a keras model so fit() logs per-epoch metrics and the final
+    model to the run context."""
+    if context is None:
+        import mlrun_tpu
+
+        context = mlrun_tpu.get_or_create_ctx("tf-keras")
+    handler = KerasModelHandler(model, context, model_name, tag,
+                                x_test=x_test, y_test=y_test,
+                                log_model=log_model)
+    if model is not None:
+        handler.patch()
+    return handler
+
+
+class _MLRunLoggingCallback:
+    """Per-epoch metric logging callback (reference logging_callback)."""
+
+    def __new__(cls, context, handler):
+        from tensorflow import keras
+
+        class _Callback(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if logs and context.is_logging_worker():
+                    context.log_metrics(
+                        {k: float(v) for k, v in logs.items()}, step=epoch)
+
+            def on_train_end(self, logs=None):
+                handler._post_fit(logs)
+
+        return _Callback()
+
+
+class KerasModelHandler:
+    def __init__(self, model, context, model_name="model", tag="",
+                 x_test=None, y_test=None, log_model=True):
+        self.model = model
+        self.context = context
+        self.model_name = model_name
+        self.tag = tag
+        self.x_test = x_test
+        self.y_test = y_test
+        self._log_model = log_model
+        self._patched = False
+
+    def patch(self):
+        if self._patched:
+            return self.model
+        original_fit = self.model.fit
+        handler = self
+
+        def wrapped_fit(*args, **kwargs):
+            callbacks = list(kwargs.get("callbacks") or [])
+            callbacks.append(_MLRunLoggingCallback(handler.context, handler))
+            kwargs["callbacks"] = callbacks
+            return original_fit(*args, **kwargs)
+
+        self.model.fit = wrapped_fit
+        self._patched = True
+        return self.model
+
+    def _post_fit(self, logs=None):
+        metrics = {k: float(v) for k, v in (logs or {}).items()}
+        if self.x_test is not None and self.y_test is not None:
+            try:
+                evaluation = self.model.evaluate(
+                    self.x_test, self.y_test, verbose=0, return_dict=True)
+                metrics.update(
+                    {f"test_{k}": float(v) for k, v in evaluation.items()})
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("keras evaluation failed", error=str(exc))
+        if metrics:
+            self.context.log_results(metrics)
+        if self._log_model:
+            self.log_model(metrics)
+
+    def log_model(self, metrics: dict | None = None):
+        tmp_dir = tempfile.mkdtemp()
+        path = os.path.join(tmp_dir, f"{self.model_name}.keras")
+        self.model.save(path)
+        return self.context.log_model(
+            self.model_name, model_file=path, framework="tf.keras",
+            metrics=metrics or {}, tag=self.tag)
+
+
+class TFKerasModelServer:
+    """V2ModelServer for saved keras models."""
+
+    def __new__(cls, *args, **kwargs):
+        from ...serving.v2_serving import V2ModelServer
+
+        class _Server(V2ModelServer):
+            def load(self):
+                from tensorflow import keras
+
+                model_file, _ = self.get_model(".keras")
+                self.model = keras.models.load_model(model_file)
+
+            def predict(self, request):
+                import numpy as np
+
+                inputs = np.asarray(request["inputs"])
+                return self.model.predict(inputs, verbose=0).tolist()
+
+        return _Server(*args, **kwargs)

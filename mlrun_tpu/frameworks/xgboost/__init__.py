@@ -1,0 +1,27 @@
+"""XGBoost auto-logger (reference analog: mlrun/frameworks/xgboost/).
+
+xgboost follows the sklearn estimator API, so the sklearn handler carries the
+logging; this module exists for API parity and gates on the library.
+"""
+
+from __future__ import annotations
+
+
+def apply_mlrun(model=None, context=None, model_name: str = "model",
+                tag: str = "", **kwargs):
+    try:
+        import xgboost  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "xgboost is not installed in this environment") from exc
+    from ..sklearn import apply_mlrun as sklearn_apply
+
+    handler = sklearn_apply(model=model, context=context,
+                            model_name=model_name, tag=tag, **kwargs)
+    return handler
+
+
+def XGBoostModelServer(*args, **kwargs):
+    from ..sklearn import SKLearnModelServer
+
+    return SKLearnModelServer(*args, **kwargs)

@@ -117,7 +117,12 @@ class MonitoringApplicationController:
             if window.empty:
                 continue
             self._processed_rows[endpoint_id] = len(df)
-            sample_df = _inputs_frame(window)
+            try:
+                sample_df = _inputs_frame(window)
+            except Exception as exc:  # noqa: BLE001 - bad rows skip endpoint
+                logger.warning("could not parse inputs window",
+                               endpoint=endpoint_id, error=str(exc))
+                continue
             try:
                 endpoint = self.db.get_model_endpoint(self.project,
                                                       endpoint_id)
@@ -163,9 +168,17 @@ def _inputs_frame(window: pd.DataFrame) -> pd.DataFrame:
                     rows.append([item])
     if not rows:
         return pd.DataFrame()
-    if isinstance(rows[0], dict):
-        return pd.DataFrame(rows)
-    width = max(len(r) for r in rows)
+    dict_rows = [r for r in rows if isinstance(r, dict)]
+    list_rows = [r for r in rows if isinstance(r, list)]
+    if dict_rows and not list_rows:
+        return pd.DataFrame(dict_rows)
+    if list_rows and dict_rows:
+        # mixed shapes: name list positions f0.. and merge with dict rows
+        list_rows = [
+            {f"f{i}": v for i, v in enumerate(r)} for r in list_rows
+        ]
+        return pd.DataFrame(list_rows + dict_rows)
+    width = max(len(r) for r in list_rows)
     return pd.DataFrame(
-        [r + [None] * (width - len(r)) for r in rows],
+        [r + [None] * (width - len(r)) for r in list_rows],
         columns=[f"f{i}" for i in range(width)])

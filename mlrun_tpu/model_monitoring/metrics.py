@@ -50,7 +50,10 @@ def drift_per_feature(sample_df, reference_df, bins: int = 20) -> dict:
     for column in reference_df.columns:
         if column not in sample_df.columns:
             continue
-        ref_values = np.asarray(reference_df[column], dtype=np.float64)
+        try:
+            ref_values = np.asarray(reference_df[column], dtype=np.float64)
+        except (TypeError, ValueError):
+            continue  # non-numeric column (label/categorical) — skip
         ref_values = ref_values[np.isfinite(ref_values)]
         if ref_values.size == 0:
             continue
@@ -58,7 +61,10 @@ def drift_per_feature(sample_df, reference_df, bins: int = 20) -> dict:
         if lo == hi:
             hi = lo + 1.0
         ref_hist, _ = histogram(ref_values, bins, (lo, hi))
-        cur_hist, _ = histogram(sample_df[column], bins, (lo, hi))
+        try:
+            cur_hist, _ = histogram(sample_df[column], bins, (lo, hi))
+        except (TypeError, ValueError):
+            continue
         out[column] = {
             "tvd": total_variance_distance(ref_hist, cur_hist),
             "hellinger": hellinger_distance(ref_hist, cur_hist),

@@ -10,3 +10,13 @@ from .llama import (  # noqa: F401
     tiny_llama,
 )
 from .lora import init_lora, lora_param_count, merge_lora  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    bert_base,
+    classification_loss,
+    classify,
+    encode,
+    mlm_logits,
+    mlm_loss,
+    tiny_bert,
+)

@@ -1,0 +1,223 @@
+"""BERT-family encoder — TPU-first, same stacked-scan design as llama.py.
+
+Covers the reference's BERT-base fine-tune path (BASELINE configs: "BERT-base
+fine-tune via frameworks.huggingface on tpujob"). Modernized encoder: RoPE
+instead of learned positions (length-extensible), pre-LayerNorm, GELU MLP,
+non-causal attention via ops.attention. Heads: masked-LM and sequence
+classification (mean-pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.rotary import apply_rope, rope_table
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_layers: int = 12
+    embed_dim: int = 768
+    n_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-12
+    n_classes: int = 2
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    def param_count(self) -> int:
+        per_layer = (
+            4 * self.embed_dim * self.qkv_dim
+            + 2 * self.embed_dim * self.mlp_dim
+            + 4 * self.embed_dim  # 2 layernorms (scale+bias)
+        )
+        return (self.vocab_size * self.embed_dim
+                + self.n_layers * per_layer
+                + 2 * self.embed_dim
+                + self.embed_dim * self.n_classes + self.n_classes)
+
+
+def bert_base(**overrides) -> BertConfig:
+    return dataclasses.replace(BertConfig(), **overrides)
+
+
+def tiny_bert(**overrides) -> BertConfig:
+    return dataclasses.replace(BertConfig(
+        vocab_size=512, n_layers=2, embed_dim=128, n_heads=4, head_dim=32,
+        mlp_dim=256, n_classes=3), **overrides)
+
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def init_params(config: BertConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, 8)
+    dtype = config.dtype
+    e, h, m, L = (config.embed_dim, config.qkv_dim, config.mlp_dim,
+                  config.n_layers)
+
+    def norm_init(fan_in, shape, k):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    return {
+        "embedding": norm_init(e, (config.vocab_size, e), keys[0]),
+        "layers": {
+            "attn_norm_scale": jnp.ones((L, e), dtype),
+            "attn_norm_bias": jnp.zeros((L, e), dtype),
+            "wq": norm_init(e, (L, e, h), keys[1]),
+            "wk": norm_init(e, (L, e, h), keys[2]),
+            "wv": norm_init(e, (L, e, h), keys[3]),
+            "wo": norm_init(h, (L, h, e), keys[4]),
+            "mlp_norm_scale": jnp.ones((L, e), dtype),
+            "mlp_norm_bias": jnp.zeros((L, e), dtype),
+            "w_up": norm_init(e, (L, e, m), keys[5]),
+            "w_down": norm_init(m, (L, m, e), keys[6]),
+        },
+        "final_norm_scale": jnp.ones((e,), dtype),
+        "final_norm_bias": jnp.zeros((e,), dtype),
+        "classifier_w": norm_init(e, (e, config.n_classes), keys[7]),
+        "classifier_b": jnp.zeros((config.n_classes,), jnp.float32),
+    }
+
+
+def _layer_body(config: BertConfig, x, lp, cos, sin, mask):
+    b, s, e = x.shape
+    h = layer_norm(x, lp["attn_norm_scale"], lp["attn_norm_bias"],
+                   config.norm_eps)
+
+    def proj(h_in, w):
+        return jnp.einsum("bse,eh->bsh", h_in, w,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+    q = proj(h, lp["wq"]).reshape(b, s, config.n_heads, config.head_dim)
+    k = proj(h, lp["wk"]).reshape(b, s, config.n_heads, config.head_dim)
+    v = proj(h, lp["wv"]).reshape(b, s, config.n_heads, config.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(q, k, v, causal=False, impl=config.attention_impl)
+    if mask is not None:
+        attn = attn * mask[:, :, None, None].astype(attn.dtype)
+    x = x + proj(attn.reshape(b, s, config.qkv_dim), lp["wo"])
+
+    h2 = layer_norm(x, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
+                    config.norm_eps)
+    up = proj(h2, lp["w_up"])
+    x = x + proj(jax.nn.gelu(up), lp["w_down"])
+    return x
+
+
+def encode(config: BertConfig, params: Params, tokens: jax.Array,
+           mask: jax.Array | None = None) -> jax.Array:
+    """tokens [B, S] (+ attention mask [B, S]) -> hidden [B, S, E]."""
+    b, s = tokens.shape
+    x = params["embedding"][tokens].astype(config.dtype)
+    cos, sin = rope_table(jnp.arange(s), config.head_dim, config.rope_theta)
+
+    body = functools.partial(_layer_body, config)
+    if config.remat:
+        body = jax.checkpoint(body,
+                              policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(carry, lp):
+        return body(carry, lp, cos, sin, mask), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    return layer_norm(x, params["final_norm_scale"],
+                      params["final_norm_bias"], config.norm_eps)
+
+
+def classify(config: BertConfig, params: Params, tokens: jax.Array,
+             mask: jax.Array | None = None) -> jax.Array:
+    """Sequence classification logits [B, n_classes] (mean-pool head)."""
+    hidden = encode(config, params, tokens, mask)
+    if mask is not None:
+        weights = mask.astype(jnp.float32)[:, :, None]
+        pooled = jnp.sum(hidden.astype(jnp.float32) * weights, axis=1) / \
+            jnp.maximum(jnp.sum(weights, axis=1), 1.0)
+    else:
+        pooled = jnp.mean(hidden.astype(jnp.float32), axis=1)
+    return pooled @ params["classifier_w"].astype(jnp.float32) + \
+        params["classifier_b"]
+
+
+def mlm_logits(config: BertConfig, params: Params, tokens: jax.Array,
+               mask: jax.Array | None = None) -> jax.Array:
+    """Masked-LM logits [B, S, vocab] (tied embedding head)."""
+    hidden = encode(config, params, tokens, mask)
+    return jnp.einsum("bse,ve->bsv", hidden.astype(jnp.float32),
+                      params["embedding"].astype(jnp.float32))
+
+
+def classification_loss(config: BertConfig, params: Params, tokens, labels,
+                        mask=None) -> tuple[jax.Array, dict]:
+    logits = classify(config, params, tokens, mask)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(nll)
+    accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, {"loss": loss, "accuracy": accuracy}
+
+
+def mlm_loss(config: BertConfig, params: Params, tokens, targets,
+             mlm_mask) -> tuple[jax.Array, dict]:
+    """mlm_mask: 1 where the token was masked and should be predicted."""
+    logits = mlm_logits(config, params, tokens)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    weight = mlm_mask.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(weight), 1.0)
+    loss = jnp.sum(nll * weight) / total
+    return loss, {"loss": loss, "masked_tokens": total}
+
+
+def make_classifier_train_step(config: BertConfig, optimizer, mesh=None):
+    """Sharded classification train step (params sharded by the shared
+    rules; 'wk/wv' here are full-head so the llama rules still apply)."""
+    from ..parallel.sharding import batch_sharding, tree_shardings
+
+    def step(params, opt_state, tokens, labels, mask):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: classification_loss(config, p, tokens, labels, mask),
+            has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    if mesh is None:
+        return jax.jit(step)
+    shapes = jax.eval_shape(
+        lambda: init_params(config, jax.random.PRNGKey(0)))
+    shardings = tree_shardings(shapes, mesh)
+    opt_shapes = jax.eval_shape(optimizer.init, shapes)
+    opt_shardings = tree_shardings(opt_shapes, mesh)
+    data = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, opt_shardings, data, data, data),
+        out_shardings=(shardings, opt_shardings, None),
+        donate_argnums=(0, 1))

@@ -38,15 +38,19 @@ def runs_to_html(runs: list[dict], display: bool = True) -> str:
     """Render a run list to an HTML table."""
     headers = ["uid", "name", "state", "start", "results", "artifacts"]
     rows = []
+    import re
+
     for run in runs:
         meta = run.get("metadata", {})
         status = run.get("status", {})
         state = status.get("state", "")
+        # states are free-form strings from the DB — never interpolate raw
+        state_class = re.sub(r"[^a-z0-9-]", "", str(state).lower())[:32]
         rows.append(
             "<tr>"
             f"<td>{_cell((meta.get('uid') or '')[:12])}</td>"
             f"<td>{_cell(meta.get('name'))}</td>"
-            f"<td class='mlt-state-{state}'>{_cell(state)}</td>"
+            f"<td class='mlt-state-{state_class}'>{_cell(state)}</td>"
             f"<td>{_cell(str(status.get('start_time', ''))[:19])}</td>"
             f"<td>{_cell(status.get('results'))}</td>"
             f"<td>{_cell(list((status.get('artifact_uris') or {})))}</td>"

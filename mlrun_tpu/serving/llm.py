@@ -143,6 +143,23 @@ class LLMEngine:
             functools.partial(_forward_with_cache, config),
             donate_argnums=(2,))
 
+        # fused greedy decode: N tokens per dispatch via lax.scan
+        def decode_n(params, first_token, cache, n):
+            def body(carry, _):
+                token, cache_in = carry
+                logits, cache_out = _forward_with_cache(
+                    config, params, token, cache_in)
+                next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (next_token[:, None], cache_out), next_token
+
+            (_, cache), tokens = jax.lax.scan(
+                body, (first_token, cache), None, length=n)
+            return tokens, cache  # tokens: [n, B]
+
+        self._decode_n = jax.jit(decode_n, static_argnums=(3,),
+                                 donate_argnums=(2,))
+        self.decode_chunk = 32
+
     def warmup(self):
         """Compile every prefill bucket + the decode step ahead of traffic."""
         started = time.perf_counter()
@@ -152,7 +169,10 @@ class LLMEngine:
             logits, cache = self._prefill(self.params, tokens, cache)
             step_tok = jnp.zeros((self.batch, 1), jnp.int32)
             logits, cache = self._decode(self.params, step_tok, cache)
-            jax.block_until_ready(logits)
+            step_tok = jnp.zeros((self.batch, 1), jnp.int32)
+            tokens_out, cache = self._decode_n(self.params, step_tok, cache,
+                                               self.decode_chunk)
+            float(jnp.sum(logits))  # host fetch = real sync on the relay
         logger.info("llm engine warm", buckets=list(self.prefill_buckets),
                     warmup_s=round(time.perf_counter() - started, 2))
 
@@ -192,13 +212,35 @@ class LLMEngine:
 
         out_tokens = [int(np.asarray(next_token)[0])]
         t1 = time.perf_counter()
-        for _ in range(max_new_tokens - 1):
-            if eos_id is not None and out_tokens[-1] == eos_id:
-                break
-            step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
-            logits, cache = self._decode(self.params, step, cache)
-            next_token = self._sample(logits)
-            out_tokens.append(int(jax.block_until_ready(next_token)[0]))
+        remaining = max_new_tokens - 1
+        if self.temperature and self.temperature > 0:
+            # sampled decode: per-token loop (carry randomness on host)
+            for _ in range(remaining):
+                if eos_id is not None and out_tokens[-1] == eos_id:
+                    break
+                step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
+                logits, cache = self._decode(self.params, step, cache)
+                next_token = self._sample(logits)
+                out_tokens.append(int(np.asarray(next_token)[0]))
+        else:
+            # greedy: fused multi-token scan per dispatch. Always run the
+            # full compiled chunk (ONE program, compiled at warmup) and
+            # truncate host-side — a variable tail would recompile per
+            # distinct length on the serving path.
+            while remaining > 0:
+                if eos_id is not None and out_tokens[-1] == eos_id:
+                    break
+                if prompt_len + len(out_tokens) + self.decode_chunk \
+                        > self.max_len:
+                    break  # cache capacity: full chunk wouldn't fit
+                step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
+                tokens, cache = self._decode_n(self.params, step, cache,
+                                               self.decode_chunk)
+                chunk = np.asarray(tokens)[:, 0].tolist()[:remaining]
+                if eos_id is not None and eos_id in chunk:
+                    chunk = chunk[: chunk.index(eos_id) + 1]
+                out_tokens.extend(int(t) for t in chunk)
+                remaining -= len(chunk)
         decode_time = time.perf_counter() - t1
         stats = {
             "ttft_s": ttft,

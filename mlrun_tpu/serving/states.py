@@ -328,17 +328,20 @@ class QueueStep(BaseStep):
             return None  # downstream continues on a worker thread
         return event
 
-    def wait_empty(self, timeout: float = 30.0):
+    def wait_empty(self, timeout: float = 30.0) -> bool:
+        """Drain; True when empty, False on timeout (callers must not treat
+        a timeout as completion)."""
         if self._queue is None:
-            return
+            return True
         import time as time_mod
 
         deadline = time_mod.monotonic() + timeout
         while time_mod.monotonic() < deadline:
             with self._lock:
                 if self._pending == 0:
-                    return
+                    return True
             time_mod.sleep(0.01)
+        return False
 
 
 class FlowStep(BaseStep):
@@ -508,10 +511,17 @@ class FlowStep(BaseStep):
                 queue.append(
                     (child, result if index == 0 else copy.deepcopy(result)))
 
-    def _flush(self, timeout: float = 30.0):
+    def _flush(self, timeout: float = 30.0) -> bool:
+        drained = True
         for step in self._steps.values():
             if isinstance(step, QueueStep):
-                step.wait_empty(timeout)
+                if not step.wait_empty(timeout):
+                    from ..utils import logger
+
+                    logger.warning("async queue did not drain within timeout",
+                                   step=step.name, timeout=timeout)
+                    drained = False
+        return drained
 
     def plot(self, filename=None, format=None, **kw):
         """Render the graph as mermaid text (graphviz-free)."""

@@ -112,3 +112,32 @@ def test_hf_weight_mapping_shapes():
     # same argmax + close logits
     assert np.array_equal(ours.argmax(-1), theirs.argmax(-1))
     assert float(np.max(np.abs(ours - theirs))) < 2e-2
+
+
+def test_tf_keras_apply_mlrun():
+    tf = pytest.importorskip("tensorflow")
+
+    def handler(context):
+        import numpy as np
+        from tensorflow import keras
+
+        from mlrun_tpu.frameworks.tf_keras import apply_mlrun
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 4)).astype("float32")
+        y = (X.sum(axis=1) > 0).astype("float32")
+        model = keras.Sequential([
+            keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+            keras.layers.Dense(1, activation="sigmoid"),
+        ])
+        model.compile(optimizer="adam", loss="binary_crossentropy",
+                      metrics=["accuracy"])
+        apply_mlrun(model, context, model_name="keras-model",
+                    x_test=X[:16], y_test=y[:16])
+        model.fit(X, y, epochs=2, verbose=0)
+
+    fn = mlrun_tpu.new_function("k", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert "loss" in run.status.results
+    assert "keras-model" in run.status.artifact_uris
