@@ -70,9 +70,12 @@ def main():
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # chunked CE keeps the loss memory flat, so larger batches fit; walk
+    # down until one fits on the chip
     attempts = (
-        [("1b", 8, 2048), ("1b", 4, 2048), ("1b", 2, 1024),
-         ("tiny", 8, 256)] if on_tpu else [("tiny", 8, 128)]
+        [("1b", 32, 2048), ("1b", 16, 2048), ("1b", 8, 2048),
+         ("1b", 4, 2048), ("tiny", 8, 256)] if on_tpu
+        else [("tiny", 8, 128)]
     )
     result = None
     last_error = None

@@ -195,6 +195,21 @@ def forward(config: LlamaConfig, params: Params, tokens: jax.Array,
     activations — required under jit when the embedding table is sharded
     (the gather's output sharding is ambiguous otherwise).
     """
+    x = hidden_states(config, params, tokens, positions=positions,
+                      lora=lora, act_spec=act_spec)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def hidden_states(config: LlamaConfig, params: Params, tokens: jax.Array,
+                  positions: jax.Array | None = None,
+                  lora: Optional[Params] = None,
+                  act_spec=None) -> jax.Array:
+    """tokens [B, S] -> final-norm hidden [B, S, E] (no lm head)."""
     b, s = tokens.shape
     if act_spec is not None:
         x = params["embedding"].at[tokens].get(
@@ -222,21 +237,73 @@ def forward(config: LlamaConfig, params: Params, tokens: jax.Array,
             return body(carry, layer_params, cos, sin, None), None
 
         x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    return rms_norm(x, params["final_norm_scale"], config.norm_eps)
 
-    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+
+def chunked_loss(config: LlamaConfig, params: Params, tokens: jax.Array,
+                 targets: jax.Array, mask: jax.Array | None = None,
+                 lora: Optional[Params] = None, chunk: int = 512,
+                 act_spec=None) -> tuple[jax.Array, dict]:
+    """Cross-entropy without materializing [B, S, vocab] logits.
+
+    The lm-head matmul + softmax run per sequence chunk under
+    ``jax.checkpoint`` (recomputed in backward), so peak memory for the loss
+    drops from O(B·S·V) to O(B·chunk·V) — the difference between fitting
+    batch 8 and batch 32 at vocab 128k on a 16GB chip.
+    """
+    x = hidden_states(config, params, tokens, lora=lora, act_spec=act_spec)
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
-    logits = jnp.einsum("bse,ev->bsv", x, head,
-                        preferred_element_type=jnp.float32)
-    return logits
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    b, s, e = x.shape
+    n_chunks = max(1, s // chunk)
+    chunk = s // n_chunks  # equal chunks (s divisible in practice; else 1)
+    if s % n_chunks:
+        n_chunks, chunk = 1, s
+
+    xc = x.reshape(b, n_chunks, chunk, e).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    mc = mask.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_stats(x_chunk, t_chunk, m_chunk):
+        logits = jnp.einsum("bce,ev->bcv", x_chunk, head,
+                            preferred_element_type=jnp.float32)
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            log_probs, t_chunk[..., None], axis=-1)[..., 0]
+        correct = (jnp.argmax(logits, axis=-1) == t_chunk)
+        return (jnp.sum(nll * m_chunk),
+                jnp.sum(correct * m_chunk), jnp.sum(m_chunk))
+
+    def scan_body(carry, xs):
+        loss_sum, correct_sum, count = carry
+        l, c, n = chunk_stats(*xs)
+        return (loss_sum + l, correct_sum + c, count + n), None
+
+    (loss_sum, correct_sum, count), _ = jax.lax.scan(
+        scan_body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32)), (xc, tc, mc))
+    total = jnp.maximum(count, 1.0)
+    loss = loss_sum / total
+    return loss, {"loss": loss, "accuracy": correct_sum / total,
+                  "tokens": total}
 
 
 def loss_fn(config: LlamaConfig, params: Params, tokens: jax.Array,
             targets: jax.Array, mask: jax.Array | None = None,
             lora: Optional[Params] = None,
-            act_spec=None) -> tuple[jax.Array, dict]:
-    """Next-token cross-entropy; returns (loss, metrics)."""
+            act_spec=None, loss_chunk: int = 0) -> tuple[jax.Array, dict]:
+    """Next-token cross-entropy; returns (loss, metrics).
+
+    ``loss_chunk > 0`` uses the memory-efficient chunked head (see
+    chunked_loss)."""
+    if loss_chunk:
+        return chunked_loss(config, params, tokens, targets, mask=mask,
+                            lora=lora, chunk=loss_chunk, act_spec=act_spec)
     logits = forward(config, params, tokens, lora=lora, act_spec=act_spec)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     if act_spec is not None:

@@ -51,9 +51,13 @@ class ServiceState:
 
 
 def build_app(state: ServiceState | None = None) -> web.Application:
+    from .clusterization import clusterization_middleware, is_chief
+
     state = state or ServiceState()
-    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app = web.Application(client_max_size=64 * 1024 * 1024,
+                          middlewares=[clusterization_middleware()])
     app["state"] = state
+    app["is_chief"] = is_chief()
 
     r = web.RouteTableDef()
 
@@ -564,6 +568,35 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         return json_response({"state": workflow["state"],
                               "error": workflow.get("error")})
 
+    # -- api gateways (stored as api-gateway kind function objects) -------------
+    @r.post(API + "/projects/{project}/api-gateways/{name}")
+    async def store_api_gateway(request):
+        body = await request.json()
+        gateway = body.get("data", body)
+        gateway["kind"] = "api-gateway"
+        state.db.store_function(gateway, request.match_info["name"],
+                                request.match_info["project"],
+                                tag="latest")
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/api-gateways/{name}")
+    async def get_api_gateway(request):
+        from ..db.base import RunDBError
+
+        try:
+            gateway = state.db.get_function(
+                request.match_info["name"], request.match_info["project"])
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": gateway})
+
+    @r.get(API + "/projects/{project}/api-gateways")
+    async def list_api_gateways(request):
+        funcs = state.db.list_functions(
+            project=request.match_info["project"])
+        return json_response({"api_gateways": [
+            f for f in funcs if f.get("kind") == "api-gateway"]})
+
     # -- background tasks --------------------------------------------------------------------
     @r.get(API + "/projects/{project}/background-tasks/{name}")
     async def get_background_task(request):
@@ -581,6 +614,10 @@ def build_app(state: ServiceState | None = None) -> web.Application:
 
 async def _start_periodic(app: web.Application):
     state: ServiceState = app["state"]
+    if not app.get("is_chief", True):
+        # workers proxy mutating ops; only the chief monitors + schedules
+        app["_periodic"] = []
+        return
 
     async def monitor_loop():
         while True:

@@ -47,6 +47,9 @@ class TrainConfig:
     lora_alpha: float = 32.0
     mesh_shape: dict | None = None
     seq_axis: str | None = None  # set to e.g. "seq" for context parallelism
+    # chunked cross-entropy: avoids the [B,S,vocab] logits allocation
+    # (0 = full logits). 512 is a good default for 128k vocab.
+    loss_chunk: int = 512
 
 
 class TrainState:
@@ -106,7 +109,8 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
 
     def loss_for(params, lora, tokens, targets):
         return llama_mod.loss_fn(model_config, params, tokens, targets,
-                                 lora=lora, act_spec=act_spec)
+                                 lora=lora, act_spec=act_spec,
+                                 loss_chunk=train_config.loss_chunk)
 
     def compute_grads(params, lora, tokens, targets):
         if is_lora:

@@ -141,3 +141,33 @@ def test_grad_accum_equivalence(cfg):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             atol=5e-2, rtol=0.3)
+
+
+def test_chunked_loss_matches_full(cfg):
+    """chunked CE == full-logits CE (values and gradients)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlrun_tpu.models.llama import chunked_loss, init_params, loss_fn
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 32), dtype=np.int32))
+    targets = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 32), dtype=np.int32))
+
+    full, m_full = loss_fn(cfg, params, tokens, targets)
+    chunked, m_chunked = chunked_loss(cfg, params, tokens, targets, chunk=8)
+    assert abs(float(full) - float(chunked)) < 1e-3
+    assert abs(float(m_full["accuracy"]) - float(m_chunked["accuracy"])) \
+        < 1e-6
+
+    g_full = jax.grad(
+        lambda p: loss_fn(cfg, p, tokens, targets)[0])(params)
+    g_chunk = jax.grad(
+        lambda p: chunked_loss(cfg, p, tokens, targets, chunk=8)[0])(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_chunk)):
+        assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32)))) < 2e-2

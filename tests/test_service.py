@@ -31,22 +31,28 @@ def service(tmp_path, monkeypatch):
     loop = asyncio.new_event_loop()
     started = threading.Event()
 
-    async def serve():
+    runner_box = {}
+
+    async def serve2():
         runner = web.AppRunner(build_app(state))
         await runner.setup()
+        runner_box["runner"] = runner
         site = web.TCPSite(runner, "127.0.0.1", port)
         await site.start()
         started.set()
-        while True:
-            await asyncio.sleep(3600)
+        while not runner_box.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
 
     thread = threading.Thread(
         target=lambda: (asyncio.set_event_loop(loop),
-                        loop.run_until_complete(serve())),
+                        loop.run_until_complete(serve2())),
         daemon=True)
     thread.start()
     assert started.wait(10)
     yield f"http://127.0.0.1:{port}", state
+    runner_box["stop"] = True
+    thread.join(timeout=5)
     loop.call_soon_threadsafe(loop.stop)
 
 
@@ -199,3 +205,75 @@ def test_cron_parser():
         CronSchedule("* * *")
     daily = CronSchedule("30 3 * * *")
     assert daily.min_interval_seconds() == 24 * 3600
+
+
+def test_api_gateway_roundtrip(service, http_db):
+    from mlrun_tpu.runtimes.api_gateway import APIGateway
+
+    gateway = APIGateway("gw1", project="p1",
+                         functions=["p1/srv-a:latest", "p1/srv-b:latest"])
+    gateway.with_canary(["p1/srv-a:latest", "p1/srv-b:latest"], [80, 20])
+    gateway.save(db=http_db)
+    fetched = http_db.api_call("GET", "projects/p1/api-gateways/gw1")["data"]
+    assert fetched["spec"]["canary"] == [80, 20]
+    listed = http_db.api_call("GET", "projects/p1/api-gateways")
+    assert len(listed["api_gateways"]) == 1
+    picks = {gateway.pick_function() for _ in range(50)}
+    assert picks <= {"p1/srv-a:latest", "p1/srv-b:latest"}
+
+
+def test_worker_proxies_mutations_to_chief(service, http_db, monkeypatch):
+    """chief/worker clusterization: a worker forwards POSTs to the chief."""
+    import asyncio as aio
+    import socket as socketlib
+    import threading as threadinglib
+
+    from aiohttp import web as aioweb
+
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.app import ServiceState, build_app
+
+    chief_url, chief_state = service
+    monkeypatch.setenv("MLT_CLUSTER_ROLE", "worker")
+    monkeypatch.setenv("MLT_CHIEF_URL", chief_url)
+
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        worker_port = s.getsockname()[1]
+
+    loop = aio.new_event_loop()
+    started = threadinglib.Event()
+    box = {}
+
+    async def serve_worker():
+        # worker has its OWN (empty) db — proving reads/writes diverge
+        import tempfile
+
+        worker_db = SQLiteRunDB(tempfile.mktemp(suffix=".sqlite"))
+        runner = aioweb.AppRunner(build_app(ServiceState(db=worker_db)))
+        await runner.setup()
+        site = aioweb.TCPSite(runner, "127.0.0.1", worker_port)
+        await site.start()
+        started.set()
+        while not box.get("stop"):
+            await aio.sleep(0.05)
+        await runner.cleanup()
+
+    thread = threadinglib.Thread(
+        target=lambda: (aio.set_event_loop(loop),
+                        loop.run_until_complete(serve_worker())),
+        daemon=True)
+    thread.start()
+    assert started.wait(10)
+    try:
+        from mlrun_tpu.db.httpdb import HTTPRunDB
+
+        worker_client = HTTPRunDB(f"http://127.0.0.1:{worker_port}")
+        # mutating call against the worker → proxied to chief's DB
+        worker_client.store_project("proxied-proj",
+                                    {"metadata": {"name": "proxied-proj"}})
+        assert chief_state.db.get_project("proxied-proj") is not None
+    finally:
+        box["stop"] = True
+        thread.join(timeout=5)
+        loop.call_soon_threadsafe(loop.stop)
