@@ -232,22 +232,64 @@ class _RemoteRunner(_PipelineRunner):
 
 class _KFPRunner(_PipelineRunner):
     """Compile the workflow to Kubeflow Pipelines when kfp is available
-    (reference pipelines.py:542)."""
+    (reference pipelines.py:542 + pipeline-adapters mlrun_op, ops.py:66)."""
 
     engine = "kfp"
+
+    @staticmethod
+    def _step_to_container_op(step: "PipelineStep", artifact_path: str):
+        """One workflow step → a KFP container op running the in-pod
+        contract (`mlrun-tpu run --from-env`), the mlrun_op analog."""
+        import json as jsonlib
+
+        import kfp.dsl as dsl
+
+        function = step.function
+        run = {
+            "metadata": {"name": step.name,
+                         "project": function.metadata.project},
+            "spec": {"parameters": step.params, "inputs": step.inputs,
+                     "handler": step.handler or
+                     function.spec.default_handler,
+                     "output_path": artifact_path,
+                     "function": function.uri},
+        }
+        op = dsl.ContainerOp(
+            name=step.name,
+            image=function.full_image_path(),
+            command=["mlrun-tpu", "run", "--from-env"],
+        )
+        op.container.add_env_variable(
+            {"name": "MLT_EXEC_CONFIG",
+             "value": jsonlib.dumps(run, default=str)})
+        build = function.spec.build
+        if build and build.functionSourceCode:
+            op.container.add_env_variable(
+                {"name": "MLT_EXEC_CODE",
+                 "value": build.functionSourceCode})
+        return op
 
     @classmethod
     def run(cls, project, workflow_spec, name="", workflow_handler=None,
             secrets=None, artifact_path=None, namespace=None, source=None,
             args=None, local=False, watch=False) -> _PipelineRunStatus:
         try:
-            import kfp  # noqa: F401
+            import kfp
         except ImportError as exc:
             raise ImportError(
                 "the kfp engine requires the 'kfp' package; use "
                 "engine='local' or engine='remote' instead") from exc
-        raise NotImplementedError(
-            "kfp compilation is not wired yet; use engine='local'/'remote'")
+
+        handler = workflow_handler or _load_workflow_handler(
+            workflow_spec, project)
+        client = kfp.Client(namespace=namespace) if namespace else \
+            kfp.Client()
+        run_result = client.create_run_from_pipeline_func(
+            handler, arguments=args or {},
+            experiment_name=project.name)
+        return _PipelineRunStatus(str(run_result.run_id), cls, project,
+                                  workflow=workflow_spec,
+                                  state=RunStates.running)
 
 
 def get_workflow_engine(engine: str = "", local: bool = False):

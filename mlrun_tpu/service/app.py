@@ -597,6 +597,27 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         return json_response({"api_gateways": [
             f for f in funcs if f.get("kind") == "api-gateway"]})
 
+    # -- operations / introspection ---------------------------------------------
+    @r.get(API + "/operations/memory-report")
+    async def memory_report(request):
+        """reference analog: server/api/utils/memory_reports.py (objgraph) —
+        here host RSS + device HBM via the profiler util."""
+        from ..utils.profiler import memory_report as report
+
+        return json_response({"data": report()})
+
+    @r.get(API + "/frontend-spec")
+    async def frontend_spec(request):
+        return json_response({
+            "feature_flags": {"tpujob": True, "serving": True,
+                              "feature_store": True,
+                              "model_monitoring": True},
+            "default_artifact_path": mlconf.resolve_artifact_path(
+                "{project}"),
+            "runtime_kinds": ["local", "handler", "job", "tpujob", "dask",
+                              "serving", "remote", "application"],
+        })
+
     # -- background tasks --------------------------------------------------------------------
     @r.get(API + "/projects/{project}/background-tasks/{name}")
     async def get_background_task(request):

@@ -1,0 +1,86 @@
+"""Serving TTFT/throughput benchmark (the second BASELINE.md target:
+<200ms p50 TTFT on v5e).
+
+Measures the LLM engine in-process (prefill+first-token latency across
+prompt-length buckets) and optionally through the HTTP gateway.
+
+Run: python scripts/bench_serving.py [--model 1b] [--http]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_engine(model: str, prompt_lens=(64, 256, 768), iters: int = 8,
+                 max_len: int = 2048):
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.models import init_params, llama3_1b, tiny_llama
+    from mlrun_tpu.serving.llm import LLMEngine
+
+    config = llama3_1b() if model == "1b" else tiny_llama(
+        attention_impl="reference")
+    if model != "1b":
+        prompt_lens = (16, 32)
+        max_len = 256
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = LLMEngine(config, params, max_len=max_len,
+                       prefill_buckets=tuple(
+                           min(2 ** (p - 1).bit_length(), max_len)
+                           for p in prompt_lens))
+    engine.warmup()
+
+    rng = np.random.default_rng(0)
+    ttfts = []
+    decode_tps = []
+    for prompt_len in prompt_lens:
+        for _ in range(iters):
+            prompt = rng.integers(0, config.vocab_size, prompt_len).tolist()
+            _, stats = engine.generate(prompt, max_new_tokens=33)
+            ttfts.append(stats["ttft_s"])
+            decode_tps.append(stats["decode_tokens_per_sec"])
+    ttfts.sort()
+    n = len(ttfts)
+    return {
+        "p50_ttft_ms": round(ttfts[n // 2] * 1000, 2),
+        "p95_ttft_ms": round(ttfts[int(n * 0.95)] * 1000, 2),
+        "decode_tokens_per_sec": round(
+            sum(decode_tps) / max(len(decode_tps), 1), 1),
+        "samples": n,
+        "prompt_lens": list(prompt_lens),
+        "model": model,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="auto", choices=["auto", "1b",
+                                                            "tiny"])
+    parser.add_argument("--iters", type=int, default=8)
+    args = parser.parse_args()
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    model = args.model if args.model != "auto" else ("1b" if on_tpu
+                                                     else "tiny")
+    result = bench_engine(model, iters=args.iters)
+    out = {
+        "metric": "llm_serving_p50_ttft_ms",
+        "value": result["p50_ttft_ms"],
+        "unit": "ms",
+        # target < 200ms → vs_baseline > 1 means better than target
+        "vs_baseline": round(200.0 / max(result["p50_ttft_ms"], 1e-6), 3),
+        "detail": result,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

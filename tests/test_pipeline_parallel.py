@@ -1,0 +1,66 @@
+"""Pipeline-parallel tests: pipelined forward == plain forward (CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlrun_tpu.models import init_params, tiny_llama
+from mlrun_tpu.models.llama import forward
+from mlrun_tpu.parallel.mesh import make_mesh
+from mlrun_tpu.parallel.pipeline import (
+    make_pipeline_forward,
+    pipeline_loss_fn,
+    split_layers_for_stages,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(attention_impl="reference", remat=False)
+    # 4 layers so 2 stages x 2 layers
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, n_layers=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh({"pipe": 2})
+    pp_params = dict(params)
+    pp_params["layers"] = split_layers_for_stages(params["layers"], 2)
+    return cfg, params, pp_params, mesh
+
+
+def test_split_layers(setup):
+    cfg, params, pp_params, mesh = setup
+    assert pp_params["layers"]["wq"].shape[:2] == (2, 2)
+
+
+def test_pipelined_forward_matches_plain(setup):
+    cfg, params, pp_params, mesh = setup
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16),
+                                          dtype=np.int32))
+    plain = forward(cfg, params, tokens)
+    pp_forward = make_pipeline_forward(cfg, mesh, num_microbatches=2)
+    pipelined = pp_forward(pp_params, tokens)
+    err = float(jnp.max(jnp.abs(plain - pipelined)))
+    assert err < 2e-2, err  # bf16 accumulation-order tolerance
+
+
+def test_pipelined_grad_flows(setup):
+    cfg, params, pp_params, mesh = setup
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16),
+                                      dtype=np.int32))
+    targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16),
+                                       dtype=np.int32))
+    loss_fn = pipeline_loss_fn(cfg, mesh, num_microbatches=2)
+    (loss, metrics), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(pp_params, tokens, targets)
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.sum(jnp.abs(g.astype(jnp.float32))))
+                for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0  # gradients reach every stage's params
+    # every stage's wq grads nonzero
+    wq_grads = np.asarray(grads["layers"]["wq"], np.float32)
+    for stage in range(2):
+        assert np.abs(wq_grads[stage]).max() > 0, f"stage {stage} grad zero"
