@@ -46,6 +46,7 @@ class RuntimeKinds:
     job = "job"
     tpujob = "tpujob"
     dask = "dask"
+    spark = "spark"
     serving = "serving"
     remote = "remote"  # generic http-triggered function (nuclio analog)
     application = "application"
@@ -54,15 +55,16 @@ class RuntimeKinds:
     def all() -> list[str]:
         return [
             RuntimeKinds.local, RuntimeKinds.handler, RuntimeKinds.job,
-            RuntimeKinds.tpujob, RuntimeKinds.dask, RuntimeKinds.serving,
-            RuntimeKinds.remote, RuntimeKinds.application,
+            RuntimeKinds.tpujob, RuntimeKinds.dask, RuntimeKinds.spark,
+            RuntimeKinds.serving, RuntimeKinds.remote,
+            RuntimeKinds.application,
         ]
 
     @staticmethod
     def remote_kinds() -> list[str]:
         return [RuntimeKinds.job, RuntimeKinds.tpujob, RuntimeKinds.dask,
-                RuntimeKinds.serving, RuntimeKinds.remote,
-                RuntimeKinds.application]
+                RuntimeKinds.spark, RuntimeKinds.serving,
+                RuntimeKinds.remote, RuntimeKinds.application]
 
     @staticmethod
     def pod_creating_kinds() -> list[str]:

@@ -52,6 +52,16 @@ def ingest(featureset: Union[FeatureSet, str], source,
         if entity not in source.columns and source.index.name != entity:
             raise ValueError(f"entity column '{entity}' missing from source")
 
+    # transform graph + windowed aggregations (pandas engine).
+    # copy + reset index: never mutate the caller's frame, and rolling
+    # assignment needs unique row labels
+    source = source.copy().reset_index(drop=True)
+    from .steps import apply_aggregations, apply_transforms
+
+    source = apply_transforms(source, fset.spec.transforms)
+    source = apply_aggregations(source, fset.spec.aggregations, entities,
+                                fset.spec.timestamp_key)
+
     # schema inference
     if not fset.spec.features:
         fset.spec.features = [

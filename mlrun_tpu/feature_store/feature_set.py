@@ -32,11 +32,13 @@ class Feature(ModelObj):
 
 class FeatureSetSpec(ModelObj):
     _dict_fields = ["entities", "features", "targets", "timestamp_key",
-                    "description", "engine", "label_column", "source"]
+                    "description", "engine", "label_column", "source",
+                    "aggregations", "transforms"]
 
     def __init__(self, entities=None, features=None, targets=None,
                  timestamp_key=None, description=None, engine=None,
-                 label_column=None, source=None):
+                 label_column=None, source=None, aggregations=None,
+                 transforms=None):
         self.entities = entities or []
         self.features = features or []
         self.targets = targets or []
@@ -45,6 +47,8 @@ class FeatureSetSpec(ModelObj):
         self.engine = engine or "pandas"
         self.label_column = label_column
         self.source = source
+        self.aggregations = aggregations or []
+        self.transforms = transforms or []
 
 
 class FeatureSetStatus(ModelObj):
@@ -124,6 +128,26 @@ class FeatureSet(ModelObj):
                     with_defaults: bool = True):
         self.spec.targets = targets if targets is not None else (
             ["parquet"] if with_defaults else [])
+        return self
+
+    def add_aggregation(self, column: str, operations: list[str],
+                        windows: list[str] | None = None,
+                        name: str | None = None):
+        """Windowed aggregation (reference FeatureSet.add_aggregation):
+        produces <name>_<op>_<window> features at ingest."""
+        self.spec.aggregations.append({
+            "name": name or column, "column": column,
+            "operations": list(operations),
+            "windows": list(windows) if windows else []})
+        return self
+
+    def add_transform_step(self, step):
+        """Append a transform step instance or {class_name, class_args};
+        instances are stored in serializable dict form so the feature set
+        survives the DB roundtrip."""
+        from .steps import step_to_dict
+
+        self.spec.transforms.append(step_to_dict(step))
         return self
 
     def _target_path(self, project: str | None = None) -> str:

@@ -100,7 +100,8 @@ class PipelineContext:
     """State for one workflow execution."""
 
     def __init__(self, project=None, workflow_name: str = "", local=True,
-                 watch=False, artifact_path: str = "", args: dict | None = None):
+                 watch=False, artifact_path: str = "", args: dict | None = None,
+                 engine: str = "local"):
         self.project = project
         self.project_name = project.name if project is not None else ""
         self.workflow_name = workflow_name
@@ -108,6 +109,7 @@ class PipelineContext:
         self.watch = watch
         self.artifact_path = artifact_path
         self.args = args or {}
+        self.engine = engine
         self.workflow_id = uuid.uuid4().hex
         self.runs: list[RunObject] = []
         self.state = RunStates.running
@@ -280,12 +282,32 @@ class _KFPRunner(_PipelineRunner):
                 "the kfp engine requires the 'kfp' package; use "
                 "engine='local' or engine='remote' instead") from exc
 
+        global _current_context
+
         handler = workflow_handler or _load_workflow_handler(
             workflow_spec, project)
+        # during kfp tracing, run_function emits container ops (engine=kfp
+        # in the pipeline context) instead of executing steps
+        compile_context = PipelineContext(
+            project=project, workflow_name=name, local=False,
+            artifact_path=artifact_path or project.spec.artifact_path,
+            args=args, engine="kfp")
+
+        def traced_handler(*handler_args, **handler_kwargs):
+            global _current_context
+
+            with _context_lock:
+                _current_context = compile_context
+            try:
+                return handler(*handler_args, **handler_kwargs)
+            finally:
+                with _context_lock:
+                    _current_context = None
+
         client = kfp.Client(namespace=namespace) if namespace else \
             kfp.Client()
         run_result = client.create_run_from_pipeline_func(
-            handler, arguments=args or {},
+            traced_handler, arguments=args or {},
             experiment_name=project.name)
         return _PipelineRunStatus(str(run_result.run_id), cls, project,
                                   workflow=workflow_spec,

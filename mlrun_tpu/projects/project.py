@@ -224,6 +224,12 @@ class MlrunProject(ModelObj):
                 outputs=outputs, artifact_path=artifact_path,
                 hyperparams=hyperparams,
                 hyper_param_options=hyper_param_options, returns=returns)
+            if getattr(context, "engine", "local") == "kfp":
+                # kfp tracing: emit a container op, do NOT execute
+                from .pipelines import _KFPRunner
+
+                return _KFPRunner._step_to_container_op(
+                    step, context.artifact_path)
             run = step.run(context)
             context.runs.append(run)
             return step

@@ -14,6 +14,7 @@ def _registry() -> dict:
     from .kubejob import KubejobRuntime
     from .remote import ApplicationRuntime, RemoteRuntime
     from .serving import ServingRuntime
+    from .sparkjob import SparkRuntime
     from .tpujob import TpuJobRuntime
 
     return {
@@ -23,6 +24,7 @@ def _registry() -> dict:
         RuntimeKinds.job: KubejobRuntime,
         RuntimeKinds.tpujob: TpuJobRuntime,
         RuntimeKinds.dask: DaskRuntime,
+        RuntimeKinds.spark: SparkRuntime,
         RuntimeKinds.serving: ServingRuntime,
         RuntimeKinds.remote: RemoteRuntime,
         RuntimeKinds.application: ApplicationRuntime,

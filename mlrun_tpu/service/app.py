@@ -608,14 +608,15 @@ def build_app(state: ServiceState | None = None) -> web.Application:
 
     @r.get(API + "/frontend-spec")
     async def frontend_spec(request):
+        from ..common.runtimes_constants import RuntimeKinds
+
         return json_response({
             "feature_flags": {"tpujob": True, "serving": True,
                               "feature_store": True,
                               "model_monitoring": True},
             "default_artifact_path": mlconf.resolve_artifact_path(
                 "{project}"),
-            "runtime_kinds": ["local", "handler", "job", "tpujob", "dask",
-                              "serving", "remote", "application"],
+            "runtime_kinds": RuntimeKinds.all(),
         })
 
     # -- background tasks --------------------------------------------------------------------

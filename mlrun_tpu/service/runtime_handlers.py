@@ -332,12 +332,29 @@ class DaskHandler(KubeJobHandler):
     kind = RuntimeKinds.dask
 
 
+class SparkHandler(BaseRuntimeHandler):
+    """SparkApplication CRD (reference sparkjob handler). Requires the
+    kubernetes provider — a local process cannot materialize a spark
+    cluster."""
+
+    kind = RuntimeKinds.spark
+
+    def build_resource(self, runtime, run: RunObject) -> dict:
+        if isinstance(self.provider, LocalProcessProvider):
+            raise ValueError(
+                "the spark runtime needs a kubernetes provider with the "
+                "spark-operator installed; run with local=True for a local "
+                "SparkSession instead")
+        return runtime.generate_spark_application(run)
+
+
 def get_runtime_handler(kind: str, db, provider: Provider
                         ) -> BaseRuntimeHandler:
     cls = {
         RuntimeKinds.job: KubeJobHandler,
         RuntimeKinds.tpujob: TpuJobHandler,
         RuntimeKinds.dask: DaskHandler,
+        RuntimeKinds.spark: SparkHandler,
     }.get(kind)
     if cls is None:
         raise ValueError(f"no runtime handler for kind '{kind}'")

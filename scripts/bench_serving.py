@@ -1,10 +1,10 @@
 """Serving TTFT/throughput benchmark (the second BASELINE.md target:
 <200ms p50 TTFT on v5e).
 
-Measures the LLM engine in-process (prefill+first-token latency across
-prompt-length buckets) and optionally through the HTTP gateway.
+Measures the LLM engine in-process: prefill + first-token latency across
+prompt-length buckets, plus steady-state decode throughput.
 
-Run: python scripts/bench_serving.py [--model 1b] [--http]
+Run: python scripts/bench_serving.py [--model {auto,1b,tiny}] [--iters N]
 Prints one JSON line.
 """
 

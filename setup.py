@@ -1,0 +1,20 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="mlrun-tpu",
+    version="0.1.0",
+    description="TPU-native MLOps orchestration framework",
+    packages=find_packages(include=["mlrun_tpu", "mlrun_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "pydantic>=2", "aiohttp", "requests", "pyyaml", "click",
+        "numpy", "pandas", "fsspec",
+    ],
+    extras_require={
+        "tpu": ["jax[tpu]", "flax", "optax", "orbax-checkpoint", "einops"],
+        "cpu": ["jax[cpu]", "flax", "optax", "orbax-checkpoint", "einops"],
+    },
+    entry_points={
+        "console_scripts": ["mlrun-tpu = mlrun_tpu.__main__:main"],
+    },
+)

@@ -103,3 +103,57 @@ def test_source_time_filter(isolated_home, tmp_path):
                            start_time="2026-01-15", end_time="2026-02-15")
     df = source.to_dataframe()
     assert list(df["v"]) == [2]
+
+
+def test_transforms_and_aggregations(isolated_home):
+    import pandas as pd
+
+    from mlrun_tpu.feature_store import FeatureSet, ingest
+    from mlrun_tpu.feature_store.steps import Imputer, MapValues, OneHotEncoder
+
+    fs = FeatureSet("events", entities=["user"], timestamp_key="ts")
+    fs.add_transform_step(Imputer(method="avg"))
+    fs.add_transform_step(MapValues(
+        {"tier": {"gold": 3, "silver": 2, "default": 1}}, suffix="_n"))
+    fs.add_aggregation("amount", ["sum", "avg"], windows=["1h"])
+    df = pd.DataFrame({
+        "user": ["a", "a", "a", "b"],
+        "ts": pd.to_datetime(["2026-01-01 10:00", "2026-01-01 10:30",
+                              "2026-01-01 12:00", "2026-01-01 10:15"]),
+        "amount": [10.0, 20.0, 40.0, 5.0],
+        "tier": ["gold", "silver", "bronze", "gold"],
+    })
+    df.loc[1, "amount"] = None  # imputed to mean
+    out = ingest(fs, df)
+    assert "amount_sum_1h" in out.columns
+    assert "tier_n" in out.columns
+    by_ts = out.set_index("ts")["tier_n"]
+    assert by_ts[pd.Timestamp("2026-01-01 10:00")] == 3
+    assert by_ts[pd.Timestamp("2026-01-01 10:30")] == 2
+    assert not out["amount"].isna().any()
+    # 1h window: the 12:00 event for user a excludes the 10:xx ones
+    row_12 = out[(out["user"] == "a")
+                 & (out["ts"] == pd.Timestamp("2026-01-01 12:00"))]
+    assert float(row_12["amount_sum_1h"].iloc[0]) == 40.0
+
+
+def test_validator_and_filter(isolated_home):
+    import pandas as pd
+    import pytest as _pytest
+
+    from mlrun_tpu.feature_store import FeatureSet, ingest
+    from mlrun_tpu.feature_store.steps import FeaturesetValidator, FilterRows
+
+    fs = FeatureSet("clean", entities=["id"])
+    fs.add_transform_step(FilterRows("value >= 0"))
+    fs.add_transform_step(FeaturesetValidator(
+        {"value": {"max": 100}}, raise_on_fail=True))
+    good = pd.DataFrame({"id": ["a", "b", "c"], "value": [1.0, -5.0, 50.0]})
+    out = ingest(fs, good)
+    assert len(out) == 2  # negative row filtered
+
+    fs2 = FeatureSet("bad", entities=["id"])
+    fs2.add_transform_step(FeaturesetValidator(
+        {"value": {"max": 10}}, raise_on_fail=True))
+    with _pytest.raises(ValueError, match="validation failed"):
+        ingest(fs2, pd.DataFrame({"id": ["a"], "value": [99.0]}))

@@ -80,3 +80,18 @@ def test_jobset_condition_mapping():
     assert JobSetConditions.to_run_state(
         [{"type": "Failed", "status": "True"}]) == "error"
     assert JobSetConditions.to_run_state([]) == "running"
+
+
+def test_spark_application_crd():
+    """control-plane assertion for the spark runtime CRD (reference
+    tests/api/runtime_handlers sparkjob analog)."""
+    fn = mlrun_tpu.new_function("etl", kind="spark", project="p1",
+                                image="spark:img")
+    fn.with_executor_resources(mem="8g", cpu="2", replicas=4)
+    run = _run_obj()
+    crd = fn.generate_spark_application(run)
+    assert crd["apiVersion"] == "sparkoperator.k8s.io/v1beta2"
+    assert crd["spec"]["executor"]["instances"] == 4
+    assert crd["spec"]["executor"]["memory"] == "8g"
+    assert crd["spec"]["driver"]["env"][-1]["name"] == \
+        mlconf.exec_config_env
