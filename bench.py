@@ -29,8 +29,12 @@ def _bench(model_scale: str, batch: int, seq: int, steps: int = 8):
 
     n = jax.device_count()
     mesh = make_mesh({"fsdp": n})
+    # chunked-CE peak memory ~ batch*chunk*vocab*4B — hold batch*chunk at
+    # ~4k tokens so larger batches don't blow the loss allocation
+    loss_chunk = max(64, 4096 // batch)
     train_config = TrainConfig(
-        total_steps=steps + 4, lora_rank=16, lora_alpha=32.0, grad_accum=1)
+        total_steps=steps + 4, lora_rank=16, lora_alpha=32.0, grad_accum=1,
+        loss_chunk=loss_chunk)
     trainer = Trainer(config, train_config, mesh=mesh)
     trainer.init(0)
     stream = synthetic_token_stream(batch, seq, config.vocab_size)

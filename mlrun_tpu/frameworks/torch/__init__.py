@@ -1,0 +1,144 @@
+"""PyTorch user-code adapter (reference analog: mlrun/frameworks/pytorch/ —
+apply_mlrun + train/evaluate helpers, mlrun_interface.py:106,220).
+
+IMPORTANT design note: the reference's Horovod/NCCL distributed path
+(hvd.init :561-566, allreduce :849, DistributedSampler :903) is deliberately
+NOT reproduced — TPU-scale training goes through the JAX auto-trainer
+(frameworks/jax). This adapter provides user-code parity for existing torch
+training scripts running host-side (CPU): auto-logging of per-epoch metrics
+and model registration into the same registry.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Optional
+
+from ...execution import MLClientCtx
+from ...utils import logger
+
+
+def apply_mlrun(model=None, context: MLClientCtx | None = None,
+                model_name: str = "model", tag: str = "", **kwargs):
+    if context is None:
+        import mlrun_tpu
+
+        context = mlrun_tpu.get_or_create_ctx("torch")
+    return TorchModelHandler(model, context, model_name, tag)
+
+
+class TorchModelHandler:
+    def __init__(self, model, context, model_name="model", tag=""):
+        self.model = model
+        self.context = context
+        self.model_name = model_name
+        self.tag = tag
+
+    def log_epoch(self, epoch: int, metrics: dict):
+        if self.context.is_logging_worker():
+            self.context.log_metrics(
+                {k: float(v) for k, v in metrics.items()}, step=epoch)
+
+    def log_model(self, metrics: dict | None = None,
+                  parameters: dict | None = None):
+        import torch
+
+        tmp_dir = tempfile.mkdtemp()
+        path = os.path.join(tmp_dir, f"{self.model_name}.pt")
+        torch.save(self.model.state_dict(), path)
+        return self.context.log_model(
+            self.model_name, model_file=path, framework="pytorch",
+            metrics=metrics or {}, parameters=parameters or {},
+            tag=self.tag)
+
+
+def train(model, loss_fn, optimizer, train_loader,
+          context: MLClientCtx | None = None, epochs: int = 1,
+          validation_loader=None, model_name: str = "model",
+          log_model: bool = True) -> dict:
+    """Minimal torch training loop with auto-logging
+    (reference pytorch/__init__.py:46 train analog, host-side)."""
+    import torch
+
+    handler = apply_mlrun(model, context, model_name)
+    context = handler.context
+    final: dict = {}
+    for epoch in range(epochs):
+        model.train()
+        total, count = 0.0, 0
+        for inputs, targets in train_loader:
+            optimizer.zero_grad()
+            loss = loss_fn(model(inputs), targets)
+            loss.backward()
+            optimizer.step()
+            total += float(loss.detach())
+            count += 1
+        metrics = {"loss": total / max(count, 1)}
+        if validation_loader is not None:
+            model.eval()
+            vtotal, vcount = 0.0, 0
+            with torch.no_grad():
+                for inputs, targets in validation_loader:
+                    vtotal += float(loss_fn(model(inputs), targets))
+                    vcount += 1
+            metrics["validation_loss"] = vtotal / max(vcount, 1)
+        handler.log_epoch(epoch, metrics)
+        final = metrics
+    if context is not None:
+        context.log_results(final)
+    if log_model:
+        handler.log_model(metrics=final)
+    return final
+
+
+def evaluate(model, loss_fn, loader, context: MLClientCtx | None = None
+             ) -> dict:
+    """Evaluation loop (reference pytorch/__init__.py:212 analog)."""
+    import torch
+
+    model.eval()
+    total, count = 0.0, 0
+    with torch.no_grad():
+        for inputs, targets in loader:
+            total += float(loss_fn(model(inputs), targets))
+            count += 1
+    results = {"eval_loss": total / max(count, 1)}
+    if context is not None:
+        context.log_results(results)
+    return results
+
+
+class TorchModelServer:
+    """V2ModelServer for saved torch state dicts; requires a model_class
+    factory passed as a class arg."""
+
+    def __new__(cls, *args, **kwargs):
+        from ...serving.v2_serving import V2ModelServer
+
+        class _Server(V2ModelServer):
+            def __init__(self, *a, model_factory: Callable | None = None,
+                         **kw):
+                super().__init__(*a, **kw)
+                self.model_factory = model_factory
+
+            def load(self):
+                import torch
+
+                if self.model_factory is None:
+                    raise ValueError(
+                        "TorchModelServer needs a model_factory class arg")
+                model_file, _ = self.get_model(".pt")
+                self.model = self.model_factory()
+                self.model.load_state_dict(
+                    torch.load(model_file, weights_only=True))
+                self.model.eval()
+
+            def predict(self, request):
+                import torch
+
+                inputs = torch.tensor(request["inputs"])
+                with torch.no_grad():
+                    return self.model(inputs).tolist()
+
+        return _Server(*args, **kwargs)

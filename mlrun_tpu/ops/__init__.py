@@ -6,3 +6,4 @@ from .attention import (  # noqa: F401
 from .norms import rms_norm, rms_norm_pallas  # noqa: F401
 from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from .rotary import apply_rope, apply_rope_qk, rope_table  # noqa: F401
+from .ulysses import make_ulysses_attention, ulysses_attention  # noqa: F401

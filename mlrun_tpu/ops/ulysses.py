@@ -1,0 +1,84 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence exchange.
+
+Complement to ring attention (ops/ring_attention.py): instead of rotating kv
+around a ring, each device holds a sequence shard and the attention heads
+are redistributed with ``jax.lax.all_to_all`` so every device computes FULL
+attention for a subset of heads, then a second all-to-all restores sequence
+sharding. Two collectives per attention instead of P-1 ppermutes — better
+when heads >= devices and the interconnect favors large all-to-alls (TPU
+ICI), while ring attention wins at extreme sequence lengths (no full-seq
+materialization). Both are exact.
+
+Use inside shard_map with q/k/v sharded P(batch, seq_axis, None, None).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import attention_reference
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "seq", causal: bool = True,
+                      q_offset=None) -> jax.Array:
+    """Exact attention over a sequence-sharded axis via all-to-all.
+
+    q,k,v: local shards [B, S_local, H, D] (kv heads already repeated to H;
+    H must be divisible by the axis size). Returns [B, S_local, H, D].
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % axis_size:
+        raise ValueError(
+            f"heads {h} not divisible by sequence-parallel size {axis_size}")
+
+    h_per = h // axis_size
+
+    # same-axis all_to_all + explicit transposes: the exchanged axis always
+    # indexes the SOURCE device afterwards, which keeps the layout
+    # unambiguous (cross-axis split/concat interleaving is implementation-
+    # defined).
+    def scatter_heads(x):
+        # [B, s, H, D] -> [B, s, P(group), h', D]; send group g to device g
+        x = x.reshape(b, s_local, axis_size, h_per, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=2)
+        # now axis2 = source device i, rows are i's local seq shard:
+        # [B, s, P(src), h', D] -> global seq source-major
+        x = x.transpose(0, 2, 1, 3, 4)  # [B, P(src), s, h', D]
+        return x.reshape(b, axis_size * s_local, h_per, d)
+
+    def gather_heads(x):
+        # [B, S_global, h', D] -> [B, P(dest), s, h', D]; send shard i to i
+        x = x.reshape(b, axis_size, s_local, h_per, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=1)
+        # axis1 = source device g = head-group owner: restore g-major heads
+        x = x.transpose(0, 2, 1, 3, 4)  # [B, s, P(g), h', D]
+        return x.reshape(b, s_local, h, d)
+
+    q_full = scatter_heads(q)
+    k_full = scatter_heads(k)
+    v_full = scatter_heads(v)
+    out_full = attention_reference(q_full, k_full, v_full, causal=causal)
+    return gather_heads(out_full)
+
+
+def make_ulysses_attention(mesh, seq_axis: str = "seq", causal: bool = True):
+    """Wrap in shard_map: fn(q, k, v) on arrays sharded
+    P(batch_axes, seq_axis, None, None)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+                       and mesh.shape[a] > 1) or None
+    spec = P(batch_axes, seq_axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ulysses(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal)
+
+    return _ulysses

@@ -141,3 +141,43 @@ def test_tf_keras_apply_mlrun():
     assert run.state == "completed", run.status.error
     assert "loss" in run.status.results
     assert "keras-model" in run.status.artifact_uris
+
+
+def test_torch_train_and_serve():
+    torch = pytest.importorskip("torch")
+
+    def handler(context):
+        import torch
+        from torch import nn
+
+        from mlrun_tpu.frameworks.torch import train
+
+        rng = torch.Generator().manual_seed(0)
+        X = torch.randn(64, 4, generator=rng)
+        y = X.sum(dim=1, keepdim=True)
+        loader = [(X[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        train(model, nn.MSELoss(),
+              torch.optim.Adam(model.parameters(), lr=1e-2),
+              loader, context=context, epochs=3, model_name="torch-model")
+
+    fn = mlrun_tpu.new_function("tt", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert "loss" in run.status.results
+    assert "torch-model" in run.status.artifact_uris
+
+    # serve the registered state dict
+    from torch import nn
+
+    from mlrun_tpu.frameworks.torch import TorchModelServer
+    from mlrun_tpu.serving import MockEvent
+
+    server = TorchModelServer(
+        None, name="t", model_path=run.status.artifact_uris["torch-model"],
+        model_factory=lambda: nn.Sequential(
+            nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1)))
+    server.post_init()
+    out = server.do_event(MockEvent(body={"inputs": [[1.0, 2.0, 3.0, 4.0]]},
+                                    path="/v2/models/t/infer"))
+    assert len(out.body["outputs"]) == 1
