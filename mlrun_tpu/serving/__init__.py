@@ -25,3 +25,4 @@ from .states import (  # noqa: F401
     TaskStep,
 )
 from .v2_serving import TpuModelServer, V2ModelServer  # noqa: F401
+from .v1_serving import MLModelServer  # noqa: F401

@@ -148,3 +148,22 @@ def test_async_flow_engine():
     from mlrun_tpu.serving.streams import get_in_memory_stream
 
     assert len(get_in_memory_stream("async-q")) == 1
+
+
+def test_v1_legacy_server():
+    from mlrun_tpu.serving import MLModelServer
+
+    class M(MLModelServer):
+        def load(self):
+            pass
+
+        def predict(self, request):
+            return [sum(x) for x in request["inputs"]]
+
+    fn = mlrun_tpu.new_function("v1", kind="serving")
+    fn.set_topology("router")
+    fn.add_model("m", class_name=M, model_path="")
+    server = fn.to_mock_server()
+    out = server.test("/v2/models/m/infer",
+                      body={"instances": [[1, 2], [3, 4]]})
+    assert out["predictions"] == [3, 7]
