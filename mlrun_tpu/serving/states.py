@@ -344,6 +344,45 @@ class QueueStep(BaseStep):
         return False
 
 
+class JoinStep(BaseStep):
+    """Merge fan-out branches (reference analog: the storey stream ``Merge``
+    step, mlrun/serving/merger.py:37): buffers the event per id until all
+    parent branches delivered, then emits one merged event (dict bodies are
+    union-merged; non-dict bodies are collected into a list)."""
+
+    kind = "join"
+
+    def __init__(self, name=None, after=None, expected: int | None = None):
+        super().__init__(name, after)
+        self.expected = expected
+        self._pending: dict = {}
+        self._lock = None
+
+    def init_object(self, context, namespace, mode="sync"):
+        import threading
+
+        self._lock = threading.Lock()
+        self._pending = {}
+
+    def run(self, event, *args, **kwargs):
+        expected = self.expected or max(len(self.after or []), 1)
+        key = getattr(event, "id", None) or id(event)
+        with self._lock:
+            bucket = self._pending.setdefault(key, [])
+            bucket.append(event.body)
+            if len(bucket) < expected:
+                return None  # wait for the remaining branches
+            bodies = self._pending.pop(key)
+        if all(isinstance(b, dict) for b in bodies):
+            merged: dict = {}
+            for body in bodies:
+                merged.update(body)
+        else:
+            merged = bodies
+        event.body = merged
+        return event
+
+
 class FlowStep(BaseStep):
     """A container of steps forming a DAG (states.py:892)."""
 
@@ -387,6 +426,9 @@ class FlowStep(BaseStep):
                                       and class_name == "queue"):
             step = QueueStep(name=name, path=class_args.pop("path", ""),
                              **class_args)
+        elif isinstance(class_name, str) and class_name in ("$join", "join"):
+            step = JoinStep(name=name,
+                            expected=class_args.pop("expected", None))
         elif isinstance(class_name, str) and class_name == "$router":
             step = RouterStep(name=name, class_args=class_args)
         elif isinstance(class_name, RouterStep):
@@ -475,8 +517,9 @@ class FlowStep(BaseStep):
                     result = self._steps[step.on_error].run(error_event)
                 else:
                     raise
-            if result is None and isinstance(step, QueueStep):
-                # async boundary: downstream continues on worker threads
+            if result is None and isinstance(step, (QueueStep, JoinStep)):
+                # queue: downstream continues on workers; join: waiting for
+                # the remaining branches
                 continue
             if getattr(step, "responder", False):
                 response = result
@@ -505,7 +548,7 @@ class FlowStep(BaseStep):
                     result = self._steps[step.on_error].run(error_event)
                 else:
                     raise
-            if result is None and isinstance(step, QueueStep):
+            if result is None and isinstance(step, (QueueStep, JoinStep)):
                 continue
             for index, child in enumerate(self._children(step.name)):
                 queue.append(
@@ -554,7 +597,8 @@ class RootFlowStep(FlowStep):
 def step_from_dict(struct: dict) -> BaseStep:
     kind = struct.get("kind", "task")
     cls = {"task": TaskStep, "router": RouterStep, "queue": QueueStep,
-           "flow": FlowStep, "error_step": ErrorStep}.get(kind, TaskStep)
+           "flow": FlowStep, "error_step": ErrorStep,
+           "join": JoinStep}.get(kind, TaskStep)
     step = cls.from_dict(struct)
     if kind == "router" and isinstance(step.routes, dict):
         step.routes = {

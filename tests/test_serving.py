@@ -167,3 +167,21 @@ def test_v1_legacy_server():
     out = server.test("/v2/models/m/infer",
                       body={"instances": [[1, 2], [3, 4]]})
     assert out["predictions"] == [3, 7]
+
+
+def test_join_step_merges_branches():
+    """fan-out -> two transforms -> join merges both results
+    (reference storey Merge analog)."""
+    fn = mlrun_tpu.new_function("j", kind="serving")
+    graph = fn.set_topology("flow")
+    src = graph.to(name="src", handler=lambda x: {"v": x})
+    src.to(name="b1", handler=lambda d: {"plus": d["v"] + 1})
+    src.to(name="b2", handler=lambda d: {"times": d["v"] * 2})
+    join = graph.add_step("$join", name="join", after=["b1", "b2"])
+    join.respond()
+    server = fn.to_mock_server()
+    out = server.test(body=5)
+    assert out == {"plus": 6, "times": 10}
+    # second event: buffer must not leak state between events
+    out2 = server.test(body=2)
+    assert out2 == {"plus": 3, "times": 4}
