@@ -147,8 +147,9 @@ def param_shapes(config: LlamaConfig) -> Params:
 # -- forward ----------------------------------------------------------------
 
 def _layer_body(config: LlamaConfig, x, layer_params, cos, sin,
-                lora: Optional[dict] = None):
-    """One decoder layer. x: [B, S, E]."""
+                lora: Optional[dict] = None, attention_fn=None):
+    """One decoder layer. x: [B, S, E]. ``attention_fn`` overrides the
+    attention dispatcher (context-parallel paths pass ring/ulysses)."""
     b, s, e = x.shape
     lp = layer_params
 
@@ -173,7 +174,10 @@ def _layer_body(config: LlamaConfig, x, layer_params, cos, sin,
                                         config.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attention(q, k, v, causal=True, impl=config.attention_impl)
+    if attention_fn is not None:
+        attn = attention_fn(q, k, v)
+    else:
+        attn = attention(q, k, v, causal=True, impl=config.attention_impl)
     attn = attn.reshape(b, s, config.qkv_dim)
     x = x + proj(attn, lp["wo"], "wo")
 

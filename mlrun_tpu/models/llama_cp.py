@@ -1,0 +1,142 @@
+"""Context-parallel llama training — sequence axis inside the train step.
+
+Long-context fine-tuning where activations are sharded along the sequence on
+a ``seq`` mesh axis: the decoder runs under ``jax.shard_map`` manual over
+``seq`` only (other mesh axes stay ``auto`` so GSPMD keeps handling
+fsdp/tensor sharding of the weights), and attention is exact ring attention
+(ICI neighbor ppermutes) or Ulysses all-to-all. RoPE positions and the
+causal mask use global offsets derived from the shard index.
+
+This is the capability the reference lacks entirely (SURVEY.md §5.7) wired
+end-to-end: loss and gradients match the plain (non-CP) path exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.norms import rms_norm
+from ..ops.ring_attention import ring_attention
+from ..ops.rotary import rope_table
+from ..ops.ulysses import ulysses_attention
+from .llama import LlamaConfig, Params, _layer_body
+
+
+def _cp_hidden(config: LlamaConfig, params: Params, tokens: jax.Array,
+               seq_axis: str, attn_impl: str) -> jax.Array:
+    """Per-shard decoder body (runs inside shard_map manual over seq)."""
+    b, s_local = tokens.shape
+    shard = jax.lax.axis_index(seq_axis)
+    positions = shard * s_local + jnp.arange(s_local)
+    cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
+
+    if attn_impl == "ring":
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, axis_name=seq_axis, causal=True)
+    elif attn_impl == "ulysses":
+        from ..ops.attention import _repeat_kv
+
+        def attn_fn(q, k, v):
+            n_rep = q.shape[2] // k.shape[2]
+            return ulysses_attention(q, _repeat_kv(k, n_rep),
+                                     _repeat_kv(v, n_rep),
+                                     axis_name=seq_axis, causal=True)
+    else:
+        raise ValueError(f"unknown cp attention impl '{attn_impl}'")
+
+    x = params["embedding"][tokens].astype(config.dtype)
+
+    body = functools.partial(_layer_body, config)
+    if config.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(carry, layer_params):
+        return body(carry, layer_params, cos, sin, None,
+                    attention_fn=attn_fn), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    return rms_norm(x, params["final_norm_scale"], config.norm_eps)
+
+
+def make_context_parallel_loss(config: LlamaConfig, mesh: Mesh,
+                               seq_axis: str = "seq",
+                               attn_impl: str = "ring",
+                               batch_axes: tuple | None = None):
+    """Build loss(params, tokens, targets) with sequence-sharded activations.
+
+    tokens/targets: [B, S_global]; params: plain llama tree. Axes other than
+    ``seq_axis`` stay auto (GSPMD shards weights/batch as usual).
+    """
+    # in_specs may only name MANUAL axes; batch sharding over data/fsdp
+    # stays auto and rides the arrays' own NamedShardings
+    data_spec = P(None, seq_axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), data_spec, data_spec),
+        out_specs=P(None, seq_axis),
+        check_vma=False,
+        # manual over the seq axis only — the rest stay auto so GSPMD keeps
+        # sharding weights/batch (fsdp/tensor/data) as usual
+        axis_names=frozenset({seq_axis}))
+    def nll_shards(params, tokens, targets):
+        x = _cp_hidden(config, params, tokens, seq_axis, attn_impl)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embedding"].T
+        logits = jnp.einsum("bse,ev->bsv", x, head,
+                            preferred_element_type=jnp.float32)
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        # per-token nll [B, s_local]; the global [B, S] array reassembles
+        # along seq — reductions over auto (batch) axes happen outside
+        nll = -jnp.take_along_axis(
+            log_probs, targets[..., None], axis=-1)[..., 0]
+        # pin the auto axes replicated: GSPMD may otherwise pick a batch
+        # sharding the out_specs (manual axes only) cannot express
+        return jax.lax.with_sharding_constraint(nll, P(None, None))
+
+    def loss(params, tokens, targets):
+        nll = nll_shards(params, tokens, targets)
+        loss_value = jnp.mean(nll)
+        return loss_value, {"loss": loss_value,
+                            "tokens": jnp.asarray(nll.size, jnp.float32)}
+
+    # NOTE: must run under jit — jax 0.9's eager path for partial-manual
+    # shard_map re-enters with full specs and rejects them
+    return jax.jit(loss)
+
+
+def make_cp_train_step(config: LlamaConfig, mesh: Mesh, optimizer,
+                       seq_axis: str = "seq", attn_impl: str = "ring"):
+    """Jitted context-parallel train step (full fine-tune)."""
+    from ..parallel.sharding import tree_shardings
+
+    loss_fn = make_context_parallel_loss(config, mesh, seq_axis, attn_impl)
+
+    def step(params, opt_state, tokens, targets):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, tokens, targets)
+        import optax
+
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    shapes = jax.eval_shape(
+        lambda: __import__("mlrun_tpu.models.llama", fromlist=["init_params"]
+                           ).init_params(config, jax.random.PRNGKey(0)))
+    param_sh = tree_shardings(shapes, mesh)
+    opt_sh = tree_shardings(jax.eval_shape(optimizer.init, shapes), mesh)
+    batch_axes = tuple(a for a in ("data", "fsdp")
+                       if a in mesh.axis_names and mesh.shape[a] > 1) or None
+    data_sh = NamedSharding(mesh, P(batch_axes, seq_axis))
+    return jax.jit(step,
+                   in_shardings=(param_sh, opt_sh, data_sh, data_sh),
+                   out_shardings=(param_sh, opt_sh, None),
+                   donate_argnums=(0, 1))
