@@ -136,7 +136,8 @@ def make_cp_train_step(config: LlamaConfig, mesh: Mesh, optimizer,
     batch_axes = tuple(a for a in ("data", "fsdp")
                        if a in mesh.axis_names and mesh.shape[a] > 1) or None
     data_sh = NamedSharding(mesh, P(batch_axes, seq_axis))
+    # NOTE: no donation — donating through partial-manual shard_map trips an
+    # XLA CPU CHECK ("Invalid binary instruction opcode copy") in jax 0.9
     return jax.jit(step,
                    in_shardings=(param_sh, opt_sh, data_sh, data_sh),
-                   out_shardings=(param_sh, opt_sh, None),
-                   donate_argnums=(0, 1))
+                   out_shardings=(param_sh, opt_sh, None))

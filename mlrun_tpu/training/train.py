@@ -50,6 +50,9 @@ class TrainConfig:
     # chunked cross-entropy: avoids the [B,S,vocab] logits allocation
     # (0 = full logits). 512 is a good default for 128k vocab.
     loss_chunk: int = 512
+    # long-context: "ring" | "ulysses" shards the SEQUENCE over seq_axis
+    # inside the step (models/llama_cp). Full fine-tune only for now.
+    context_parallel: str | None = None
 
 
 class TrainState:
@@ -92,6 +95,27 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
     (state, metrics). Works for full fine-tune and LoRA (frozen base)."""
     is_lora = train_config.lora_rank > 0
     accum = max(1, train_config.grad_accum)
+
+    if train_config.context_parallel:
+        if is_lora or accum > 1:
+            raise ValueError(
+                "context_parallel currently supports full fine-tune with "
+                "grad_accum=1 (LoRA/accum variants tracked for R2)")
+        seq_axis = train_config.seq_axis or "seq"
+        if seq_axis not in mesh.axis_names:
+            raise ValueError(
+                f"context_parallel needs a '{seq_axis}' axis in the mesh")
+        if any(mesh.shape[a] > 1 for a in mesh.axis_names if a != seq_axis):
+            # jax 0.9 XLA CHECK-crashes on backward through partial-manual
+            # shard_map when another mesh axis is active; CP training is
+            # seq-only until that is fixed (the CP LOSS works on mixed
+            # meshes — see models/llama_cp + tests)
+            raise ValueError(
+                "context_parallel training currently requires a seq-only "
+                "mesh (e.g. {'seq': N}); mixed data x seq hits an XLA "
+                "compiler bug in this jax version")
+        return _make_cp_step(model_config, train_config, optimizer, mesh,
+                             seq_axis, rules)
 
     # under Auto axis types GSPMD resolves the embedding gather itself;
     # act_spec stays available for Explicit-mode meshes
@@ -200,6 +224,30 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
     jitted._state_shardings = state_shardings
     jitted._data_sharding = data_sh
     return jitted
+
+
+def _make_cp_step(model_config, train_config, optimizer, mesh, seq_axis,
+                  rules):
+    """Context-parallel step adapter: wraps models/llama_cp's train step in
+    the (state, tokens, targets) -> (state, metrics) contract."""
+    from ..models.llama_cp import make_cp_train_step
+
+    raw_step = make_cp_train_step(
+        model_config, mesh, optimizer, seq_axis=seq_axis,
+        attn_impl=train_config.context_parallel)
+
+    def step_fn(state: TrainState, tokens, targets):
+        params, opt_state, metrics = raw_step(
+            state.params, state.opt_state, tokens, targets)
+        new_state = TrainState(params, opt_state, state.step + 1, None)
+        return new_state, metrics
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+                       and mesh.shape[a] > 1) or None
+    step_fn._data_sharding = NamedSharding(
+        mesh, PartitionSpec(batch_axes, seq_axis))
+    step_fn._state_shardings = None
+    return step_fn
 
 
 def init_train_state(model_config: LlamaConfig, train_config: TrainConfig,
