@@ -20,3 +20,9 @@ from .bert import (  # noqa: F401
     mlm_loss,
     tiny_bert,
 )
+from .moe import (  # noqa: F401
+    MoEConfig,
+    make_moe_rules,
+    mixtral_8x7b_like,
+    tiny_moe,
+)

@@ -1,0 +1,228 @@
+"""Mixture-of-Experts llama variant — GShard-style capacity dispatch + EP.
+
+Completes the parallelism inventory (SURVEY.md §2.4 reserved the expert
+axis): the dense SwiGLU MLP is replaced by top-k routed experts whose
+weights are stacked ``[L, E, ...]`` and sharded over an ``expert`` mesh axis
+(parallel/sharding rules below). Dispatch/combine are the TPU-idiomatic
+one-hot einsums (static capacity; no dynamic shapes), so XLA lays the token
+shuffle onto all-to-alls across the expert axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rope, rope_table
+from .llama import LlamaConfig
+
+Params = dict
+
+# sharding rules for the expert-stacked tensors (prepended by users of
+# make_moe_rules): experts sharded over 'expert', their matrices over
+# fsdp/tensor like the dense ones
+MOE_RULES = [
+    (r".*experts_gate.*", (None, "expert", "fsdp", "tensor")),
+    (r".*experts_up.*", (None, "expert", "fsdp", "tensor")),
+    (r".*experts_down.*", (None, "expert", "tensor", "fsdp")),
+    (r".*router.*", (None, "fsdp", None)),
+]
+
+
+def make_moe_rules():
+    from ..parallel.sharding import DEFAULT_RULES
+
+    return MOE_RULES + list(DEFAULT_RULES)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.embed_dim
+        attn = (self.embed_dim * self.qkv_dim
+                + 2 * self.embed_dim * self.kv_dim
+                + self.qkv_dim * self.embed_dim)
+        moe = (self.n_experts * 3 * self.embed_dim * self.mlp_dim
+               + self.embed_dim * self.n_experts)
+        per_layer = attn + moe + 2 * self.embed_dim
+        head = 0 if self.tie_embeddings else self.vocab_size * self.embed_dim
+        return embed + self.n_layers * per_layer + self.embed_dim + head
+
+
+def tiny_moe(**overrides) -> MoEConfig:
+    return dataclasses.replace(MoEConfig(
+        vocab_size=512, n_layers=2, embed_dim=128, n_heads=4, n_kv_heads=2,
+        head_dim=32, mlp_dim=128, n_experts=4, top_k=2,
+        tie_embeddings=True, remat=False), **overrides)
+
+
+def mixtral_8x7b_like(**overrides) -> MoEConfig:
+    return dataclasses.replace(MoEConfig(
+        vocab_size=32000, n_layers=32, embed_dim=4096, n_heads=32,
+        n_kv_heads=8, head_dim=128, mlp_dim=14336, n_experts=8, top_k=2,
+        rope_theta=1e6), **overrides)
+
+
+def init_params(config: MoEConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, 10)
+    dtype = config.dtype
+    e, h, kv, m = (config.embed_dim, config.qkv_dim, config.kv_dim,
+                   config.mlp_dim)
+    L, E = config.n_layers, config.n_experts
+
+    def norm_init(fan_in, shape, k):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * fan_in ** -0.5).astype(dtype)
+
+    params: Params = {
+        "embedding": norm_init(e, (config.vocab_size, e), keys[0]),
+        "layers": {
+            "attn_norm_scale": jnp.ones((L, e), dtype),
+            "wq": norm_init(e, (L, e, h), keys[1]),
+            "wk": norm_init(e, (L, e, kv), keys[2]),
+            "wv": norm_init(e, (L, e, kv), keys[3]),
+            "wo": norm_init(h, (L, h, e), keys[4]),
+            "mlp_norm_scale": jnp.ones((L, e), dtype),
+            "router": norm_init(e, (L, e, E), keys[5]).astype(jnp.float32),
+            "experts_gate": norm_init(e, (L, E, e, m), keys[6]),
+            "experts_up": norm_init(e, (L, E, e, m), keys[7]),
+            "experts_down": norm_init(m, (L, E, m, e), keys[8]),
+        },
+        "final_norm_scale": jnp.ones((e,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = norm_init(
+            e, (e, config.vocab_size), keys[9])
+    return params
+
+
+def _moe_mlp(config: MoEConfig, x, lp):
+    """GShard top-k dispatch: x [B, S, M] -> [B, S, M] + aux loss scalar."""
+    b, s, m = x.shape
+    E, k = config.n_experts, config.top_k
+    capacity = max(1, int(config.capacity_factor * s * k / E))
+
+    router_logits = jnp.einsum(
+        "bsm,me->bse", x.astype(jnp.float32), lp["router"])
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E]
+
+    # aux load-balancing loss (Switch): E * sum(fraction_tokens * mean_prob)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=(0, 1))
+    mean_probs = jnp.mean(probs, axis=(0, 1))
+    aux_loss = E * jnp.sum(frac_tokens * mean_probs)
+
+    # top-k selection with renormalized gates
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)          # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert's capacity buffer
+    dispatch = jnp.zeros((b, s, E, capacity), jnp.float32)
+    combine = jnp.zeros((b, s, E, capacity), jnp.float32)
+    # running token count per expert, updated per choice rank
+    counts = jnp.zeros((b, E), jnp.int32)
+    for choice in range(k):
+        idx = expert_idx[:, :, choice]                      # [B,S]
+        gate = gate_vals[:, :, choice]                      # [B,S]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [B,S,E]
+        # position_in_expert = tokens of same expert before me (+ carried)
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts[:, None, :]
+        counts = counts + jnp.sum(onehot, axis=1)
+        my_pos = jnp.sum(pos * onehot, axis=-1)             # [B,S]
+        keep = my_pos < capacity
+        cap_onehot = jax.nn.one_hot(my_pos, capacity,
+                                    dtype=jnp.float32)      # [B,S,C]
+        mask = (onehot.astype(jnp.float32)[:, :, :, None]
+                * cap_onehot[:, :, None, :]
+                * keep.astype(jnp.float32)[:, :, None, None])
+        dispatch = dispatch + mask
+        combine = combine + mask * gate[:, :, None, None]
+
+    # dispatch tokens to expert buffers: [E, B, C, M]
+    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch,
+                           x.astype(jnp.float32)).astype(x.dtype)
+    gate_h = jnp.einsum("ebcm,emh->ebch", expert_in, lp["experts_gate"],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    up_h = jnp.einsum("ebcm,emh->ebch", expert_in, lp["experts_up"],
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "ebch,ehm->ebcm", jax.nn.silu(gate_h) * up_h, lp["experts_down"],
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.einsum("bsec,ebcm->bsm", combine,
+                     expert_out.astype(jnp.float32)).astype(x.dtype)
+    return out, aux_loss
+
+
+def _layer_body(config: MoEConfig, x, lp, cos, sin):
+    b, s, e = x.shape
+
+    def proj(h_in, w):
+        return jnp.einsum("bse,eh->bsh", h_in, w,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+    h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
+    q = proj(h, lp["wq"]).reshape(b, s, config.n_heads, config.head_dim)
+    key = proj(h, lp["wk"]).reshape(b, s, config.n_kv_heads, config.head_dim)
+    value = proj(h, lp["wv"]).reshape(b, s, config.n_kv_heads,
+                                      config.head_dim)
+    q = apply_rope(q, cos, sin)
+    key = apply_rope(key, cos, sin)
+    attn = attention(q, key, value, causal=True, impl=config.attention_impl)
+    x = x + proj(attn.reshape(b, s, config.qkv_dim), lp["wo"])
+
+    h2 = rms_norm(x, lp["mlp_norm_scale"], config.norm_eps)
+    moe_out, aux = _moe_mlp(config, h2, lp)
+    return x + moe_out, aux
+
+
+def forward(config: MoEConfig, params: Params, tokens: jax.Array
+            ) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (logits [B, S, V] f32, aux_loss scalar)."""
+    b, s = tokens.shape
+    x = params["embedding"][tokens].astype(config.dtype)
+    cos, sin = rope_table(jnp.arange(s), config.head_dim, config.rope_theta)
+
+    body = functools.partial(_layer_body, config)
+    if config.remat:
+        body = jax.checkpoint(body,
+                              policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(carry, lp):
+        out, aux = body(carry, lp, cos, sin)
+        return out, aux
+
+    x, aux_losses = jax.lax.scan(scan_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.mean(aux_losses)
+
+
+def loss_fn(config: MoEConfig, params: Params, tokens, targets,
+            mask=None) -> tuple[jax.Array, dict]:
+    logits, aux_loss = forward(config, params, tokens)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(jnp.sum(mask), 1.0)
+    ce = jnp.sum(nll * mask) / total
+    loss = ce + config.router_aux_weight * aux_loss
+    return loss, {"loss": loss, "ce_loss": ce, "aux_loss": aux_loss}

@@ -1,0 +1,85 @@
+"""MoE + expert-parallelism tests (CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mlrun_tpu.models.moe import (
+    forward,
+    init_params,
+    loss_fn,
+    make_moe_rules,
+    tiny_moe,
+)
+from mlrun_tpu.parallel.mesh import make_mesh
+from mlrun_tpu.parallel.sharding import batch_sharding, tree_shardings
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_moe(attention_impl="reference")
+
+
+def test_forward_shapes_and_aux(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    logits, aux = forward(cfg, params, jnp.zeros((2, 16), jnp.int32))
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # balanced-ish routing at init: aux loss near 1.0 (perfect balance = 1)
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_param_count(cfg):
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert actual == cfg.param_count()
+
+
+def test_expert_capacity_drops_gracefully(cfg):
+    """With tiny capacity most tokens get dropped but forward stays finite
+    (residual path carries them)."""
+    import dataclasses
+
+    small = dataclasses.replace(cfg, capacity_factor=0.1)
+    params = init_params(small, jax.random.PRNGKey(0))
+    logits, _ = forward(small, params, jnp.zeros((2, 16), jnp.int32))
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_moe_trains_sharded_with_expert_axis(cfg):
+    """Expert-parallel mesh: experts sharded over 'expert', loss decreases."""
+    mesh = make_mesh({"expert": 2, "fsdp": 2})
+    rules = make_moe_rules()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    shardings = tree_shardings(params, mesh, rules)
+    # expert tensors actually sharded on the expert axis
+    assert "expert" in str(shardings["layers"]["experts_gate"].spec)
+    params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+    optimizer = optax.adam(1e-2)
+    opt_state = jax.tree_util.tree_map(
+        jax.device_put, optimizer.init(params),
+        tree_shardings(jax.eval_shape(optimizer.init, params), mesh, rules))
+    data_sh = batch_sharding(mesh)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets), has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (4, 32), dtype=np.int32), data_sh)
+    targets = jax.device_put(
+        rng.integers(0, cfg.vocab_size, (4, 32), dtype=np.int32), data_sh)
+    first = last = None
+    for _ in range(10):
+        params, opt_state, metrics = step(params, opt_state, tokens, targets)
+        loss = float(metrics["ce_loss"])
+        first = first if first is not None else loss
+        last = loss
+    assert last < first, (first, last)
