@@ -71,9 +71,22 @@ def _bench(model_scale: str, batch: int, seq: int, steps: int = 8):
 
 
 def main():
+    # fail fast instead of hanging the driver if the TPU relay is wedged
+    # (a killed client can leave the backend init blocking indefinitely)
+    import signal
+
+    def _watchdog(signum, frame):
+        raise SystemExit(
+            "bench: jax backend init did not complete within 180s "
+            "(TPU relay unresponsive)")
+
+    signal.signal(signal.SIGALRM, _watchdog)
+    signal.alarm(180)
     import jax
 
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    devices = jax.devices()
+    signal.alarm(0)
+    on_tpu = devices[0].platform in ("tpu", "axon")
     # chunked CE keeps the loss memory flat, so larger batches fit; walk
     # down until one fits on the chip
     attempts = (
