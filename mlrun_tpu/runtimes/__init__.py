@@ -11,6 +11,7 @@ from .local import HandlerRuntime, LocalRuntime  # noqa: F401
 
 def _registry() -> dict:
     from .daskjob import DaskRuntime
+    from .databricks import DatabricksRuntime
     from .kubejob import KubejobRuntime
     from .remote import ApplicationRuntime, RemoteRuntime
     from .serving import ServingRuntime
@@ -25,6 +26,7 @@ def _registry() -> dict:
         RuntimeKinds.tpujob: TpuJobRuntime,
         RuntimeKinds.dask: DaskRuntime,
         RuntimeKinds.spark: SparkRuntime,
+        "databricks": DatabricksRuntime,
         RuntimeKinds.serving: ServingRuntime,
         RuntimeKinds.remote: RemoteRuntime,
         RuntimeKinds.application: ApplicationRuntime,

@@ -619,6 +619,42 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             "runtime_kinds": RuntimeKinds.all(),
         })
 
+    # -- grafana proxy (reference: server/api/api/endpoints/grafana_proxy.py,
+    # crud/model_monitoring/grafana.py — simpleJSON datasource contract) ----
+    @r.get(API + "/grafana-proxy/model-endpoints")
+    async def grafana_health(request):
+        return json_response({"status": "ok"})
+
+    @r.post(API + "/grafana-proxy/model-endpoints/search")
+    async def grafana_search(request):
+        body = await request.json() if request.can_read_body else {}
+        project = (body.get("target") or "").split(":")[0] \
+            or mlconf.default_project
+        endpoints = state.db.list_model_endpoints(project)
+        return json_response([e.get("uid") for e in endpoints])
+
+    @r.post(API + "/grafana-proxy/model-endpoints/query")
+    async def grafana_query(request):
+        body = await request.json()
+        rows = []
+        columns = [{"text": "endpoint_id", "type": "string"},
+                   {"text": "model", "type": "string"},
+                   {"text": "requests", "type": "number"},
+                   {"text": "avg_latency_microsec", "type": "number"},
+                   {"text": "drift_status", "type": "string"}]
+        for target in body.get("targets", [{}]):
+            spec = (target.get("target") or "")
+            project = spec.split(":")[0] or mlconf.default_project
+            for endpoint in state.db.list_model_endpoints(project):
+                metrics = endpoint.get("metrics", {})
+                rows.append([
+                    endpoint.get("uid"), endpoint.get("name"),
+                    metrics.get("requests", 0),
+                    metrics.get("avg_latency_microsec", 0),
+                    endpoint.get("drift_status", "")])
+        return json_response([{"type": "table", "columns": columns,
+                               "rows": rows}])
+
     # -- background tasks --------------------------------------------------------------------
     @r.get(API + "/projects/{project}/background-tasks/{name}")
     async def get_background_task(request):

@@ -251,6 +251,83 @@ class LLMEngine:
         }
         return out_tokens, stats
 
+    def generate_batch(self, prompts: list, max_new_tokens: int = 64,
+                       eos_id: int | None = None) -> tuple[list, dict]:
+        """Batched greedy generation for EQUAL-LENGTH prompts (one fused
+        decode scan serves the whole batch). Mixed lengths fall back to a
+        per-prompt loop — exact per-row positions/pad masking in the cache
+        is R2 work.
+
+        Engine must be built with batch >= len(prompts).
+        """
+        import numpy as np
+
+        n = len(prompts)
+        if n > self.batch:
+            raise ValueError(
+                f"{n} prompts exceed engine batch size {self.batch}")
+        lengths = {len(p) for p in prompts}
+        if len(lengths) > 1:
+            outs, agg = [], {"ttft_s": 0.0, "decode_tokens_per_sec": 0.0}
+            for prompt in prompts:
+                tokens, stats = self.generate(prompt, max_new_tokens, eos_id)
+                outs.append(tokens)
+                agg["ttft_s"] = max(agg["ttft_s"], stats["ttft_s"])
+                agg["decode_tokens_per_sec"] += stats[
+                    "decode_tokens_per_sec"]
+            agg["batch"] = n
+            return outs, agg
+
+        prompt_len = lengths.pop()
+        bucket = self._bucket_for(prompt_len)
+        padded = np.zeros((self.batch, bucket), np.int32)
+        for i, prompt in enumerate(prompts):
+            padded[i, :prompt_len] = prompt
+
+        t0 = time.perf_counter()
+        cache = init_kv_cache(self.config, self.batch, self.max_len)
+        logits, cache = self._prefill(self.params, jnp.asarray(padded),
+                                      cache)
+        if prompt_len != bucket:
+            cache["pos"] = jnp.full((self.batch,), prompt_len - 1, jnp.int32)
+            last = jnp.asarray(padded[:, prompt_len - 1:prompt_len])
+            logits, cache = self._decode(self.params, last, cache)
+        else:
+            cache["pos"] = jnp.full((self.batch,), prompt_len, jnp.int32)
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = [[int(t)] for t in np.asarray(next_token)[:n]]
+        ttft = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        remaining = max_new_tokens - 1
+        step = next_token[:, None]
+        while remaining > 0:
+            if bucket + max_new_tokens - remaining + self.decode_chunk \
+                    > self.max_len:
+                break
+            tokens, cache = self._decode_n(self.params, step, cache,
+                                           self.decode_chunk)
+            chunk = np.asarray(tokens)  # [chunk, B]
+            take = min(self.decode_chunk, remaining)
+            for i in range(n):
+                row = chunk[:take, i].tolist()
+                if eos_id is not None and eos_id in row:
+                    row = row[: row.index(eos_id) + 1]
+                if not out[i] or (eos_id is None
+                                  or out[i][-1] != eos_id):
+                    out[i].extend(int(t) for t in row)
+            step = tokens[-1][:, None]
+            remaining -= take
+        decode_time = time.perf_counter() - t1
+        generated = sum(len(o) for o in out) - n
+        stats = {
+            "ttft_s": ttft,
+            "decode_tokens_per_sec": generated / decode_time
+            if decode_time > 0 and generated else 0.0,
+            "batch": n,
+        }
+        return out, stats
+
     def _sample(self, logits):
         if self.temperature and self.temperature > 0:
             key = jax.random.PRNGKey(int(time.time_ns()) % (2**31))

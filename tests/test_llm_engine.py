@@ -57,3 +57,25 @@ def test_kv_cache_shapes():
     assert cache["k"].shape == (cfg.n_layers, 2, 64, cfg.n_kv_heads,
                                 cfg.head_dim)
     assert cache["pos"].shape == (2,)
+
+
+def test_generate_batch_matches_single(engine):
+    """Equal-length batch: every row must match its single-prompt result."""
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7, 6, 5], [3, 3, 3, 3, 3]]
+    cfg = engine.config
+    eng = LLMEngine(cfg, engine.params, max_len=128,
+                    prefill_buckets=(32,), batch=4)
+    batch_out, stats = eng.generate_batch(prompts, max_new_tokens=8)
+    assert stats["batch"] == 3
+    for prompt, got in zip(prompts, batch_out):
+        single, _ = eng.generate(prompt, max_new_tokens=8)
+        assert got == single, (prompt, got, single)
+
+
+def test_generate_batch_mixed_lengths_fallback(engine):
+    cfg = engine.config
+    eng = LLMEngine(cfg, engine.params, max_len=128, prefill_buckets=(32,),
+                    batch=2)
+    outs, stats = eng.generate_batch([[1, 2, 3], [4, 5, 6, 7, 8]],
+                                     max_new_tokens=4)
+    assert len(outs) == 2 and all(len(o) == 4 for o in outs)

@@ -277,3 +277,17 @@ def test_worker_proxies_mutations_to_chief(service, http_db, monkeypatch):
         box["stop"] = True
         thread.join(timeout=5)
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_grafana_proxy(service, http_db):
+    http_db.store_model_endpoint("p1", "ep1", {
+        "uid": "ep1", "name": "m", "metrics": {
+            "requests": 5, "avg_latency_microsec": 1200.0},
+        "drift_status": "no_detection"})
+    found = http_db.api_call("POST", "grafana-proxy/model-endpoints/search",
+                             json_body={"target": "p1"})
+    assert found == ["ep1"]
+    table = http_db.api_call("POST", "grafana-proxy/model-endpoints/query",
+                             json_body={"targets": [{"target": "p1"}]})
+    assert table[0]["rows"][0][0] == "ep1"
+    assert table[0]["rows"][0][2] == 5

@@ -95,3 +95,16 @@ def test_spark_application_crd():
     assert crd["spec"]["executor"]["memory"] == "8g"
     assert crd["spec"]["driver"]["env"][-1]["name"] == \
         mlconf.exec_config_env
+
+
+def test_databricks_submit_payload():
+    fn = mlrun_tpu.new_function("dbx", kind="databricks", project="p1")
+    fn.with_code(body="def handler(context): pass")
+    fn.spec.cluster_id = "c-123"
+    payload = fn.generate_submit_payload(_run_obj())
+    task = payload["tasks"][0]
+    assert task["existing_cluster_id"] == "c-123"
+    import json
+    params = json.loads(task["spark_python_task"]["parameters"][0])
+    assert params["code_b64"]
+    assert params["run_spec"]["metadata"]["name"] == "train"
