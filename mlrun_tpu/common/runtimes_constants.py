@@ -47,6 +47,7 @@ class RuntimeKinds:
     tpujob = "tpujob"
     dask = "dask"
     spark = "spark"
+    databricks = "databricks"
     serving = "serving"
     remote = "remote"  # generic http-triggered function (nuclio analog)
     application = "application"
@@ -56,8 +57,8 @@ class RuntimeKinds:
         return [
             RuntimeKinds.local, RuntimeKinds.handler, RuntimeKinds.job,
             RuntimeKinds.tpujob, RuntimeKinds.dask, RuntimeKinds.spark,
-            RuntimeKinds.serving, RuntimeKinds.remote,
-            RuntimeKinds.application,
+            RuntimeKinds.databricks, RuntimeKinds.serving,
+            RuntimeKinds.remote, RuntimeKinds.application,
         ]
 
     @staticmethod

@@ -27,8 +27,10 @@ class ClientLocalLauncher(BaseLauncher):
         run = self._enrich_run(runtime, task)
         self._validate_run(run)
 
-        # convert remote kinds invoked with local=True into a local execution
-        if runtime.kind not in ("local", "handler", ""):
+        # local=True forces in-process execution of any kind's handler;
+        # otherwise client-driven kinds (dask/spark/databricks) keep their
+        # own _run, which talks to their execution substrate directly
+        if runtime.kind not in ("local", "handler", "") and self._is_local:
             runtime = self._convert_to_local(runtime)
 
         execution = MLClientCtx.from_dict(

@@ -65,13 +65,13 @@ def new_function(name: str = "", project: str = "", tag: str = "",
     runtime.spec.args = args or []
     runtime.spec.mode = mode
     if handler is not None:
-        if kind in (RuntimeKinds.local, RuntimeKinds.handler) \
-                and callable(handler):
+        if callable(handler):
             runtime.spec.default_handler = handler.__name__
+            # kept for in-process execution (local kinds and local=True
+            # conversions of remote kinds)
             runtime._handler = handler
         else:
-            runtime.spec.default_handler = (
-                handler if isinstance(handler, str) else handler.__name__)
+            runtime.spec.default_handler = handler
     if source:
         runtime.spec.build.source = source
     if requirements:

@@ -26,7 +26,7 @@ def _registry() -> dict:
         RuntimeKinds.tpujob: TpuJobRuntime,
         RuntimeKinds.dask: DaskRuntime,
         RuntimeKinds.spark: SparkRuntime,
-        "databricks": DatabricksRuntime,
+        RuntimeKinds.databricks: DatabricksRuntime,
         RuntimeKinds.serving: ServingRuntime,
         RuntimeKinds.remote: RemoteRuntime,
         RuntimeKinds.application: ApplicationRuntime,

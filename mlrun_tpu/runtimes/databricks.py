@@ -7,11 +7,7 @@ the control-plane shape is testable without the SDK.
 
 from __future__ import annotations
 
-import base64
-
-from ..config import mlconf
 from ..model import RunObject
-from ..utils import logger
 from .pod import KubeResource, KubeResourceSpec
 
 
@@ -30,7 +26,9 @@ class DatabricksSpec(KubeResourceSpec):
 
 class DatabricksRuntime(KubeResource):
     kind = "databricks"
-    _is_remote = True
+    # client-side driven like DaskRuntime: _run submits to the Databricks
+    # workspace directly (no service-side resource handler involved)
+    _is_remote = False
     _nested_fields = {**KubeResource._nested_fields, "spec": DatabricksSpec}
 
     def __init__(self, metadata=None, spec=None, status=None):
@@ -70,12 +68,37 @@ class DatabricksRuntime(KubeResource):
     def _run(self, runobj: RunObject, execution) -> dict:
         try:
             from databricks.sdk import WorkspaceClient  # gated
+            from databricks.sdk.service import jobs as dbx_jobs
         except ImportError as exc:
             raise ImportError(
                 "the databricks runtime requires the databricks-sdk "
                 "package") from exc
         client = WorkspaceClient()
         payload = self.generate_submit_payload(runobj)
-        run = client.jobs.submit(**payload).result()
-        execution.commit(completed=True)
+        tasks = []
+        for task in payload["tasks"]:
+            spark_task = dbx_jobs.SparkPythonTask(
+                python_file=task["spark_python_task"]["python_file"],
+                parameters=task["spark_python_task"]["parameters"])
+            tasks.append(dbx_jobs.SubmitTask(
+                task_key=task["task_key"],
+                spark_python_task=spark_task,
+                existing_cluster_id=task.get("existing_cluster_id"),
+                new_cluster=dbx_jobs.ClusterSpec.from_dict(
+                    task["new_cluster"]) if "new_cluster" in task else None,
+                timeout_seconds=task.get("timeout_seconds")))
+        run = client.jobs.submit(run_name=payload["run_name"],
+                                 tasks=tasks).result()
+        execution.log_result("databricks_run_id", run.run_id)
+        if run.run_page_url:
+            execution.log_result("databricks_run_url", run.run_page_url)
+        state = run.state
+        result_state = getattr(state, "result_state", None)
+        if result_state is not None and str(result_state) not in (
+                "RunResultState.SUCCESS", "SUCCESS"):
+            execution.set_state(
+                error=f"databricks run ended with {result_state}: "
+                      f"{getattr(state, 'state_message', '')}")
+        else:
+            execution.commit(completed=True)
         return execution.to_dict()

@@ -263,20 +263,33 @@ class LLMEngine:
         import numpy as np
 
         n = len(prompts)
+        if n == 0:
+            return [], {"ttft_s": 0.0, "decode_tokens_per_sec": 0.0,
+                        "batch": 0}
         if n > self.batch:
             raise ValueError(
                 f"{n} prompts exceed engine batch size {self.batch}")
         lengths = {len(p) for p in prompts}
-        if len(lengths) > 1:
-            outs, agg = [], {"ttft_s": 0.0, "decode_tokens_per_sec": 0.0}
+        # sampled decoding carries host-side randomness — use the per-prompt
+        # path so semantics match generate() exactly
+        if len(lengths) > 1 or (self.temperature and self.temperature > 0):
+            outs = []
+            started = time.perf_counter()
+            first_ttft = None
             for prompt in prompts:
                 tokens, stats = self.generate(prompt, max_new_tokens, eos_id)
                 outs.append(tokens)
-                agg["ttft_s"] = max(agg["ttft_s"], stats["ttft_s"])
-                agg["decode_tokens_per_sec"] += stats[
-                    "decode_tokens_per_sec"]
-            agg["batch"] = n
-            return outs, agg
+                first_ttft = first_ttft if first_ttft is not None \
+                    else stats["ttft_s"]
+            wall = time.perf_counter() - started
+            generated = sum(len(o) for o in outs)
+            return outs, {
+                "ttft_s": first_ttft or 0.0,
+                # true aggregate: total tokens over total wall time
+                "decode_tokens_per_sec": generated / wall if wall > 0
+                else 0.0,
+                "batch": n,
+            }
 
         prompt_len = lengths.pop()
         bucket = self._bucket_for(prompt_len)
@@ -300,9 +313,11 @@ class LLMEngine:
 
         t1 = time.perf_counter()
         remaining = max_new_tokens - 1
+        generated_so_far = 1
         step = next_token[:, None]
         while remaining > 0:
-            if bucket + max_new_tokens - remaining + self.decode_chunk \
+            # same capacity guard as generate(): pos starts at prompt_len
+            if prompt_len + generated_so_far + self.decode_chunk \
                     > self.max_len:
                 break
             tokens, cache = self._decode_n(self.params, step, cache,
@@ -318,6 +333,7 @@ class LLMEngine:
                     out[i].extend(int(t) for t in row)
             step = tokens[-1][:, None]
             remaining -= take
+            generated_so_far += self.decode_chunk  # cache rows consumed
         decode_time = time.perf_counter() - t1
         generated = sum(len(o) for o in out) - n
         stats = {

@@ -79,3 +79,25 @@ def test_generate_batch_mixed_lengths_fallback(engine):
     outs, stats = eng.generate_batch([[1, 2, 3], [4, 5, 6, 7, 8]],
                                      max_new_tokens=4)
     assert len(outs) == 2 and all(len(o) == 4 for o in outs)
+
+
+def test_generate_batch_capacity_guard_matches_single(engine):
+    """Regression: batch capacity guard keyed on prompt_len (not bucket)."""
+    cfg = engine.config
+    eng = LLMEngine(cfg, engine.params, max_len=64, prefill_buckets=(32,),
+                    batch=2)
+    single, _ = eng.generate([1, 2, 3, 4, 5], max_new_tokens=20)
+    batch, _ = eng.generate_batch([[1, 2, 3, 4, 5], [1, 2, 3, 4, 5]],
+                                  max_new_tokens=20)
+    assert batch[0] == single
+    assert len(batch[0]) == 20
+
+
+def test_generate_batch_empty():
+    cfg = tiny_llama(attention_impl="reference")
+    import jax as _jax
+
+    eng = LLMEngine(cfg, init_params(cfg, _jax.random.PRNGKey(0)),
+                    max_len=64, prefill_buckets=(32,))
+    outs, stats = eng.generate_batch([], max_new_tokens=4)
+    assert outs == [] and stats["batch"] == 0
