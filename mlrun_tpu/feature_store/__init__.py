@@ -12,3 +12,8 @@ from .feature_set import (  # noqa: F401
     FeatureSet,
     FeatureVector,
 )
+from .ingestion_service import (  # noqa: F401
+    FeatureSetIngestStep,
+    ingestion_service_function,
+)
+from .steps import apply_aggregations, apply_transforms  # noqa: F401

@@ -157,3 +157,38 @@ def test_validator_and_filter(isolated_home):
         {"value": {"max": 10}}, raise_on_fail=True))
     with _pytest.raises(ValueError, match="validation failed"):
         ingest(fs2, pd.DataFrame({"id": ["a"], "value": [99.0]}))
+
+
+def test_realtime_ingestion_service(isolated_home):
+    """Events posted to the ingestion serving graph land in online KV +
+    offline parquet (deploy_ingestion_service_v2 analog)."""
+    import pandas as pd
+
+    from mlrun_tpu.feature_store import (
+        FeatureSet,
+        ingestion_service_function,
+    )
+    from mlrun_tpu.feature_store.steps import MapValues
+
+    fs = FeatureSet("live-events", entities=["user"])
+    fs.metadata.project = "rtproj"
+    fs.add_transform_step(MapValues(
+        {"tier": {"gold": 2, "default": 1}}, suffix="_n"))
+    fn = ingestion_service_function(fs, project="rtproj")
+    server = fn.to_mock_server()
+
+    out = server.test(body={"user": "a", "v": 1.0, "tier": "gold"})
+    assert out["ingested"] == 1
+    server.test(body=[{"user": "b", "v": 2.0, "tier": "silver"},
+                      {"user": "a", "v": 3.0, "tier": "gold"}])
+
+    step = fn.spec.graph.steps["ingest"]._object
+    # online lookup reflects the LATEST event per entity
+    assert step.get(["a"])["v"] == 3.0
+    assert step.get(["a"])["tier_n"] == 2
+    assert step.get(["b"])["tier_n"] == 1
+    # offline parquet after flush
+    step.flush()
+    df = pd.read_parquet(fs._target_path())
+    assert set(df["user"]) == {"a", "b"}
+    assert len(df) == 2  # deduped per entity
