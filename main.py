@@ -1,3 +1,6 @@
+import os
 import mlrun_tpu
-def handler(context, x: int = 1):
-    context.log_result('doubled', x * 2)
+def train_handler(context, steps: int = 1):
+    # rank-0 check mirrors multi-host behavior
+    assert context.is_logging_worker()
+    context.log_result('trained_steps', steps)

@@ -31,6 +31,21 @@ from .runtime_handlers import LocalProcessProvider
 API = mlconf.api_base_path.rstrip("/")
 
 
+def paginate(items: list, request) -> list:
+    """limit/offset slicing for list endpoints (reference pagination
+    analog — token-based pagination cache is R2)."""
+    try:
+        offset = int(request.query.get("offset", 0))
+        limit = int(request.query.get("limit", 0))
+    except ValueError:
+        return items
+    if offset:
+        items = items[offset:]
+    if limit:
+        items = items[:limit]
+    return items
+
+
 def json_response(data, status: int = 200):
     return web.json_response(data, status=status, dumps=lambda d: json.dumps(
         d, default=str))
@@ -110,7 +125,7 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             state=q.get("state", ""), labels=q.getall("label", None),
             last=int(q.get("last", 0)), iter=bool(int(q.get("iter", 0))),
             uid=q.getall("uid", None))
-        return json_response({"runs": runs})
+        return json_response({"runs": paginate(runs, request)})
 
     @r.delete(API + "/projects/{project}/runs/{uid}")
     async def del_run(request):
@@ -192,7 +207,8 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             name=q.get("name", ""), project=request.match_info["project"],
             tag=q.get("tag"), labels=q.getall("label", None),
             kind=q.get("kind"), tree=q.get("tree"))
-        return json_response({"artifacts": artifacts})
+        return json_response(
+            {"artifacts": paginate(artifacts, request)})
 
     @r.delete(API + "/projects/{project}/artifacts/{key}")
     async def del_artifact(request):
@@ -232,7 +248,7 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             project=request.match_info["project"],
             tag=request.query.get("tag", ""),
             labels=request.query.getall("label", None))
-        return json_response({"funcs": funcs})
+        return json_response({"funcs": paginate(funcs, request)})
 
     @r.delete(API + "/projects/{project}/functions/{name}")
     async def delete_function(request):
