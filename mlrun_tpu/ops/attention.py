@@ -119,6 +119,130 @@ except Exception:  # noqa: BLE001
     _PALLAS_OK = False
 
 
+def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         m_scr, l_scr, acc_scr, *,
+                         num_kb: int, kv_len: int, scale: float,
+                         causal: bool):
+    """Grid-pipelined flash forward: grid (bh, q_blocks, k_blocks).
+
+    Unlike the v1 kernel (full KV resident in VMEM), each program sees one
+    (q_block, k_block) tile — pallas double-buffers the HBM→VMEM streams
+    across the innermost grid dim, so sequence length is bounded by HBM,
+    not VMEM. Running max/denominator/accumulator live in scratch that
+    persists across the k grid steps of a fixed (bh, qi).
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = kb * block_k
+    # causal: whole tile masked out when every k is beyond every q
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
+                  interpret=None):
+    """Grid-pipelined flash forward; q,k,v [B, S, H, D] (kv pre-repeated)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    orig_sq, orig_sk = sq, sk
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        sq += pad_q
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        sk += pad_k
+    scale = d ** -0.5
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    num_kb = sk // block_k
+    grid = (b * h, sq // block_q, num_kb)
+    kernel = functools.partial(
+        _flash_fwd_kernel_v2, num_kb=num_kb, kv_len=orig_sk, scale=scale,
+        causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),   # accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq)
+    if pad_q:
+        o = o[:, :orig_sq]
+        lse = lse[:, :, :orig_sq]
+    return o, lse
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def _flash_fwd(q, k, v, causal=True, block_q=256, block_k=256,
@@ -234,13 +358,14 @@ def _blockwise_bwd(q, k, v, o, lse, g, causal: bool, block: int = 512):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention_mlt(q, k, v, causal: bool = True):
-    """Our pallas flash attention (kv must already match q heads)."""
-    o, _ = _flash_fwd(q, k, v, causal=causal)
+    """Our pallas flash attention (kv must already match q heads); forward
+    is the grid-pipelined v2 kernel."""
+    o, _ = _flash_fwd_v2(q, k, v, causal=causal)
     return o
 
 
 def _flash_mlt_fwd(q, k, v, causal):
-    o, lse = _flash_fwd(q, k, v, causal=causal)
+    o, lse = _flash_fwd_v2(q, k, v, causal=causal)
     return o, (q, k, v, o, lse)
 
 

@@ -124,3 +124,16 @@ def test_ulysses_rejects_indivisible_heads(qkv):
     bad_q = q[:, :, :3]  # 3 heads not divisible by 4
     with _pytest.raises(Exception, match="divisible"):
         make_ulysses_attention(mesh, "seq")(bad_q, bad_q, bad_q)
+
+
+def test_flash_v2_grid_kernel(qkv):
+    """Grid-pipelined kernel: multiple k blocks, odd lengths, both masks."""
+    from mlrun_tpu.ops.attention import _flash_fwd_v2
+
+    q, k, v = qkv
+    kk, vv = _repeat_kv(k, 2), _repeat_kv(v, 2)
+    for causal in (True, False):
+        ref = attention_reference(q, kk, vv, causal=causal)
+        o, _ = _flash_fwd_v2(q, kk, vv, causal=causal, block_q=128,
+                             block_k=64, interpret=True)
+        assert float(jnp.max(jnp.abs(o - ref))) < 2e-5
