@@ -1,0 +1,34 @@
+# mlrun-tpu make targets (reference analog: Makefile test/test-go-unit/...)
+
+PYTHON ?= python
+
+.PHONY: test test-fast native bench bench-serving dryrun clean
+
+test:            ## full suite on the virtual 8-device CPU mesh
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:       ## skip the slow jax-compile-heavy suites
+	$(PYTHON) -m pytest tests/ -q \
+	  --ignore=tests/test_models_training.py \
+	  --ignore=tests/test_context_parallel.py \
+	  --ignore=tests/test_pipeline_parallel.py \
+	  --ignore=tests/test_bert.py --ignore=tests/test_moe.py \
+	  --ignore=tests/test_checkpoint.py --ignore=tests/test_ops.py \
+	  --ignore=tests/test_llm_engine.py
+
+native:          ## build the C++ log collector (mlt-logd)
+	$(MAKE) -C native
+
+bench:           ## training benchmark (one JSON line)
+	$(PYTHON) bench.py
+
+bench-serving:   ## serving TTFT benchmark (one JSON line)
+	$(PYTHON) scripts/bench_serving.py
+
+dryrun:          ## multi-chip sharding dryrun on 8 virtual CPU devices
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PYTHON) __graft_entry__.py 8
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
