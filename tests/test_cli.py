@@ -1,0 +1,80 @@
+"""CLI tests via subprocess (reference analog: the __main__ click surface;
+the in-pod `run --from-env` contract is the critical path)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(args, env_extra=None, cwd=None, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "mlrun_tpu"] + args,
+        capture_output=True, text=True, env=env, cwd=cwd, timeout=timeout)
+
+
+@pytest.fixture()
+def cli_home(tmp_path, monkeypatch):
+    home = str(tmp_path / "home")
+    monkeypatch.setenv("MLT_HOME", home)
+    return {"MLT_HOME": home}
+
+
+def test_version(cli_home):
+    out = _cli(["version"], cli_home)
+    assert out.returncode == 0
+    assert "mlrun-tpu version" in out.stdout
+
+
+def test_run_script_and_get(tmp_path, cli_home):
+    script = tmp_path / "job.py"
+    script.write_text(
+        "def handler(context, x: int = 1):\n"
+        "    context.log_result('double', x * 2)\n")
+    out = _cli(["run", str(script), "--handler", "handler",
+                "--param", "x=21", "--name", "cli-job"], cli_home)
+    assert out.returncode == 0, out.stderr
+    assert "completed" in out.stdout
+
+    listed = _cli(["get", "runs"], cli_home)
+    assert "cli-job" in listed.stdout
+    assert "'double': 42" in listed.stdout
+
+
+def test_run_from_env_contract(tmp_path, cli_home):
+    """The in-pod entrypoint: spec via MLT_EXEC_CONFIG, code via
+    MLT_EXEC_CODE."""
+    import base64
+
+    code = ("def handler(context):\n"
+            "    context.log_result('ok', context.get_param('p'))\n")
+    config = {"metadata": {"name": "inpod", "project": "default"},
+              "spec": {"parameters": {"p": 5}, "handler": "handler"}}
+    env = dict(cli_home)
+    env["MLT_EXEC_CONFIG"] = json.dumps(config)
+    env["MLT_EXEC_CODE"] = base64.b64encode(code.encode()).decode()
+    out = _cli(["run", "--from-env"], env, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    assert "completed" in out.stdout
+
+
+def test_run_failure_exit_code(tmp_path, cli_home):
+    script = tmp_path / "bad.py"
+    script.write_text("def handler(context):\n    raise ValueError('no')\n")
+    out = _cli(["run", str(script), "--handler", "handler"], cli_home)
+    assert out.returncode == 1
+    assert "error" in out.stdout
+
+
+def test_from_env_missing_config_errors(cli_home):
+    out = _cli(["run", "--from-env"], cli_home)
+    assert out.returncode != 0
+    assert "MLT_EXEC_CONFIG" in out.stderr + out.stdout
