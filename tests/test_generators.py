@@ -1,0 +1,69 @@
+"""Hyper-param generator tests (reference analog:
+tests/runtimes/test_generators.py)."""
+
+import mlrun_tpu
+from mlrun_tpu.model import HyperParamOptions, RunObject
+from mlrun_tpu.runtimes.generators import (
+    GridGenerator,
+    ListGenerator,
+    RandomGenerator,
+    get_generator,
+    select_best_iteration,
+)
+
+
+def _run(hyperparams, **options):
+    run = RunObject()
+    run.spec.hyperparams = hyperparams
+    run.spec.hyper_param_options = HyperParamOptions(**options)
+    return run
+
+
+def test_grid_cartesian_product():
+    run = _run({"a": [1, 2], "b": ["x", "y", "z"]})
+    tasks = list(GridGenerator().generate(run))
+    assert len(tasks) == 6
+    assert tasks[0].spec.parameters == {"a": 1, "b": "x"}
+    assert tasks[-1].spec.parameters == {"a": 2, "b": "z"}
+    assert [t.metadata.iteration for t in tasks] == list(range(1, 7))
+
+
+def test_random_respects_max_iterations():
+    run = _run({"a": list(range(100))}, max_iterations=5)
+    tasks = list(RandomGenerator(
+        HyperParamOptions(max_iterations=5)).generate(run))
+    assert len(tasks) == 5
+    assert all(t.spec.parameters["a"] in range(100) for t in tasks)
+
+
+def test_get_generator_strategy_selection():
+    assert isinstance(get_generator(_run({"a": [1]}).spec), GridGenerator)
+    spec = _run({"a": [1]}, strategy="list").spec
+    assert isinstance(get_generator(spec), ListGenerator)
+    assert get_generator(RunObject().spec) is None
+
+
+def test_max_errors_aborts_sweep():
+    calls = []
+
+    def handler(context, a: int = 0):
+        calls.append(a)
+        raise RuntimeError("always fails")
+
+    fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
+    run = fn.run(hyperparams={"a": [1, 2, 3, 4, 5, 6]},
+                 hyper_param_options={"max_errors": 2, "selector": "max.x"},
+                 local=True)
+    # aborted after max_errors iterations, not all six
+    assert len(calls) == 2
+    assert run.state == "error"
+
+
+def test_select_best_iteration_min():
+    rows = [{"iter": 1, "results": {"loss": 0.5}},
+            {"iter": 2, "results": {"loss": 0.2}},
+            {"iter": 3, "results": {"loss": 0.9}}]
+    assert select_best_iteration(rows, "min.loss") == 2
+    assert select_best_iteration(rows, "max.loss") == 3
+    assert select_best_iteration(rows, "") == 0
+    assert select_best_iteration(rows, "min.absent") == 0
