@@ -263,10 +263,15 @@ def chunked_loss(config: LlamaConfig, params: Params, tokens: jax.Array,
         mask = jnp.ones_like(targets, jnp.float32)
     mask = mask.astype(jnp.float32)
     b, s, e = x.shape
-    n_chunks = max(1, s // chunk)
-    chunk = s // n_chunks  # equal chunks (s divisible in practice; else 1)
-    if s % n_chunks:
-        n_chunks, chunk = 1, s
+    chunk = min(chunk, s)
+    n_chunks = -(-s // chunk)
+    pad = n_chunks * chunk - s
+    if pad:
+        # pad to a chunk multiple (mask=0 on pad) so the O(B·chunk·V) bound
+        # holds for any sequence length
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
 
     xc = x.reshape(b, n_chunks, chunk, e).transpose(1, 0, 2, 3)
     tc = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)

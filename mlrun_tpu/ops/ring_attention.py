@@ -47,8 +47,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Exact attention over a sequence-sharded axis (inside shard_map).
 
     q,k,v: local shards [B, S_local, H(q/kv), D]. The kv shard rotates
-    ``axis_size`` times around the ring; accumulation is online-softmax so
-    memory stays O(S_local).
+    ``axis_size - 1`` times around the ring (the final block is folded in
+    without a trailing rotation); accumulation is online-softmax so memory
+    stays O(S_local).
     """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -67,8 +68,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # ring: at step t we hold the kv shard originally from device (my_idx - t)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def body(carry, t):
-        num, m, l, k_cur, v_cur = carry
+    def accumulate(acc, t, k_cur, v_cur):
+        num, m, l = acc
         src_idx = (my_idx - t) % axis_size
         k_offset = src_idx * k_cur.shape[1]
         bnum, bm, bl = _block_attn(q, k_cur, v_cur, q_offset, k_offset,
@@ -78,13 +79,20 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         beta = jnp.exp(bm - m_new)
         num = num * alpha[..., None] + bnum * beta[..., None]
         l = l * alpha + bl * beta
-        # rotate kv to the next device (skip after the last step)
+        return num, m_new, l
+
+    def body(carry, t):
+        num, m, l, k_cur, v_cur = carry
+        num, m, l = accumulate((num, m, l), t, k_cur, v_cur)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (num, m_new, l, k_nxt, v_nxt), None
+        return (num, m, l, k_nxt, v_nxt), None
 
-    (num, m, l, _, _), _ = jax.lax.scan(
-        body, (num0, m0, l0, k, v), jnp.arange(axis_size))
+    # scan the first P-1 ring steps (each ends with a rotation), then fold in
+    # the final kv shard outside the scan — P-1 rotations total, not P
+    (num, m, l, k_last, v_last), _ = jax.lax.scan(
+        body, (num0, m0, l0, k, v), jnp.arange(axis_size - 1))
+    num, m, l = accumulate((num, m, l), axis_size - 1, k_last, v_last)
     l = jnp.maximum(l, 1e-30)
     return (num / l[..., None]).astype(q.dtype)
 

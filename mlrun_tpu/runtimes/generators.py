@@ -33,8 +33,10 @@ class TaskGenerator:
         condition = self.options.stop_condition
         if not condition:
             return False
+        from ..utils.safe_eval import safe_eval
+
         try:
-            return bool(eval(condition, {"__builtins__": {}}, results))
+            return bool(safe_eval(condition, results))
         except Exception:  # noqa: BLE001 - bad condition never stops the sweep
             return False
 

@@ -316,6 +316,9 @@ class LLMEngine:
         generated_so_far = 1
         step = next_token[:, None]
         while remaining > 0:
+            if eos_id is not None and all(
+                    o and o[-1] == eos_id for o in out[:n]):
+                break  # every row finished — skip further decode dispatches
             # same capacity guard as generate(): pos starts at prompt_len
             if prompt_len + generated_so_far + self.decode_chunk \
                     > self.max_len:

@@ -8,6 +8,7 @@ import json
 from typing import Optional
 
 from ..utils import logger
+from ..utils.safe_eval import safe_eval
 
 
 class RemoteStep:
@@ -35,8 +36,7 @@ class RemoteStep:
 
     def _resolve_url(self, event) -> str:
         if self.url_expression:
-            return eval(self.url_expression, {"__builtins__": {}},
-                        {"event": event})
+            return safe_eval(self.url_expression, {"event": event})
         url = self.url.rstrip("/")
         if self.subpath:
             url += "/" + self.subpath.lstrip("/")
@@ -48,8 +48,7 @@ class RemoteStep:
         url = self._resolve_url(event)
         body = event.body
         if self.body_expression:
-            body = eval(self.body_expression, {"__builtins__": {}},
-                        {"event": event})
+            body = safe_eval(self.body_expression, {"event": event})
         kwargs = {}
         if self.method.upper() != "GET" and body is not None:
             if isinstance(body, (dict, list)):

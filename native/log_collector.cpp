@@ -50,6 +50,7 @@ struct Tailer {
   std::string project, uid, src;
   std::thread thread;
   std::atomic<bool> stop{false};
+  std::atomic<bool> finished{false};  // set by tail_loop on exit
 };
 
 std::mutex g_tailers_mu;
@@ -115,7 +116,10 @@ void tail_loop(Tailer* t) {
   std::string dest = dest_path(t->project, t->uid);
   ensure_parent(dest);
   FILE* out = fopen(dest.c_str(), "ab");
-  if (!out) return;
+  if (!out) {
+    t->finished.store(true);
+    return;
+  }
   // resume from how much we already copied
   long copied = ftell(out);
   char buf[64 * 1024];
@@ -142,13 +146,22 @@ void tail_loop(Tailer* t) {
     }
   }
   fclose(out);
+  t->finished.store(true);
 }
 
 void start_tail(const std::string& project, const std::string& uid,
                 const std::string& src, bool persist_state) {
   std::lock_guard<std::mutex> lock(g_tailers_mu);
   std::string key = key_of(project, uid);
-  if (g_tailers.count(key)) return;
+  auto it = g_tailers.find(key);
+  if (it != g_tailers.end()) {
+    // a tailer that exited (e.g. idle timeout) must not block a new START;
+    // finished == true guarantees tail_loop returned, so join is instant
+    if (!it->second->finished.load()) return;
+    it->second->thread.join();
+    delete it->second;
+    g_tailers.erase(it);
+  }
   Tailer* t = new Tailer();
   t->project = project;
   t->uid = uid;
@@ -312,10 +325,21 @@ void handle_conn(int fd) {
       send_str(fd, "OK\n");
     } else if (cmd == "LIST") {
       std::lock_guard<std::mutex> lock(g_tailers_mu);
+      std::vector<std::string> active;
+      for (auto it = g_tailers.begin(); it != g_tailers.end();) {
+        if (it->second->finished.load()) {  // reap exited tailers
+          it->second->thread.join();
+          delete it->second;
+          it = g_tailers.erase(it);
+        } else {
+          active.push_back(it->first);
+          ++it;
+        }
+      }
       char header[64];
-      snprintf(header, sizeof(header), "OK %zu\n", g_tailers.size());
+      snprintf(header, sizeof(header), "OK %zu\n", active.size());
       send_str(fd, header);
-      for (auto& kv : g_tailers) send_str(fd, kv.first + "\n");
+      for (auto& k : active) send_str(fd, k + "\n");
     } else {
       send_str(fd, "ERR unknown command\n");
     }

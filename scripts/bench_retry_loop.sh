@@ -1,0 +1,20 @@
+#!/bin/bash
+# Retry bench.py until the TPU relay comes back, then record the result.
+# Each attempt relies on bench.py's internal 180s watchdog (no external
+# kill — killing a jax client mid-init can wedge the relay further).
+OUT=${1:-/root/repo/BENCH_LOCAL_r2.json}
+LOG=/tmp/bench_retry.log
+for i in $(seq 1 60); do
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
+  python /root/repo/bench.py > /tmp/bench_attempt.out 2>> "$LOG"
+  rc=$?
+  if [ $rc -eq 0 ] && [ -s /tmp/bench_attempt.out ]; then
+    cp /tmp/bench_attempt.out "$OUT"
+    echo "SUCCESS on attempt $i" >> "$LOG"
+    exit 0
+  fi
+  echo "attempt $i rc=$rc" >> "$LOG"
+  sleep 600
+done
+echo "exhausted attempts" >> "$LOG"
+exit 1

@@ -163,6 +163,23 @@ def test_chunked_loss_matches_full(cfg):
     assert abs(float(m_full["accuracy"]) - float(m_chunked["accuracy"])) \
         < 1e-6
 
+    # non-multiple sequence length pads to a chunk multiple (mask=0 on pad)
+    # instead of collapsing to one full-sequence chunk
+    odd_tok, odd_tgt = tokens[:, :27], targets[:, :27]
+    full_odd, m_full_odd = loss_fn(cfg, params, odd_tok, odd_tgt)
+    chunk_odd, m_chunk_odd = chunked_loss(
+        cfg, params, odd_tok, odd_tgt, chunk=8)
+    assert abs(float(full_odd) - float(chunk_odd)) < 1e-3
+    assert float(m_chunk_odd["tokens"]) == 27 * 2
+    g_full_odd = jax.grad(
+        lambda p: loss_fn(cfg, p, odd_tok, odd_tgt)[0])(params)
+    g_chunk_odd = jax.grad(
+        lambda p: chunked_loss(cfg, p, odd_tok, odd_tgt, chunk=8)[0])(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full_odd),
+                    jax.tree_util.tree_leaves(g_chunk_odd)):
+        assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32)))) < 2e-2
+
     g_full = jax.grad(
         lambda p: loss_fn(cfg, p, tokens, targets)[0])(params)
     g_chunk = jax.grad(

@@ -1,0 +1,64 @@
+"""AST-restricted expression evaluator (replaces raw eval on
+config-supplied strings; reference uses bare eval —
+mlrun/runtimes/generators.py, mlrun/serving/remote.py)."""
+
+import pytest
+
+from mlrun_tpu.utils.safe_eval import UnsafeExpressionError, safe_eval
+
+
+def test_comparisons_and_boolean_ops():
+    assert safe_eval("accuracy > 0.9 and loss < 0.5",
+                     {"accuracy": 0.95, "loss": 0.1}) is True
+    assert safe_eval("accuracy > 0.9", {"accuracy": 0.5}) is False
+
+
+def test_arithmetic_subscript_fstring():
+    assert safe_eval("(a + b) * 2", {"a": 1, "b": 2}) == 6
+    assert safe_eval("d['k'][0]", {"d": {"k": [7]}}) == 7
+    assert safe_eval("f'http://{host}/v1'", {"host": "x"}) == "http://x/v1"
+
+
+def test_attribute_access_non_dunder():
+    class Event:
+        body = {"path": "abc"}
+
+    assert safe_eval("event.body['path']", {"event": Event()}) == "abc"
+
+
+def test_builtin_whitelist_calls():
+    assert safe_eval("max(len(xs), 2)", {"xs": [1, 2, 3]}) == 3
+    assert safe_eval("str(round(v, 2))", {"v": 1.234}) == "1.23"
+
+
+@pytest.mark.parametrize("expr", [
+    "().__class__.__mro__",                       # attribute traversal
+    "x.__globals__", "x._private",                # dunder / underscore attr
+    "__import__('os')",                           # dunder name
+    "open('/etc/passwd')",                        # non-whitelisted call
+    "(lambda: 1)()",                              # lambda
+    "[x for x in xs]",                            # comprehension
+    "exec('1')",
+    "'{0.__class__.__mro__}'.format(x)",          # format-string traversal
+    "'{v.__dict__}'.format_map(d)",
+    "d['f']('echo pwned')",                       # computed-callable call
+    "(min if True else max)('x')",                # ifexp func
+    "sorted(['x'], key=d['f'])",                  # kwarg-smuggled callable
+])
+def test_bypass_vectors_rejected(expr):
+    with pytest.raises((UnsafeExpressionError, SyntaxError)):
+        safe_eval(expr, {"x": object(), "xs": [1],
+                         "d": {"f": print, "v": object()}})
+
+
+def test_stop_condition_uses_safe_eval():
+    from mlrun_tpu.model import HyperParamOptions
+    from mlrun_tpu.runtimes.generators import GridGenerator
+
+    gen = GridGenerator(HyperParamOptions(
+        stop_condition="().__class__ and accuracy > 0"))
+    # unsafe condition is rejected -> treated as "never stop", not executed
+    assert gen.eval_stop_condition({"accuracy": 1.0}) is False
+
+    gen2 = GridGenerator(HyperParamOptions(stop_condition="accuracy > 0.9"))
+    assert gen2.eval_stop_condition({"accuracy": 0.95}) is True
