@@ -187,8 +187,7 @@ def db(port, host):
     """Start the metadata/orchestration service (aiohttp)."""
     from .service.app import run_app
 
-    run_app(host=host or mlconf.httpdb.host,
-            port=port or mlconf.httpdb.port)
+    run_app(host=host, port=port)
 
 
 @main.command()

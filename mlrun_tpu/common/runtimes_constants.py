@@ -62,6 +62,12 @@ class RuntimeKinds:
         ]
 
     @staticmethod
+    def handled_kinds() -> list[str]:
+        """Kinds with a server-side runtime handler (resource recovery)."""
+        return [RuntimeKinds.job, RuntimeKinds.tpujob, RuntimeKinds.dask,
+                RuntimeKinds.spark]
+
+    @staticmethod
     def remote_kinds() -> list[str]:
         return [RuntimeKinds.job, RuntimeKinds.tpujob, RuntimeKinds.dask,
                 RuntimeKinds.spark, RuntimeKinds.serving,

@@ -85,6 +85,11 @@ CREATE TABLE IF NOT EXISTS events (
 CREATE TABLE IF NOT EXISTS hub_sources (
     name TEXT PRIMARY KEY, idx INTEGER, body TEXT
 );
+CREATE TABLE IF NOT EXISTS runtime_resources (
+    project TEXT NOT NULL, uid TEXT NOT NULL, kind TEXT,
+    resource_id TEXT, started REAL,
+    PRIMARY KEY (project, uid)
+);
 CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
 CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 """
@@ -236,6 +241,33 @@ class SQLiteRunDB(RunDBInterface):
                                   state=state, iter=True):
             self.del_run(get_in(run, "metadata.uid"), project,
                          get_in(run, "metadata.iteration", 0))
+
+    # -- runtime resources (durable handler state; reference recovers by
+    # listing cluster resources per label selector, base.py:65 — here the
+    # mapping survives service restarts in the DB and is reconciled against
+    # the provider on startup) ---------------------------------------------
+    def store_runtime_resource(self, uid: str, project: str, kind: str,
+                               resource_id: str, started: float):
+        project = self._project_or_default(project)
+        self._execute(
+            "INSERT OR REPLACE INTO runtime_resources "
+            "(project, uid, kind, resource_id, started) VALUES (?,?,?,?,?)",
+            (project, uid, kind, resource_id, started))
+
+    def list_runtime_resources(self, kind: str = "") -> list[dict]:
+        sql = ("SELECT project, uid, kind, resource_id, started "
+               "FROM runtime_resources")
+        params: tuple = ()
+        if kind:
+            sql += " WHERE kind=?"
+            params = (kind,)
+        return [dict(row) for row in self._query(sql, params)]
+
+    def del_runtime_resource(self, uid: str, project: str = ""):
+        project = self._project_or_default(project)
+        self._execute(
+            "DELETE FROM runtime_resources WHERE project=? AND uid=?",
+            (project, uid))
 
     # -- logs --------------------------------------------------------------
     def _log_path(self, project: str, uid: str) -> str:

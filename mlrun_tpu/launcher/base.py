@@ -108,14 +108,22 @@ class BaseLauncher(ABC):
             return False
 
         if generator.use_parallel():
-            from concurrent.futures import ThreadPoolExecutor
+            # N iterations as concurrent resources with a max-parallel cap
+            # (reference parallelizes via dask/process pools,
+            # mlrun/runtimes/local.py:74); early stop cancels queued
+            # iterations instead of draining them
+            from concurrent.futures import ThreadPoolExecutor, as_completed
 
-            workers = generator.options.parallel_runs
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                for task, result in pool.map(
-                        run_one, generator.generate(run)):
-                    if record(task, result):
+            workers = int(generator.options.parallel_runs)
+            pool = ThreadPoolExecutor(max_workers=workers)
+            try:
+                futures = [pool.submit(run_one, task)
+                           for task in generator.generate(run)]
+                for future in as_completed(futures):
+                    if record(*future.result()):
                         break
+            finally:
+                pool.shutdown(wait=True, cancel_futures=True)
         else:
             for task in generator.generate(run):
                 if record(*run_one(task)):

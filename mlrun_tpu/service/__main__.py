@@ -1,0 +1,18 @@
+"""``python -m mlrun_tpu.service`` — start the orchestration service
+(same entry as the ``mlrun-tpu db`` CLI command)."""
+
+import argparse
+
+from .app import run_app
+
+
+def main():
+    parser = argparse.ArgumentParser(description="mlrun-tpu API service")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="")
+    args = parser.parse_args()
+    run_app(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,7 @@ class ServiceState:
         self.db = db or SQLiteRunDB()
         self.provider = provider or LocalProcessProvider(self.db)
         self.launcher = ServerSideLauncher(self.db, self.provider)
+        self.launcher.recover()  # re-adopt resources from before a restart
         self.background_tasks: dict[str, dict] = {}
         self.workflows: dict[str, dict] = {}
         self.started = time.time()
@@ -747,7 +748,9 @@ async def _stop_periodic(app: web.Application):
         task.cancel()
 
 
-def run_app(host: str = "0.0.0.0", port: int = 8787):
+def run_app(host: str = "", port: int = 0):
+    host = host or mlconf.httpdb.host
+    port = port or mlconf.httpdb.port
     # make the advertised port consistent for spawned run resources
     mlconf.httpdb.port = port
     logger.info("starting mlrun-tpu service", host=host, port=port,

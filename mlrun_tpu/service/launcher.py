@@ -28,6 +28,29 @@ class ServerSideLauncher(BaseLauncher):
                 kind, self.db, self.provider)
         return self._handlers[kind]
 
+    def recover(self):
+        """Rebuild handler resource maps after a service restart (reference
+        base.py:65 lists cluster resources by label; here DB rows + provider
+        discovery)."""
+        kinds: set[str] = set()
+        lister = getattr(self.db, "list_runtime_resources", None)
+        if lister:
+            try:
+                kinds = {row["kind"] for row in lister() if row.get("kind")}
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("resource recovery listing failed",
+                               error=str(exc))
+        if hasattr(self.provider, "list_resources"):
+            # provider label discovery must run even for kinds with zero DB
+            # rows (lost/fresh DB with live cluster resources)
+            kinds |= set(RuntimeKinds.handled_kinds())
+        for kind in kinds:
+            try:
+                self.handler_for(kind).recover_resources()
+            except Exception as exc:  # noqa: BLE001 - recover what we can
+                logger.warning("resource recovery failed", kind=kind,
+                               error=str(exc))
+
     def monitor_all(self):
         for handler in self._handlers.values():
             try:

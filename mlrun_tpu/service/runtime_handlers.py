@@ -86,7 +86,9 @@ class LocalProcessProvider(Provider):
         proc = subprocess.Popen(
             command + list(args), env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, cwd=container.get("workingDir") or None)
-        resource_id = f"proc-{proc.pid}"
+        # fingerprint with the kernel start time so a recovered resource id
+        # can never be confused with a recycled pid
+        resource_id = f"proc-{proc.pid}-{_proc_start_ticks(proc.pid)}"
         with self._lock:
             self._procs[resource_id] = proc
 
@@ -106,7 +108,13 @@ class LocalProcessProvider(Provider):
     def state(self, resource_id: str) -> str:
         proc = self._procs.get(resource_id)
         if proc is None:
-            return PodPhases.unknown
+            # recovered resource from a previous service process: the Popen
+            # handle is gone, but pid + start-time fingerprint tell us
+            # whether the same process still runs (the run itself reports
+            # its state over HTTP, so liveness is all the monitor needs)
+            if self._recovered_alive(resource_id):
+                return PodPhases.running
+            return PodPhases.failed
         code = proc.poll()
         if code is None:
             return PodPhases.running
@@ -114,8 +122,37 @@ class LocalProcessProvider(Provider):
 
     def delete(self, resource_id: str):
         proc = self._procs.pop(resource_id, None)
-        if proc is not None and proc.poll() is None:
-            proc.terminate()
+        if proc is not None:
+            if proc.poll() is None:
+                proc.terminate()
+            return
+        if self._recovered_alive(resource_id):
+            pid, _ = self._pid_of(resource_id)
+            try:
+                os.kill(pid, 15)
+            except OSError:
+                pass
+
+    @classmethod
+    def _recovered_alive(cls, resource_id: str) -> bool:
+        """True only when the pid is alive AND (when recorded) its kernel
+        start time matches — a recycled pid never counts as the run."""
+        pid, ticks = cls._pid_of(resource_id)
+        if not pid or not _pid_alive(pid):
+            return False
+        return ticks == 0 or _proc_start_ticks(pid) == ticks
+
+    @staticmethod
+    def _pid_of(resource_id: str) -> tuple[int, int]:
+        if resource_id.startswith("proc-"):
+            parts = resource_id[5:].split("-")
+            try:
+                pid = int(parts[0])
+                ticks = int(parts[1]) if len(parts) > 1 else 0
+                return pid, ticks
+            except ValueError:
+                return 0, 0
+        return 0, 0
 
 
 class KubernetesProvider(Provider):
@@ -167,6 +204,49 @@ class KubernetesProvider(Provider):
         else:
             self._core.delete_namespaced_pod(name, self.namespace)
 
+    def list_resources(self, class_label: str) -> list[tuple[str, str, str]]:
+        """Discover live cluster resources by label selector (reference
+        base.py:65,189 recovers handler state the same way). Returns
+        (resource_id, run_uid, project) triples."""
+        selector = f"mlrun-tpu/class={class_label}"
+        found = []
+        pods = self._core.list_namespaced_pod(
+            self.namespace, label_selector=selector)
+        for pod in pods.items:
+            labels = pod.metadata.labels or {}
+            found.append((f"pod/{pod.metadata.name}",
+                          labels.get("mlrun-tpu/uid", ""),
+                          labels.get("mlrun-tpu/project", "")))
+        jobsets = self._custom.list_namespaced_custom_object(
+            "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+            label_selector=selector)
+        for js in jobsets.get("items", []):
+            labels = js.get("metadata", {}).get("labels", {})
+            found.append((f"jobset/{js['metadata']['name']}",
+                          labels.get("mlrun-tpu/uid", ""),
+                          labels.get("mlrun-tpu/project", "")))
+        return [f for f in found if f[1]]
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def _proc_start_ticks(pid: int) -> int:
+    """Kernel start time (jiffies since boot, /proc/<pid>/stat field 22) —
+    a stable process identity that survives pid reuse. 0 when unavailable
+    (non-linux), which degrades to pid-only liveness."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode(errors="replace")
+        return int(stat.rsplit(") ", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return 0
+
 
 def _extract_pod_spec(resource: dict) -> dict:
     if resource.get("kind") == "JobSet":
@@ -181,8 +261,11 @@ class BaseRuntimeHandler:
     def __init__(self, db, provider: Provider):
         self.db = db
         self.provider = provider
-        # run uid -> (resource_id, project, started_monotonic)
+        # run uid -> (resource_id, project, started_walltime); mirrored in
+        # the DB's runtime_resources table so a service restart can rebuild
+        # it (reference recovers via cluster label listing, base.py:65)
         self._resources: dict[str, tuple[str, str, float]] = {}
+        self._lock = threading.RLock()
 
     # -- resource building --------------------------------------------------
     def build_resource(self, runtime, run: RunObject) -> dict:
@@ -191,8 +274,12 @@ class BaseRuntimeHandler:
     def run(self, runtime, run: RunObject, execution=None) -> dict:
         resource = self.build_resource(runtime, run)
         resource_id = self.provider.create(resource, run.metadata.uid)
-        self._resources[run.metadata.uid] = (
-            resource_id, run.metadata.project, time.monotonic())
+        started = time.time()
+        with self._lock:
+            self._resources[run.metadata.uid] = (
+                resource_id, run.metadata.project, started)
+        self._persist(run.metadata.uid, run.metadata.project, resource_id,
+                      started)
         self.db.update_run(
             {"status.state": RunStates.running,
              "status.start_time": now_iso()},
@@ -201,51 +288,127 @@ class BaseRuntimeHandler:
                     resource=resource_id, uid=run.metadata.uid)
         return {"resource_id": resource_id}
 
+    # -- durable state ------------------------------------------------------
+    def _persist(self, uid: str, project: str, resource_id: str,
+                 started: float):
+        store = getattr(self.db, "store_runtime_resource", None)
+        if store:
+            try:
+                store(uid, project, self.kind, resource_id, started)
+            except Exception as exc:  # noqa: BLE001 - tracking best-effort
+                logger.warning("runtime resource persist failed",
+                               error=str(exc))
+
+    def _forget(self, uid: str, project: str):
+        with self._lock:
+            self._resources.pop(uid, None)
+        drop = getattr(self.db, "del_runtime_resource", None)
+        if drop:
+            try:
+                drop(uid, project)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("runtime resource forget failed",
+                               error=str(exc))
+
+    def recover_resources(self):
+        """Rebuild the resource map after a service restart: DB rows first,
+        then provider label discovery for resources the DB missed."""
+        lister = getattr(self.db, "list_runtime_resources", None)
+        recovered = 0
+        if lister:
+            for row in lister(kind=self.kind):
+                with self._lock:
+                    if row["uid"] not in self._resources:
+                        self._resources[row["uid"]] = (
+                            row["resource_id"], row["project"],
+                            float(row["started"] or time.time()))
+                        recovered += 1
+        discover = getattr(self.provider, "list_resources", None)
+        if discover:
+            try:
+                for resource_id, uid, project in discover(self.kind):
+                    with self._lock:
+                        if uid not in self._resources:
+                            self._resources[uid] = (
+                                resource_id, project, time.time())
+                            recovered += 1
+                            self._persist(uid, project, resource_id,
+                                          time.time())
+            except Exception as exc:  # noqa: BLE001 - discovery best-effort
+                logger.warning("provider resource discovery failed",
+                               kind=self.kind, error=str(exc))
+        if recovered:
+            logger.info("recovered runtime resources", kind=self.kind,
+                        count=recovered)
+
     # -- monitoring (reference base.py:189 monitor_runs) ---------------------
     def monitor_runs(self):
-        for uid, (resource_id, project, started) in list(
-                self._resources.items()):
+        with self._lock:
+            snapshot = list(self._resources.items())
+        for uid, (resource_id, project, started) in snapshot:
+            try:
+                self._monitor_one(uid, resource_id, project, started)
+            except Exception as exc:  # noqa: BLE001 - one bad resource must
+                # not wedge monitoring for every other run of this kind
+                logger.warning("monitoring resource failed", uid=uid,
+                               resource=resource_id, error=str(exc))
+
+    def _monitor_one(self, uid: str, resource_id: str, project: str,
+                     started: float):
+        try:
             phase = self.provider.state(resource_id)
-            run_state = PodPhases.to_run_state(phase)
-            run = self.db.read_run(uid, project)
-            if run is None:
-                self.provider.delete(resource_id)
-                self._resources.pop(uid, None)
-                continue
-            current = get_in(run, "status.state")
-            if current in (RunStates.aborting,):
-                self.provider.delete(resource_id)
-                self.db.update_run({"status.state": RunStates.aborted},
-                                   uid, project)
-                self._resources.pop(uid, None)
-                continue
-            if run_state in RunStates.terminal_states():
-                updates = {"status.last_update": now_iso()}
-                # the in-run process writes richer state; only force error
-                # when the resource failed but the run never reported it
-                if run_state == RunStates.error and current not in \
-                        RunStates.terminal_states():
-                    updates["status.state"] = RunStates.error
-                    updates["status.error"] = (
-                        get_in(run, "status.error")
-                        or "execution resource failed")
-                elif current not in RunStates.terminal_states():
-                    updates["status.state"] = run_state
-                self.db.update_run(updates, uid, project)
-                self._resources.pop(uid, None)
-                continue
-            # stuck-state thresholds (reference base.py:518)
-            threshold = self._state_threshold(run, run_state)
-            if threshold > 0 and time.monotonic() - started > threshold:
-                logger.warning("aborting stuck run", uid=uid,
-                               state=run_state, threshold=threshold)
-                self.provider.delete(resource_id)
-                self.db.update_run(
-                    {"status.state": RunStates.aborted,
-                     "status.status_text":
-                     f"stuck in state {run_state} over {threshold}s"},
-                    uid, project)
-                self._resources.pop(uid, None)
+        except Exception as exc:  # noqa: BLE001 - e.g. k8s 404 after the
+            # resource was GC'd while the service was down
+            logger.warning("resource state probe failed — treating as gone",
+                           uid=uid, resource=resource_id, error=str(exc))
+            phase = PodPhases.failed
+        run_state = PodPhases.to_run_state(phase)
+        run = self.db.read_run(uid, project)
+        if run is None:
+            self._delete_quietly(resource_id)
+            self._forget(uid, project)
+            return
+        current = get_in(run, "status.state")
+        if current in (RunStates.aborting,):
+            self._delete_quietly(resource_id)
+            self.db.update_run({"status.state": RunStates.aborted},
+                               uid, project)
+            self._forget(uid, project)
+            return
+        if run_state in RunStates.terminal_states():
+            updates = {"status.last_update": now_iso()}
+            # the in-run process writes richer state; only force error
+            # when the resource failed but the run never reported it
+            if run_state == RunStates.error and current not in \
+                    RunStates.terminal_states():
+                updates["status.state"] = RunStates.error
+                updates["status.error"] = (
+                    get_in(run, "status.error")
+                    or "execution resource failed")
+            elif current not in RunStates.terminal_states():
+                updates["status.state"] = run_state
+            self.db.update_run(updates, uid, project)
+            self._forget(uid, project)
+            return
+        # stuck-state thresholds (reference base.py:518)
+        threshold = self._state_threshold(run, run_state)
+        if threshold > 0 and time.time() - started > threshold:
+            logger.warning("aborting stuck run", uid=uid,
+                           state=run_state, threshold=threshold)
+            self._delete_quietly(resource_id)
+            self.db.update_run(
+                {"status.state": RunStates.aborted,
+                 "status.status_text":
+                 f"stuck in state {run_state} over {threshold}s"},
+                uid, project)
+            self._forget(uid, project)
+
+    def _delete_quietly(self, resource_id: str):
+        try:
+            self.provider.delete(resource_id)
+        except Exception as exc:  # noqa: BLE001 - already-gone is fine
+            logger.warning("resource delete failed", resource=resource_id,
+                           error=str(exc))
 
     @staticmethod
     def _state_threshold(run: dict, state: str) -> float:
@@ -260,16 +423,19 @@ class BaseRuntimeHandler:
         return -1
 
     def delete_resources(self, uid: str):
-        entry = self._resources.pop(uid, None)
+        with self._lock:
+            entry = self._resources.get(uid)
         if entry:
             self.provider.delete(entry[0])
+            self._forget(uid, entry[1])
 
     def abort_run(self, uid: str, project: str):
         self.db.update_run({"status.state": RunStates.aborting}, uid, project)
-        entry = self._resources.get(uid)
+        with self._lock:
+            entry = self._resources.get(uid)
         if entry:
             self.provider.delete(entry[0])
-            self._resources.pop(uid, None)
+            self._forget(uid, project)
         self.db.update_run({"status.state": RunStates.aborted}, uid, project)
 
 

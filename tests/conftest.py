@@ -64,3 +64,60 @@ def cpu_mesh8():
     from mlrun_tpu.parallel.mesh import make_mesh
 
     return make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+
+
+@pytest.fixture()
+def service(tmp_path, monkeypatch):
+    """Run the service in a thread; yield (base_url, state)."""
+    import asyncio
+    import socket
+    import threading
+
+    from aiohttp import web
+
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.app import ServiceState, build_app
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    mlconf.httpdb.port = port  # advertise the ephemeral port to resources
+    db = SQLiteRunDB(str(tmp_path / "svc.sqlite"),
+                     logs_dir=str(tmp_path / "logs"))
+    state = ServiceState(db=db)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    runner_box = {}
+
+    async def serve2():
+        runner = web.AppRunner(build_app(state))
+        await runner.setup()
+        runner_box["runner"] = runner
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        started.set()
+        while not runner_box.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    thread = threading.Thread(
+        target=lambda: (asyncio.set_event_loop(loop),
+                        loop.run_until_complete(serve2())),
+        daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield f"http://127.0.0.1:{port}", state
+    runner_box["stop"] = True
+    thread.join(timeout=5)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture()
+def http_db(service):
+    from mlrun_tpu.db.httpdb import HTTPRunDB
+
+    url, _ = service
+    return HTTPRunDB(url).connect()
