@@ -43,6 +43,9 @@ def ingest(featureset: Union[FeatureSet, str], source,
     from ..datastore.sources import resolve_source
 
     fset = _resolve_feature_set(featureset)
+    if fset.spec.engine == "dask":
+        return _ingest_dask(fset, source, targets=targets,
+                            return_df=return_df, overwrite=overwrite)
     source = resolve_source(source).to_dataframe()
     if not isinstance(source, pd.DataFrame):
         raise ValueError("pandas-engine ingest expects a DataFrame or url")
@@ -54,8 +57,10 @@ def ingest(featureset: Union[FeatureSet, str], source,
 
     # transform graph + windowed aggregations (pandas engine).
     # copy + reset index: never mutate the caller's frame, and rolling
-    # assignment needs unique row labels
-    source = source.copy().reset_index(drop=True)
+    # assignment needs unique row labels. An entity carried on the index
+    # is promoted to a column (the validation above accepted it there).
+    keep_index = source.index.name in entities
+    source = source.copy().reset_index(drop=not keep_index)
     from .steps import apply_aggregations, apply_transforms
 
     source = apply_transforms(source, fset.spec.transforms)
@@ -118,6 +123,90 @@ def ingest(featureset: Union[FeatureSet, str], source,
     return source if return_df else None
 
 
+def _ingest_dask(fset: FeatureSet, source, targets=None,
+                 return_df: bool = True, overwrite: bool | None = None):
+    """Dask-engine ingest (reference analog: storey/spark ingest engines;
+    here dask.dataframe keeps large ParquetSource/CsvSource ingests
+    out-of-core). Gated on the dask package; windowed aggregations need the
+    pandas engine. Extra (non-parquet) targets materialize the frame."""
+    import dask.dataframe as dd  # gated import
+
+    if fset.spec.aggregations:
+        raise ValueError(
+            "windowed aggregations are not supported by the dask ingest "
+            "engine — use engine='pandas' for this feature set")
+    from ..datastore.sources import resolve_source
+
+    src = resolve_source(source)
+    path = getattr(src, "path", "") or ""
+    if isinstance(source, pd.DataFrame):
+        ddf = dd.from_pandas(source, npartitions=4)
+    elif path.endswith(".parquet") or path.endswith(".pq"):
+        ddf = dd.read_parquet(path)
+    elif path.endswith(".csv"):
+        ddf = dd.read_csv(path)
+    else:
+        ddf = dd.from_pandas(src.to_dataframe(), npartitions=4)
+
+    from .steps import apply_transforms
+
+    if fset.spec.transforms:
+        meta = apply_transforms(ddf.head(10), fset.spec.transforms)
+        ddf = ddf.map_partitions(
+            lambda part: apply_transforms(part, fset.spec.transforms),
+            meta=meta)
+
+    entities = fset.entity_names
+    for entity in entities:
+        if entity not in ddf.columns:
+            raise ValueError(f"entity column '{entity}' missing from source")
+    if not fset.spec.features:
+        fset.spec.features = [
+            {"name": c, "value_type": str(dtype)}
+            for c, dtype in ddf.dtypes.items() if c not in entities
+        ]
+    out_path = fset._target_path()
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    if overwrite is False and os.path.exists(out_path):
+        # append + last-wins dedupe per entity, matching the pandas path
+        existing = dd.read_parquet(out_path)
+        ddf = dd.concat([existing, ddf])
+        if entities:
+            ddf = ddf.drop_duplicates(subset=entities, keep="last")
+        # collect before rewriting the directory being read
+        ddf = dd.from_pandas(ddf.compute(), npartitions=4)
+    # a directory of part files (pd.read_parquet loads it transparently)
+    ddf.to_parquet(out_path, write_index=False)
+    target_records = [{"name": "parquet", "kind": "parquet",
+                       "path": out_path, "updated": now_iso()}]
+
+    extra_targets = targets if targets is not None else \
+        (fset.spec.targets or [])
+    if extra_targets:
+        from ..datastore.targets import resolve_target
+
+        project = getattr(fset.metadata, "project", "") or \
+            mlconf.default_project
+        materialized = ddf.compute()
+        for target in extra_targets:
+            if isinstance(target, str) and target == "parquet":
+                continue
+            target_obj = resolve_target(target)
+            if not target_obj.path:
+                target_obj.path = target_obj.default_path(project, fset.name)
+            target_obj.write_dataframe(
+                materialized, key_columns=entities,
+                timestamp_key=fset.spec.timestamp_key)
+            target_records.append(target_obj.status_record())
+
+    fset.status.targets = target_records
+    fset.status.state = "ready"
+    fset.save()
+    logger.info("ingested feature set (dask)", name=fset.name,
+                path=out_path)
+    return ddf.compute() if return_df else None
+
+
 def preview(featureset: Union[FeatureSet, str], source, limit: int = 20):
     fset = _resolve_feature_set(featureset)
     if isinstance(source, str):
@@ -167,45 +256,21 @@ def get_offline_features(feature_vector: Union[str, FeatureVector],
                          entity_rows: pd.DataFrame | None = None,
                          target=None, drop_columns: list | None = None,
                          with_indexes: bool = False,
-                         engine: str = "local") -> OfflineVectorResponse:
+                         engine: str = "local",
+                         engine_args: dict | None = None
+                         ) -> OfflineVectorResponse:
     """Join the vector's feature sets into one offline dataframe
-    (reference api.py:99; merger analog retrieval/base.py:30)."""
+    (reference api.py:99). ``engine`` selects the merger: local (pandas),
+    partitioned (out-of-core single host), dask, spark — see
+    retrieval.py (reference analog retrieval/base.py:30)."""
+    from .retrieval import get_merger
+
     vector = _resolve_vector(feature_vector)
     project = getattr(vector.metadata, "project", "") or ""
-    merged: pd.DataFrame | None = entity_rows
-    for set_name, feature in vector.parse_features():
-        fset = _resolve_feature_set(set_name, project=project)
-        df = fset.to_dataframe()
-        entities = fset.entity_names
-        if feature != "*":
-            df = df[entities + [feature]]
-        if merged is None:
-            merged = df
-        else:
-            join_keys = [c for c in entities if c in merged.columns]
-            if not join_keys:
-                raise ValueError(
-                    f"no common entity columns to join feature set "
-                    f"'{set_name}' (entities={entities})")
-            merged = merged.merge(df, on=join_keys, how="left")
-    if merged is None:
-        raise ValueError("feature vector has no features")
-    if vector.spec.label_feature:
-        set_name, feature = vector.spec.label_feature.rsplit(".", 1)
-        fset = _resolve_feature_set(set_name, project=project)
-        df = fset.to_dataframe()[fset.entity_names + [feature]]
-        join_keys = [c for c in fset.entity_names if c in merged.columns]
-        merged = merged.merge(df, on=join_keys, how="left")
-    if drop_columns:
-        merged = merged.drop(columns=[c for c in drop_columns
-                                      if c in merged.columns])
-    if not (with_indexes or vector.spec.with_indexes):
-        entity_cols = set()
-        for set_name, _ in vector.parse_features():
-            entity_cols.update(
-                _resolve_feature_set(set_name, project=project).entity_names)
-        merged = merged.drop(
-            columns=[c for c in entity_cols if c in merged.columns])
+    merger = get_merger(engine, vector, project=project,
+                        **(engine_args or {}))
+    merged = merger.merge(entity_rows=entity_rows, drop_columns=drop_columns,
+                          with_indexes=with_indexes)
     response = OfflineVectorResponse(merged, vector)
     if target:
         path = target if isinstance(target, str) else getattr(

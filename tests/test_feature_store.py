@@ -192,3 +192,136 @@ def test_realtime_ingestion_service(isolated_home):
     df = pd.read_parquet(fs._target_path())
     assert set(df["user"]) == {"a", "b"}
     assert len(df) == 2  # deduped per entity
+
+
+def test_partitioned_merger_parity(stocks):
+    """Out-of-core hash-partitioned merge == pandas merge on the same data
+    (merge-engine seam; reference retrieval/base.py:30 engine selection)."""
+    fv = FeatureVector("v3", features=["stocks.price", "quotes.vol"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    local = get_offline_features(fv, engine="local").to_dataframe()
+    part = get_offline_features(
+        fv, engine="partitioned",
+        engine_args={"partitions": 3, "batch_rows": 2}).to_dataframe()
+    key = local.columns.tolist()
+    assert len(part) == len(local)
+    pd.testing.assert_frame_equal(
+        local.sort_values(key).reset_index(drop=True),
+        part[key].sort_values(key).reset_index(drop=True))
+
+
+def test_partitioned_merger_with_entity_rows_and_label(stocks):
+    fv = FeatureVector("v4", features=["stocks.price"])
+    fv.metadata.project = "fsproj"
+    fv.spec.label_feature = "quotes.vol"
+    fv.save()
+    entity_rows = pd.DataFrame({"ticker": ["B", "C", "A", "A"]})
+    local = get_offline_features(
+        fv, entity_rows=entity_rows, engine="local").to_dataframe()
+    part = get_offline_features(
+        fv, entity_rows=entity_rows, engine="partitioned",
+        engine_args={"partitions": 2}).to_dataframe()
+    key = local.columns.tolist()
+    pd.testing.assert_frame_equal(
+        local.sort_values(key).reset_index(drop=True),
+        part[key].sort_values(key).reset_index(drop=True))
+
+
+def test_partitioned_merger_larger_than_partition(isolated_home):
+    """1000 rows through 4 partitions with 64-row streaming batches."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    n = 1000
+    left = pd.DataFrame({"uid": np.arange(n),
+                         "a": rng.normal(size=n)})
+    right = pd.DataFrame({"uid": rng.permutation(n)[:700],
+                          "b": rng.normal(size=700)})
+    fs1 = FeatureSet("big1", entities=["uid"])
+    fs1.metadata.project = "fsproj"
+    ingest(fs1, left)
+    fs2 = FeatureSet("big2", entities=["uid"])
+    fs2.metadata.project = "fsproj"
+    ingest(fs2, right)
+    fv = FeatureVector("vbig", features=["big1.a", "big2.b"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    local = get_offline_features(fv, engine="local").to_dataframe()
+    part = get_offline_features(
+        fv, engine="partitioned",
+        engine_args={"partitions": 4, "batch_rows": 64}).to_dataframe()
+    key = local.columns.tolist()
+    assert len(part) == len(local) == n
+    pd.testing.assert_frame_equal(
+        local.sort_values(key).reset_index(drop=True),
+        part[key].sort_values(key).reset_index(drop=True))
+
+
+def test_dask_merger_parity(stocks):
+    """Gated: runs only where dask is installed (parity contract is the
+    same as the partitioned merger)."""
+    pytest.importorskip("dask.dataframe")
+    fv = FeatureVector("v5", features=["stocks.price", "quotes.vol"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    local = get_offline_features(fv, engine="local").to_dataframe()
+    dask_df = get_offline_features(fv, engine="dask").to_dataframe()
+    key = local.columns.tolist()
+    pd.testing.assert_frame_equal(
+        local.sort_values(key).reset_index(drop=True),
+        dask_df[key].sort_values(key).reset_index(drop=True))
+
+
+def test_unknown_engine_rejected(stocks):
+    fv = FeatureVector("v6", features=["stocks.price"])
+    fv.metadata.project = "fsproj"
+    fv.save()
+    with pytest.raises(ValueError, match="unknown offline merge engine"):
+        get_offline_features(fv, engine="nope")
+
+
+def test_partitioned_rebuckets_on_key_change(isolated_home):
+    """A join on ['user','day'] followed by a label join on ['user'] must
+    re-bucket — reusing the old buckets would silently mis-join."""
+    import numpy as np
+
+    rng = np.random.default_rng(2)
+    n = 200
+    users = rng.integers(0, 20, n)
+    days = rng.integers(0, 5, n)
+    base = pd.DataFrame({"user": users, "day": days}).drop_duplicates()
+    fs1 = FeatureSet("ud1", entities=["user", "day"])
+    fs1.metadata.project = "fsproj"
+    ingest(fs1, base.assign(a=rng.normal(size=len(base))))
+    fs2 = FeatureSet("ud2", entities=["user", "day"])
+    fs2.metadata.project = "fsproj"
+    ingest(fs2, base.assign(b=rng.normal(size=len(base))))
+    fs3 = FeatureSet("ulabel", entities=["user"])
+    fs3.metadata.project = "fsproj"
+    ingest(fs3, pd.DataFrame({"user": np.arange(20),
+                              "y": rng.normal(size=20)}))
+    fv = FeatureVector("vkeys", features=["ud1.a", "ud2.b"])
+    fv.metadata.project = "fsproj"
+    fv.spec.label_feature = "ulabel.y"
+    fv.save()
+    local = get_offline_features(fv, engine="local").to_dataframe()
+    part = get_offline_features(
+        fv, engine="partitioned",
+        engine_args={"partitions": 4, "batch_rows": 16}).to_dataframe()
+    key = local.columns.tolist()
+    assert part["y"].notna().all()  # every user has a label
+    pd.testing.assert_frame_equal(
+        local.sort_values(key).reset_index(drop=True),
+        part[key].sort_values(key).reset_index(drop=True))
+
+
+def test_ingest_entity_on_index(isolated_home):
+    """An entity carried as the DataFrame index is promoted to a column."""
+    df = pd.DataFrame({"price": [1.0, 2.0]},
+                      index=pd.Index(["A", "B"], name="ticker"))
+    fs = FeatureSet("idx", entities=["ticker"])
+    fs.metadata.project = "fsproj"
+    out = ingest(fs, df)
+    assert "ticker" in out.columns
+    assert sorted(out["ticker"]) == ["A", "B"]
