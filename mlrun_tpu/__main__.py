@@ -10,6 +10,7 @@ import json
 import os
 import pathlib
 import sys
+import tempfile
 
 import click
 
@@ -53,7 +54,11 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
         # embedded code (reference MLRUN_EXEC_CODE contract, __main__.py:313)
         code = os.environ.get(mlconf.exec_code_env)
         if code and not url:
-            url = "main.py"
+            # a private temp dir, NOT the cwd — with the local-process
+            # provider the subprocess inherits the service's cwd and a
+            # bare "main.py" would clobber whatever file lives there
+            code_dir = tempfile.mkdtemp(prefix="mlt-exec-")
+            url = os.path.join(code_dir, "main.py")
             pathlib.Path(url).write_text(
                 base64.b64decode(code).decode())
 

@@ -352,6 +352,36 @@ class HTTPRunDB(RunDBInterface):
         self.api_call("POST", self._path(project, "events", kind),
                       "emit event", json_body=event)
 
+    # -- project secrets (reference mlrun/db/httpdb.py:3034-3232; values
+    # are write-only over HTTP — list returns key names only) --------------
+    def create_project_secrets(self, project: str, secrets: dict,
+                               provider: str = "kubernetes"):
+        self.api_call(
+            "POST", self._path(project, "secrets"), "store secrets",
+            json_body={"provider": provider, "secrets": secrets})
+
+    # same operation under the server-side store's name, so code written
+    # against either db implementation (e.g. notification masking) works
+    store_project_secrets = create_project_secrets
+
+    def list_project_secret_keys(self, project: str,
+                                 provider: str = "kubernetes") -> list[str]:
+        resp = self.api_call(
+            "GET", self._path(project, "secret-keys"), "list secret keys",
+            params={"provider": provider})
+        return resp.get("secret_keys", [])
+
+    def delete_project_secrets(self, project: str,
+                               secrets: list | None = None,
+                               provider: str = "kubernetes"):
+        if secrets is not None and not secrets:
+            return  # an empty key list deletes nothing (None deletes all)
+        params: dict = {"provider": provider}
+        if secrets is not None:
+            params["secret"] = secrets
+        self.api_call("DELETE", self._path(project, "secrets"),
+                      "delete secrets", params=params)
+
     # -- submit / build -----------------------------------------------------
     def submit_job(self, runspec: dict, schedule=None) -> dict:
         body = dict(runspec)

@@ -90,6 +90,11 @@ CREATE TABLE IF NOT EXISTS runtime_resources (
     resource_id TEXT, started REAL,
     PRIMARY KEY (project, uid)
 );
+CREATE TABLE IF NOT EXISTS project_secrets (
+    project TEXT NOT NULL, provider TEXT NOT NULL DEFAULT 'kubernetes',
+    name TEXT NOT NULL, value TEXT,
+    PRIMARY KEY (project, provider, name)
+);
 CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
 CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 """
@@ -268,6 +273,53 @@ class SQLiteRunDB(RunDBInterface):
         self._execute(
             "DELETE FROM runtime_resources WHERE project=? AND uid=?",
             (project, uid))
+
+    # -- project secrets (reference: mlrun/db/httpdb.py:3034-3232 client +
+    # k8s-secret store server-side; here a DB-backed store whose VALUES are
+    # only readable server-side — the HTTP surface exposes keys alone) -----
+    def store_project_secrets(self, project: str, secrets: dict,
+                              provider: str = "kubernetes"):
+        project = self._project_or_default(project)
+        for name, value in (secrets or {}).items():
+            self._execute(
+                "INSERT OR REPLACE INTO project_secrets "
+                "(project, provider, name, value) VALUES (?,?,?,?)",
+                (project, provider, name, str(value)))
+
+    def list_project_secret_keys(self, project: str,
+                                 provider: str = "kubernetes") -> list[str]:
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT name FROM project_secrets WHERE project=? AND provider=? "
+            "ORDER BY name", (project, provider))
+        return [row["name"] for row in rows]
+
+    def get_project_secrets(self, project: str, keys: list | None = None,
+                            provider: str = "kubernetes") -> dict:
+        """Server-side only: returns secret VALUES (never exposed over the
+        REST list surface)."""
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT name, value FROM project_secrets "
+            "WHERE project=? AND provider=?", (project, provider))
+        out = {row["name"]: row["value"] for row in rows}
+        if keys is not None:
+            out = {k: v for k, v in out.items() if k in keys}
+        return out
+
+    def delete_project_secrets(self, project: str, keys: list | None = None,
+                               provider: str = "kubernetes"):
+        project = self._project_or_default(project)
+        if keys is None:
+            self._execute(
+                "DELETE FROM project_secrets WHERE project=? AND provider=?",
+                (project, provider))
+            return
+        for key in keys:
+            self._execute(
+                "DELETE FROM project_secrets "
+                "WHERE project=? AND provider=? AND name=?",
+                (project, provider, key))
 
     # -- logs --------------------------------------------------------------
     def _log_path(self, project: str, uid: str) -> str:

@@ -154,7 +154,16 @@ class BaseLauncher(ABC):
         from ..utils.notifications import NotificationPusher
 
         try:
-            NotificationPusher([run]).push()
+            run_dict = run.to_dict()
+            NotificationPusher([run_dict]).push()
+            # persist sent/error statuses so the server-side monitor does
+            # not push the same notifications again on resource retirement
+            specs = run_dict.get("spec", {}).get("notifications")
+            from ..db import get_run_db
+
+            get_run_db().update_run(
+                {"spec.notifications": specs},
+                run.metadata.uid, run.metadata.project)
         except Exception as exc:  # noqa: BLE001
             logger.warning("notification push failed", error=str(exc))
 

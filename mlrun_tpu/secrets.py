@@ -51,7 +51,13 @@ class SecretsStore:
             raise ValueError(f"unsupported secrets source kind '{kind}'")
 
     def get(self, key: str, default: str | None = None):
-        return self._secrets.get(key, os.environ.get(key, default))
+        if key in self._secrets:
+            return self._secrets[key]
+        if key in os.environ:
+            return os.environ[key]
+        # project secrets injected into resources arrive as MLT_SECRET_*
+        # env (service runtime_handlers._secret_env)
+        return os.environ.get("MLT_SECRET_" + key, default)
 
     def items(self):
         return self._secrets.items()

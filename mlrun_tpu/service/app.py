@@ -324,6 +324,11 @@ def build_app(state: ServiceState | None = None) -> web.Application:
                                 or mlconf.default_project)
         runtime = rebuild_function(function_dict)
         run.metadata.labels.setdefault("kind", runtime.kind)
+        # notification secret-params never reach the stored run or the
+        # resource env (reference api/utils.py:221 mask_notification_params)
+        from .secrets import mask_notification_params
+
+        mask_notification_params(state.db, run)
 
         if schedule:
             record = {
@@ -613,6 +618,35 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             project=request.match_info["project"])
         return json_response({"api_gateways": [
             f for f in funcs if f.get("kind") == "api-gateway"]})
+
+    # -- project secrets (reference: server/api/api/endpoints/secrets.py;
+    # values are write/delete-only over REST — the list surface returns
+    # keys alone) ----------------------------------------------------------
+    @r.post(API + "/projects/{project}/secrets")
+    async def store_project_secrets(request):
+        body = await request.json()
+        provider = body.get("provider", "kubernetes")
+        secrets = body.get("secrets") or {}
+        if not isinstance(secrets, dict):
+            return error_response("secrets must be a mapping")
+        state.db.store_project_secrets(
+            request.match_info["project"], secrets, provider=provider)
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/secret-keys")
+    async def list_project_secret_keys(request):
+        provider = request.query.get("provider", "kubernetes")
+        keys = state.db.list_project_secret_keys(
+            request.match_info["project"], provider=provider)
+        return json_response({"secret_keys": keys})
+
+    @r.delete(API + "/projects/{project}/secrets")
+    async def delete_project_secrets(request):
+        provider = request.query.get("provider", "kubernetes")
+        keys = request.query.getall("secret", []) or None
+        state.db.delete_project_secrets(
+            request.match_info["project"], keys=keys, provider=provider)
+        return json_response({"ok": True})
 
     # -- operations / introspection ---------------------------------------------
     @r.get(API + "/operations/memory-report")

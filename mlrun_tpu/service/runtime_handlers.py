@@ -204,6 +204,26 @@ class KubernetesProvider(Provider):
         else:
             self._core.delete_namespaced_pod(name, self.namespace)
 
+    def ensure_project_secret(self, project: str, secrets: dict) -> str:
+        """Create/replace the project's k8s Secret and return its name."""
+        import base64
+
+        import kubernetes
+
+        name = f"mlrun-tpu-secrets-{project}"
+        body = kubernetes.client.V1Secret(
+            metadata=kubernetes.client.V1ObjectMeta(
+                name=name, labels={"mlrun-tpu/project": project}),
+            data={k: base64.b64encode(str(v).encode()).decode()
+                  for k, v in secrets.items()})
+        try:
+            self._core.replace_namespaced_secret(name, self.namespace, body)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+            self._core.create_namespaced_secret(self.namespace, body)
+        return name
+
     def list_resources(self, class_label: str) -> list[tuple[str, str, str]]:
         """Discover live cluster resources by label selector (reference
         base.py:65,189 recovers handler state the same way). Returns
@@ -273,6 +293,7 @@ class BaseRuntimeHandler:
 
     def run(self, runtime, run: RunObject, execution=None) -> dict:
         resource = self.build_resource(runtime, run)
+        self._apply_secret_projection(resource, run.metadata.project)
         resource_id = self.provider.create(resource, run.metadata.uid)
         started = time.time()
         with self._lock:
@@ -389,6 +410,7 @@ class BaseRuntimeHandler:
                 updates["status.state"] = run_state
             self.db.update_run(updates, uid, project)
             self._forget(uid, project)
+            self._push_notifications(uid, project, run)
             return
         # stuck-state thresholds (reference base.py:518)
         threshold = self._state_threshold(run, run_state)
@@ -402,6 +424,55 @@ class BaseRuntimeHandler:
                  f"stuck in state {run_state} over {threshold}s"},
                 uid, project)
             self._forget(uid, project)
+
+    def _push_notifications(self, uid: str, project: str, run: dict):
+        """Server-side push when the monitor retires a terminal resource —
+        the only place masked (secret-backed) notification params can be
+        resolved (reference RunNotificationPusher). ``run`` is the dict the
+        monitor already read; statuses are re-read so an in-run push that
+        landed after the monitor's read is not repeated."""
+        if not get_in(run, "spec.notifications"):
+            return
+        run = self.db.read_run(uid, project) or run
+        specs = run.get("spec", {}).get("notifications") or []
+        # the in-run process already pushed what it could (unmasked specs);
+        # the server covers masked ones and anything not yet sent
+        pending = [s for s in specs if isinstance(s, dict)
+                   and s.get("status") != "sent"]
+        if not pending:
+            return
+        from ..utils.notifications import NotificationPusher
+        from .secrets import NOTIFICATION_SECRET_PREFIX, \
+            resolve_notification_params
+
+        run = dict(run)
+        run["spec"] = dict(run["spec"])
+        run["spec"]["notifications"] = pending
+        try:
+            NotificationPusher(
+                [run],
+                secret_resolver=lambda proj, params:
+                resolve_notification_params(self.db, proj, params)).push()
+            # pending entries are the same dict objects as in specs, so
+            # their pushed statuses are visible in the full list
+            self.db.update_run({"spec.notifications": specs}, uid, project)
+        except Exception as exc:  # noqa: BLE001 - notification is best-effort
+            logger.warning("server-side notification push failed", uid=uid,
+                           error=str(exc))
+        # per-run notification secrets are single-use — drop them so the
+        # store (and any projected k8s Secret) does not grow unboundedly
+        drop = getattr(self.db, "delete_project_secrets", None)
+        if drop:
+            used = [s.get("params", {}).get("secret") for s in specs
+                    if isinstance(s, dict)
+                    and (s.get("params") or {}).get("secret", "").startswith(
+                        NOTIFICATION_SECRET_PREFIX)]
+            if used:
+                try:
+                    drop(project, keys=[k for k in used if k])
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("notification secret cleanup failed",
+                                   error=str(exc))
 
     def _delete_quietly(self, resource_id: str):
         try:
@@ -421,6 +492,34 @@ class BaseRuntimeHandler:
         if state == RunStates.running:
             return float(thresholds.get("executing", -1))
         return -1
+
+    def _secret_env(self, project: str) -> dict:
+        """Project secrets as MLT_SECRET_* env for the resource. With a
+        kubernetes provider the values ride a k8s Secret + envFrom instead
+        (``_apply_secret_projection``) so they never appear in the pod
+        manifest; the local provider carries them as plain subprocess env."""
+        if hasattr(self.provider, "ensure_project_secret"):
+            return {}
+        from .secrets import project_secret_env
+
+        return project_secret_env(self.db, project)
+
+    def _apply_secret_projection(self, resource: dict, project: str):
+        """Project the project-secret store into the pod spec via a k8s
+        Secret object + envFrom secretRef (reference pod.py secret mounts)."""
+        ensure = getattr(self.provider, "ensure_project_secret", None)
+        if ensure is None:
+            return
+        from .secrets import project_secret_env
+
+        secrets = project_secret_env(self.db, project)
+        if not secrets:
+            return
+        secret_name = ensure(project, secrets)
+        pod_spec = _extract_pod_spec(resource)
+        for container in pod_spec.get("containers", []):
+            container.setdefault("envFrom", []).append(
+                {"secretRef": {"name": secret_name}})
 
     def delete_resources(self, uid: str):
         with self._lock:
@@ -450,6 +549,7 @@ class KubeJobHandler(BaseRuntimeHandler):
             "MLT_DBPATH": mlconf.get("dbpath", "")
             or f"http://127.0.0.1:{mlconf.httpdb.port}",
         }
+        env.update(self._secret_env(run.metadata.project))
         build = runtime.spec.build
         if build and build.functionSourceCode:
             env[mlconf.exec_code_env] = build.functionSourceCode
@@ -484,6 +584,7 @@ class TpuJobHandler(BaseRuntimeHandler):
             "MLT_DBPATH": mlconf.get("dbpath", "")
             or f"http://127.0.0.1:{mlconf.httpdb.port}",
         }
+        env.update(self._secret_env(run.metadata.project))
         build = runtime.spec.build
         if build and build.functionSourceCode:
             env[mlconf.exec_code_env] = build.functionSourceCode

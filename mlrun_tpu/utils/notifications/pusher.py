@@ -9,8 +9,13 @@ from .notification import notification_types
 
 
 class NotificationPusher:
-    def __init__(self, runs: list):
+    def __init__(self, runs: list, secret_resolver=None):
+        """``secret_resolver(project, params) -> params`` resolves masked
+        (secret-backed) notification params — available server-side only;
+        without it masked notifications are skipped (the service pushes
+        them when the run reaches a terminal state)."""
         self._runs = runs
+        self._secret_resolver = secret_resolver
 
     def push(self):
         for run in self._runs:
@@ -23,19 +28,33 @@ class NotificationPusher:
                         continue
                     self._push_one(spec, run_dict, state)
 
-    @staticmethod
-    def _push_one(spec: dict, run_dict: dict, state: str):
+    def _push_one(self, spec: dict, run_dict: dict, state: str):
         kind = spec.get("kind", "console")
         cls = notification_types.get(kind)
         if cls is None:
             logger.warning("unknown notification kind", kind=kind)
             return
         meta = run_dict.get("metadata", {})
+        params = spec.get("params", {}) or {}
+        if params.get("secret"):
+            if self._secret_resolver is None:
+                logger.debug(
+                    "skipping secret-backed notification (pushed "
+                    "server-side)", kind=kind)
+                return
+            try:
+                params = self._secret_resolver(meta.get("project", ""),
+                                               params)
+            except Exception as exc:  # noqa: BLE001
+                spec["status"] = "error"
+                logger.warning("notification secret resolution failed",
+                               kind=kind, error=str(exc))
+                return
         message = spec.get("message") or (
             f"run {meta.get('project')}/{meta.get('name')} finished: {state}")
         severity = spec.get("severity", "info")
         try:
-            cls(spec.get("name", ""), spec.get("params", {})).push(
+            cls(spec.get("name", ""), params).push(
                 message, severity, [run_dict])
             spec["status"] = "sent"
             spec["sent_time"] = now_iso()
