@@ -127,6 +127,44 @@ class HTTPRunDB(RunDBInterface):
                              params=params)
         return resp.get("runs", [])
 
+    @staticmethod
+    def _encode_list_filters(filters: dict) -> dict:
+        """Map pythonic filter kwargs onto the server's query encoding
+        (same mapping list_runs/list_artifacts use inline)."""
+        params = dict(filters)
+        labels = params.pop("labels", None)
+        if labels:
+            params["label"] = labels if isinstance(labels, list) else [
+                f"{k}={v}" for k, v in labels.items()]
+        if "iter" in params:
+            params["iter"] = int(bool(params["iter"]))
+        return {k: v for k, v in params.items() if v not in (None, "")}
+
+    def paginated_list_runs(self, project="", page_size=20, page_token="",
+                            **filters) -> tuple[list, str | None]:
+        """Token-paginated listing (reference httpdb.py:304). Returns
+        (runs, next_token); pass next_token back until it is None."""
+        params = self._encode_list_filters(filters)
+        params["page_size"] = page_size
+        if page_token:
+            params["page_token"] = page_token
+        resp = self.api_call("GET", self._path(project, "runs"),
+                             "list runs", params=params)
+        return (resp.get("runs", []),
+                (resp.get("pagination") or {}).get("page_token"))
+
+    def paginated_list_artifacts(self, project="", page_size=20,
+                                 page_token="", **filters
+                                 ) -> tuple[list, str | None]:
+        params = self._encode_list_filters(filters)
+        params["page_size"] = page_size
+        if page_token:
+            params["page_token"] = page_token
+        resp = self.api_call("GET", self._path(project, "artifacts"),
+                             "list artifacts", params=params)
+        return (resp.get("artifacts", []),
+                (resp.get("pagination") or {}).get("page_token"))
+
     def del_run(self, uid, project="", iter=0):
         self.api_call("DELETE", self._path(project, "runs", uid), "del run",
                       params={"iter": iter})

@@ -95,9 +95,43 @@ CREATE TABLE IF NOT EXISTS project_secrets (
     name TEXT NOT NULL, value TEXT,
     PRIMARY KEY (project, provider, name)
 );
+CREATE TABLE IF NOT EXISTS pagination_cache (
+    token TEXT PRIMARY KEY, method TEXT, filters TEXT,
+    next_offset INTEGER, created TEXT
+);
 CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
 CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 """
+
+# Schema versioning via PRAGMA user_version (reference analog: the 29
+# Alembic migrations under server/api/migrations/). A fresh DB is created
+# at SCHEMA_VERSION; an existing DB replays only the missing migrations in
+# order. Version 1 is the round-1 pre-versioning schema (user_version 0
+# with a populated sqlite_master).
+SCHEMA_VERSION = 4
+
+_MIGRATIONS: dict[int, str] = {
+    2: """
+CREATE TABLE IF NOT EXISTS runtime_resources (
+    project TEXT NOT NULL, uid TEXT NOT NULL, kind TEXT,
+    resource_id TEXT, started REAL,
+    PRIMARY KEY (project, uid)
+);
+""",
+    3: """
+CREATE TABLE IF NOT EXISTS project_secrets (
+    project TEXT NOT NULL, provider TEXT NOT NULL DEFAULT 'kubernetes',
+    name TEXT NOT NULL, value TEXT,
+    PRIMARY KEY (project, provider, name)
+);
+""",
+    4: """
+CREATE TABLE IF NOT EXISTS pagination_cache (
+    token TEXT PRIMARY KEY, method TEXT, filters TEXT,
+    next_offset INTEGER, created TEXT
+);
+""",
+}
 
 
 def _labels_match(body: dict, labels) -> bool:
@@ -152,8 +186,31 @@ class SQLiteRunDB(RunDBInterface):
         return conn
 
     def _init_schema(self):
-        self._conn.executescript(_SCHEMA)
-        self._conn.commit()
+        conn = self._conn
+        version = conn.execute("PRAGMA user_version").fetchone()[0]
+        if version == 0:
+            populated = conn.execute(
+                "SELECT name FROM sqlite_master "
+                "WHERE type='table' AND name='runs'").fetchone()
+            if populated:
+                version = 1  # pre-versioning (round-1) deployment
+            else:
+                conn.executescript(_SCHEMA)
+                conn.execute(f"PRAGMA user_version={SCHEMA_VERSION}")
+                conn.commit()
+                return
+        if version > SCHEMA_VERSION:
+            raise RunDBError(
+                f"database schema version {version} is newer than this "
+                f"build supports ({SCHEMA_VERSION})")
+        for target in range(version + 1, SCHEMA_VERSION + 1):
+            conn.executescript(_MIGRATIONS[target])
+            conn.execute(f"PRAGMA user_version={target}")
+            conn.commit()
+
+    @property
+    def schema_version(self) -> int:
+        return self._conn.execute("PRAGMA user_version").fetchone()[0]
 
     def _execute(self, sql: str, params: tuple = ()):
         cur = self._conn.execute(sql, params)
@@ -246,6 +303,66 @@ class SQLiteRunDB(RunDBInterface):
                                   state=state, iter=True):
             self.del_run(get_in(run, "metadata.uid"), project,
                          get_in(run, "metadata.iteration", 0))
+
+    # -- token pagination (reference analog: pagination_cache in
+    # server/api/db/sqldb/models.py + paginated list calls in
+    # mlrun/db/httpdb.py:304). A token is an opaque handle to a cached
+    # (method, filters, position); the same token advances in place on
+    # each page and is dropped when the listing is exhausted. ---------------
+    _PAGE_TOKEN_TTL_SECONDS = 3600
+
+    def paginated_list(self, method: str, page_size: int = 20,
+                       page_token: str = "", **filters
+                       ) -> tuple[list, Optional[str]]:
+        """Page through any list_* method with an opaque token. Returns
+        (items, next_token); next_token is None on the last page.
+
+        Positioning is offset-based over a re-executed query (the filters
+        travel with the token), matching the reference's pagination-cache
+        semantics: rows inserted/deleted mid-walk can shift later pages.
+        """
+        import secrets as pysecrets
+        from datetime import datetime, timedelta, timezone
+
+        page_size = max(1, int(page_size))
+        now = datetime.now(timezone.utc)
+        self._execute(
+            "DELETE FROM pagination_cache WHERE created < ?",
+            ((now - timedelta(
+                seconds=self._PAGE_TOKEN_TTL_SECONDS)).isoformat(),))
+        if page_token:
+            rows = self._query(
+                "SELECT method, filters, next_offset FROM pagination_cache "
+                "WHERE token=?", (page_token,))
+            if not rows:
+                raise RunDBError(f"invalid or expired page token "
+                                 f"'{page_token}'")
+            if rows[0]["method"] != method:
+                raise RunDBError(
+                    f"page token was issued for {rows[0]['method']!r}, "
+                    f"not {method!r}")
+            filters = json.loads(rows[0]["filters"])
+            offset = int(rows[0]["next_offset"])
+        else:
+            offset = 0
+        if not method.startswith("list_") or not hasattr(self, method):
+            raise RunDBError(f"unknown list method '{method}'")
+        items = getattr(self, method)(**filters)
+        page = items[offset:offset + page_size]
+        next_offset = offset + page_size
+        if next_offset >= len(items):
+            if page_token:
+                self._execute("DELETE FROM pagination_cache WHERE token=?",
+                              (page_token,))
+            return page, None
+        token = page_token or pysecrets.token_urlsafe(16)
+        self._execute(
+            "INSERT OR REPLACE INTO pagination_cache "
+            "(token, method, filters, next_offset, created) "
+            "VALUES (?,?,?,?,?)",
+            (token, method, json.dumps(filters), next_offset,
+             now.isoformat()))
+        return page, token
 
     # -- runtime resources (durable handler state; reference recovers by
     # listing cluster resources per label selector, base.py:65 — here the

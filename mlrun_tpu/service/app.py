@@ -31,6 +31,23 @@ from .runtime_handlers import LocalProcessProvider
 API = mlconf.api_base_path.rstrip("/")
 
 
+def token_paginated_response(state, request, method: str, key: str,
+                             filters: dict):
+    """Token-pagination branch shared by list endpoints: parse page
+    params, delegate to the DB pagination cache, shape the response."""
+    from ..db.base import RunDBError
+
+    q = request.query
+    try:
+        items, token = state.db.paginated_list(
+            method, page_size=int(q.get("page_size", 20)),
+            page_token=q.get("page_token", ""), **filters)
+    except (RunDBError, ValueError) as exc:
+        return error_response(str(exc), 400)
+    return json_response({key: items,
+                          "pagination": {"page_token": token}})
+
+
 def paginate(items: list, request) -> list:
     """limit/offset slicing for list endpoints (reference pagination
     analog — token-based pagination cache is R2)."""
@@ -121,11 +138,15 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     @r.get(API + "/projects/{project}/runs")
     async def list_runs(request):
         q = request.query
-        runs = state.db.list_runs(
+        filters = dict(
             name=q.get("name", ""), project=request.match_info["project"],
             state=q.get("state", ""), labels=q.getall("label", None),
             last=int(q.get("last", 0)), iter=bool(int(q.get("iter", 0))),
             uid=q.getall("uid", None))
+        if "page_size" in q or "page_token" in q:
+            return token_paginated_response(state, request, "list_runs",
+                                            "runs", filters)
+        runs = state.db.list_runs(**filters)
         return json_response({"runs": paginate(runs, request)})
 
     @r.delete(API + "/projects/{project}/runs/{uid}")
@@ -204,10 +225,14 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     @r.get(API + "/projects/{project}/artifacts")
     async def list_artifacts(request):
         q = request.query
-        artifacts = state.db.list_artifacts(
+        filters = dict(
             name=q.get("name", ""), project=request.match_info["project"],
             tag=q.get("tag"), labels=q.getall("label", None),
             kind=q.get("kind"), tree=q.get("tree"))
+        if "page_size" in q or "page_token" in q:
+            return token_paginated_response(
+                state, request, "list_artifacts", "artifacts", filters)
+        artifacts = state.db.list_artifacts(**filters)
         return json_response(
             {"artifacts": paginate(artifacts, request)})
 

@@ -1,0 +1,147 @@
+"""Schema versioning (PRAGMA user_version migrations; reference analog:
+server/api/migrations/ Alembic chain) and token pagination (reference
+analog: pagination_cache, mlrun/db/httpdb.py:304)."""
+
+import sqlite3
+
+import pytest
+
+from mlrun_tpu.db.base import RunDBError
+from mlrun_tpu.db.sqlitedb import SCHEMA_VERSION, SQLiteRunDB
+
+# the round-1 schema (user_version 0, no runtime_resources /
+# project_secrets / pagination_cache tables) — a real pre-versioning DB
+_V1_SCHEMA = """
+CREATE TABLE runs (
+    project TEXT NOT NULL, uid TEXT NOT NULL,
+    iteration INTEGER NOT NULL DEFAULT 0,
+    name TEXT, state TEXT, start_time TEXT, last_update TEXT, body TEXT,
+    PRIMARY KEY (project, uid, iteration)
+);
+CREATE TABLE artifacts (
+    project TEXT NOT NULL, key TEXT NOT NULL, uid TEXT NOT NULL,
+    tree TEXT, iteration INTEGER DEFAULT 0, tag TEXT, kind TEXT,
+    updated TEXT, body TEXT,
+    PRIMARY KEY (project, key, uid)
+);
+"""
+
+
+def test_migrates_v1_file_to_current(tmp_path):
+    path = str(tmp_path / "old.sqlite")
+    conn = sqlite3.connect(path)
+    conn.executescript(_V1_SCHEMA)
+    conn.execute(
+        "INSERT INTO runs (project, uid, iteration, name, state, body) "
+        "VALUES ('p', 'u1', 0, 'r1', 'completed', "
+        "'{\"metadata\": {\"uid\": \"u1\"}, "
+        "\"status\": {\"state\": \"completed\"}}')")
+    conn.commit()
+    conn.close()
+
+    db = SQLiteRunDB(path, logs_dir=str(tmp_path / "logs"))
+    assert db.schema_version == SCHEMA_VERSION
+    # pre-existing data survives
+    run = db.read_run("u1", "p")
+    assert run["status"]["state"] == "completed"
+    # migrated tables are usable
+    db.store_runtime_resource("u1", "p", "job", "proc-1-2", 0.0)
+    assert db.list_runtime_resources()[0]["uid"] == "u1"
+    db.store_project_secrets("p", {"K": "v"})
+    assert db.list_project_secret_keys("p") == ["K"]
+
+
+def test_fresh_db_created_at_current_version(tmp_path):
+    db = SQLiteRunDB(str(tmp_path / "new.sqlite"),
+                     logs_dir=str(tmp_path / "logs"))
+    assert db.schema_version == SCHEMA_VERSION
+
+
+def test_newer_schema_rejected(tmp_path):
+    path = str(tmp_path / "future.sqlite")
+    conn = sqlite3.connect(path)
+    conn.executescript(_V1_SCHEMA)
+    conn.execute(f"PRAGMA user_version={SCHEMA_VERSION + 1}")
+    conn.commit()
+    conn.close()
+    with pytest.raises(RunDBError, match="newer than this build"):
+        SQLiteRunDB(path, logs_dir=str(tmp_path / "logs"))
+
+
+def test_reopen_is_idempotent(tmp_path):
+    path = str(tmp_path / "re.sqlite")
+    SQLiteRunDB(path, logs_dir=str(tmp_path / "logs"))
+    db = SQLiteRunDB(path, logs_dir=str(tmp_path / "logs"))
+    assert db.schema_version == SCHEMA_VERSION
+
+
+def test_token_pagination_walks_all_pages(tmp_path):
+    db = SQLiteRunDB(str(tmp_path / "p.sqlite"),
+                     logs_dir=str(tmp_path / "logs"))
+    for i in range(25):
+        db.store_run({"metadata": {"uid": f"u{i:02d}", "name": "sweep"},
+                      "status": {"state": "completed"}}, f"u{i:02d}", "pp")
+    db.store_run({"metadata": {"uid": "other", "name": "different"},
+                  "status": {"state": "completed"}}, "other", "pp")
+
+    seen = []
+    page, token = db.paginated_list("list_runs", page_size=10,
+                                    project="pp", name="sweep")
+    seen += page
+    assert len(page) == 10 and token
+    page, token = db.paginated_list("list_runs", page_token=token,
+                                    page_size=10)
+    seen += page
+    assert len(page) == 10 and token
+    page, token = db.paginated_list("list_runs", page_token=token,
+                                    page_size=10)
+    seen += page
+    assert len(page) == 5 and token is None  # exhausted
+    uids = {r["metadata"]["uid"] for r in seen}
+    assert len(uids) == 25 and "other" not in uids  # filter held via token
+
+    with pytest.raises(RunDBError, match="invalid or expired"):
+        db.paginated_list("list_runs", page_token="bogus")
+
+
+def test_pagination_over_http(service, http_db):
+    url, state = service
+    for i in range(7):
+        state.db.store_run({"metadata": {"uid": f"h{i}", "name": "hr"},
+                            "status": {"state": "completed"}}, f"h{i}", "hp")
+    runs, token = http_db.paginated_list_runs("hp", page_size=3)
+    assert len(runs) == 3 and token
+    runs2, token = http_db.paginated_list_runs("hp", page_size=3,
+                                               page_token=token)
+    assert len(runs2) == 3 and token
+    runs3, token = http_db.paginated_list_runs("hp", page_size=3,
+                                               page_token=token)
+    assert len(runs3) == 1 and token is None
+    with pytest.raises(RunDBError, match="invalid or expired"):
+        http_db.paginated_list_runs("hp", page_token="bogus")
+
+
+def test_pagination_edge_cases(tmp_path, service, http_db):
+    url, state = service
+    for i in range(3):
+        state.db.store_run({"metadata": {"uid": f"e{i}", "name": "er"},
+                            "status": {"state": "completed"}}, f"e{i}", "ep")
+    # page_size <= 0 clamps to 1 (never an infinite empty-page loop)
+    page, token = state.db.paginated_list("list_runs", page_size=0,
+                                          project="ep")
+    assert len(page) == 1 and token
+    # a token is bound to its method
+    with pytest.raises(RunDBError, match="issued for"):
+        state.db.paginated_list("list_artifacts", page_token=token)
+    # malformed page_size over HTTP -> 400, not 500
+    import requests
+
+    resp = requests.get(f"{url}/api/v1/projects/ep/runs?page_size=abc")
+    assert resp.status_code == 400
+    # label filters survive the client encoding
+    state.db.store_run({"metadata": {"uid": "lab1", "name": "lr",
+                                     "labels": {"team": "a"}},
+                        "status": {"state": "completed"}}, "lab1", "ep")
+    runs, _ = http_db.paginated_list_runs("ep", page_size=10,
+                                          labels={"team": "a"})
+    assert [r["metadata"]["uid"] for r in runs] == ["lab1"]
