@@ -123,6 +123,8 @@ def train(context: MLClientCtx | None = None,
           lora_alpha: float = 32.0,
           grad_accum: int = 1,
           mesh_shape: dict | None = None,
+          context_parallel: str | None = None,
+          seq_axis: str | None = None,
           checkpoint_dir: str = "",
           checkpoint_every: int = 0,
           resume: bool = True,
@@ -148,9 +150,14 @@ def train(context: MLClientCtx | None = None,
     initialize_distributed()
 
     model_config = _resolve_model_config(model, model_overrides)
+    if context_parallel and not mesh_shape:
+        # long-context default: all chips on the sequence axis
+        mesh_shape = {seq_axis or "seq": jax.device_count()}
     train_config = TrainConfig(
         learning_rate=learning_rate, total_steps=steps, lora_rank=lora_rank,
-        lora_alpha=lora_alpha, grad_accum=grad_accum, mesh_shape=mesh_shape)
+        lora_alpha=lora_alpha, grad_accum=grad_accum, mesh_shape=mesh_shape,
+        context_parallel=context_parallel,
+        seq_axis=seq_axis or ("seq" if context_parallel else None))
     mesh = make_mesh(mesh_shape)
     trainer = Trainer(model_config, train_config, mesh=mesh)
     trainer.init(seed)

@@ -51,7 +51,9 @@ class TrainConfig:
     # (0 = full logits). 512 is a good default for 128k vocab.
     loss_chunk: int = 512
     # long-context: "ring" | "ulysses" shards the SEQUENCE over seq_axis
-    # inside the step (models/llama_cp). Full fine-tune only for now.
+    # inside the step (models/llama_cp). Composes with LoRA and grad_accum;
+    # mesh may be seq-only or data x seq (fsdp/tensor can't combine with
+    # CP under jax 0.9 — see make_train_step).
     context_parallel: str | None = None
 
 
@@ -76,6 +78,35 @@ jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
 
 
+def accumulate_grads(compute_grads: Callable, target_tree, tokens, targets,
+                     accum: int):
+    """Gradient accumulation shared by the plain and context-parallel
+    steps: split the batch into ``accum`` micro-batches, scan
+    ``compute_grads(tokens, targets) -> (grads, metrics)``, average the
+    gradients, and report the last micro-batch's metrics."""
+    b = tokens.shape[0]
+    if b < accum or b % accum:
+        raise ValueError(
+            f"grad_accum={accum} needs a batch divisible by it "
+            f"(got batch={b}); a non-multiple would silently drop samples "
+            "and an empty micro-batch yields NaN loss")
+    micro = b // accum
+    tok = tokens.reshape(accum, micro, -1)
+    tgt = targets.reshape(accum, micro, -1)
+
+    def body(grads_sum, xs):
+        t, g = xs
+        grads, metrics = compute_grads(t, g)
+        return jax.tree_util.tree_map(
+            lambda a, b_: a + b_, grads_sum, grads), metrics
+
+    zero = jax.tree_util.tree_map(jnp.zeros_like, target_tree)
+    grads, metrics_stack = jax.lax.scan(body, zero, (tok, tgt))
+    grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+    metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics_stack)
+    return grads, metrics
+
+
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, config.learning_rate, config.warmup_steps,
@@ -97,23 +128,23 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
     accum = max(1, train_config.grad_accum)
 
     if train_config.context_parallel:
-        if is_lora or accum > 1:
-            raise ValueError(
-                "context_parallel currently supports full fine-tune with "
-                "grad_accum=1 (LoRA/accum variants tracked for R2)")
         seq_axis = train_config.seq_axis or "seq"
         if seq_axis not in mesh.axis_names:
             raise ValueError(
                 f"context_parallel needs a '{seq_axis}' axis in the mesh")
-        if any(mesh.shape[a] > 1 for a in mesh.axis_names if a != seq_axis):
+        offending = [a for a in mesh.axis_names
+                     if a not in (seq_axis, "data") and mesh.shape[a] > 1]
+        if offending:
             # jax 0.9 XLA CHECK-crashes on backward through partial-manual
-            # shard_map when another mesh axis is active; CP training is
-            # seq-only until that is fixed (the CP LOSS works on mixed
-            # meshes — see models/llama_cp + tests)
+            # shard_map when an auto axis is active. The 'data' axis is
+            # supported via the full-manual data x seq mode (params
+            # replicated over data); fsdp/tensor cannot combine with CP
+            # until the compiler bug is fixed — scale batch with
+            # grad_accum instead.
             raise ValueError(
-                "context_parallel training currently requires a seq-only "
-                "mesh (e.g. {'seq': N}); mixed data x seq hits an XLA "
-                "compiler bug in this jax version")
+                f"context_parallel training supports seq-only or "
+                f"data x seq meshes in this jax version (active axes "
+                f"{offending} cannot combine with '{seq_axis}')")
         return _make_cp_step(model_config, train_config, optimizer, mesh,
                              seq_axis, rules)
 
@@ -153,25 +184,10 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
 
     def step_fn(state: TrainState, tokens, targets):
         if accum > 1:
-            b = tokens.shape[0]
-            micro = b // accum
-            tok = tokens[: micro * accum].reshape(accum, micro, -1)
-            tgt = targets[: micro * accum].reshape(accum, micro, -1)
-
-            def accum_body(carry, xs):
-                grads_sum, _ = carry
-                t, g = xs
-                grads, metrics = compute_grads(state.params, state.lora, t, g)
-                grads_sum = jax.tree_util.tree_map(
-                    lambda a, b_: a + b_, grads_sum, grads)
-                return (grads_sum, metrics), None
-
-            zero = jax.tree_util.tree_map(
-                jnp.zeros_like, state.lora if is_lora else state.params)
-            (grads, metrics), _ = jax.lax.scan(
-                accum_body, (zero, {"loss": 0.0, "accuracy": 0.0,
-                                    "tokens": 0.0}), (tok, tgt))
-            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            grads, metrics = accumulate_grads(
+                lambda t, g: compute_grads(state.params, state.lora, t, g),
+                state.lora if is_lora else state.params,
+                tokens, targets, accum)
         else:
             grads, metrics = compute_grads(state.params, state.lora, tokens,
                                            targets)
@@ -229,20 +245,24 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
 def _make_cp_step(model_config, train_config, optimizer, mesh, seq_axis,
                   rules):
     """Context-parallel step adapter: wraps models/llama_cp's train step in
-    the (state, tokens, targets) -> (state, metrics) contract."""
+    the (state, tokens, targets) -> (state, metrics) contract. Supports
+    full fine-tune and LoRA, with gradient accumulation."""
     from ..models.llama_cp import make_cp_train_step
 
     raw_step = make_cp_train_step(
         model_config, mesh, optimizer, seq_axis=seq_axis,
-        attn_impl=train_config.context_parallel)
+        attn_impl=train_config.context_parallel,
+        lora_rank=train_config.lora_rank,
+        lora_alpha=train_config.lora_alpha,
+        grad_accum=train_config.grad_accum)
 
     def step_fn(state: TrainState, tokens, targets):
-        params, opt_state, metrics = raw_step(
-            state.params, state.opt_state, tokens, targets)
-        new_state = TrainState(params, opt_state, state.step + 1, None)
+        params, lora, opt_state, metrics = raw_step(
+            state.params, state.lora, state.opt_state, tokens, targets)
+        new_state = TrainState(params, opt_state, state.step + 1, lora)
         return new_state, metrics
 
-    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
+    batch_axes = tuple(a for a in ("data",) if a in mesh.axis_names
                        and mesh.shape[a] > 1) or None
     step_fn._data_sharding = NamedSharding(
         mesh, PartitionSpec(batch_axes, seq_axis))

@@ -67,9 +67,9 @@ def test_cp_train_step_learns(setup):
     optimizer = optax.adam(1e-3)
     step = make_cp_train_step(cfg, mesh, optimizer, "seq", "ring")
     opt_state = optimizer.init(params)
-    p, o, m0 = step(params, opt_state, tokens, targets)
+    p, lo, o, m0 = step(params, None, opt_state, tokens, targets)
     for _ in range(2):
-        p, o, m = step(p, o, tokens, targets)
+        p, lo, o, m = step(p, lo, o, tokens, targets)
     assert float(m["loss"]) < float(m0["loss"])
 
 
@@ -93,15 +93,99 @@ def test_trainer_cp_validations(setup):
     from mlrun_tpu.training import TrainConfig, Trainer
 
     cfg, *_ = setup
-    mesh = make_mesh({"seq": 4})
-    with pytest.raises(ValueError, match="full fine-tune"):
-        Trainer(cfg, TrainConfig(context_parallel="ring", seq_axis="seq",
-                                 lora_rank=4), mesh=mesh)
     mesh2 = make_mesh({"fsdp": 4})
     with pytest.raises(ValueError, match="axis"):
         Trainer(cfg, TrainConfig(context_parallel="ring", seq_axis="seq"),
                 mesh=mesh2)
-    mesh3 = make_mesh({"data": 2, "seq": 4})
-    with pytest.raises(ValueError, match="seq-only"):
+    mesh3 = make_mesh({"fsdp": 2, "seq": 4})
+    with pytest.raises(ValueError, match="cannot combine"):
         Trainer(cfg, TrainConfig(context_parallel="ring", seq_axis="seq"),
                 mesh=mesh3)
+
+
+def test_cp_lora_parity(setup):
+    """CP LoRA gradients == plain-path LoRA gradients (the flagship
+    long-context LoRA fine-tune combination; VERDICT r1 weak #5)."""
+    import jax
+
+    from mlrun_tpu.models.llama import loss_fn as plain_loss
+    from mlrun_tpu.models.lora import init_lora
+
+    cfg, params, tokens, targets, _ = setup
+    lora = init_lora(cfg, jax.random.PRNGKey(3), rank=4, alpha=8.0)
+    mesh = make_mesh({"seq": 4})
+    cp_loss = make_context_parallel_loss(cfg, mesh, "seq", "ring")
+
+    (cp_val, _), cp_grads = jax.value_and_grad(
+        lambda lo: cp_loss(params, tokens, targets, lora=lo),
+        has_aux=True)(lora)
+    (pl_val, _), pl_grads = jax.value_and_grad(
+        lambda lo: plain_loss(cfg, params, tokens, targets, lora=lo)[:2],
+        has_aux=True)(lora)
+    assert abs(float(cp_val) - float(pl_val)) < 5e-3
+    for a, b in zip(jax.tree_util.tree_leaves(cp_grads),
+                    jax.tree_util.tree_leaves(pl_grads)):
+        assert float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)))) < 2e-2
+
+
+def test_trainer_cp_lora_with_accum(setup):
+    """Trainer: CP + LoRA + grad accumulation on a seq mesh — base weights
+    frozen, LoRA updates, loss finite."""
+    import jax
+
+    from mlrun_tpu.training import TrainConfig, Trainer, \
+        synthetic_token_stream
+
+    cfg, *_ = setup
+    mesh = make_mesh({"seq": 4})
+    trainer = Trainer(cfg, TrainConfig(context_parallel="ring",
+                                       seq_axis="seq", lora_rank=4,
+                                       grad_accum=2, learning_rate=1e-3),
+                      mesh=mesh)
+    trainer.init(0)
+    base_before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).copy(), trainer.state.params)
+    lora_before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).copy(), trainer.state.lora)
+    metrics = trainer.fit(synthetic_token_stream(4, 64, cfg.vocab_size),
+                          steps=2, log_every=1)
+    assert np.isfinite(metrics["loss"])
+    for a, b in zip(jax.tree_util.tree_leaves(base_before),
+                    jax.tree_util.tree_leaves(trainer.state.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))  # frozen base
+    changed = any(
+        float(np.max(np.abs(a - np.asarray(b)))) > 0
+        for a, b in zip(jax.tree_util.tree_leaves(lora_before),
+                        jax.tree_util.tree_leaves(trainer.state.lora)))
+    assert changed  # LoRA actually trained
+
+
+def test_trainer_cp_data_mesh(setup):
+    """CP on a mixed data x seq mesh via the full-manual mode (the jax 0.9
+    partial-manual backward bug is sharded around, not hit)."""
+    from mlrun_tpu.training import TrainConfig, Trainer, \
+        synthetic_token_stream
+
+    cfg, *_ = setup
+    mesh = make_mesh({"data": 2, "seq": 4})
+    trainer = Trainer(cfg, TrainConfig(context_parallel="ring",
+                                       seq_axis="seq", lora_rank=4,
+                                       learning_rate=1e-3), mesh=mesh)
+    trainer.init(0)
+    metrics = trainer.fit(synthetic_token_stream(4, 64, cfg.vocab_size),
+                          steps=2, log_every=1)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_cp_data_mesh_loss_parity(setup):
+    """Full-manual data x seq CP loss == plain loss on the same batch."""
+    from mlrun_tpu.models.llama import loss_fn as plain_loss
+
+    cfg, params, tokens, targets, _ = setup
+    mesh = make_mesh({"data": 2, "seq": 4})
+    cp_loss = make_context_parallel_loss(cfg, mesh, "seq", "ring",
+                                         data_axes=("data",))
+    cp_val, _ = cp_loss(params, tokens, targets)
+    pl_val, _ = plain_loss(cfg, params, tokens, targets)
+    assert abs(float(cp_val) - float(pl_val)) < 5e-3
