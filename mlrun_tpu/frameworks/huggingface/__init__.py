@@ -9,21 +9,112 @@ TPU via mlrun_tpu.serving.llm.
 
 from __future__ import annotations
 
+import json
+import os
+
 import numpy as np
 
 from ...utils import logger
 
 
-def load_hf_weights_into_llama(model_name_or_path: str, config=None,
-                               dtype=None):
-    """Load an HF Llama-family torch checkpoint into (LlamaConfig, params).
+class _CheckpointReader:
+    """Tensor-by-tensor access to an HF checkpoint directory WITHOUT
+    instantiating the torch model: safetensors (single or sharded via
+    model.safetensors.index.json) are opened lazily per file; pytorch .bin
+    falls back to a torch mmap load. Peak host memory is one tensor at a
+    time, which is what lets 8B-class weights load inside a container."""
 
-    Weights come via transformers (torch CPU) and are re-laid-out into the
-    stacked [n_layers, ...] tree. Big models stream layer by layer.
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._file_of: dict[str, str] = {}
+        self._handles: dict = {}
+        self._bin_state: dict = {}
+
+        st_index = os.path.join(directory, "model.safetensors.index.json")
+        st_single = os.path.join(directory, "model.safetensors")
+        bin_index = os.path.join(directory, "pytorch_model.bin.index.json")
+        bin_single = os.path.join(directory, "pytorch_model.bin")
+        if os.path.exists(st_index):
+            with open(st_index) as fp:
+                weight_map = json.load(fp)["weight_map"]
+            self._file_of = dict(weight_map)
+            self._kind = "safetensors"
+        elif os.path.exists(st_single):
+            from safetensors import safe_open
+
+            with safe_open(st_single, framework="np") as f:
+                self._file_of = {k: "model.safetensors" for k in f.keys()}
+            self._kind = "safetensors"
+        elif os.path.exists(bin_index):
+            with open(bin_index) as fp:
+                self._file_of = dict(json.load(fp)["weight_map"])
+            self._kind = "bin"
+        elif os.path.exists(bin_single):
+            self._file_of = {}
+            self._kind = "bin_single"
+        else:
+            raise FileNotFoundError(
+                f"no model.safetensors[.index.json] or pytorch_model.bin"
+                f"[.index.json] under {directory}")
+
+    def get(self, name: str) -> np.ndarray:
+        if self._kind == "safetensors":
+            from safetensors import safe_open
+
+            fname = self._file_of[name]
+            handle = self._handles.get(fname)
+            if handle is None:
+                handle = safe_open(os.path.join(self.directory, fname),
+                                   framework="np")
+                self._handles[fname] = handle
+            return handle.get_tensor(name)
+        # torch .bin path: mmap keeps tensors on disk until accessed
+        import torch
+
+        fname = self._file_of.get(name, "pytorch_model.bin")
+        state = self._bin_state.get(fname)
+        if state is None:
+            state = torch.load(os.path.join(self.directory, fname),
+                               map_location="cpu", mmap=True,
+                               weights_only=True)
+            self._bin_state[fname] = state
+        return np.asarray(state[name].float().numpy())
+
+    def close(self):
+        self._handles.clear()
+        self._bin_state.clear()
+
+
+def _resolve_checkpoint_dir(model_name_or_path: str) -> str:
+    if os.path.isdir(model_name_or_path):
+        return model_name_or_path
+    from huggingface_hub import snapshot_download
+
+    # only the serving checkpoint + configs — a bare snapshot would also
+    # pull duplicate original/*.pth weights, doubling disk in-container
+    return snapshot_download(
+        model_name_or_path,
+        allow_patterns=["*.safetensors", "*.safetensors.index.json",
+                        "*.bin", "*.bin.index.json", "*.json", "*.model",
+                        "tokenizer*"],
+        ignore_patterns=["original/*", "*.pth", "*.gguf"])
+
+
+def load_hf_weights_into_llama(model_name_or_path: str, config=None,
+                               dtype=None, shardings=None):
+    """Load an HF Llama-family checkpoint into (LlamaConfig, params).
+
+    Streams the checkpoint shard-by-shard (never instantiates the torch
+    model): each stacked leaf of the target tree is assembled tensor by
+    tensor in the target dtype and placed on device immediately, so host
+    peak memory is one leaf + one source tensor — 8B-class weights load
+    inside a 16GB container. ``shardings`` may be a pytree of
+    NamedShardings matching the param tree to place leaves sharded across
+    a mesh directly.
     """
+    import jax
     import jax.numpy as jnp
-    import torch
-    from transformers import AutoConfig, AutoModelForCausalLM
+    from transformers import AutoConfig
 
     from ...models.llama import LlamaConfig
 
@@ -44,43 +135,84 @@ def load_hf_weights_into_llama(model_name_or_path: str, config=None,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
     )
     dtype = dtype or config.dtype
+    if jnp.dtype(dtype).name == "bfloat16":
+        import ml_dtypes
 
-    model = AutoModelForCausalLM.from_pretrained(
-        model_name_or_path, torch_dtype=torch.float32)
-    sd = model.state_dict()
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(jnp.dtype(dtype).name)
 
-    def get(name):
-        return np.asarray(sd[name].numpy())
+    reader = _CheckpointReader(
+        _resolve_checkpoint_dir(model_name_or_path))
 
-    def stack(fmt, transpose=True):
-        mats = [get(fmt.format(i)) for i in range(config.n_layers)]
-        arr = np.stack(mats)
+    def place(array, path: tuple):
+        sharding = None
+        if shardings is not None:
+            node = shardings
+            for key in path:
+                node = node[key]
+            sharding = node
+        if sharding is not None:
+            return jax.device_put(array, sharding)
+        return jnp.asarray(array)
+
+    def leaf(name: str, path: tuple, transpose=False):
+        tensor = reader.get(name)
         if transpose:
-            arr = arr.transpose(0, 2, 1)  # torch [out,in] -> ours [in,out]
-        return jnp.asarray(arr, dtype)
+            tensor = tensor.transpose(1, 0)
+        return place(np.asarray(tensor, np_dtype), path)
 
+    def stacked(fmt: str, path: tuple, transpose=True):
+        """Assemble [n_layers, ...] leaf one layer-tensor at a time in the
+        TARGET dtype (the fp32 source tensor is freed per layer)."""
+        first = reader.get(fmt.format(0))
+        if transpose:
+            first = first.transpose(1, 0)  # torch [out,in] -> ours [in,out]
+        out = np.empty((config.n_layers,) + first.shape, np_dtype)
+        out[0] = first.astype(np_dtype)
+        del first
+        for i in range(1, config.n_layers):
+            tensor = reader.get(fmt.format(i))
+            if transpose:
+                tensor = tensor.transpose(1, 0)
+            out[i] = tensor.astype(np_dtype)
+            del tensor
+        return place(out, path)
+
+    layers_path = ("layers",)
     params = {
-        "embedding": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "embedding": leaf("model.embed_tokens.weight", ("embedding",)),
         "layers": {
-            "attn_norm_scale": jnp.asarray(np.stack(
-                [get(f"model.layers.{i}.input_layernorm.weight")
-                 for i in range(config.n_layers)]), dtype),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "mlp_norm_scale": jnp.asarray(np.stack(
-                [get(f"model.layers.{i}.post_attention_layernorm.weight")
-                 for i in range(config.n_layers)]), dtype),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+            "attn_norm_scale": stacked(
+                "model.layers.{}.input_layernorm.weight",
+                layers_path + ("attn_norm_scale",), transpose=False),
+            "wq": stacked("model.layers.{}.self_attn.q_proj.weight",
+                          layers_path + ("wq",)),
+            "wk": stacked("model.layers.{}.self_attn.k_proj.weight",
+                          layers_path + ("wk",)),
+            "wv": stacked("model.layers.{}.self_attn.v_proj.weight",
+                          layers_path + ("wv",)),
+            "wo": stacked("model.layers.{}.self_attn.o_proj.weight",
+                          layers_path + ("wo",)),
+            "mlp_norm_scale": stacked(
+                "model.layers.{}.post_attention_layernorm.weight",
+                layers_path + ("mlp_norm_scale",), transpose=False),
+            "w_gate": stacked("model.layers.{}.mlp.gate_proj.weight",
+                              layers_path + ("w_gate",)),
+            "w_up": stacked("model.layers.{}.mlp.up_proj.weight",
+                            layers_path + ("w_up",)),
+            "w_down": stacked("model.layers.{}.mlp.down_proj.weight",
+                              layers_path + ("w_down",)),
         },
-        "final_norm_scale": jnp.asarray(get("model.norm.weight"), dtype),
+        "final_norm_scale": leaf("model.norm.weight",
+                                 ("final_norm_scale",)),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = jnp.asarray(
-            get("lm_head.weight").transpose(1, 0), dtype)
+        params["lm_head"] = leaf("lm_head.weight", ("lm_head",),
+                                 transpose=True)
+    reader.close()
+    logger.info("streamed HF checkpoint", model=model_name_or_path,
+                layers=config.n_layers)
     return config, params
 
 

@@ -368,7 +368,9 @@ class LLMModelServer:
             def __init__(self, *a, model_preset: str = "tiny",
                          tokenizer: str | None = None, max_len: int = 1024,
                          max_new_tokens: int = 64, hf_model: str | None = None,
-                         temperature: float = 0.0, warmup: bool = True, **kw):
+                         temperature: float = 0.0, warmup: bool = True,
+                         continuous_batching: bool = False, slots: int = 4,
+                         **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -377,8 +379,10 @@ class LLMModelServer:
                 self.hf_model = hf_model
                 self.temperature = temperature
                 self._warmup = warmup
+                self.continuous_batching = continuous_batching
+                self.slots = slots
                 self._tokenizer = None
-                self.engine: LLMEngine | None = None
+                self.engine = None
 
             def load(self):
                 from ..frameworks.jax.auto_trainer import MODEL_PRESETS
@@ -398,28 +402,72 @@ class LLMModelServer:
 
                     self._tokenizer = AutoTokenizer.from_pretrained(
                         self.tokenizer_id)
-                self.engine = LLMEngine(
-                    config, params, max_len=self.max_len,
-                    temperature=self.temperature)
-                if self._warmup:
-                    self.engine.warmup()
+                if self.continuous_batching:
+                    # slot-based scheduler: concurrent requests interleave
+                    # on one decode batch (greedy only)
+                    if self.temperature and self.temperature > 0:
+                        raise ValueError(
+                            "continuous_batching decodes greedily; "
+                            "temperature sampling needs "
+                            "continuous_batching=False")
+                    from .llm_batch import ContinuousBatchingEngine
+
+                    self.engine = ContinuousBatchingEngine(
+                        config, params, max_len=self.max_len,
+                        slots=self.slots)
+                    if self._warmup:
+                        self.engine.warmup()
+                    self.engine.start()
+                else:
+                    self.engine = LLMEngine(
+                        config, params, max_len=self.max_len,
+                        temperature=self.temperature)
+                    if self._warmup:
+                        self.engine.warmup()
                 self.model = self.engine
 
             def predict(self, request):
-                outputs = []
-                for item in request["inputs"]:
+                inputs = request["inputs"]
+                id_lists = []
+                for item in inputs:
                     if isinstance(item, str):
                         if self._tokenizer is None:
                             raise ValueError(
                                 "string inputs need a tokenizer= class arg")
-                        ids = self._tokenizer(item)["input_ids"]
+                        id_lists.append(self._tokenizer(item)["input_ids"])
                     else:
-                        ids = list(item)
-                    tokens, stats = self.engine.generate(
+                        id_lists.append(list(item))
+
+                if self.continuous_batching:
+                    # submit everything, then collect — requests share the
+                    # decode batch instead of running serially. Bounded
+                    # wait: a dead scheduler fails the futures rather than
+                    # wedging the worker.
+                    futures = [self.engine.submit(
                         ids, max_new_tokens=self.max_new_tokens)
-                    self.set_metric("ttft_s", stats["ttft_s"])
-                    self.set_metric("decode_tps",
-                                    stats["decode_tokens_per_sec"])
+                        for ids in id_lists]
+                    results = [f.result(timeout=600) for f in futures]
+                    if results:
+                        self.set_metric(
+                            "ttft_s",
+                            min(s["ttft_s"] for _, s in results))
+                        generated = sum(s["generated"] for _, s in results)
+                        wall = max(s["total_s"] for _, s in results)
+                        if wall > 0:
+                            self.set_metric("decode_tps", generated / wall)
+                    out_tokens = [tokens for tokens, _ in results]
+                else:
+                    out_tokens = []
+                    for ids in id_lists:
+                        tokens, stats = self.engine.generate(
+                            ids, max_new_tokens=self.max_new_tokens)
+                        self.set_metric("ttft_s", stats["ttft_s"])
+                        self.set_metric("decode_tps",
+                                        stats["decode_tokens_per_sec"])
+                        out_tokens.append(tokens)
+
+                outputs = []
+                for item, tokens in zip(inputs, out_tokens):
                     if self._tokenizer is not None and isinstance(item, str):
                         outputs.append(self._tokenizer.decode(tokens))
                     else:

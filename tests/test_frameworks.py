@@ -69,9 +69,11 @@ def test_jax_evaluate():
     assert "eval_loss" in results and results["eval_loss"] > 0
 
 
-def test_hf_weight_mapping_shapes():
+def test_hf_weight_mapping_shapes(monkeypatch):
     """Map a tiny random HF llama into our stacked tree (no download —
-    builds the HF model from a local config)."""
+    builds the HF model from a local config). The loader must STREAM the
+    checkpoint without ever instantiating the torch model (8B-class
+    weights would not fit in container RAM otherwise)."""
     transformers = pytest.importorskip("transformers")
     import tempfile
 
@@ -82,8 +84,17 @@ def test_hf_weight_mapping_shapes():
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=128, tie_word_embeddings=False)
     model = transformers.LlamaForCausalLM(config)
+    monkeypatch.setattr(
+        transformers.AutoModelForCausalLM, "from_pretrained",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "loader must stream, not instantiate the torch model")))
     with tempfile.TemporaryDirectory() as tmp:
-        model.save_pretrained(tmp)
+        # sharded safetensors exercises the index.json multi-file path
+        model.save_pretrained(tmp, max_shard_size="100KB")
+        import os
+
+        assert os.path.exists(
+            os.path.join(tmp, "model.safetensors.index.json"))
         from mlrun_tpu.frameworks.huggingface import (
             load_hf_weights_into_llama,
         )
@@ -181,3 +192,39 @@ def test_torch_train_and_serve():
     out = server.do_event(MockEvent(body={"inputs": [[1.0, 2.0, 3.0, 4.0]]},
                                     path="/v2/models/t/infer"))
     assert len(out.body["outputs"]) == 1
+
+
+def test_hf_weight_mapping_bin_fallback():
+    """pytorch_model.bin checkpoints load through the torch-mmap path."""
+    transformers = pytest.importorskip("transformers")
+    import tempfile
+
+    import numpy as np
+    import torch
+
+    config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    model = transformers.LlamaForCausalLM(config)
+    with tempfile.TemporaryDirectory() as tmp:
+        model.save_pretrained(tmp, safe_serialization=False)
+        from mlrun_tpu.frameworks.huggingface import (
+            load_hf_weights_into_llama,
+        )
+
+        our_config, params = load_hf_weights_into_llama(tmp)
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from mlrun_tpu.models.llama import forward
+
+    our_config = dataclasses.replace(
+        our_config, dtype=jnp.float32, attention_impl="reference",
+        remat=False)
+    tokens = np.array([[3, 1, 8]], dtype=np.int32)
+    ours = np.asarray(forward(our_config, params, jnp.asarray(tokens)))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
+    assert np.array_equal(ours.argmax(-1), theirs.argmax(-1))
