@@ -14,7 +14,10 @@ import sys
 import time
 
 
-def _bench(model_scale: str, batch: int, seq: int, steps: int = 8):
+def _bench(model_scale: str, batch: int, seq: int, steps: int = 8,
+           remat_policy: str = "nothing"):
+    import dataclasses
+
     import jax
 
     from mlrun_tpu.models import llama3_1b, tiny_llama
@@ -23,7 +26,8 @@ def _bench(model_scale: str, batch: int, seq: int, steps: int = 8):
     from mlrun_tpu.training.mfu import chip_peak_flops
 
     if model_scale == "1b":
-        config = llama3_1b()
+        config = dataclasses.replace(llama3_1b(),
+                                     remat_policy=remat_policy)
     else:
         config = tiny_llama(attention_impl="reference")
 
@@ -88,23 +92,28 @@ def main():
     signal.alarm(0)
     on_tpu = devices[0].platform in ("tpu", "axon")
     # chunked CE keeps the loss memory flat, so larger batches fit; walk
-    # down until one fits on the chip
+    # down until one fits on the chip. save_attn remat (keep attention
+    # outputs, recompute only the MLP) trades a little memory for less
+    # backward recompute — try it before full-recompute at each batch.
     attempts = (
-        [("1b", 32, 2048), ("1b", 16, 2048), ("1b", 8, 2048),
-         ("1b", 4, 2048), ("tiny", 8, 256)] if on_tpu
-        else [("tiny", 8, 128)]
+        [("1b", 32, 2048, "save_attn"), ("1b", 32, 2048, "nothing"),
+         ("1b", 16, 2048, "save_attn"), ("1b", 16, 2048, "nothing"),
+         ("1b", 8, 2048, "save_attn"), ("1b", 8, 2048, "nothing"),
+         ("1b", 4, 2048, "nothing"), ("tiny", 8, 256, "nothing")]
+        if on_tpu else [("tiny", 8, 128, "nothing")]
     )
     result = None
     last_error = None
-    for scale, batch, seq in attempts:
+    for scale, batch, seq, policy in attempts:
         try:
-            result = _bench(scale, batch, seq)
+            result = _bench(scale, batch, seq, remat_policy=policy)
             result["model"] = scale
+            result["remat_policy"] = policy
             break
         except Exception as exc:  # noqa: BLE001 - fall through to smaller cfg
             last_error = exc
-            print(f"bench config {scale}/b{batch}/s{seq} failed: {exc}",
-                  file=sys.stderr)
+            print(f"bench config {scale}/b{batch}/s{seq}/{policy} "
+                  f"failed: {exc}", file=sys.stderr)
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_error}")
 

@@ -1,5 +1,15 @@
 from .base import DataItem, DataStore, FileStats, parse_url  # noqa: F401
 from .datastore import StoreManager, register_store, schema_to_store, store_manager  # noqa: F401
+from .profiles import (  # noqa: F401
+    DatastoreProfile,
+    DatastoreProfileAzureBlob,
+    DatastoreProfileBasic,
+    DatastoreProfileGCS,
+    DatastoreProfileRedis,
+    DatastoreProfileS3,
+    register_temporary_client_datastore_profile,
+    remove_temporary_client_datastore_profile,
+)
 from .sources import (  # noqa: F401
     BigQuerySource,
     CSVSource,

@@ -53,9 +53,21 @@ class StoreManager:
             self._db = get_run_db()
         return self._db
 
-    def get_or_create_store(self, url: str,
-                            secrets: dict | None = None) -> tuple[DataStore, str]:
+    def get_or_create_store(self, url: str, secrets: dict | None = None,
+                            project: str = "") -> tuple[DataStore, str]:
         scheme, endpoint, path = parse_url(url)
+        if scheme == "ds":
+            # ds://<profile>/<subpath> → the profile's real url + secrets
+            # (reference datastore_profile.py resolution); resolved against
+            # this manager's db and the caller's project scope
+            from .profiles import datastore_profile_read
+
+            profile = datastore_profile_read(endpoint, project=project,
+                                             db=self._db)
+            real_url = profile.url(path)
+            merged = dict(profile.secrets())
+            merged.update(secrets or {})
+            return self.get_or_create_store(real_url, secrets=merged or None)
         store_key = f"{scheme}://{endpoint}"
         if store_key not in self._stores or secrets:
             cls = schema_to_store.get(scheme)
@@ -85,7 +97,8 @@ class StoreManager:
                 raise ValueError(f"artifact {url} has no target_path")
             key = key or meta.get("metadata", {}).get("key", "")
             url = target
-        store, path = self.get_or_create_store(url, secrets=secrets)
+        store, path = self.get_or_create_store(url, secrets=secrets,
+                                               project=project)
         return DataItem(key or path, store, path, url=url, meta=meta,
                         artifact_url=artifact_url)
 

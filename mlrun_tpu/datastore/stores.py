@@ -117,14 +117,60 @@ class FsspecStore(DataStore):
             import fsspec
 
             protocol = {"gs": "gcs", "az": "abfs"}.get(self.kind, self.kind)
-            storage_options = {}
-            if self.kind == "s3":
-                key = self._get_secret_or_env("AWS_ACCESS_KEY_ID")
-                secret = self._get_secret_or_env("AWS_SECRET_ACCESS_KEY")
-                if key:
-                    storage_options = {"key": key, "secret": secret}
-            self._fs = fsspec.filesystem(protocol, **storage_options)
+            self._fs = fsspec.filesystem(protocol, **self.storage_options())
         return self._fs
+
+    def storage_options(self) -> dict:
+        """Per-kind credential/option mapping (reference analog: the
+        per-store option handling in mlrun/datastore/s3.py:26,
+        azure_blob.py:31, google_cloud_storage.py) — values come from the
+        store's secrets (e.g. a ds:// profile) or the environment."""
+        options: dict = {}
+        if self.kind == "s3":
+            key = self._get_secret_or_env("AWS_ACCESS_KEY_ID")
+            secret = self._get_secret_or_env("AWS_SECRET_ACCESS_KEY")
+            if key:
+                options["key"] = key
+                options["secret"] = secret
+            endpoint = self._get_secret_or_env("S3_ENDPOINT_URL")
+            if endpoint:
+                options["endpoint_url"] = endpoint
+            region = self._get_secret_or_env("AWS_REGION")
+            if region:
+                options.setdefault("client_kwargs", {})[
+                    "region_name"] = region
+            if self._get_secret_or_env("S3_ANONYMOUS").strip().lower() in \
+                    ("1", "true", "yes"):
+                options["anon"] = True
+        elif self.kind in ("gs", "gcs"):
+            creds_json = self._get_secret_or_env("GCP_CREDENTIALS")
+            creds_path = self._get_secret_or_env(
+                "GOOGLE_APPLICATION_CREDENTIALS")
+            if creds_json:
+                import json as jsonlib
+
+                options["token"] = jsonlib.loads(creds_json)
+            elif creds_path:
+                options["token"] = creds_path
+        elif self.kind in ("az", "abfs"):
+            conn = self._get_secret_or_env("AZURE_STORAGE_CONNECTION_STRING")
+            if conn:
+                options["connection_string"] = conn
+            account = self._get_secret_or_env("AZURE_STORAGE_ACCOUNT_NAME")
+            if account:
+                options["account_name"] = account
+            account_key = self._get_secret_or_env(
+                "AZURE_STORAGE_ACCOUNT_KEY")
+            if account_key:
+                options["account_key"] = account_key
+            for field, env in (("client_id", "AZURE_STORAGE_CLIENT_ID"),
+                               ("client_secret",
+                                "AZURE_STORAGE_CLIENT_SECRET"),
+                               ("tenant_id", "AZURE_STORAGE_TENANT_ID")):
+                value = self._get_secret_or_env(env)
+                if value:
+                    options[field] = value
+        return options
 
     def _full(self, key: str) -> str:
         return f"{self.endpoint}{key}" if self.endpoint else key.lstrip("/")

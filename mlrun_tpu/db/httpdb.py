@@ -420,6 +420,38 @@ class HTTPRunDB(RunDBInterface):
         self.api_call("DELETE", self._path(project, "secrets"),
                       "delete secrets", params=params)
 
+    # -- datastore profiles -------------------------------------------------
+    def store_datastore_profile(self, profile: dict, project: str = "",
+                                private: dict | None = None):
+        self.api_call(
+            "PUT",
+            self._path(project, "datastore-profiles", profile["name"]),
+            "store datastore profile",
+            json_body={"profile": profile, "private": private})
+
+    def get_datastore_profile(self, name: str, project: str = ""
+                              ) -> dict | None:
+        try:
+            resp = self.api_call(
+                "GET", self._path(project, "datastore-profiles", name),
+                "get datastore profile")
+        except RunDBError as exc:
+            if "not found" in str(exc):
+                return None  # same missing-profile contract as SQLiteRunDB
+            raise
+        return resp.get("data")
+
+    def list_datastore_profiles(self, project: str = "") -> list[dict]:
+        resp = self.api_call(
+            "GET", self._path(project, "datastore-profiles"),
+            "list datastore profiles")
+        return resp.get("datastore_profiles", [])
+
+    def delete_datastore_profile(self, name: str, project: str = ""):
+        self.api_call(
+            "DELETE", self._path(project, "datastore-profiles", name),
+            "delete datastore profile")
+
     # -- submit / build -----------------------------------------------------
     def submit_job(self, runspec: dict, schedule=None) -> dict:
         body = dict(runspec)

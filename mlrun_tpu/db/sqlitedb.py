@@ -99,6 +99,10 @@ CREATE TABLE IF NOT EXISTS pagination_cache (
     token TEXT PRIMARY KEY, method TEXT, filters TEXT,
     next_offset INTEGER, created TEXT
 );
+CREATE TABLE IF NOT EXISTS datastore_profiles (
+    project TEXT NOT NULL, name TEXT NOT NULL, type TEXT, body TEXT,
+    PRIMARY KEY (project, name)
+);
 CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
 CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 """
@@ -108,7 +112,7 @@ CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 # at SCHEMA_VERSION; an existing DB replays only the missing migrations in
 # order. Version 1 is the round-1 pre-versioning schema (user_version 0
 # with a populated sqlite_master).
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 _MIGRATIONS: dict[int, str] = {
     2: """
@@ -129,6 +133,12 @@ CREATE TABLE IF NOT EXISTS project_secrets (
 CREATE TABLE IF NOT EXISTS pagination_cache (
     token TEXT PRIMARY KEY, method TEXT, filters TEXT,
     next_offset INTEGER, created TEXT
+);
+""",
+    5: """
+CREATE TABLE IF NOT EXISTS datastore_profiles (
+    project TEXT NOT NULL, name TEXT NOT NULL, type TEXT, body TEXT,
+    PRIMARY KEY (project, name)
 );
 """,
 }
@@ -437,6 +447,54 @@ class SQLiteRunDB(RunDBInterface):
                 "DELETE FROM project_secrets "
                 "WHERE project=? AND provider=? AND name=?",
                 (project, provider, key))
+
+    # -- datastore profiles (reference datastore_profile.py server side:
+    # public part in the DB, private part in project secrets) --------------
+    def store_datastore_profile(self, profile: dict, project: str = "",
+                                private: dict | None = None):
+        project = self._project_or_default(project)
+        name = profile["name"]
+        self._execute(
+            "INSERT OR REPLACE INTO datastore_profiles "
+            "(project, name, type, body) VALUES (?,?,?,?)",
+            (project, name, profile.get("type", "basic"),
+             json.dumps(profile)))
+        from ..datastore.profiles import PROFILE_SECRET_PREFIX
+
+        if private:
+            self.store_project_secrets(
+                project, {PROFILE_SECRET_PREFIX + name:
+                          json.dumps(private)})
+        else:
+            # a re-store without a private part is a credential
+            # rotation/clear — never leave stale secrets behind
+            self.delete_project_secrets(
+                project, keys=[PROFILE_SECRET_PREFIX + name])
+
+    def get_datastore_profile(self, name: str, project: str = ""
+                              ) -> Optional[dict]:
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT body FROM datastore_profiles WHERE project=? AND name=?",
+            (project, name))
+        return json.loads(rows[0]["body"]) if rows else None
+
+    def list_datastore_profiles(self, project: str = "") -> list[dict]:
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT body FROM datastore_profiles WHERE project=? "
+            "ORDER BY name", (project,))
+        return [json.loads(row["body"]) for row in rows]
+
+    def delete_datastore_profile(self, name: str, project: str = ""):
+        project = self._project_or_default(project)
+        self._execute(
+            "DELETE FROM datastore_profiles WHERE project=? AND name=?",
+            (project, name))
+        from ..datastore.profiles import PROFILE_SECRET_PREFIX
+
+        self.delete_project_secrets(project,
+                                    keys=[PROFILE_SECRET_PREFIX + name])
 
     # -- logs --------------------------------------------------------------
     def _log_path(self, project: str, uid: str) -> str:

@@ -54,8 +54,10 @@ def _cp_hidden(config: LlamaConfig, params: Params, tokens: jax.Array,
 
     body = functools.partial(_layer_body, config)
     if config.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        from .llama import _remat_policy
+
+        body = jax.checkpoint(body,
+                              policy=_remat_policy(config.remat_policy))
 
     if lora is not None:
         def scan_fn(carry, scanned):

@@ -1,0 +1,40 @@
+"""Per-type-family packagers (reference analog: mlrun/package/packagers/ —
+default.py, python_standard_library_packagers.py, numpy_packagers.py,
+pandas_packagers.py; plus a TPU-native jax family)."""
+
+from .default import DefaultPackager  # noqa: F401
+from .jax_packagers import JaxArrayPackager, JaxPytreePackager  # noqa: F401
+from .numpy_packagers import (  # noqa: F401
+    NumpyArrayDictPackager,
+    NumpyArrayListPackager,
+    NumpyArrayPackager,
+    NumpyScalarPackager,
+)
+from .pandas_packagers import (  # noqa: F401
+    PandasDataFramePackager,
+    PandasSeriesPackager,
+)
+from .python_standard_library import (  # noqa: F401
+    BytesPackager,
+    CollectionPackager,
+    DatetimePackager,
+    PathPackager,
+    PrimitivePackager,
+)
+
+DEFAULT_PACKAGERS = (
+    # highest priority first: specific families before generic fallbacks
+    PandasDataFramePackager,
+    PandasSeriesPackager,
+    NumpyArrayPackager,
+    NumpyScalarPackager,
+    NumpyArrayDictPackager,
+    NumpyArrayListPackager,
+    JaxArrayPackager,
+    JaxPytreePackager,
+    DatetimePackager,
+    PathPackager,
+    BytesPackager,
+    PrimitivePackager,
+    CollectionPackager,
+)

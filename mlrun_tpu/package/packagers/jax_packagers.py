@@ -1,0 +1,87 @@
+"""JAX packagers — device arrays and pytrees (TPU-native addition; the
+reference has no jax family). Scalars land as results, arrays as npy
+artifacts, pytrees-of-arrays as one npz keyed by flattened tree paths so
+``unpack`` can rebuild the structure."""
+
+from __future__ import annotations
+
+from .default import DefaultPackager
+
+
+def _is_jax_array(obj) -> bool:
+    try:
+        import jax
+
+        return isinstance(obj, jax.Array)
+    except Exception:  # noqa: BLE001 - no jax, no match
+        return False
+
+
+class JaxArrayPackager(DefaultPackager):
+    artifact_types = ("artifact", "result", "file")
+    priority = 3
+
+    def can_pack(self, obj):
+        return _is_jax_array(obj)
+
+    def can_unpack(self, hint):
+        try:
+            import jax
+
+            return hint is jax.Array
+        except Exception:  # noqa: BLE001
+            return False
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        import numpy as np
+
+        host = np.asarray(obj)
+        if host.ndim == 0 or artifact_type == "result":
+            context.log_result(
+                key, host.item() if host.ndim == 0 else host.tolist())
+            return
+        path = self.new_file(".npy")
+        np.save(path, host)
+        context.log_artifact(key, local_path=path, format="npy")
+
+    def unpack(self, data_item, hint):
+        import jax.numpy as jnp
+        import numpy as np
+
+        return jnp.asarray(np.load(data_item.local()))
+
+
+class JaxPytreePackager(DefaultPackager):
+    """Nested dict/list pytrees whose leaves are jax arrays → one npz with
+    '/'-joined key paths."""
+
+    priority = 3
+
+    def can_pack(self, obj):
+        if not isinstance(obj, (dict, list)) or not obj:
+            return False
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(obj)
+        return bool(leaves) and all(_is_jax_array(x) for x in leaves)
+
+    def can_unpack(self, hint):
+        return False  # dict/list hints route to the collection packager
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        import jax
+        import numpy as np
+
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(obj)[0]:
+            name = "/".join(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            flat[name] = np.asarray(leaf)
+        out = self.new_file(".npz")
+        np.savez(out, **flat)
+        context.log_artifact(key, local_path=out, format="npz")
+
+    def unpack(self, data_item, hint):  # pragma: no cover - can_unpack False
+        import numpy as np
+
+        return dict(np.load(data_item.local()))

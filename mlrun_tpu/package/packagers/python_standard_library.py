@@ -1,0 +1,105 @@
+"""Standard-library packagers (reference analog:
+mlrun/package/packagers/python_standard_library_packagers.py — int/float/
+str/bool/bytes/collections/pathlib, re-implemented compactly)."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import pathlib
+
+from .default import DefaultPackager
+
+
+class PrimitivePackager(DefaultPackager):
+    handled_types = (int, float, str, bool)
+    artifact_types = ("result", "artifact")
+    default_artifact_type = "result"
+
+    def can_pack(self, obj):
+        # bool is int's subclass; isinstance covers both deliberately
+        return isinstance(obj, self.handled_types)
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        if artifact_type == "artifact":
+            context.log_artifact(key, body=str(obj))
+        else:
+            context.log_result(key, obj)
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        text = raw.decode() if isinstance(raw, bytes) else raw
+        if hint is str:
+            return text
+        if hint is bool:
+            return text.strip().lower() in ("1", "true", "yes")
+        return hint(text)
+
+
+class BytesPackager(DefaultPackager):
+    handled_types = (bytes, bytearray)
+    priority = 4
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        context.log_artifact(key, body=bytes(obj))
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        data = raw if isinstance(raw, (bytes, bytearray)) else \
+            str(raw).encode()
+        return hint(data)
+
+
+class CollectionPackager(DefaultPackager):
+    handled_types = (dict, list, tuple, set, frozenset)
+    artifact_types = ("result", "artifact", "file")
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        if isinstance(obj, (set, tuple, frozenset)):
+            obj = list(obj)
+        blob = json.dumps(obj, default=str)
+        # small collections → results; big (or explicit) → json artifact
+        if artifact_type in ("artifact", "file") or len(blob) > 1024:
+            context.log_artifact(key, body=blob, format="json")
+        else:
+            context.log_result(key, obj)
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        text = raw.decode() if isinstance(raw, bytes) else raw
+        obj = json.loads(text)
+        if hint in (tuple, set, frozenset):
+            return hint(obj)
+        return obj
+
+
+class PathPackager(DefaultPackager):
+    handled_types = (pathlib.Path, pathlib.PurePath)
+    priority = 4
+
+    def can_unpack(self, hint):
+        return hint in (pathlib.Path, pathlib.PurePath)
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        context.log_artifact(key, local_path=str(obj))
+
+    def unpack(self, data_item, hint):
+        return pathlib.Path(data_item.local())
+
+
+class DatetimePackager(DefaultPackager):
+    handled_types = (datetime.datetime, datetime.date, datetime.time)
+    default_artifact_type = "result"
+    priority = 4
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        context.log_result(key, obj.isoformat())
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        text = (raw.decode() if isinstance(raw, bytes) else raw).strip()
+        if hint is datetime.date:
+            return datetime.date.fromisoformat(text)
+        if hint is datetime.time:
+            return datetime.time.fromisoformat(text)
+        return datetime.datetime.fromisoformat(text)

@@ -1,198 +1,44 @@
 """Type → artifact codec system (reference analog:
-mlrun/package/packagers_manager.py:37 and mlrun/package/packagers/).
+mlrun/package/packagers_manager.py:37 + mlrun/package/packagers/).
 
 ``pack`` routes a returned python object to log_result / log_dataset /
-log_artifact / log_model by type; ``unpack`` converts a DataItem to the type
-hinted on the handler parameter. JAX pytrees and numpy arrays are first-class.
+log_artifact / log_model by type family; ``unpack`` converts a DataItem to
+the type hinted on the handler parameter. Families live in
+``package/packagers/`` (stdlib, numpy, pandas, jax) ordered by priority;
+type hints may be concrete types, strings ("pandas.DataFrame"), or typing
+constructs (Optional/Union/List[...] — see type_hints.reduce_hint). JAX
+pytrees and numpy arrays are first-class.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import pathlib
-import tempfile
-from typing import Any, Optional
+from typing import Any
 
+from .packagers import DEFAULT_PACKAGERS
+from .packagers.default import DefaultPackager
+from .type_hints import reduce_hint
 
-class Packager:
-    """One type family's pack/unpack logic."""
+# re-exported names kept from the round-1 flat module (tests/user code may
+# subclass these)
+from .packagers import (  # noqa: F401  (re-exports)
+    CollectionPackager,
+    JaxArrayPackager as JaxPackager,
+    NumpyArrayPackager as NumpyPackager,
+    PandasDataFramePackager as PandasPackager,
+    PathPackager,
+    PrimitivePackager,
+)
 
-    handled_types: tuple = ()
-    artifact_type = "artifact"
-
-    def can_pack(self, obj: Any) -> bool:
-        return isinstance(obj, self.handled_types)
-
-    def can_unpack(self, hint) -> bool:
-        return hint in self.handled_types
-
-    def pack(self, context, obj, key: str, **cfg):
-        raise NotImplementedError
-
-    def unpack(self, data_item, hint):
-        raise NotImplementedError
-
-
-class PrimitivePackager(Packager):
-    handled_types = (int, float, str, bool, bytes)
-
-    def pack(self, context, obj, key, **cfg):
-        if isinstance(obj, bytes):
-            context.log_artifact(key, body=obj)
-        else:
-            context.log_result(key, obj)
-
-    def unpack(self, data_item, hint):
-        raw = data_item.get()
-        if hint is bytes:
-            return raw
-        text = raw.decode() if isinstance(raw, bytes) else raw
-        if hint is str:
-            return text
-        return hint(text)
-
-
-class CollectionPackager(Packager):
-    handled_types = (dict, list, tuple, set)
-
-    def pack(self, context, obj, key, **cfg):
-        if isinstance(obj, (set, tuple)):
-            obj = list(obj)
-        # small collections → results; big → json artifact
-        blob = json.dumps(obj, default=str)
-        if len(blob) <= 1024:
-            context.log_result(key, obj)
-        else:
-            context.log_artifact(key, body=blob, format="json")
-
-    def unpack(self, data_item, hint):
-        raw = data_item.get()
-        text = raw.decode() if isinstance(raw, bytes) else raw
-        obj = json.loads(text)
-        if hint in (tuple, set):
-            return hint(obj)
-        return obj
-
-
-class NumpyPackager(Packager):
-    artifact_type = "artifact"
-
-    def can_pack(self, obj):
-        import numpy as np
-
-        return isinstance(obj, np.ndarray)
-
-    def can_unpack(self, hint):
-        import numpy as np
-
-        return hint is np.ndarray
-
-    def pack(self, context, obj, key, **cfg):
-        if obj.ndim == 0:
-            context.log_result(key, obj.item())
-            return
-        import numpy as np
-
-        tmp = tempfile.NamedTemporaryFile(suffix=".npy", delete=False)
-        np.save(tmp.name, obj)
-        context.log_artifact(key, local_path=tmp.name, format="npy")
-
-    def unpack(self, data_item, hint):
-        import numpy as np
-
-        return np.load(data_item.local())
-
-
-class JaxPackager(Packager):
-    """JAX arrays/pytrees — device arrays land as npy artifacts, scalars as
-    results (TPU-native addition; no reference analog)."""
-
-    def can_pack(self, obj):
-        try:
-            import jax
-
-            return isinstance(obj, jax.Array)
-        except Exception:  # noqa: BLE001
-            return False
-
-    def can_unpack(self, hint):
-        try:
-            import jax
-
-            return hint is jax.Array
-        except Exception:  # noqa: BLE001
-            return False
-
-    def pack(self, context, obj, key, **cfg):
-        import numpy as np
-
-        host = np.asarray(obj)
-        if host.ndim == 0:
-            context.log_result(key, host.item())
-            return
-        tmp = tempfile.NamedTemporaryFile(suffix=".npy", delete=False)
-        np.save(tmp.name, host)
-        context.log_artifact(key, local_path=tmp.name, format="npy")
-
-    def unpack(self, data_item, hint):
-        import jax.numpy as jnp
-        import numpy as np
-
-        return jnp.asarray(np.load(data_item.local()))
-
-
-class PandasPackager(Packager):
-    artifact_type = "dataset"
-
-    def can_pack(self, obj):
-        import pandas as pd
-
-        return isinstance(obj, (pd.DataFrame, pd.Series))
-
-    def can_unpack(self, hint):
-        import pandas as pd
-
-        return hint in (pd.DataFrame, pd.Series)
-
-    def pack(self, context, obj, key, **cfg):
-        import pandas as pd
-
-        if isinstance(obj, pd.Series):
-            obj = obj.to_frame()
-        context.log_dataset(key, df=obj, format=cfg.get("file_format", "parquet"))
-
-    def unpack(self, data_item, hint):
-        import pandas as pd
-
-        df = data_item.as_df()
-        if hint is pd.Series:
-            return df.iloc[:, 0]
-        return df
-
-
-class PathPackager(Packager):
-    def can_pack(self, obj):
-        return isinstance(obj, pathlib.Path)
-
-    def can_unpack(self, hint):
-        return hint in (pathlib.Path,)
-
-    def pack(self, context, obj, key, **cfg):
-        context.log_artifact(key, local_path=str(obj))
-
-    def unpack(self, data_item, hint):
-        return pathlib.Path(data_item.local())
+Packager = DefaultPackager  # round-1 name for the base class
 
 
 class PackagersManager:
     def __init__(self):
-        self._packagers: list[Packager] = [
-            PandasPackager(), NumpyPackager(), JaxPackager(),
-            PrimitivePackager(), CollectionPackager(), PathPackager(),
-        ]
+        self._packagers: list[DefaultPackager] = sorted(
+            (cls() for cls in DEFAULT_PACKAGERS),
+            key=lambda p: p.priority)
 
-    def register(self, packager: Packager, first: bool = True):
+    def register(self, packager: DefaultPackager, first: bool = True):
         if first:
             self._packagers.insert(0, packager)
         else:
@@ -200,19 +46,26 @@ class PackagersManager:
 
     def pack(self, context, obj: Any, log_hint: dict):
         key = log_hint.get("key", "return")
-        artifact_type = log_hint.get("artifact_type")
+        artifact_type = log_hint.get("artifact_type") or ""
         if artifact_type == "result":
-            context.log_result(key, obj)
-            return
+            # explicit result hint wins regardless of family
+            if _jsonable(obj):
+                context.log_result(key, obj)
+                return
         if artifact_type == "model":
-            context.log_model(key, body=obj if isinstance(obj, (bytes, str)) else None)
+            context.log_model(
+                key, body=obj if isinstance(obj, (bytes, str)) else None)
             return
+        cfg = {k: v for k, v in log_hint.items()
+               if k not in ("key", "artifact_type")}
         for packager in self._packagers:
             try:
                 if packager.can_pack(obj):
-                    packager.pack(context, obj, key, **{
-                        k: v for k, v in log_hint.items()
-                        if k not in ("key", "artifact_type")})
+                    try:
+                        packager.pack(context, obj, key,
+                                      artifact_type=artifact_type, **cfg)
+                    finally:
+                        packager.cleanup()
                     return
             except ImportError:
                 continue
@@ -220,19 +73,30 @@ class PackagersManager:
         context.log_result(key, str(obj))
 
     def unpack(self, data_item, hint):
-        if hint is None or hint is Any:
-            return data_item
         from ..datastore.base import DataItem
 
-        if hint is DataItem:
+        candidates = reduce_hint(hint)
+        if not candidates or DataItem in candidates:
             return data_item
-        if hint in (str,) and data_item.kind == "file":
-            # mirror the reference convention: str hint on an input = local path
+        if str in candidates and data_item.kind == "file":
+            # mirror the reference convention: str hint on an input = local
+            # path
             return data_item.local()
-        for packager in self._packagers:
-            try:
-                if packager.can_unpack(hint):
-                    return packager.unpack(data_item, hint)
-            except ImportError:
-                continue
+        for candidate in candidates:
+            for packager in self._packagers:
+                try:
+                    if packager.can_unpack(candidate):
+                        return packager.unpack(data_item, candidate)
+                except ImportError:
+                    continue
         return data_item
+
+
+def _jsonable(obj) -> bool:
+    import json
+
+    try:
+        json.dumps(obj)
+        return True
+    except (TypeError, ValueError):
+        return False

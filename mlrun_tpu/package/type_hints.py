@@ -1,0 +1,61 @@
+"""Type-hint parsing for handler IO (reference analog:
+mlrun/package/utils/type_hint_utils.py — string-hint resolution and
+typing-construct reduction, re-implemented compactly).
+
+``reduce_hint`` turns any annotation — a concrete type, a string like
+"pandas.DataFrame", or a typing construct (Optional[X], Union[A, B],
+List[int], Annotated[X, ...]) — into the list of concrete candidate types a
+packager can match against.
+"""
+
+from __future__ import annotations
+
+import builtins
+import importlib
+import typing
+from typing import Any, Union
+
+_SHORTHAND_MODULES = {
+    "np": "numpy", "pd": "pandas", "jnp": "jax.numpy", "plt":
+    "matplotlib.pyplot",
+}
+
+
+def parse_string_hint(hint: str):
+    """Resolve "module.Type" / builtin-name strings to the actual type.
+    Returns None when the module is unavailable or the name is unknown."""
+    hint = hint.strip()
+    if "." not in hint:
+        return getattr(builtins, hint, None)
+    module_name, _, attr = hint.rpartition(".")
+    module_name = _SHORTHAND_MODULES.get(module_name, module_name)
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError:
+        return None
+    return getattr(module, attr, None)
+
+
+def reduce_hint(hint: Any) -> list:
+    """Reduce an annotation to concrete candidate types (ordered; empty
+    when nothing concrete can be derived)."""
+    if hint is None or hint is Any or hint is typing.Any:
+        return []
+    if isinstance(hint, str):
+        resolved = parse_string_hint(hint)
+        return reduce_hint(resolved) if resolved is not None else []
+    origin = typing.get_origin(hint)
+    if origin is None:
+        return [hint] if isinstance(hint, type) else []
+    if origin is Union:  # Optional[X] is Union[X, None]
+        out = []
+        for arg in typing.get_args(hint):
+            if arg is type(None):
+                continue
+            out.extend(reduce_hint(arg))
+        return out
+    if origin is getattr(typing, "Annotated", object()):
+        args = typing.get_args(hint)
+        return reduce_hint(args[0]) if args else []
+    # parameterized generic (List[int], Dict[str, float], ...) → its origin
+    return [origin] if isinstance(origin, type) else []

@@ -673,6 +673,39 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             request.match_info["project"], keys=keys, provider=provider)
         return json_response({"ok": True})
 
+    # -- datastore profiles (reference: server-side datastore_profile
+    # endpoints; private fields go to the project-secret store and are
+    # never returned) ------------------------------------------------------
+    @r.put(API + "/projects/{project}/datastore-profiles/{name}")
+    async def store_datastore_profile(request):
+        body = await request.json()
+        profile = body.get("profile") or {}
+        profile["name"] = request.match_info["name"]
+        state.db.store_datastore_profile(
+            profile, request.match_info["project"],
+            private=body.get("private") or None)
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/datastore-profiles/{name}")
+    async def get_datastore_profile(request):
+        profile = state.db.get_datastore_profile(
+            request.match_info["name"], request.match_info["project"])
+        if profile is None:
+            return error_response("datastore profile not found", 404)
+        return json_response({"data": profile})
+
+    @r.get(API + "/projects/{project}/datastore-profiles")
+    async def list_datastore_profiles(request):
+        return json_response({"datastore_profiles":
+                              state.db.list_datastore_profiles(
+                                  request.match_info["project"])})
+
+    @r.delete(API + "/projects/{project}/datastore-profiles/{name}")
+    async def delete_datastore_profile(request):
+        state.db.delete_datastore_profile(
+            request.match_info["name"], request.match_info["project"])
+        return json_response({"ok": True})
+
     # -- operations / introspection ---------------------------------------------
     @r.get(API + "/operations/memory-report")
     async def memory_report(request):

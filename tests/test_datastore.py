@@ -45,3 +45,102 @@ def test_store_uri_resolution(rundb_mock, tmp_path):
 def test_unsupported_scheme():
     with pytest.raises(ValueError):
         store_manager.object(url="bogus://x/y")
+
+
+def test_temporary_client_profile_resolves_ds_url(tmp_path):
+    """ds://profile/sub resolves through the client-side registry to the
+    real store (reference datastore_profile.py)."""
+    from mlrun_tpu.datastore import (
+        DatastoreProfileBasic,
+        register_temporary_client_datastore_profile,
+        remove_temporary_client_datastore_profile,
+        store_manager,
+    )
+
+    (tmp_path / "d").mkdir()
+    (tmp_path / "d" / "x.txt").write_text("hello-profile")
+    profile = DatastoreProfileBasic("local", url=f"file://{tmp_path}/d")
+    register_temporary_client_datastore_profile(profile)
+    try:
+        item = store_manager.object(url="ds://local/x.txt")
+        assert item.get().decode() == "hello-profile"
+    finally:
+        remove_temporary_client_datastore_profile("local")
+
+
+def test_profile_public_private_split(service, http_db):
+    """Server-side profiles: public part over REST, private part in the
+    project secret store only."""
+    url, state = service
+    http_db.store_datastore_profile(
+        {"name": "mybucket", "type": "s3",
+         "fields": {"bucket": "b1", "endpoint_url": "http://minio:9000"}},
+        project="dsp",
+        private={"access_key_id": "AK", "secret_key": "SK"})
+    public = http_db.get_datastore_profile("mybucket", "dsp")
+    assert public["fields"]["bucket"] == "b1"
+    assert "SK" not in str(public)
+    assert [p["name"] for p in
+            http_db.list_datastore_profiles("dsp")] == ["mybucket"]
+
+    # server-side resolution merges the private part back
+    from mlrun_tpu.datastore.profiles import datastore_profile_read
+
+    profile = datastore_profile_read("mybucket", project="dsp", db=state.db)
+    assert profile.secrets()["AWS_ACCESS_KEY_ID"] == "AK"
+    assert profile.secrets()["S3_ENDPOINT_URL"] == "http://minio:9000"
+    assert profile.url("path/f.parquet") == "s3://b1/path/f.parquet"
+
+    http_db.delete_datastore_profile("mybucket", "dsp")
+    assert http_db.list_datastore_profiles("dsp") == []
+    assert state.db.list_project_secret_keys("dsp") == []
+
+
+def test_s3_storage_options_mapping():
+    """Per-store credential plumbing builds fsspec storage options from
+    profile secrets (reference s3.py:26 option handling)."""
+    from mlrun_tpu.datastore.stores import FsspecStore
+
+    store = FsspecStore(None, "s3://x", "s3", "bkt", secrets={
+        "AWS_ACCESS_KEY_ID": "AK", "AWS_SECRET_ACCESS_KEY": "SK",
+        "S3_ENDPOINT_URL": "http://minio:9000", "AWS_REGION": "us-east-1"})
+    options = store.storage_options()
+    assert options == {"key": "AK", "secret": "SK",
+                       "endpoint_url": "http://minio:9000",
+                       "client_kwargs": {"region_name": "us-east-1"}}
+
+    az = FsspecStore(None, "az://c", "az", "cont", secrets={
+        "AZURE_STORAGE_CONNECTION_STRING": "cs",
+        "AZURE_STORAGE_ACCOUNT_NAME": "acct"})
+    assert az.storage_options() == {"connection_string": "cs",
+                                    "account_name": "acct"}
+
+
+def test_profile_private_cleared_on_restore(service, http_db):
+    """Re-storing a profile without a private part clears stale secrets
+    (credential rotation must never silently reuse old keys)."""
+    url, state = service
+    http_db.store_datastore_profile(
+        {"name": "rot", "type": "s3", "fields": {"bucket": "b"}},
+        project="dsp2", private={"secret_key": "OLD"})
+    assert state.db.list_project_secret_keys("dsp2")
+    http_db.store_datastore_profile(
+        {"name": "rot", "type": "s3", "fields": {"bucket": "b"}},
+        project="dsp2")
+    assert state.db.list_project_secret_keys("dsp2") == []
+    assert http_db.get_datastore_profile("missing", "dsp2") is None
+
+
+def test_ds_url_resolves_project_profile(service, http_db, tmp_path):
+    """ds:// urls resolve DB-stored profiles in the caller's project."""
+    from mlrun_tpu.datastore import StoreManager
+
+    url, state = service
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "z.txt").write_text("proj-profile")
+    http_db.store_datastore_profile(
+        {"name": "projstore", "type": "basic",
+         "fields": {"url": f"file://{tmp_path}/data"}}, project="dsp3")
+    manager = StoreManager(db=state.db)
+    item = manager.object(url="ds://projstore/z.txt", project="dsp3")
+    assert item.get().decode() == "proj-profile"

@@ -188,3 +188,40 @@ def test_chunked_loss_matches_full(cfg):
                     jax.tree_util.tree_leaves(g_chunk)):
         assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                      - b.astype(jnp.float32)))) < 2e-2
+
+
+def test_remat_policies_same_loss_and_grads(cfg):
+    """save_attn / dots remat policies change memory scheduling only —
+    loss and gradients must match the full-recompute policy exactly."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from mlrun_tpu.models.llama import init_params, loss_fn
+
+    base = dataclasses.replace(cfg, remat=True, remat_policy="nothing")
+    params = init_params(base, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 32), dtype=np.int32))
+
+    def grads_for(policy):
+        c = dataclasses.replace(base, remat_policy=policy)
+        val, g = jax.value_and_grad(
+            lambda p: loss_fn(c, p, tokens, tokens)[0])(params)
+        return float(val), g
+
+    v0, g0 = grads_for("nothing")
+    for policy in ("save_attn", "dots"):
+        v, g = grads_for(policy)
+        assert abs(v - v0) < 1e-5
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g)):
+            assert float(jnp.max(jnp.abs(
+                a.astype(jnp.float32) - b.astype(jnp.float32)))) < 1e-4
+
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        grads_for("bogus")

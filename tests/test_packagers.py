@@ -43,3 +43,67 @@ def test_dataitem_passthrough(tmp_path):
     fn = mlrun_tpu.new_function("p", kind="local", handler=handler)
     run = fn.run(inputs={"data": str(path)}, local=True)
     assert run.status.results["size"] == 3
+
+
+def test_extended_families_roundtrip(tmp_path):
+    """New packager families: jax arrays/pytrees, numpy npz dict/list,
+    datetime, bytes (reference packagers/ module split)."""
+    import datetime
+
+    import jax.numpy as jnp
+
+    def handler(context):
+        return (jnp.arange(4.0),
+                {"layer0": np.ones((2, 2)), "layer1": np.zeros(3)},
+                [np.arange(2), np.arange(3)],
+                datetime.datetime(2026, 7, 29, 12, 0),
+                b"\x00\x01",
+                np.float32(0.25))
+
+    fn = mlrun_tpu.new_function("p2", kind="local", handler=handler)
+    run = fn.run(local=True, returns=[
+        "jaxarr", "npdict", "nplist", "when", "blob", "scalar"])
+    assert "jaxarr" in run.status.artifact_uris
+    assert "npdict" in run.status.artifact_uris
+    assert "nplist" in run.status.artifact_uris
+    assert run.status.results["when"] == "2026-07-29T12:00:00"
+    assert "blob" in run.status.artifact_uris
+    assert run.status.results["scalar"] == 0.25
+    loaded = np.load(run.artifact("npdict").local())
+    assert set(loaded.files) == {"layer0", "layer1"}
+
+
+def test_typing_hint_unpacking(tmp_path):
+    """Optional/Union/string hints reduce to concrete families."""
+    from typing import Optional
+
+    csv = tmp_path / "in.csv"
+    pd.DataFrame({"x": [1, 2, 3]}).to_csv(csv, index=False)
+    npy = tmp_path / "a.npy"
+    np.save(npy, np.arange(5))
+
+    def handler(context, data: Optional[pd.DataFrame] = None,
+                arr: "np.ndarray" = None):
+        context.log_result("rows", len(data))
+        context.log_result("total", int(arr.sum()))
+
+    fn = mlrun_tpu.new_function("p3", kind="local", handler=handler)
+    run = fn.run(inputs={"data": str(csv), "arr": str(npy)}, local=True)
+    assert run.status.results["rows"] == 3
+    assert run.status.results["total"] == 10
+
+
+def test_reduce_hint_variants():
+    from typing import Any, Dict, List, Optional, Union
+
+    from mlrun_tpu.package.type_hints import reduce_hint
+
+    assert reduce_hint(int) == [int]
+    assert reduce_hint(Optional[str]) == [str]
+    assert set(reduce_hint(Union[int, float])) == {int, float}
+    assert reduce_hint(List[int]) == [list]
+    assert reduce_hint(Dict[str, int]) == [dict]
+    assert reduce_hint("pandas.DataFrame") == [pd.DataFrame]
+    assert reduce_hint("np.ndarray") == [np.ndarray]
+    assert reduce_hint("nonexistent.module.T") == []
+    assert reduce_hint(None) == [] and reduce_hint(Any) == []
