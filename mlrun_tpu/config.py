@@ -45,6 +45,15 @@ default_config: dict[str, Any] = {
         "token": "",
         "logs_poll_interval": 2.0,
     },
+    "projects": {
+        # leader/follower sync (reference server/api/utils/projects/
+        # leader.py:42, follower.py:46): when leader_url points at another
+        # mlrun-tpu service, this instance follows — projects are synced
+        # from the leader periodically and local project mutations are
+        # forwarded to it
+        "leader_url": "",
+        "sync_interval": 30.0,
+    },
     "runs": {
         "monitoring_interval": 30.0,
         # per-state stuck thresholds in seconds (reference: state_thresholds,

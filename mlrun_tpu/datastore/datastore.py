@@ -63,11 +63,12 @@ class StoreManager:
             from .profiles import datastore_profile_read
 
             profile = datastore_profile_read(endpoint, project=project,
-                                             db=self._db)
+                                             db=self._get_db())
             real_url = profile.url(path)
             merged = dict(profile.secrets())
             merged.update(secrets or {})
-            return self.get_or_create_store(real_url, secrets=merged or None)
+            return self.get_or_create_store(real_url, secrets=merged or None,
+                                            project=project)
         store_key = f"{scheme}://{endpoint}"
         if store_key not in self._stores or secrets:
             cls = schema_to_store.get(scheme)

@@ -1,17 +1,101 @@
-"""ONNX adapter (reference analog: mlrun/frameworks/onnx/).
+"""ONNX adapter (reference analog: mlrun/frameworks/onnx/ — to_onnx model
+conversion + ONNXModelServer).
 
-Gated on onnx/onnxruntime. On TPU deployments the preferred path is native
-jax export (the model registry stores orbax/jax trees); onnx remains for
-interop with external serving stacks.
+Gated on the onnx/onnxruntime packages (not in the TPU base image). On TPU
+deployments the preferred path is native jax export (the model registry
+stores orbax/jax trees); onnx remains for interop with external serving
+stacks.
 """
 
 from __future__ import annotations
 
+import os
+import tempfile
+from typing import Any
 
-def to_onnx(model, context=None, model_name: str = "model", **kwargs):
-    raise ImportError(
-        "onnx export requires the onnx package (not in this environment); "
-        "use the jax/orbax model registry path instead")
+
+def to_onnx(model: Any, context=None, model_name: str = "model",
+            sample_input=None, input_names: list | None = None,
+            output_names: list | None = None, target_path: str = "",
+            **export_kwargs) -> str:
+    """Convert a torch module / sklearn estimator / keras model to ONNX and
+    (when a context is given) register it in the artifact registry.
+
+    Returns the exported file path. Requires the ``onnx`` package plus the
+    family converter (torch bundles its exporter; sklearn needs skl2onnx,
+    keras needs tf2onnx).
+    """
+    try:
+        import onnx  # noqa: F401  - gated: the serializer every path needs
+    except ImportError as exc:
+        raise ImportError(
+            "onnx export requires the onnx package; use the jax/orbax "
+            "model registry path on TPU deployments") from exc
+
+    path = target_path or os.path.join(tempfile.mkdtemp(prefix="mlt-onnx-"),
+                                       f"{model_name}.onnx")
+
+    exported = False
+    try:
+        import torch
+    except ImportError:  # guard ONLY the import — export errors must
+        torch = None     # surface, not fall through to other families
+
+    if torch is not None and isinstance(model, torch.nn.Module):
+        if sample_input is None:
+            raise ValueError(
+                "torch export needs sample_input (example args)")
+        if not isinstance(sample_input, tuple):
+            sample_input = (sample_input,)
+        torch.onnx.export(
+            model, sample_input, path,
+            input_names=input_names, output_names=output_names,
+            **export_kwargs)
+        exported = True
+
+    if not exported and _is_sklearn(model):
+        from skl2onnx import to_onnx as skl_to_onnx  # gated import
+
+        onx = skl_to_onnx(model, X=sample_input, **export_kwargs)
+        with open(path, "wb") as fp:
+            fp.write(onx.SerializeToString())
+        exported = True
+
+    if not exported and _is_keras(model):
+        import tf2onnx  # gated import
+
+        model_proto, _ = tf2onnx.convert.from_keras(model, **export_kwargs)
+        with open(path, "wb") as fp:
+            fp.write(model_proto.SerializeToString())
+        exported = True
+
+    if not exported:
+        raise ValueError(
+            f"no onnx converter for model type {type(model).__name__} "
+            "(torch module, sklearn estimator, or keras model expected)")
+
+    if context is not None:
+        context.log_model(model_name, model_file=path, framework="onnx",
+                          upload=True)
+    return path
+
+
+def _is_sklearn(model) -> bool:
+    try:
+        from sklearn.base import BaseEstimator
+
+        return isinstance(model, BaseEstimator)
+    except ImportError:
+        return False
+
+
+def _is_keras(model) -> bool:
+    try:
+        from tensorflow import keras
+
+        return isinstance(model, keras.Model)
+    except ImportError:
+        return False
 
 
 def ONNXModelServer(*args, **kwargs):

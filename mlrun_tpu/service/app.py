@@ -78,6 +78,9 @@ class ServiceState:
         self.provider = provider or LocalProcessProvider(self.db)
         self.launcher = ServerSideLauncher(self.db, self.provider)
         self.launcher.recover()  # re-adopt resources from before a restart
+        from .projects_sync import ProjectsFollower
+
+        self.projects_follower = ProjectsFollower(self.db)
         self.background_tasks: dict[str, dict] = {}
         self.workflows: dict[str, dict] = {}
         self.started = time.time()
@@ -424,7 +427,20 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     @r.post(API + "/projects/{name}")
     async def store_project(request):
         body = await request.json()
-        stored = state.db.store_project(request.match_info["name"], body)
+        name = request.match_info["name"]
+        if state.projects_follower.enabled:
+            # leader-first (reference follower.py create/store flow)
+            loop = asyncio.get_event_loop()
+            try:
+                stored = await loop.run_in_executor(
+                    None,
+                    lambda: state.projects_follower.forward_store(name,
+                                                                  body))
+            except Exception as exc:  # noqa: BLE001
+                return error_response(f"project leader rejected: {exc}",
+                                      502)
+            return json_response({"data": stored})
+        stored = state.db.store_project(name, body)
         return json_response({"data": stored})
 
     @r.get(API + "/projects/{name}")
@@ -443,11 +459,17 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     async def delete_project(request):
         from ..db.base import RunDBError
 
+        name = request.match_info["name"]
+        strategy = request.query.get("deletion_strategy", "restricted")
         try:
-            state.db.delete_project(
-                request.match_info["name"],
-                deletion_strategy=request.query.get(
-                    "deletion_strategy", "restricted"))
+            if state.projects_follower.enabled:
+                loop = asyncio.get_event_loop()
+                await loop.run_in_executor(
+                    None,
+                    lambda: state.projects_follower.forward_delete(
+                        name, deletion_strategy=strategy))
+            else:
+                state.db.delete_project(name, deletion_strategy=strategy)
         except RunDBError as exc:
             return error_response(str(exc), 412)
         return json_response({"ok": True})
@@ -813,6 +835,16 @@ async def _start_periodic(app: web.Application):
         asyncio.create_task(monitor_loop()),
         asyncio.create_task(scheduler_loop()),
     ]
+
+    if state.projects_follower.enabled:
+        async def projects_sync_loop():
+            while True:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, state.projects_follower.sync_safe)
+                await asyncio.sleep(
+                    float(mlconf.projects.sync_interval))
+
+        app["_periodic"].append(asyncio.create_task(projects_sync_loop()))
 
 
 async def _fire_schedule(state: ServiceState, schedule: dict):

@@ -76,6 +76,30 @@ class LogCollectorClient:
     def start_log(self, project: str, uid: str, src_path: str):
         self._command(f"START {project} {uid} {src_path}")
 
+    def start_command(self, project: str, uid: str, command: str,
+                      token: str = ""):
+        """Stream a subprocess's stdout into the store (pod-log streaming:
+        reference server.go:880 streams the k8s pod-log API; here the
+        daemon runs e.g. ``kubectl logs -f`` which carries cluster auth).
+
+        Command streaming is token-gated — the daemon must run with
+        ``--cmd-token`` (or MLT_LOGD_CMD_TOKEN) and the same token must be
+        presented here (default: the MLT_LOGD_CMD_TOKEN env var)."""
+        token = token or os.environ.get("MLT_LOGD_CMD_TOKEN", "")
+        payload = command.encode()
+        self._command(
+            f"STARTCMD {project} {uid} {token or '-'} {len(payload)}",
+            payload=payload)
+
+    def start_pod_logs(self, project: str, uid: str, pod: str,
+                       namespace: str = "default", container: str = "",
+                       token: str = ""):
+        """Collect a pod's logs via the kubectl streaming API."""
+        command = f"kubectl logs -f {pod} -n {namespace}"
+        if container:
+            command += f" -c {container}"
+        self.start_command(project, uid, command, token=token)
+
     def append(self, project: str, uid: str, data: bytes):
         if isinstance(data, str):
             data = data.encode()

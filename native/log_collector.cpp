@@ -11,6 +11,9 @@
 //
 // Protocol (text header lines, binary payloads):
 //   START <project> <uid> <src_path>\n          -> OK\n
+//   STARTCMD <project> <uid> <nbytes>\n<cmd>    -> OK\n   (stream a
+//       subprocess's stdout, e.g. "kubectl logs -f <pod> -n <ns>" — the
+//       pod-log API equivalent of the reference's streaming goroutine)
 //   APPEND <project> <uid> <nbytes>\n<bytes>    -> OK\n
 //   GET <project> <uid> <offset> <max>\n        -> OK <n>\n<bytes>
 //   SIZE <project> <uid>\n                      -> OK <n>\n
@@ -23,10 +26,12 @@
 #include <dirent.h>
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <signal.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -44,10 +49,17 @@
 namespace {
 
 std::string g_store_dir = "/tmp/mlt-logs";
+// STARTCMD runs shell commands as the daemon user, so it is OFF unless a
+// shared token is configured (--cmd-token / MLT_LOGD_CMD_TOKEN) and each
+// STARTCMD presents it — without this gate any local process could use the
+// unauthenticated localhost socket as an arbitrary-command service
+std::string g_cmd_token;
 std::atomic<bool> g_running{true};
 
 struct Tailer {
   std::string project, uid, src;
+  bool is_command = false;  // src is a shell command whose stdout we stream
+  pid_t child_pid = -1;     // command tailer's subprocess (for STOP)
   std::thread thread;
   std::atomic<bool> stop{false};
   std::atomic<bool> finished{false};  // set by tail_loop on exit
@@ -110,6 +122,108 @@ void write_state(const std::string& project, const std::string& uid,
 
 void remove_state(const std::string& project, const std::string& uid) {
   unlink(state_path(project, uid).c_str());
+  unlink((state_path(project, uid) + ".cmd").c_str());
+}
+
+// commands may be long and contain newlines — they live whole in a
+// sidecar file, never inline in the line-based state record
+void write_command_file(const std::string& project, const std::string& uid,
+                        const std::string& command) {
+  std::string path = state_path(project, uid) + ".cmd";
+  ensure_parent(path);
+  FILE* f = fopen(path.c_str(), "wb");
+  if (f) {
+    fwrite(command.data(), 1, command.size(), f);
+    fclose(f);
+  }
+}
+
+bool read_command_file(const std::string& project, const std::string& uid,
+                       std::string* command) {
+  std::string path = state_path(project, uid) + ".cmd";
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  char buf[4096];
+  command->clear();
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0)
+    command->append(buf, n);
+  fclose(f);
+  return true;
+}
+
+int spawn_command(const std::string& command, pid_t* child_pid) {
+  // fork/exec with our own pipe (instead of popen) so STOP can SIGTERM
+  // the child by pid — a quiet `kubectl logs -f` would otherwise never
+  // notice the reader went away and leak forever
+  int fds[2];
+  if (pipe(fds) != 0) return -1;
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(fds[0]);
+    close(fds[1]);
+    return -1;
+  }
+  if (pid == 0) {
+    dup2(fds[1], 1);
+    dup2(fds[1], 2);
+    close(fds[0]);
+    close(fds[1]);
+    execl("/bin/sh", "sh", "-c", command.c_str(),
+          static_cast<char*>(nullptr));
+    _exit(127);
+  }
+  close(fds[1]);
+  *child_pid = pid;
+  return fds[0];
+}
+
+void command_tail_loop(Tailer* t) {
+  // stream a subprocess's stdout into the store (pod-log streaming: the
+  // command is typically `kubectl logs -f <pod> -n <ns>`, which carries
+  // the cluster auth the daemon itself does not need to speak)
+  std::string dest = dest_path(t->project, t->uid);
+  ensure_parent(dest);
+  FILE* out = fopen(dest.c_str(), "ab");
+  if (!out) {
+    t->finished.store(true);
+    return;
+  }
+  pid_t pid = -1;
+  int fd = spawn_command(t->src, &pid);
+  if (fd < 0) {
+    fclose(out);
+    t->finished.store(true);
+    return;
+  }
+  t->child_pid = pid;
+  char buf[64 * 1024];
+  while (!t->stop.load() && g_running.load()) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int ready = poll(&pfd, 1, 200);
+    if (ready < 0) break;
+    if (ready == 0) continue;
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) break;  // command exited (pod gone / stream closed)
+    fwrite(buf, 1, static_cast<size_t>(n), out);
+    fflush(out);
+  }
+  close(fd);
+  // reap the child: TERM, short grace, then KILL
+  kill(pid, SIGTERM);
+  for (int i = 0; i < 20; ++i) {
+    if (waitpid(pid, nullptr, WNOHANG) != 0) {
+      pid = -1;
+      break;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  if (pid > 0) {
+    kill(pid, SIGKILL);
+    waitpid(pid, nullptr, 0);
+  }
+  fclose(out);
+  t->finished.store(true);
 }
 
 void tail_loop(Tailer* t) {
@@ -150,7 +264,8 @@ void tail_loop(Tailer* t) {
 }
 
 void start_tail(const std::string& project, const std::string& uid,
-                const std::string& src, bool persist_state) {
+                const std::string& src, bool persist_state,
+                bool is_command = false) {
   std::lock_guard<std::mutex> lock(g_tailers_mu);
   std::string key = key_of(project, uid);
   auto it = g_tailers.find(key);
@@ -166,9 +281,17 @@ void start_tail(const std::string& project, const std::string& uid,
   t->project = project;
   t->uid = uid;
   t->src = src;
-  t->thread = std::thread(tail_loop, t);
+  t->is_command = is_command;
+  t->thread = std::thread(is_command ? command_tail_loop : tail_loop, t);
   g_tailers[key] = t;
-  if (persist_state) write_state(project, uid, src);
+  if (persist_state) {
+    if (is_command) {
+      write_command_file(project, uid, src);
+      write_state(project, uid, "cmd:@");
+    } else {
+      write_state(project, uid, "file:" + src);
+    }
+  }
 }
 
 void resume_from_state() {
@@ -191,7 +314,18 @@ void resume_from_state() {
       strip(project);
       strip(uid);
       strip(src);
-      start_tail(project, uid, src, false);
+      std::string source = src;
+      bool is_command = false;
+      if (source.rfind("cmd:", 0) == 0) {
+        is_command = true;
+        if (!read_command_file(project, uid, &source)) {
+          fclose(f);
+          continue;  // sidecar missing — nothing safe to run
+        }
+      } else if (source.rfind("file:", 0) == 0) {
+        source = source.substr(5);
+      }
+      start_tail(project, uid, source, false, is_command);
       fprintf(stderr, "resumed log collection %s/%s <- %s\n", project, uid,
               src);
     }
@@ -249,6 +383,25 @@ void handle_conn(int fd) {
         continue;
       }
       start_tail(project, uid, src, true);
+      send_str(fd, "OK\n");
+    } else if (cmd == "STARTCMD") {
+      std::string project, uid, token;
+      long nbytes = 0;
+      iss >> project >> uid >> token >> nbytes;
+      if (!valid_component(project) || !valid_component(uid) || nbytes <= 0 ||
+          nbytes > 65536) {
+        send_str(fd, "ERR bad arguments\n");
+        continue;
+      }
+      std::vector<char> cmdbuf(static_cast<size_t>(nbytes));
+      if (!read_exact(fd, cmdbuf.data(), cmdbuf.size())) break;
+      if (g_cmd_token.empty() || token != g_cmd_token) {
+        send_str(fd, "ERR command streaming disabled (set --cmd-token "
+                     "and present it)\n");
+        continue;
+      }
+      start_tail(project, uid, std::string(cmdbuf.begin(), cmdbuf.end()),
+                 true, true);
       send_str(fd, "OK\n");
     } else if (cmd == "APPEND") {
       std::string project, uid;
@@ -355,12 +508,20 @@ int main(int argc, char** argv) {
     std::string arg = argv[i];
     if (arg == "--port" && i + 1 < argc) port = atoi(argv[++i]);
     if (arg == "--store-dir" && i + 1 < argc) g_store_dir = argv[++i];
+    if (arg == "--cmd-token" && i + 1 < argc) g_cmd_token = argv[++i];
+  }
+  if (g_cmd_token.empty()) {
+    const char* env_token = getenv("MLT_LOGD_CMD_TOKEN");
+    if (env_token) g_cmd_token = env_token;
   }
   signal(SIGPIPE, SIG_IGN);
   ensure_parent(g_store_dir + "/x");
   resume_from_state();
 
-  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  // CLOEXEC: command tailers popen() subprocesses that must NOT inherit
+  // the listening socket (an inherited fd would block rebinding the port
+  // after a daemon restart while a streamed command still runs)
+  int srv = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   int one = 1;
   setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
@@ -375,7 +536,7 @@ int main(int argc, char** argv) {
   fprintf(stderr, "mlt-logd listening on 127.0.0.1:%d store=%s\n", port,
           g_store_dir.c_str());
   while (g_running.load()) {
-    int fd = accept(srv, nullptr, nullptr);
+    int fd = accept4(srv, nullptr, nullptr, SOCK_CLOEXEC);
     if (fd < 0) continue;
     std::thread(handle_conn, fd).detach();
   }

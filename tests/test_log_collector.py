@@ -134,3 +134,197 @@ def test_db_routes_through_collector(daemon, tmp_path, monkeypatch):
     assert data == b"via collector"
     # file path untouched — proves the native path served it
     assert not os.path.exists(os.path.join(str(tmp_path / "logs"), "p9"))
+
+
+def test_command_streaming(tmp_path):
+    """STARTCMD streams a subprocess's stdout into the store (the pod-log
+    streaming mode; reference server.go:880)."""
+    import subprocess
+    import time
+
+    from mlrun_tpu.utils.log_collector import (
+        LogCollectorClient,
+        binary_path,
+        build_binary,
+    )
+
+    assert build_binary()
+    port = 18944
+    proc = subprocess.Popen(
+        [binary_path(), "--port", str(port), "--store-dir",
+         str(tmp_path), "--cmd-token", "tok123"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = LogCollectorClient(f"127.0.0.1:{port}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        # a short-lived "pod log stream": prints two lines then exits
+        client.start_command("p", "cmdrun",
+                             "printf 'line-one\\nline-two\\n'; sleep 0.2",
+                             token="tok123")
+        deadline = time.monotonic() + 10
+        data = b""
+        while time.monotonic() < deadline:
+            data = client.get_log("p", "cmdrun")
+            if b"line-two" in data:
+                break
+            time.sleep(0.2)
+        assert b"line-one\nline-two\n" == data, data
+        # exited commands are reaped from LIST like file tailers
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.list_in_progress() == []:
+                break
+            time.sleep(0.2)
+        assert client.list_in_progress() == []
+    finally:
+        proc.terminate()
+
+
+def test_command_streaming_resumes_after_restart(tmp_path):
+    """A restarted daemon re-launches persisted command tailers."""
+    import subprocess
+    import time
+
+    from mlrun_tpu.utils.log_collector import (
+        LogCollectorClient,
+        binary_path,
+    )
+
+    port = 18945
+    marker = tmp_path / "marker"
+    proc = subprocess.Popen(
+        [binary_path(), "--port", str(port), "--store-dir",
+         str(tmp_path), "--cmd-token", "tok123"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = LogCollectorClient(f"127.0.0.1:{port}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        # long-running command; persisted in the state store
+        client.start_command(
+            "p", "resumer", f"touch {marker}; echo started; sleep 30",
+            token="tok123")
+        for _ in range(50):
+            if marker.exists():
+                break
+            time.sleep(0.1)
+        proc.terminate()
+        proc.wait(timeout=5)
+        marker.unlink()
+
+        proc = subprocess.Popen(
+            [binary_path(), "--port", str(port), "--store-dir",
+             str(tmp_path), "--cmd-token", "tok123"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if marker.exists():  # the command was re-launched
+                break
+            time.sleep(0.2)
+        assert marker.exists()
+        assert "p/resumer" in client.list_in_progress()
+    finally:
+        proc.terminate()
+
+
+def test_command_streaming_requires_token(tmp_path):
+    """STARTCMD is rejected without the configured token (and entirely
+    when the daemon has no token) — the daemon must never be a localhost
+    arbitrary-command service."""
+    import subprocess
+    import time
+
+    from mlrun_tpu.utils.log_collector import (
+        LogCollectorClient,
+        binary_path,
+    )
+
+    port = 18946
+    proc = subprocess.Popen(
+        [binary_path(), "--port", str(port), "--store-dir", str(tmp_path)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = LogCollectorClient(f"127.0.0.1:{port}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        with pytest.raises(RuntimeError, match="disabled"):
+            client.start_command("p", "u", "echo nope")
+        assert not (tmp_path / "p" / "u").exists()
+    finally:
+        proc.terminate()
+
+    # token configured, wrong token presented
+    proc = subprocess.Popen(
+        [binary_path(), "--port", str(port + 1), "--store-dir",
+         str(tmp_path), "--cmd-token", "right"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = LogCollectorClient(f"127.0.0.1:{port + 1}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        with pytest.raises(RuntimeError):
+            client.start_command("p", "u2", "echo nope", token="wrong")
+        assert not (tmp_path / "p" / "u2").exists()
+    finally:
+        proc.terminate()
+
+
+def test_stop_kills_streamed_command(tmp_path):
+    """STOP terminates the streamed subprocess (a quiet `kubectl logs -f`
+    must not leak past its request)."""
+    import subprocess
+    import time
+
+    from mlrun_tpu.utils.log_collector import (
+        LogCollectorClient,
+        binary_path,
+    )
+
+    port = 18948
+    pidfile = tmp_path / "pid"
+    proc = subprocess.Popen(
+        [binary_path(), "--port", str(port), "--store-dir", str(tmp_path),
+         "--cmd-token", "tok123"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        client = LogCollectorClient(f"127.0.0.1:{port}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        client.start_command(
+            "p", "quiet", f"echo $$ > {pidfile}; exec sleep 600",
+            token="tok123")
+        for _ in range(50):
+            if pidfile.exists() and pidfile.read_text().strip():
+                break
+            time.sleep(0.1)
+        child_pid = int(pidfile.read_text().strip())
+        client.stop_log("p", "quiet")
+        import os
+
+        deadline = time.monotonic() + 10
+        gone = False
+        while time.monotonic() < deadline:
+            try:
+                os.kill(child_pid, 0)
+            except OSError:
+                gone = True
+                break
+            time.sleep(0.2)
+        assert gone, f"streamed child {child_pid} still alive after STOP"
+    finally:
+        proc.terminate()

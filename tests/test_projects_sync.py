@@ -1,0 +1,98 @@
+"""Projects leader/follower sync (reference analog:
+server/api/utils/projects/leader.py:42, follower.py:46)."""
+
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def follower_service(service, tmp_path, monkeypatch):
+    """A second service configured to follow the first (the leader)."""
+    import asyncio
+    import socket
+    import threading
+
+    from aiohttp import web
+
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.app import ServiceState, build_app
+
+    leader_url, leader_state = service
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    db = SQLiteRunDB(str(tmp_path / "follower.sqlite"),
+                     logs_dir=str(tmp_path / "flogs"))
+    mlconf.projects.leader_url = leader_url
+    mlconf.projects.sync_interval = 0.3
+    state = ServiceState(db=db)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    box = {}
+
+    async def serve():
+        runner = web.AppRunner(build_app(state))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        started.set()
+        while not box.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    thread = threading.Thread(
+        target=lambda: (asyncio.set_event_loop(loop),
+                        loop.run_until_complete(serve())), daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield f"http://127.0.0.1:{port}", state
+    box["stop"] = True
+    thread.join(timeout=5)
+    mlconf.projects.leader_url = ""
+
+
+def test_follower_syncs_projects_from_leader(service, follower_service):
+    from mlrun_tpu.db.httpdb import HTTPRunDB
+
+    leader_url, leader_state = service
+    follower_url, follower_state = follower_service
+    leader = HTTPRunDB(leader_url).connect()
+    follower = HTTPRunDB(follower_url).connect()
+
+    leader.store_project("alpha", {"metadata": {"name": "alpha"},
+                                   "spec": {"description": "from leader"}})
+    deadline = time.monotonic() + 15
+    names = []
+    while time.monotonic() < deadline:
+        names = [p.get("metadata", {}).get("name") or p.get("name")
+                 for p in follower.list_projects()]
+        if "alpha" in names:
+            break
+        time.sleep(0.2)
+    assert "alpha" in names, names
+
+    # leader-side delete archives on the follower at the next sync
+    leader.delete_project("alpha")
+    deadline = time.monotonic() + 15
+    archived = False
+    while time.monotonic() < deadline:
+        project = follower_state.db.get_project("alpha")
+        if project and project.get("status", {}).get("state") == "archived":
+            archived = True
+            break
+        time.sleep(0.2)
+    assert archived
+
+
+def test_follower_forwards_mutations_to_leader(service, follower_service):
+    from mlrun_tpu.db.httpdb import HTTPRunDB
+
+    leader_url, leader_state = service
+    follower_url, _ = follower_service
+    follower = HTTPRunDB(follower_url).connect()
+
+    follower.store_project("beta", {"metadata": {"name": "beta"}})
+    # the leader owns the lifecycle: the project must exist there
+    assert leader_state.db.get_project("beta") is not None
