@@ -58,6 +58,32 @@ class ArtifactStatus(ModelObj):
         self.stats = stats
 
 
+def upload_directory(target: str, src_dir: str) -> tuple[int, str]:
+    """Upload a local directory tree file-by-file under a target prefix
+    (shared by base/model artifacts). Returns (total_size, tree_hash) —
+    the hash digests sorted (relpath, file_sha1) pairs so identical trees
+    compare equal."""
+    from ..datastore import store_manager
+
+    store, prefix = store_manager.get_or_create_store(target)
+    prefix = prefix.rstrip("/")
+    total = 0
+    digest = hashlib.sha1()
+    entries = []
+    for root, _, files in os.walk(src_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, src_dir)
+            entries.append((rel, full))
+    for rel, full in sorted(entries):
+        store.upload(f"{prefix}/{rel}", full)
+        total += os.path.getsize(full)
+        with open(full, "rb") as fp:
+            digest.update(rel.encode())
+            digest.update(hashlib.sha1(fp.read()).digest())
+    return total, digest.hexdigest()
+
+
 class Artifact(ModelObj):
     kind = "artifact"
     _dict_fields = ["kind", "metadata", "spec", "status"]
@@ -139,6 +165,11 @@ class Artifact(ModelObj):
             self.spec.size = os.path.getsize(self.spec.src_path)
             with open(self.spec.src_path, "rb") as fp:
                 self.spec.hash = hashlib.sha1(fp.read()).hexdigest()
+        elif self.spec.src_path and os.path.isdir(self.spec.src_path):
+            # directory artifacts (tensorboard logs, checkpoints): upload
+            # the tree file by file under the target prefix
+            self.spec.size, self.spec.hash = upload_directory(
+                target, self.spec.src_path)
 
     def to_dataitem(self):
         from ..datastore import store_manager

@@ -109,7 +109,8 @@ class ArtifactManager:
 
         should_upload = upload if upload is not None else (
             item.get_body() is not None
-            or (item.spec.src_path and os.path.isfile(item.spec.src_path))
+            or (item.spec.src_path
+                and os.path.exists(item.spec.src_path))  # file OR directory
         )
         if should_upload:
             try:

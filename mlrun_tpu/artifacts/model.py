@@ -86,13 +86,10 @@ class ModelArtifact(Artifact):
             self.spec.size = os.path.getsize(self.model_file)
             self.model_file = fname
         elif src_dir and os.path.isdir(src_dir):
-            for root, _, files in os.walk(src_dir):
-                for fname in files:
-                    full = os.path.join(root, fname)
-                    rel = os.path.relpath(full, src_dir)
-                    store, path = store_manager.get_or_create_store(
-                        os.path.join(target, rel))
-                    store.upload(path, full)
+            from .base import upload_directory
+
+            self.spec.size, self.spec.hash = upload_directory(target,
+                                                              src_dir)
         # upload extra_data values that are local files
         for key, value in list(self.spec.extra_data.items()):
             if isinstance(value, str) and os.path.isfile(value):

@@ -8,3 +8,39 @@ from .schedule import ScheduleKinds, ScheduleRecord  # noqa: F401
 from .artifact import ArtifactRecord  # noqa: F401
 from .model_endpoint import ModelEndpoint  # noqa: F401
 from .alert import AlertConfigRecord, AlertSeverity, AlertState  # noqa: F401
+from .datastore_profile import (  # noqa: F401
+    DatastoreProfile,
+    DatastoreProfileCreate,
+)
+from .events import Event, EventKind  # noqa: F401
+from .feature_store import (  # noqa: F401
+    Entity,
+    Feature,
+    FeatureSetRecord,
+    FeatureSetSpec,
+    FeatureVectorRecord,
+    FeatureVectorSpec,
+)
+from .k8s import NodeSelector, Resources  # noqa: F401
+from .notification import (  # noqa: F401
+    Notification,
+    NotificationKind,
+    NotificationSeverity,
+    NotificationStatus,
+)
+from .pagination import PaginatedResponse, PaginationInfo  # noqa: F401
+from .runtime_resource import (  # noqa: F401
+    RuntimeResource,
+    RuntimeResourcesOutput,
+)
+from .secret import (  # noqa: F401
+    AuthSecretData,
+    SecretKeysData,
+    SecretProviderName,
+    SecretsData,
+)
+from .workflow import (  # noqa: F401
+    WorkflowSpec,
+    WorkflowState,
+    WorkflowStatusOut,
+)

@@ -1,0 +1,42 @@
+"""Kubernetes resource schemas (reference analog:
+mlrun/common/schemas/k8s.py — reduced to the TPU JobSet/pod surface)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pydantic
+
+
+class Resources(pydantic.BaseModel):
+    """Container resources; the accelerator resource is google.com/tpu
+    (replacing nvidia.com/gpu)."""
+
+    cpu: Optional[str] = None
+    memory: Optional[str] = None
+    tpu: Optional[int] = None
+
+    def to_k8s(self) -> dict:
+        out: dict = {}
+        if self.cpu:
+            out["cpu"] = self.cpu
+        if self.memory:
+            out["memory"] = self.memory
+        if self.tpu:
+            out["google.com/tpu"] = self.tpu
+        return out
+
+
+class NodeSelector(pydantic.BaseModel):
+    """TPU pod-slice placement (accelerator type + topology)."""
+
+    accelerator: Optional[str] = None  # e.g. tpu-v5-lite-podslice
+    topology: Optional[str] = None     # e.g. 4x4
+
+    def to_k8s(self) -> dict:
+        out = {}
+        if self.accelerator:
+            out["cloud.google.com/gke-tpu-accelerator"] = self.accelerator
+        if self.topology:
+            out["cloud.google.com/gke-tpu-topology"] = self.topology
+        return out

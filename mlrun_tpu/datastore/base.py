@@ -170,11 +170,24 @@ class DataItem:
         return self._store.listdir(self._path)
 
     def local(self) -> str:
-        """Materialize to a local file path and return it."""
+        """Materialize to a local file path (or directory, for artifacts
+        uploaded as a file tree) and return it."""
         if self._store.kind == "file":
             return self._path
         if self._local_path:
             return self._local_path
+        if not self._store.exists(self._path):
+            # a directory prefix (e.g. tensorboard logs): mirror every key
+            # under it into a temp dir
+            entries = self._store.listdir(self._path)
+            if entries:
+                local_dir = tempfile.mkdtemp(prefix="mlt-item-")
+                prefix = self._path.rstrip("/")
+                for entry in entries:
+                    target = os.path.join(local_dir, entry)
+                    self._store.download(f"{prefix}/{entry}", target)
+                self._local_path = local_dir
+                return local_dir
         suffix = self.suffix or ".tmp"
         temp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
         temp.close()

@@ -1,0 +1,19 @@
+"""Shared framework plumbing (reference analog: mlrun/frameworks/_common/ —
+MLRunInterface, artifact plans, producers; ~6k LoC re-designed compactly).
+
+The plan library turns a fitted model + evaluation data into artifact
+plots/tables; a producer selects the applicable plans and runs them inside
+the run context. Framework adapters (sklearn/xgboost/lightgbm) share it.
+"""
+
+from .plans import (  # noqa: F401
+    ArtifactPlan,
+    CalibrationCurvePlan,
+    ConfusionMatrixPlan,
+    DEFAULT_CLASSIFICATION_PLANS,
+    DEFAULT_REGRESSION_PLANS,
+    FeatureImportancePlan,
+    ResidualsPlan,
+    ROCCurvePlan,
+    produce_artifacts,
+)

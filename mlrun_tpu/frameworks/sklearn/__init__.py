@@ -71,25 +71,44 @@ class SKLearnModelHandler:
             context.set_label("model_class", type(self.model).__name__)
         except Exception:  # noqa: BLE001
             pass
-        metrics = self._compute_metrics()
+        predictions = None
+        if self.x_test is not None and self.y_test is not None:
+            try:
+                predictions = self.model.predict(self.x_test)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("test-set prediction failed",
+                               error=str(exc))
+        metrics = self._compute_metrics(predictions)
         if metrics:
             context.log_results(metrics)
+        if predictions is not None:
+            # evaluation artifact plans (confusion matrix / roc /
+            # calibration / feature importance / residuals) — reuse the
+            # predictions computed for the metrics
+            from .._common import produce_artifacts
+
+            try:
+                produce_artifacts(context, self.model, self.x_test,
+                                  self.y_test, y_pred=predictions)
+            except Exception as exc:  # noqa: BLE001 - plots are best-effort
+                logger.warning("artifact plans failed", error=str(exc))
         if self._log_model:
             self.log_model(metrics)
 
-    def _compute_metrics(self) -> dict:
+    def _compute_metrics(self, predictions=None) -> dict:
         if self.x_test is None or self.y_test is None:
             return {}
         import numpy as np
 
+        from .._common.plans import _is_classifier
+
         metrics: dict = {}
         try:
-            predictions = self.model.predict(self.x_test)
+            if predictions is None:
+                predictions = self.model.predict(self.x_test)
             y = np.asarray(self.y_test).reshape(-1)
             p = np.asarray(predictions).reshape(-1)
-            is_classifier = hasattr(self.model, "predict_proba") or \
-                p.dtype.kind in "iub"
-            if is_classifier:
+            if _is_classifier(self.model, p):
                 from sklearn.metrics import accuracy_score, f1_score
 
                 metrics["accuracy"] = float(accuracy_score(y, p))

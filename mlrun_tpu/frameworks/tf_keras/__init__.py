@@ -16,16 +16,23 @@ from ...utils import logger
 
 def apply_mlrun(model=None, context: MLClientCtx | None = None,
                 model_name: str = "model", tag: str = "",
-                x_test=None, y_test=None, log_model: bool = True, **kwargs):
+                x_test=None, y_test=None, log_model: bool = True,
+                tensorboard: bool = False,
+                tensorboard_weights: bool = False, **kwargs):
     """Patch a keras model so fit() logs per-epoch metrics and the final
-    model to the run context."""
+    model to the run context. ``tensorboard=True`` additionally writes
+    tf.summary event files (scalars per epoch; weight histograms with
+    ``tensorboard_weights=True``) and registers the log dir as an
+    artifact (reference tf_keras/callbacks TensorboardLoggingCallback)."""
     if context is None:
         import mlrun_tpu
 
         context = mlrun_tpu.get_or_create_ctx("tf-keras")
     handler = KerasModelHandler(model, context, model_name, tag,
                                 x_test=x_test, y_test=y_test,
-                                log_model=log_model)
+                                log_model=log_model,
+                                tensorboard=tensorboard,
+                                tensorboard_weights=tensorboard_weights)
     if model is not None:
         handler.patch()
     return handler
@@ -49,9 +56,41 @@ class _MLRunLoggingCallback:
         return _Callback()
 
 
+class TensorboardLoggingCallback:
+    """tf.summary writer callback (reference analog:
+    mlrun/frameworks/tf_keras/callbacks/tensorboard_logging_callback.py —
+    per-epoch scalar summaries + optional weight histograms into a run-
+    scoped log dir that lands in the artifact registry)."""
+
+    def __new__(cls, context, log_dir: str, weights: bool = False):
+        import tensorflow as tf
+        from tensorflow import keras
+
+        writer = tf.summary.create_file_writer(log_dir)
+
+        class _Callback(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if not context.is_logging_worker():
+                    return
+                with writer.as_default(step=epoch):
+                    for key, value in (logs or {}).items():
+                        tf.summary.scalar(key, float(value))
+                    if weights:
+                        for weight in self.model.weights:
+                            tf.summary.histogram(
+                                weight.name.replace(":", "_"), weight)
+                writer.flush()
+
+            def on_train_end(self, logs=None):
+                writer.close()
+
+        return _Callback()
+
+
 class KerasModelHandler:
     def __init__(self, model, context, model_name="model", tag="",
-                 x_test=None, y_test=None, log_model=True):
+                 x_test=None, y_test=None, log_model=True,
+                 tensorboard=False, tensorboard_weights=False):
         self.model = model
         self.context = context
         self.model_name = model_name
@@ -59,6 +98,9 @@ class KerasModelHandler:
         self.x_test = x_test
         self.y_test = y_test
         self._log_model = log_model
+        self._tensorboard = tensorboard
+        self._tensorboard_weights = tensorboard_weights
+        self._tb_dir: str | None = None
         self._patched = False
 
     def patch(self):
@@ -70,6 +112,12 @@ class KerasModelHandler:
         def wrapped_fit(*args, **kwargs):
             callbacks = list(kwargs.get("callbacks") or [])
             callbacks.append(_MLRunLoggingCallback(handler.context, handler))
+            if handler._tensorboard:
+                handler._tb_dir = os.path.join(
+                    tempfile.mkdtemp(prefix="mlt-tb-"), "train")
+                callbacks.append(TensorboardLoggingCallback(
+                    handler.context, handler._tb_dir,
+                    weights=handler._tensorboard_weights))
             kwargs["callbacks"] = callbacks
             return original_fit(*args, **kwargs)
 
@@ -89,6 +137,14 @@ class KerasModelHandler:
                 logger.warning("keras evaluation failed", error=str(exc))
         if metrics:
             self.context.log_results(metrics)
+        if self._tb_dir and os.path.isdir(self._tb_dir):
+            try:
+                self.context.log_artifact(
+                    f"{self.model_name}-tensorboard",
+                    local_path=self._tb_dir)
+            except Exception as exc:  # noqa: BLE001 - tb dir best-effort
+                logger.warning("tensorboard artifact failed",
+                               error=str(exc))
         if self._log_model:
             self.log_model(metrics)
 

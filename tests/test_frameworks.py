@@ -228,3 +228,120 @@ def test_hf_weight_mapping_bin_fallback():
     with torch.no_grad():
         theirs = model(torch.tensor(tokens.astype(np.int64))).logits.numpy()
     assert np.array_equal(ours.argmax(-1), theirs.argmax(-1))
+
+
+def test_artifact_plans_classification_and_regression(tmp_path):
+    """Plan library (reference frameworks/_ml_common/plans/): classifier
+    gets confusion matrix + roc + calibration + importance; regressor gets
+    residuals + importance."""
+    def handler(context):
+        import numpy as np
+        from sklearn.datasets import make_classification, make_regression
+        from sklearn.linear_model import LinearRegression
+        from sklearn.linear_model import LogisticRegression
+
+        from mlrun_tpu.frameworks._common import produce_artifacts
+
+        X, y = make_classification(n_samples=120, n_features=5,
+                                   random_state=0)
+        clf = LogisticRegression(max_iter=300).fit(X, y)
+        produced = produce_artifacts(context, clf, X, y)
+        context.log_result("clf_plans", sorted(produced))
+
+        Xr, yr = make_regression(n_samples=80, n_features=4, random_state=0)
+        reg = LinearRegression().fit(Xr, yr)
+        produced_r = produce_artifacts(context, reg, Xr, yr)
+        context.log_result("reg_plans", sorted(produced_r))
+
+    fn = mlrun_tpu.new_function("plans", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert run.status.results["clf_plans"] == [
+        "calibration_curve", "confusion_matrix", "feature_importance",
+        "roc_curve"]
+    assert run.status.results["reg_plans"] == [
+        "feature_importance", "residuals"]
+    assert run.status.results["auc"] > 0.5
+    for key in ("confusion_matrix", "roc_curve", "residuals",
+                "feature_importance"):
+        assert key in run.status.artifact_uris
+
+
+def test_sklearn_autolog_produces_plan_artifacts():
+    """apply_mlrun wires the plan library into fit()."""
+    def handler(context):
+        from sklearn.datasets import load_iris
+        from sklearn.ensemble import RandomForestClassifier
+        from sklearn.model_selection import train_test_split
+
+        from mlrun_tpu.frameworks.sklearn import apply_mlrun
+
+        data = load_iris(as_frame=True)
+        X_train, X_test, y_train, y_test = train_test_split(
+            data.data, data.target, test_size=0.3, random_state=0)
+        model = RandomForestClassifier(n_estimators=10, random_state=0)
+        apply_mlrun(model, context, model_name="rf",
+                    x_test=X_test, y_test=y_test)
+        model.fit(X_train, y_train)
+
+    fn = mlrun_tpu.new_function("ska", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert "confusion_matrix" in run.status.artifact_uris
+    assert "feature_importance" in run.status.artifact_uris
+
+
+def test_tf_keras_tensorboard_callback():
+    tf = pytest.importorskip("tensorflow")
+
+    def handler(context):
+        import numpy as np
+        from tensorflow import keras
+
+        from mlrun_tpu.frameworks.tf_keras import apply_mlrun
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 4)).astype("float32")
+        y = (X.sum(axis=1) > 0).astype("float32")
+        model = keras.Sequential([
+            keras.layers.Dense(4, activation="relu", input_shape=(4,)),
+            keras.layers.Dense(1, activation="sigmoid"),
+        ])
+        model.compile(optimizer="adam", loss="binary_crossentropy")
+        apply_mlrun(model, context, model_name="tbm", tensorboard=True,
+                    tensorboard_weights=True)
+        model.fit(X, y, epochs=2, verbose=0)
+
+    fn = mlrun_tpu.new_function("tb", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert "tbm-tensorboard" in run.status.artifact_uris
+    # event files actually written
+    import glob
+
+    target = run.artifact("tbm-tensorboard").local()
+    events = glob.glob(f"{target}/**/events.out.tfevents.*",
+                       recursive=True) + glob.glob(
+        f"{target}/events.out.tfevents.*")
+    assert events, target
+
+
+def test_plans_string_label_classifier():
+    """String-label classifiers still route to classification plans."""
+    def handler(context):
+        from sklearn.svm import SVC
+        import numpy as np
+
+        from mlrun_tpu.frameworks._common import produce_artifacts
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(60, 3))
+        y = np.where(X.sum(axis=1) > 0, "dog", "cat")
+        clf = SVC().fit(X, y)  # no predict_proba
+        produced = produce_artifacts(context, clf, X, y)
+        context.log_result("plans", sorted(produced))
+
+    fn = mlrun_tpu.new_function("strlbl", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert "confusion_matrix" in run.status.results["plans"]

@@ -40,3 +40,33 @@ def test_notification_defaults():
     n = Notification(kind="slack", name="n1")
     assert "completed" in n.when
     assert Notification.from_dict(n.to_dict()).kind == "slack"
+
+
+def test_new_schema_modules_validate():
+    """Round-2 schema modules (reference common/schemas breadth)."""
+    from mlrun_tpu.common import schemas
+
+    data = schemas.SecretsData(secrets={"k": "v"})
+    assert data.provider == schemas.SecretProviderName.kubernetes
+    notification = schemas.Notification(kind="webhook",
+                                        params={"secret": "ref"})
+    assert notification.status is None
+    page = schemas.PaginatedResponse(items=[1],
+                                     pagination={"page_token": "t"})
+    assert page.pagination.page_token == "t"
+    resources = schemas.Resources(cpu="2", memory="4Gi", tpu=8)
+    assert resources.to_k8s()["google.com/tpu"] == 8
+    selector = schemas.NodeSelector(accelerator="tpu-v5p-slice",
+                                    topology="2x2x2")
+    assert selector.to_k8s()[
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    profile = schemas.DatastoreProfileCreate(
+        profile={"name": "p", "type": "s3", "fields": {"bucket": "b"}},
+        private={"secret_key": "s"})
+    assert profile.profile.fields["bucket"] == "b"
+    event = schemas.Event(kind="drift-detected", project="p")
+    assert event.kind == schemas.EventKind.drift_detected
+    fs = schemas.FeatureSetRecord(
+        metadata={"name": "f", "project": "p"},
+        spec={"entities": [{"name": "uid"}]})
+    assert fs.spec.entities[0].name == "uid"
