@@ -1,12 +1,19 @@
-"""Dask runtime (reference analog: mlrun/runtimes/daskjob.py:186 DaskCluster).
+"""Dask runtime (reference analog: mlrun/runtimes/daskjob.py:186 DaskCluster
++ the dask-kubernetes deployment flow).
 
 Client-side ephemeral dask cluster for dataframe-parallel work and as a
 hyper-param parallel engine. On TPU deployments this remains an
-orchestration-level (CPU) engine; tensor work belongs to tpujob.
+orchestration-level (CPU) engine; tensor work belongs to tpujob. The k8s
+deployment path materializes a scheduler Deployment+Service and a worker
+Deployment (built here, created through the kubernetes provider) and the
+client connects to the scheduler service — no dask-operator dependency.
 """
 
 from __future__ import annotations
 
+import os
+
+from ..config import mlconf
 from ..common.runtimes_constants import RuntimeKinds
 from ..model import RunObject
 from ..utils import logger
@@ -16,14 +23,19 @@ from .pod import KubeResource, KubeResourceSpec
 class DaskSpec(KubeResourceSpec):
     _dict_fields = KubeResourceSpec._dict_fields + [
         "min_replicas", "max_replicas", "scheduler_timeout",
+        "scheduler_address", "worker_resources",
     ]
 
     def __init__(self, min_replicas=None, max_replicas=None,
-                 scheduler_timeout=None, **kwargs):
+                 scheduler_timeout=None, scheduler_address=None,
+                 worker_resources=None, **kwargs):
         super().__init__(**kwargs)
         self.min_replicas = min_replicas or 0
         self.max_replicas = max_replicas or 4
         self.scheduler_timeout = scheduler_timeout or "60 minutes"
+        # set (or discovered from the k8s service) → client connects remote
+        self.scheduler_address = scheduler_address or ""
+        self.worker_resources = worker_resources or {}
 
 
 class DaskRuntime(KubeResource):
@@ -39,12 +51,15 @@ class DaskRuntime(KubeResource):
 
     @property
     def client(self):
-        """Return a dask client — local cluster if dask is importable."""
+        """Return a dask client: remote when a scheduler address is set
+        (e.g. after deploy_cluster), else a local cluster."""
         try:
             from dask.distributed import Client, LocalCluster
         except ImportError as exc:
             raise ImportError(
                 "dask is not installed in this environment") from exc
+        if self.spec.scheduler_address:
+            return Client(self.spec.scheduler_address)
         if self._cluster is None:
             self._cluster = LocalCluster(
                 n_workers=max(1, self.spec.min_replicas or 1),
@@ -55,6 +70,113 @@ class DaskRuntime(KubeResource):
         if self._cluster is not None:
             self._cluster.close()
             self._cluster = None
+
+    # -- k8s deployment (reference: the dask-kubernetes cluster flow) -------
+    def _cluster_name(self) -> str:
+        return f"mlt-dask-{self.metadata.name or 'cluster'}"
+
+    def generate_cluster_resources(self) -> dict:
+        """Build the scheduler Deployment+Service and worker Deployment
+        manifests (pure builders — unit-testable without a cluster)."""
+        name = self._cluster_name()
+        image = self.spec.image or mlconf.get("default_image",
+                                              "daskdev/dask:latest")
+        labels = {"mlrun-tpu/class": "dask", "mlrun-tpu/cluster": name}
+
+        def deployment(component: str, command: list, replicas: int,
+                       resources: dict | None = None):
+            pod_labels = dict(labels, **{"mlrun-tpu/component": component})
+            container = {
+                "name": component,
+                "image": image,
+                "args": command,
+                "env": [{"name": k, "value": str(v)}
+                        for k, v in (self.spec.env or {}).items()]
+                if isinstance(self.spec.env, dict) else (self.spec.env or []),
+            }
+            if resources:
+                container["resources"] = {"limits": resources}
+            return {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": f"{name}-{component}",
+                             "namespace": mlconf.namespace,
+                             "labels": labels},
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {"matchLabels": pod_labels},
+                    "template": {"metadata": {"labels": pod_labels},
+                                 "spec": {"containers": [container]}},
+                },
+            }
+
+        scheduler = deployment(
+            "scheduler", ["dask", "scheduler", "--port", "8786",
+                          "--dashboard-address", ":8787"], 1)
+        workers = deployment(
+            "worker",
+            ["dask", "worker", f"tcp://{name}-scheduler:8786"],
+            max(1, self.spec.min_replicas or 1),
+            resources=self.spec.worker_resources or None)
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"{name}-scheduler",
+                         "namespace": mlconf.namespace, "labels": labels},
+            "spec": {
+                "selector": dict(labels,
+                                 **{"mlrun-tpu/component": "scheduler"}),
+                "ports": [
+                    {"name": "scheduler", "port": 8786,
+                     "targetPort": 8786},
+                    {"name": "dashboard", "port": 8787,
+                     "targetPort": 8787},
+                ],
+            },
+        }
+        return {"scheduler": scheduler, "workers": workers,
+                "service": service}
+
+    def deploy_cluster(self, namespace: str | None = None) -> str:
+        """Create the cluster on kubernetes (gated on the kubernetes
+        package) and record the scheduler address; returns it."""
+        import kubernetes  # gated import
+
+        if os.environ.get("KUBERNETES_SERVICE_HOST"):
+            kubernetes.config.load_incluster_config()
+        else:
+            kubernetes.config.load_kube_config()
+        namespace = namespace or mlconf.namespace
+        resources = self.generate_cluster_resources()
+        apps = kubernetes.client.AppsV1Api()
+        core = kubernetes.client.CoreV1Api()
+        apps.create_namespaced_deployment(namespace, resources["scheduler"])
+        apps.create_namespaced_deployment(namespace, resources["workers"])
+        core.create_namespaced_service(namespace, resources["service"])
+        self.spec.scheduler_address = (
+            f"tcp://{self._cluster_name()}-scheduler.{namespace}:8786")
+        logger.info("dask cluster deployed",
+                    scheduler=self.spec.scheduler_address)
+        return self.spec.scheduler_address
+
+    def delete_cluster(self, namespace: str | None = None):
+        import kubernetes  # gated import
+
+        namespace = namespace or mlconf.namespace
+        name = self._cluster_name()
+        apps = kubernetes.client.AppsV1Api()
+        core = kubernetes.client.CoreV1Api()
+        for component in ("scheduler", "worker"):
+            try:
+                apps.delete_namespaced_deployment(f"{name}-{component}",
+                                                  namespace)
+            except kubernetes.client.exceptions.ApiException:
+                pass
+        try:
+            core.delete_namespaced_service(f"{name}-scheduler", namespace)
+        except kubernetes.client.exceptions.ApiException:
+            pass
+        self.spec.scheduler_address = ""
 
     def _run(self, runobj: RunObject, execution) -> dict:
         from .local import exec_from_params, load_module

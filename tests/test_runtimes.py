@@ -92,3 +92,31 @@ def test_code_to_function_embeds_source(tmp_path):
     # embedded code executes locally
     run = fn.run(local=True, handler="handler")
     assert run.status.results["ok"] == 1
+
+
+def test_dask_cluster_manifests():
+    """k8s dask deployment builders (reference dask-kubernetes flow):
+    scheduler Deployment+Service + worker Deployment, label-linked."""
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function("dcluster", kind="dask", image="dask:img")
+    fn.spec.min_replicas = 3
+    fn.spec.worker_resources = {"cpu": "2", "memory": "4Gi"}
+    resources = fn.generate_cluster_resources()
+
+    scheduler = resources["scheduler"]
+    assert scheduler["spec"]["replicas"] == 1
+    assert scheduler["spec"]["template"]["spec"]["containers"][0][
+        "image"] == "dask:img"
+    workers = resources["workers"]
+    assert workers["spec"]["replicas"] == 3
+    worker_container = workers["spec"]["template"]["spec"]["containers"][0]
+    assert "tcp://mlt-dask-dcluster-scheduler:8786" in \
+        worker_container["args"][2]
+    assert worker_container["resources"]["limits"]["memory"] == "4Gi"
+    service = resources["service"]
+    assert service["spec"]["selector"]["mlrun-tpu/component"] == "scheduler"
+    assert {p["port"] for p in service["spec"]["ports"]} == {8786, 8787}
+    # remote client path is selected once an address is recorded
+    fn.spec.scheduler_address = "tcp://somewhere:8786"
+    assert fn.spec.to_dict()["scheduler_address"] == "tcp://somewhere:8786"
