@@ -5,17 +5,24 @@ Baseline (BASELINE.md): >=35% MFU for Llama-3-8B LoRA on v5e — on a single
 chip we measure the same train-step code path on the largest Llama config
 that fits (1B-class on one v5e), and report achieved MFU; vs_baseline is
 achieved_mfu / 0.35.
+
+Each config attempt runs in its OWN subprocess: a failed attempt (OOM,
+compile error) otherwise leaves HBM allocations behind on the chip and
+poisons every later attempt in the same process (observed 2026-07-29: after
+one compile-OOM at batch 32, even the tiny model hit RESOURCE_EXHAUSTED).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
-import time
 
 
 def _bench(model_scale: str, batch: int, seq: int, steps: int = 8,
            remat_policy: str = "nothing"):
+    """Run one measured config in THIS process (subprocess entry point)."""
     import dataclasses
 
     import jax
@@ -42,6 +49,8 @@ def _bench(model_scale: str, batch: int, seq: int, steps: int = 8,
     trainer = Trainer(config, train_config, mesh=mesh)
     trainer.init(0)
     stream = synthetic_token_stream(batch, seq, config.vocab_size)
+
+    import time
 
     # warmup (compile); NOTE: sync via host value fetch — under the axon
     # relay block_until_ready can return before execution finishes
@@ -74,27 +83,55 @@ def _bench(model_scale: str, batch: int, seq: int, steps: int = 8,
     }
 
 
-def main():
-    # fail fast instead of hanging the driver if the TPU relay is wedged
-    # (a killed client can leave the backend init blocking indefinitely)
+def _subprocess_main():
+    """Entry for one isolated attempt: bench.py --one scale batch seq policy."""
     import signal
 
     def _watchdog(signum, frame):
-        raise SystemExit(
-            "bench: jax backend init did not complete within 180s "
-            "(TPU relay unresponsive)")
+        raise SystemExit("attempt: jax backend init hang (180s)")
 
     signal.signal(signal.SIGALRM, _watchdog)
     signal.alarm(180)
     import jax
 
-    devices = jax.devices()
+    jax.devices()
     signal.alarm(0)
-    on_tpu = devices[0].platform in ("tpu", "axon")
+    _, _, scale, batch, seq, policy = sys.argv
+    result = _bench(scale, int(batch), int(seq), remat_policy=policy)
+    print("@@RESULT@@" + json.dumps(result))
+
+
+def _probe_platform() -> str:
+    """Check the device platform in a throwaway subprocess (fail-fast if
+    the TPU relay is wedged — a hung init would otherwise stall the
+    driver; a killed client can wedge the relay, so the probe exits
+    gracefully via SIGALRM rather than being killed)."""
+    code = (
+        "import signal\n"
+        "signal.signal(signal.SIGALRM, lambda s, f: (_ for _ in ()).throw("
+        "SystemExit('init hang')))\n"
+        "signal.alarm(180)\n"
+        "import jax\n"
+        "print(jax.devices()[0].platform)\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=240, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        raise SystemExit("bench: jax backend init did not complete within "
+                         "180s (TPU relay unresponsive)")
+    if out.returncode != 0:
+        raise SystemExit(f"bench: platform probe failed: {out.stderr[-400:]}")
+    return out.stdout.strip().splitlines()[-1]
+
+
+def main():
+    platform = _probe_platform()
+    on_tpu = platform in ("tpu", "axon")
     # chunked CE keeps the loss memory flat, so larger batches fit; walk
     # down until one fits on the chip. save_attn remat (keep attention
     # outputs, recompute only the MLP) trades a little memory for less
-    # backward recompute — try it before full-recompute at each batch.
+    # backward recompute.
     attempts = (
         [("1b", 32, 2048, "save_attn"), ("1b", 32, 2048, "nothing"),
          ("1b", 16, 2048, "save_attn"), ("1b", 16, 2048, "nothing"),
@@ -102,18 +139,30 @@ def main():
          ("1b", 4, 2048, "nothing"), ("tiny", 8, 256, "nothing")]
         if on_tpu else [("tiny", 8, 128, "nothing")]
     )
+    here = os.path.dirname(os.path.abspath(__file__))
     result = None
     last_error = None
     for scale, batch, seq, policy in attempts:
         try:
-            result = _bench(scale, batch, seq, remat_policy=policy)
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", scale,
+                 str(batch), str(seq), policy],
+                capture_output=True, text=True, timeout=900, cwd=here)
+        except subprocess.TimeoutExpired:
+            last_error = f"{scale}/b{batch}: timeout"
+            print(f"bench config {scale}/b{batch}/s{seq}/{policy} timed out",
+                  file=sys.stderr)
+            continue
+        marker = [ln for ln in proc.stdout.splitlines()
+                  if ln.startswith("@@RESULT@@")]
+        if proc.returncode == 0 and marker:
+            result = json.loads(marker[-1][len("@@RESULT@@"):])
             result["model"] = scale
             result["remat_policy"] = policy
             break
-        except Exception as exc:  # noqa: BLE001 - fall through to smaller cfg
-            last_error = exc
-            print(f"bench config {scale}/b{batch}/s{seq}/{policy} "
-                  f"failed: {exc}", file=sys.stderr)
+        last_error = (proc.stderr or proc.stdout)[-400:]
+        print(f"bench config {scale}/b{batch}/s{seq}/{policy} failed "
+              f"(rc={proc.returncode}): {last_error}", file=sys.stderr)
     if result is None:
         raise SystemExit(f"all bench configs failed: {last_error}")
 
@@ -129,4 +178,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        _subprocess_main()
+    else:
+        main()

@@ -107,7 +107,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse laid out [block_q, 8] (last dim = full array dim) to satisfy the
+    # TPU (8, 128)-tiling rule on output block shapes
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, 8))
 
 
 try:  # pallas imports kept lazy-safe for docs tooling
@@ -174,7 +176,7 @@ def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l), (block_q, 8))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -221,12 +223,12 @@ def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i),
+            pl.BlockSpec((1, block_q, 8), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -236,7 +238,7 @@ def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
         interpret=interpret,
     )(qt, kt, vt)
     o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, sq)
+    lse = lse[:, :, 0].reshape(b, h, sq)
     if pad_q:
         o = o[:, :orig_sq]
         lse = lse[:, :, :orig_sq]
@@ -289,17 +291,17 @@ def _flash_fwd(q, k, v, causal=True, block_q=256, block_k=256,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i),
+            pl.BlockSpec((1, block_q, 8), lambda bh, i: (bh, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
     o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, sq)
+    lse = lse[:, :, 0].reshape(b, h, sq)
     if pad_q:
         o = o[:, :orig_sq]
         lse = lse[:, :, :orig_sq]
@@ -381,6 +383,31 @@ flash_attention_mlt.defvjp(_flash_mlt_fwd, _flash_mlt_bwd)
 # library pallas kernels (tuned fwd+bwd) and the dispatcher
 # ---------------------------------------------------------------------------
 
+def _tuned_block_sizes(sq: int, sk: int):
+    """Big (512) pallas blocks for the library flash kernel.
+
+    The library default is 128x128 blocks, which at head_dim 64 leaves the
+    MXU ~12x under-utilized at bench shapes (measured on v5e: 49ms/layer at
+    128-blocks vs 4.1ms at 512-blocks for b16 s2048 h32 d64). Pick the
+    largest of 512/256/128 that divides each sequence length, for both the
+    forward and the dq/dkv backward passes.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    def pick(n: int) -> int:
+        for c in (512, 256, 128):
+            if n % c == 0:
+                return c
+        return n
+
+    bq, bk = pick(sq), pick(sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+
+
 def _jax_flash(q, k, v, causal: bool):
     """jax pallas library flash attention: expects [B, H, S, D]."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -390,7 +417,8 @@ def _jax_flash(q, k, v, causal: bool):
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _fa(qt, kt, vt, causal=causal, sm_scale=q.shape[-1] ** -0.5)
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=q.shape[-1] ** -0.5,
+              block_sizes=_tuned_block_sizes(q.shape[1], k.shape[1]))
     return out.transpose(0, 2, 1, 3)
 
 

@@ -48,6 +48,7 @@ class DaskRuntime(KubeResource):
         if not isinstance(self.spec, DaskSpec):
             self.spec = DaskSpec.from_dict(self.spec.to_dict())
         self._cluster = None
+        self._client = None
 
     @property
     def client(self):
@@ -58,15 +59,25 @@ class DaskRuntime(KubeResource):
         except ImportError as exc:
             raise ImportError(
                 "dask is not installed in this environment") from exc
+        if self._client is not None:
+            return self._client
         if self.spec.scheduler_address:
-            return Client(self.spec.scheduler_address)
+            self._client = Client(self.spec.scheduler_address)
+            return self._client
         if self._cluster is None:
             self._cluster = LocalCluster(
                 n_workers=max(1, self.spec.min_replicas or 1),
                 threads_per_worker=2)
-        return Client(self._cluster)
+        self._client = Client(self._cluster)
+        return self._client
 
     def close(self):
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001 - already-dead scheduler
+                pass
+            self._client = None
         if self._cluster is not None:
             self._cluster.close()
             self._cluster = None
@@ -75,9 +86,10 @@ class DaskRuntime(KubeResource):
     def _cluster_name(self) -> str:
         return f"mlt-dask-{self.metadata.name or 'cluster'}"
 
-    def generate_cluster_resources(self) -> dict:
+    def generate_cluster_resources(self, namespace: str | None = None) -> dict:
         """Build the scheduler Deployment+Service and worker Deployment
         manifests (pure builders — unit-testable without a cluster)."""
+        namespace = namespace or mlconf.namespace
         name = self._cluster_name()
         image = self.spec.image or mlconf.get("default_image",
                                               "daskdev/dask:latest")
@@ -100,7 +112,7 @@ class DaskRuntime(KubeResource):
                 "apiVersion": "apps/v1",
                 "kind": "Deployment",
                 "metadata": {"name": f"{name}-{component}",
-                             "namespace": mlconf.namespace,
+                             "namespace": namespace,
                              "labels": labels},
                 "spec": {
                     "replicas": replicas,
@@ -122,7 +134,7 @@ class DaskRuntime(KubeResource):
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": f"{name}-scheduler",
-                         "namespace": mlconf.namespace, "labels": labels},
+                         "namespace": namespace, "labels": labels},
             "spec": {
                 "selector": dict(labels,
                                  **{"mlrun-tpu/component": "scheduler"}),
@@ -147,7 +159,7 @@ class DaskRuntime(KubeResource):
         else:
             kubernetes.config.load_kube_config()
         namespace = namespace or mlconf.namespace
-        resources = self.generate_cluster_resources()
+        resources = self.generate_cluster_resources(namespace)
         apps = kubernetes.client.AppsV1Api()
         core = kubernetes.client.CoreV1Api()
         apps.create_namespaced_deployment(namespace, resources["scheduler"])
