@@ -88,16 +88,20 @@ def _subprocess_main():
     import signal
 
     def _watchdog(signum, frame):
-        raise SystemExit("attempt: jax backend init hang (180s)")
+        raise SystemExit("attempt: watchdog fired (hung init or bench)")
 
     signal.signal(signal.SIGALRM, _watchdog)
     signal.alarm(180)
     import jax
 
     jax.devices()
-    signal.alarm(0)
+    # keep a watchdog armed for the WHOLE attempt so the child exits
+    # gracefully before the parent's hard kill — a SIGKILLed TPU client
+    # can wedge the relay for every later attempt
+    signal.alarm(840)
     _, _, scale, batch, seq, policy = sys.argv
     result = _bench(scale, int(batch), int(seq), remat_policy=policy)
+    signal.alarm(0)
     print("@@RESULT@@" + json.dumps(result))
 
 

@@ -49,6 +49,7 @@ class DaskRuntime(KubeResource):
             self.spec = DaskSpec.from_dict(self.spec.to_dict())
         self._cluster = None
         self._client = None
+        self._client_address = ""
 
     @property
     def client(self):
@@ -59,16 +60,27 @@ class DaskRuntime(KubeResource):
         except ImportError as exc:
             raise ImportError(
                 "dask is not installed in this environment") from exc
+        # cache per scheduler address: changing spec.scheduler_address (or
+        # clearing it) invalidates the cached client instead of returning a
+        # stale connection
+        address = self.spec.scheduler_address or ""
+        if self._client is not None and self._client_address == address:
+            return self._client
         if self._client is not None:
-            return self._client
-        if self.spec.scheduler_address:
-            self._client = Client(self.spec.scheduler_address)
-            return self._client
-        if self._cluster is None:
-            self._cluster = LocalCluster(
-                n_workers=max(1, self.spec.min_replicas or 1),
-                threads_per_worker=2)
-        self._client = Client(self._cluster)
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+        if address:
+            self._client = Client(address)
+        else:
+            if self._cluster is None:
+                self._cluster = LocalCluster(
+                    n_workers=max(1, self.spec.min_replicas or 1),
+                    threads_per_worker=2)
+            self._client = Client(self._cluster)
+        self._client_address = address
         return self._client
 
     def close(self):

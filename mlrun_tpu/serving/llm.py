@@ -25,15 +25,44 @@ from ..utils import logger
 
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
-                  dtype=None) -> dict:
+                  dtype=None, kv_dtype: str = "native") -> dict:
+    """KV cache pytree. ``kv_dtype="int8"`` stores k/v per-vector symmetric
+    int8 (scale over head_dim, kept f32 per [layer, batch, pos, kv_head]) —
+    half the HBM residency of bf16, so twice the slots x context per chip.
+    Dequantization happens at attention time; see _quantize_kv."""
+    if kv_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"unknown kv_dtype '{kv_dtype}' (native | int8)")
     dtype = dtype or config.dtype
     shape = (config.n_layers, batch, max_len, config.n_kv_heads,
              config.head_dim)
+    if kv_dtype == "int8":
+        scale_shape = shape[:-1]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
+            "pos": jnp.zeros((batch,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
         "pos": jnp.zeros((batch,), jnp.int32),
     }
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., D] -> (int8 values, f32 scale over the last dim)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / 127.0
+    q = jnp.round(x.astype(jnp.float32)
+                  / jnp.maximum(scale[..., None], 1e-8)).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
@@ -82,14 +111,32 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
                                       config.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # write k,v into the cache at start..start+s (uniform start)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache["k"][layer], k.astype(cache["k"].dtype),
-            (0, start[0], 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            cache["v"][layer], v.astype(cache["v"].dtype),
-            (0, start[0], 0, 0))
-        attn = _cached_attention(config, q, k_cache, v_cache, positions,
+        quantized = "k_scale" in cache
+        if quantized:
+            kq, ks = _quantize_kv(k)
+            vq, vs = _quantize_kv(v)
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"][layer], kq, (0, start[0], 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"][layer], vq, (0, start[0], 0, 0))
+            k_scale = jax.lax.dynamic_update_slice(
+                cache["k_scale"][layer], ks, (0, start[0], 0))
+            v_scale = jax.lax.dynamic_update_slice(
+                cache["v_scale"][layer], vs, (0, start[0], 0))
+            k_attn = _dequantize_kv(k_cache, k_scale, config.dtype)
+            v_attn = _dequantize_kv(v_cache, v_scale, config.dtype)
+            scales = (k_scale, v_scale)
+        else:
+            # write k,v into the cache at start..start+s (uniform start)
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"][layer], k.astype(cache["k"].dtype),
+                (0, start[0], 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"][layer], v.astype(cache["v"].dtype),
+                (0, start[0], 0, 0))
+            k_attn, v_attn = k_cache, v_cache
+            scales = None
+        attn = _cached_attention(config, q, k_attn, v_attn, positions,
                                  max_len)
         attn = attn.reshape(b, s, config.qkv_dim)
         x_mid = x_in + proj(attn, lp["wo"])
@@ -97,16 +144,19 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
         gate = proj(h2, lp["w_gate"])
         up = proj(h2, lp["w_up"])
         out = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
-        return out, (k_cache, v_cache)
+        return out, (k_cache, v_cache, scales)
 
     # python loop over layers: compiled once per bucket; exposes per-layer
     # cache updates without scan-carry gymnastics
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for layer in range(config.n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
-        x, (k_cache, v_cache) = body(x, (layer, lp))
+        x, (k_cache, v_cache, scales) = body(x, (layer, lp))
         new_k.append(k_cache)
         new_v.append(v_cache)
+        if scales is not None:
+            new_ks.append(scales[0])
+            new_vs.append(scales[1])
 
     x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
     head = params.get("lm_head")
@@ -119,6 +169,9 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
         "v": jnp.stack(new_v),
         "pos": cache["pos"] + s,
     }
+    if new_ks:
+        new_cache["k_scale"] = jnp.stack(new_ks)
+        new_cache["v_scale"] = jnp.stack(new_vs)
     return logits[:, 0], new_cache
 
 
@@ -128,12 +181,13 @@ class LLMEngine:
     def __init__(self, config: LlamaConfig, params: Params,
                  max_len: int = 2048, batch: int = 1,
                  prefill_buckets: tuple = (128, 512, 1024),
-                 temperature: float = 0.0):
+                 temperature: float = 0.0, kv_dtype: str = "native"):
         self.config = config
         self.params = params
         self.max_len = max_len
         self.batch = batch
         self.temperature = temperature
+        self.kv_dtype = kv_dtype
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
 
@@ -164,7 +218,8 @@ class LLMEngine:
         """Compile every prefill bucket + the decode step ahead of traffic."""
         started = time.perf_counter()
         for bucket in self.prefill_buckets:
-            cache = init_kv_cache(self.config, self.batch, self.max_len)
+            cache = init_kv_cache(self.config, self.batch, self.max_len,
+                              kv_dtype=self.kv_dtype)
             tokens = jnp.zeros((self.batch, bucket), jnp.int32)
             logits, cache = self._prefill(self.params, tokens, cache)
             step_tok = jnp.zeros((self.batch, 1), jnp.int32)
@@ -195,7 +250,8 @@ class LLMEngine:
         padded[:, :prompt_len] = prompt
 
         t0 = time.perf_counter()
-        cache = init_kv_cache(self.config, self.batch, self.max_len)
+        cache = init_kv_cache(self.config, self.batch, self.max_len,
+                              kv_dtype=self.kv_dtype)
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache)
         # bucket padding advanced pos past prompt; rewind to prompt_len
         cache["pos"] = jnp.full((self.batch,), prompt_len, jnp.int32)
@@ -298,7 +354,8 @@ class LLMEngine:
             padded[i, :prompt_len] = prompt
 
         t0 = time.perf_counter()
-        cache = init_kv_cache(self.config, self.batch, self.max_len)
+        cache = init_kv_cache(self.config, self.batch, self.max_len,
+                              kv_dtype=self.kv_dtype)
         logits, cache = self._prefill(self.params, jnp.asarray(padded),
                                       cache)
         if prompt_len != bucket:
@@ -370,7 +427,8 @@ class LLMModelServer:
                          max_new_tokens: int = 64, hf_model: str | None = None,
                          temperature: float = 0.0, warmup: bool = True,
                          continuous_batching: bool = False, slots: int = 4,
-                         **kw):
+                         kv_dtype: str = "native", top_k: int = 0,
+                         top_p: float = 1.0, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -381,6 +439,9 @@ class LLMModelServer:
                 self._warmup = warmup
                 self.continuous_batching = continuous_batching
                 self.slots = slots
+                self.kv_dtype = kv_dtype
+                self.top_k = top_k
+                self.top_p = top_p
                 self._tokenizer = None
                 self.engine = None
 
@@ -404,24 +465,21 @@ class LLMModelServer:
                         self.tokenizer_id)
                 if self.continuous_batching:
                     # slot-based scheduler: concurrent requests interleave
-                    # on one decode batch (greedy only)
-                    if self.temperature and self.temperature > 0:
-                        raise ValueError(
-                            "continuous_batching decodes greedily; "
-                            "temperature sampling needs "
-                            "continuous_batching=False")
+                    # on one decode batch; per-request sampling settings
+                    # ride the shared dispatch (serving/sampling.py)
                     from .llm_batch import ContinuousBatchingEngine
 
                     self.engine = ContinuousBatchingEngine(
                         config, params, max_len=self.max_len,
-                        slots=self.slots)
+                        slots=self.slots, kv_dtype=self.kv_dtype)
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()
                 else:
                     self.engine = LLMEngine(
                         config, params, max_len=self.max_len,
-                        temperature=self.temperature)
+                        temperature=self.temperature,
+                        kv_dtype=self.kv_dtype)
                     if self._warmup:
                         self.engine.warmup()
                 self.model = self.engine
@@ -444,7 +502,9 @@ class LLMModelServer:
                     # wait: a dead scheduler fails the futures rather than
                     # wedging the worker.
                     futures = [self.engine.submit(
-                        ids, max_new_tokens=self.max_new_tokens)
+                        ids, max_new_tokens=self.max_new_tokens,
+                        temperature=self.temperature,
+                        top_k=self.top_k, top_p=self.top_p)
                         for ids in id_lists]
                     results = [f.result(timeout=600) for f in futures]
                     if results:

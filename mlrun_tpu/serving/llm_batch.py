@@ -37,9 +37,15 @@ from .llm import _cached_attention, _forward_with_cache, init_kv_cache
 
 
 def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
-                    cache: dict):
+                    cache: dict, rng: jax.Array = None,
+                    temperature: jax.Array = None,
+                    top_k: jax.Array = None, top_p: jax.Array = None):
     """One decode token per row with PER-ROW positions (slots at different
-    generation depths). tokens: [B, 1]; cache rows advance independently."""
+    generation depths). tokens: [B, 1]; cache rows advance independently.
+
+    Per-row sampling settings (temperature/top_k/top_p arrays) ride the
+    same compiled program: greedy rows (temperature 0) take an exact
+    argmax via jnp.where — see serving/sampling.py."""
     b = tokens.shape[0]
     start = cache["pos"]                      # [B]
     positions = start[:, None]                # [B, 1]
@@ -47,7 +53,7 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
     x = params["embedding"][tokens].astype(config.dtype)
     cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
 
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for layer in range(config.n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
         h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
@@ -64,12 +70,28 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
                                       config.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # per-row scatter at each row's own position
-        k_cache = cache["k"][layer].at[rows, start].set(
-            k[:, 0].astype(cache["k"].dtype))
-        v_cache = cache["v"][layer].at[rows, start].set(
-            v[:, 0].astype(cache["v"].dtype))
-        attn = _cached_attention(config, q, k_cache, v_cache, positions,
+        quantized = "k_scale" in cache
+        if quantized:
+            from .llm import _dequantize_kv, _quantize_kv
+
+            kq, ks = _quantize_kv(k[:, 0])
+            vq, vs = _quantize_kv(v[:, 0])
+            k_cache = cache["k"][layer].at[rows, start].set(kq)
+            v_cache = cache["v"][layer].at[rows, start].set(vq)
+            k_scale = cache["k_scale"][layer].at[rows, start].set(ks)
+            v_scale = cache["v_scale"][layer].at[rows, start].set(vs)
+            k_attn = _dequantize_kv(k_cache, k_scale, config.dtype)
+            v_attn = _dequantize_kv(v_cache, v_scale, config.dtype)
+            new_ks.append(k_scale)
+            new_vs.append(v_scale)
+        else:
+            # per-row scatter at each row's own position
+            k_cache = cache["k"][layer].at[rows, start].set(
+                k[:, 0].astype(cache["k"].dtype))
+            v_cache = cache["v"][layer].at[rows, start].set(
+                v[:, 0].astype(cache["v"].dtype))
+            k_attn, v_attn = k_cache, v_cache
+        attn = _cached_attention(config, q, k_attn, v_attn, positions,
                                  cache["k"].shape[2])
         attn = attn.reshape(b, 1, config.qkv_dim)
         x_mid = x + proj(attn, lp["wo"])
@@ -86,9 +108,17 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
         head = params["embedding"].T
     logits = jnp.einsum("bse,ev->bsv", x, head,
                         preferred_element_type=jnp.float32)[:, 0]
-    next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        from .sampling import sample_logits
+
+        next_token = sample_logits(logits, rng, temperature, top_k, top_p)
     new_cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
                  "pos": cache["pos"] + 1}
+    if new_ks:
+        new_cache["k_scale"] = jnp.stack(new_ks)
+        new_cache["v_scale"] = jnp.stack(new_vs)
     return next_token, new_cache
 
 
@@ -102,6 +132,9 @@ class _Slot:
     started: float = 0.0
     ttft: float = 0.0
     prompt_len: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
 
     @property
     def active(self) -> bool:
@@ -118,11 +151,13 @@ class ContinuousBatchingEngine:
 
     def __init__(self, config: LlamaConfig, params: Params,
                  max_len: int = 2048, slots: int = 4,
-                 prefill_buckets: tuple = (128, 512, 1024)):
+                 prefill_buckets: tuple = (128, 512, 1024),
+                 seed: int = 0, kv_dtype: str = "native"):
         self.config = config
         self.params = params
         self.max_len = max_len
         self.slots = slots
+        self.kv_dtype = kv_dtype
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
 
@@ -130,21 +165,28 @@ class ContinuousBatchingEngine:
                                                   config))
         self._decode = jax.jit(functools.partial(_decode_rowwise, config),
                                donate_argnums=(2,))
+        # the sampled variant is the same jit object called with the extra
+        # (rng, temperature, top_k, top_p) args — jax.jit specializes per
+        # argument structure, so greedy and sampled ticks each get their
+        # own cached executable
+        self._decode_sampled = self._decode
+        self._rng = jax.random.PRNGKey(seed)
 
-        def insert(big_cache, k_row, v_row, slot, pos):
+        def insert(big_cache, small, slot, pos):
             big_cache = dict(big_cache)
-            big_cache["k"] = jax.lax.dynamic_update_slice(
-                big_cache["k"], k_row.astype(big_cache["k"].dtype),
-                (0, slot, 0, 0, 0))
-            big_cache["v"] = jax.lax.dynamic_update_slice(
-                big_cache["v"], v_row.astype(big_cache["v"].dtype),
-                (0, slot, 0, 0, 0))
+            for name in ("k", "v", "k_scale", "v_scale"):
+                if name in big_cache:
+                    idx = (0, slot) + (0,) * (big_cache[name].ndim - 2)
+                    big_cache[name] = jax.lax.dynamic_update_slice(
+                        big_cache[name],
+                        small[name].astype(big_cache[name].dtype), idx)
             big_cache["pos"] = big_cache["pos"].at[slot].set(pos)
             return big_cache
 
         self._insert = jax.jit(insert, donate_argnums=(0,))
 
-        self._cache = init_kv_cache(config, slots, max_len)
+        self._cache = init_kv_cache(config, slots, max_len,
+                                    kv_dtype=kv_dtype)
         self._slot_state = [_Slot() for _ in range(slots)]
         self._queue: queue.Queue = queue.Queue()
         self._running = False
@@ -172,17 +214,25 @@ class ContinuousBatchingEngine:
         """Compile prefill buckets, decode step, and insertion."""
         started = time.perf_counter()
         for bucket in self.prefill_buckets:
-            small = init_kv_cache(self.config, 1, self.max_len)
+            small = init_kv_cache(self.config, 1, self.max_len,
+                                  kv_dtype=self.kv_dtype)
             tokens = jnp.zeros((1, bucket), jnp.int32)
             _, small = self._prefill(self.params, tokens, small)
             # the last-token replay used for non-bucket prompt lengths
             _, small = self._prefill(self.params,
                                      jnp.zeros((1, 1), jnp.int32), small)
-            self._cache = self._insert(self._cache, small["k"], small["v"],
-                                       0, bucket)
+            self._cache = self._insert(self._cache, small, 0, bucket)
         step = jnp.zeros((self.slots, 1), jnp.int32)
         tok, self._cache = self._decode(self.params, step, self._cache)
         float(jnp.sum(tok))  # host fetch = real sync on the relay
+        # compile the sampled variant too (first sampled request must not
+        # pay the compile)
+        tok, self._cache = self._decode_sampled(
+            self.params, step, self._cache, jax.random.PRNGKey(0),
+            jnp.zeros((self.slots,), jnp.float32),
+            jnp.zeros((self.slots,), jnp.int32),
+            jnp.ones((self.slots,), jnp.float32))
+        float(jnp.sum(tok))
         self._cache["pos"] = jnp.zeros((self.slots,), jnp.int32)
         logger.info("continuous batching engine warm",
                     slots=self.slots,
@@ -191,23 +241,28 @@ class ContinuousBatchingEngine:
 
     # -- API ----------------------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens: int = 64,
-               eos_id: int | None = None) -> Future:
+               eos_id: int | None = None, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> Future:
         future: Future = Future()
         with self._lock:
             request_id = self._next_id
             self._next_id += 1
             self._stats["requests"] += 1
         self._queue.put((request_id, list(prompt_tokens), max_new_tokens,
-                         eos_id, future, time.perf_counter()))
+                         eos_id, future, time.perf_counter(),
+                         (float(temperature), int(top_k), float(top_p))))
         if not self._running:
             self.start()
         return future
 
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
-                 eos_id: int | None = None, timeout: float = 300.0):
+                 eos_id: int | None = None, timeout: float = 300.0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
         """Synchronous convenience wrapper around submit()."""
-        return self.submit(prompt_tokens, max_new_tokens,
-                           eos_id).result(timeout=timeout)
+        return self.submit(prompt_tokens, max_new_tokens, eos_id,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p).result(timeout=timeout)
 
     @property
     def stats(self) -> dict:
@@ -233,9 +288,10 @@ class ContinuousBatchingEngine:
             return False
         try:
             (request_id, prompt, max_new, eos_id, future,
-             submitted) = self._queue.get_nowait()
+             submitted, sampling) = self._queue.get_nowait()
         except queue.Empty:
             return False
+        temperature, top_k, top_p = sampling
         prompt = np.asarray(prompt, np.int32).reshape(1, -1)
         prompt_len = prompt.shape[1]
         if prompt_len + max_new > self.max_len:
@@ -247,7 +303,8 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :prompt_len] = prompt
 
-        small = init_kv_cache(self.config, 1, self.max_len)
+        small = init_kv_cache(self.config, 1, self.max_len,
+                              kv_dtype=self.kv_dtype)
         logits, small = self._prefill(self.params, jnp.asarray(padded),
                                       small)
         if prompt_len != bucket:
@@ -256,9 +313,17 @@ class ContinuousBatchingEngine:
             small["pos"] = jnp.full((1,), prompt_len - 1, jnp.int32)
             logits, small = self._prefill(
                 self.params, jnp.asarray(prompt[:, -1:]), small)
-        first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-        self._cache = self._insert(self._cache, small["k"], small["v"],
-                                   free, prompt_len)
+        if temperature > 0:
+            from .sampling import sample_logits
+
+            self._rng, sub = jax.random.split(self._rng)
+            first_token = int(np.asarray(sample_logits(
+                logits, sub, jnp.full((1,), temperature, jnp.float32),
+                jnp.full((1,), top_k, jnp.int32),
+                jnp.full((1,), top_p, jnp.float32)))[0])
+        else:
+            first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        self._cache = self._insert(self._cache, small, free, prompt_len)
 
         slot = self._slot_state[free]
         slot.request_id = request_id
@@ -269,6 +334,9 @@ class ContinuousBatchingEngine:
         slot.started = submitted
         slot.ttft = time.perf_counter() - submitted
         slot.prompt_len = prompt_len
+        slot.temperature = temperature
+        slot.top_k = top_k
+        slot.top_p = top_p
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
@@ -301,8 +369,22 @@ class ContinuousBatchingEngine:
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]
-        next_token, self._cache = self._decode(
-            self.params, jnp.asarray(last), self._cache)
+        if any(self._slot_state[i].temperature > 0 for i in active):
+            temp = np.zeros((self.slots,), np.float32)
+            top_k = np.zeros((self.slots,), np.int32)
+            top_p = np.ones((self.slots,), np.float32)
+            for i in active:
+                slot = self._slot_state[i]
+                temp[i] = slot.temperature
+                top_k[i] = slot.top_k
+                top_p[i] = slot.top_p
+            self._rng, sub = jax.random.split(self._rng)
+            next_token, self._cache = self._decode_sampled(
+                self.params, jnp.asarray(last), self._cache, sub,
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+        else:
+            next_token, self._cache = self._decode(
+                self.params, jnp.asarray(last), self._cache)
         tokens_host = np.asarray(next_token)
         for i in active:
             slot = self._slot_state[i]

@@ -1,0 +1,78 @@
+"""On-device token sampling for the LLM engines.
+
+TPU-shaped sampling: one compiled program regardless of per-row settings.
+Temperature / top-k / top-p are ARRAYS over the batch (per-slot in the
+continuous-batching engine), so mixed greedy+sampled batches share a single
+decode dispatch — no per-request recompilation, no host round-trips.
+
+The usual trick for static shapes: top-k/top-p masks are applied inside a
+fixed-size ``lax.top_k`` workspace (TOPK_WORKSPACE logits), then sampled
+categorically and mapped back to vocab ids. Rows with no restriction
+(top_k 0, top_p 1) sample the full vocabulary directly, and rows with
+``temperature == 0`` take the argmax path via ``jnp.where`` — both are
+exact, not workspace approximations.
+
+No reference analog: the reference has no inference engine (its
+V2ModelServer calls user predict(), mlrun/serving/v2_serving.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# every sampled distribution is truncated to this many candidates; large
+# enough that top_p/top_k settings in practical ranges are exact
+TOPK_WORKSPACE = 64
+
+
+def sample_logits(logits: jax.Array, key: jax.Array,
+                  temperature: jax.Array, top_k: jax.Array,
+                  top_p: jax.Array) -> jax.Array:
+    """Sample next tokens. logits [B, V]; temperature/top_k/top_p [B].
+
+    - temperature 0 => greedy argmax for that row (exact)
+    - top_k 0       => no top-k restriction (within the workspace)
+    - top_p 1.0     => no nucleus restriction
+    Returns int32 [B].
+    """
+    b, v = logits.shape
+    temperature = jnp.asarray(temperature, jnp.float32).reshape(b)
+    top_k = jnp.asarray(top_k, jnp.int32).reshape(b)
+    top_p = jnp.asarray(top_p, jnp.float32).reshape(b)
+
+    work = min(TOPK_WORKSPACE, v)
+    top_logits, top_ids = jax.lax.top_k(logits.astype(jnp.float32), work)
+
+    # top-k mask inside the (sorted-descending) workspace
+    ranks = jnp.arange(work)[None, :]
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, work), work)[:, None]
+    masked = jnp.where(ranks < k_eff, top_logits, -jnp.inf)
+
+    # nucleus: keep the smallest prefix with cumulative prob >= top_p
+    # (always keep rank 0)
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(masked / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]  # prob mass BEFORE this rank
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, masked, -jnp.inf)
+
+    keys = jax.random.split(key, b)
+    choice = jax.vmap(
+        lambda k_, l_, t_: jax.random.categorical(k_, l_ / jnp.maximum(
+            t_, 1e-6)))(keys, masked, temperature)
+    workspace_sampled = jnp.take_along_axis(
+        top_ids, choice[:, None], axis=-1)[:, 0]
+    # unrestricted rows (top_k==0, top_p>=1) sample the FULL vocabulary —
+    # the workspace is only a device for applying top-k/top-p masks, and
+    # truncating pure temperature sampling to it would silently zero the
+    # tail's probability mass
+    full_choice = jax.vmap(
+        lambda k_, l_, t_: jax.random.categorical(
+            k_, l_.astype(jnp.float32) / jnp.maximum(t_, 1e-6)))(
+        keys, logits, temperature)
+    restricted = (top_k > 0) | (top_p < 1.0)
+    sampled = jnp.where(restricted, workspace_sampled, full_choice)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

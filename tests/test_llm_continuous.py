@@ -110,3 +110,80 @@ def test_scheduler_death_fails_futures(setup):
     with pytest.raises(RuntimeError, match="injected device failure"):
         future.result(timeout=60)
     eng.stop()
+
+
+def test_sampled_decode_mixes_with_greedy(setup, engine):
+    """A sampled request and a greedy request share the decode batch; the
+    greedy one must stay EXACTLY the argmax continuation."""
+    cfg, params = setup
+    greedy_prompt = [1, 7, 3, 9, 2]
+    f_sampled = engine.submit([4, 2, 8], max_new_tokens=8, temperature=0.9,
+                              top_k=20, top_p=0.95)
+    f_greedy = engine.submit(greedy_prompt, max_new_tokens=6)
+    sampled_tokens, _ = f_sampled.result(timeout=120)
+    greedy_tokens, _ = f_greedy.result(timeout=120)
+    assert greedy_tokens == _greedy_reference(cfg, params, greedy_prompt, 6)
+    assert len(sampled_tokens) == 8
+    vocab = cfg.vocab_size
+    assert all(0 <= t < vocab for t in sampled_tokens)
+
+
+def test_sampled_decode_varies_with_seed(setup):
+    """Two engines with different seeds produce different sampled output
+    for the same prompt (and the same output for temperature=0)."""
+    cfg, params = setup
+    outs = []
+    for seed in (1, 2):
+        eng = ContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                       prefill_buckets=(16,), seed=seed)
+        eng.start()
+        try:
+            tokens, _ = eng.generate([3, 1, 4, 1, 5], max_new_tokens=12,
+                                     temperature=1.5, top_k=0, top_p=1.0)
+            greedy, _ = eng.generate([3, 1, 4, 1, 5], max_new_tokens=5)
+        finally:
+            eng.stop()
+        outs.append((tuple(tokens), tuple(greedy)))
+    assert outs[0][1] == outs[1][1]          # greedy is seed-independent
+    assert outs[0][0] != outs[1][0]          # sampling responds to the seed
+
+
+def test_int8_kv_cache_close_to_native(setup):
+    """int8 KV cache halves residency; generation must stay close to the
+    bf16-cache engine (identical early greedy tokens on the tiny model)."""
+    cfg, params = setup
+    outs = {}
+    for kv_dtype in ("native", "int8"):
+        eng = ContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                       prefill_buckets=(16,),
+                                       kv_dtype=kv_dtype)
+        eng.start()
+        try:
+            tokens, stats = eng.generate([3, 1, 4, 1, 5], max_new_tokens=8)
+        finally:
+            eng.stop()
+        outs[kv_dtype] = tokens
+        assert len(tokens) == 8 and stats["ttft_s"] > 0
+    # int8 quantization error must not flip the first greedy tokens
+    assert outs["int8"][:4] == outs["native"][:4]
+    cache = __import__("mlrun_tpu.serving.llm", fromlist=["init_kv_cache"])
+    int8_cache = cache.init_kv_cache(cfg, 2, 64, kv_dtype="int8")
+    native_cache = cache.init_kv_cache(cfg, 2, 64)
+    int8_bytes = sum(a.nbytes for a in int8_cache.values())
+    native_bytes = sum(a.nbytes for a in native_cache.values())
+    assert int8_bytes < native_bytes * 0.75
+
+
+def test_quantize_roundtrip_error_small():
+    import jax
+    import jax.numpy as jnp
+
+    from mlrun_tpu.serving.llm import _dequantize_kv, _quantize_kv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 2, 32),
+                          jnp.bfloat16)
+    q, scale = _quantize_kv(x)
+    back = _dequantize_kv(q, scale, jnp.float32)
+    err = jnp.max(jnp.abs(back - x.astype(jnp.float32)))
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    assert float(err) <= float(amax) / 127.0 + 1e-3

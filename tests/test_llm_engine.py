@@ -101,3 +101,30 @@ def test_generate_batch_empty():
                     max_len=64, prefill_buckets=(32,))
     outs, stats = eng.generate_batch([], max_new_tokens=4)
     assert outs == [] and stats["batch"] == 0
+
+
+def test_sample_logits_properties():
+    """On-device sampler: greedy rows exact, top-k respected, top-p keeps
+    the head of the distribution, per-row settings independent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlrun_tpu.serving.sampling import sample_logits
+
+    v = 100
+    logits = jnp.tile(jnp.linspace(0.0, 5.0, v)[None, :], (4, 1))
+    temperature = jnp.asarray([0.0, 1.0, 1.0, 0.5])
+    top_k = jnp.asarray([0, 1, 5, 0])
+    top_p = jnp.asarray([1.0, 1.0, 1.0, 0.05])
+    counts = {i: set() for i in range(4)}
+    for s in range(200):
+        out = np.asarray(sample_logits(logits, jax.random.PRNGKey(s),
+                                       temperature, top_k, top_p))
+        for i in range(4):
+            counts[i].add(int(out[i]))
+    assert counts[0] == {v - 1}                      # greedy row: argmax only
+    assert counts[1] == {v - 1}                      # top_k=1: argmax only
+    assert all(t >= v - 5 for t in counts[2])        # top_k=5: top 5 ids
+    assert len(counts[2]) > 1                        # ...and actually samples
+    assert all(t >= v - 3 for t in counts[3])        # tight nucleus: head only
