@@ -90,15 +90,19 @@ def _subprocess_main():
     def _watchdog(signum, frame):
         raise SystemExit("attempt: watchdog fired (hung init or bench)")
 
+    import time
+
     signal.signal(signal.SIGALRM, _watchdog)
+    started = time.monotonic()
     signal.alarm(180)
     import jax
 
     jax.devices()
-    # keep a watchdog armed for the WHOLE attempt so the child exits
-    # gracefully before the parent's hard kill — a SIGKILLed TPU client
-    # can wedge the relay for every later attempt
-    signal.alarm(840)
+    # keep a watchdog armed for the WHOLE attempt, budgeted against total
+    # child lifetime so it always fires BEFORE the parent's 900s hard kill
+    # — a SIGKILLed TPU client can wedge the relay for every later attempt
+    elapsed = time.monotonic() - started
+    signal.alarm(max(60, int(840 - elapsed)))
     _, _, scale, batch, seq, policy = sys.argv
     result = _bench(scale, int(batch), int(seq), remat_policy=policy)
     signal.alarm(0)

@@ -181,13 +181,17 @@ class LLMEngine:
     def __init__(self, config: LlamaConfig, params: Params,
                  max_len: int = 2048, batch: int = 1,
                  prefill_buckets: tuple = (128, 512, 1024),
-                 temperature: float = 0.0, kv_dtype: str = "native"):
+                 temperature: float = 0.0, kv_dtype: str = "native",
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         self.config = config
         self.params = params
         self.max_len = max_len
         self.batch = batch
         self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
         self.kv_dtype = kv_dtype
+        self._rng = jax.random.PRNGKey(seed)
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
 
@@ -406,9 +410,15 @@ class LLMEngine:
 
     def _sample(self, logits):
         if self.temperature and self.temperature > 0:
-            key = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
-            return jax.random.categorical(
-                key, logits / self.temperature, axis=-1)
+            from .sampling import sample_logits
+
+            b = logits.shape[0]
+            self._rng, sub = jax.random.split(self._rng)
+            return sample_logits(
+                logits, sub,
+                jnp.full((b,), self.temperature, jnp.float32),
+                jnp.full((b,), self.top_k, jnp.int32),
+                jnp.full((b,), self.top_p, jnp.float32))
         return jnp.argmax(logits, axis=-1)
 
 
@@ -428,7 +438,9 @@ class LLMModelServer:
                          temperature: float = 0.0, warmup: bool = True,
                          continuous_batching: bool = False, slots: int = 4,
                          kv_dtype: str = "native", top_k: int = 0,
-                         top_p: float = 1.0, **kw):
+                         top_p: float = 1.0, paged: bool = False,
+                         page_size: int = 128,
+                         n_pages: int | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -442,6 +454,9 @@ class LLMModelServer:
                 self.kv_dtype = kv_dtype
                 self.top_k = top_k
                 self.top_p = top_p
+                self.paged = paged
+                self.page_size = page_size
+                self.n_pages = n_pages
                 self._tokenizer = None
                 self.engine = None
 
@@ -467,18 +482,34 @@ class LLMModelServer:
                     # slot-based scheduler: concurrent requests interleave
                     # on one decode batch; per-request sampling settings
                     # ride the shared dispatch (serving/sampling.py)
-                    from .llm_batch import ContinuousBatchingEngine
+                    if self.paged:
+                        # paged KV pool: oversubscribable long-prompt
+                        # serving (serving/paged.py)
+                        from .paged import PagedContinuousBatchingEngine
 
-                    self.engine = ContinuousBatchingEngine(
-                        config, params, max_len=self.max_len,
-                        slots=self.slots, kv_dtype=self.kv_dtype)
+                        self.engine = PagedContinuousBatchingEngine(
+                            config, params, max_len=self.max_len,
+                            slots=self.slots, kv_dtype=self.kv_dtype,
+                            page_size=self.page_size,
+                            n_pages=self.n_pages)
+                    else:
+                        from .llm_batch import ContinuousBatchingEngine
+
+                        self.engine = ContinuousBatchingEngine(
+                            config, params, max_len=self.max_len,
+                            slots=self.slots, kv_dtype=self.kv_dtype)
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()
                 else:
+                    if self.paged:
+                        raise ValueError(
+                            "paged=True needs continuous_batching=True "
+                            "(the paged pool backs the slot scheduler)")
                     self.engine = LLMEngine(
                         config, params, max_len=self.max_len,
                         temperature=self.temperature,
+                        top_k=self.top_k, top_p=self.top_p,
                         kv_dtype=self.kv_dtype)
                     if self._warmup:
                         self.engine.warmup()

@@ -185,8 +185,7 @@ class ContinuousBatchingEngine:
 
         self._insert = jax.jit(insert, donate_argnums=(0,))
 
-        self._cache = init_kv_cache(config, slots, max_len,
-                                    kv_dtype=kv_dtype)
+        self._cache = self._make_cache()
         self._slot_state = [_Slot() for _ in range(slots)]
         self._queue: queue.Queue = queue.Queue()
         self._running = False
@@ -195,6 +194,11 @@ class ContinuousBatchingEngine:
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "completed": 0, "ttft_sum": 0.0,
                        "tokens_out": 0}
+
+    def _make_cache(self):
+        """Slot KV storage (hook: the paged engine swaps in a page pool)."""
+        return init_kv_cache(self.config, self.slots, self.max_len,
+                             kv_dtype=self.kv_dtype)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -356,11 +360,14 @@ class ContinuousBatchingEngine:
             self._stats["tokens_out"] += len(slot.tokens)
         future, tokens = slot.future, slot.tokens
         self._slot_state[index] = _Slot()
+        self._release_slot_storage(index)
+        if future is not None and not future.cancelled():
+            future.set_result((tokens, stats))
+
+    def _release_slot_storage(self, index: int):
         # zero the freed row's position so decode writes land in its own
         # (now unused) region
         self._cache["pos"] = self._cache["pos"].at[index].set(0)
-        if future is not None and not future.cancelled():
-            future.set_result((tokens, stats))
 
     def _decode_tick(self):
         active = [i for i, s in enumerate(self._slot_state) if s.active]

@@ -1,0 +1,419 @@
+"""Paged KV cache for long-prompt serving (vLLM-style, TPU-shaped).
+
+The dense continuous-batching cache reserves ``slots x max_len`` KV rows
+even when most requests are short; a paged pool allocates KV in fixed-size
+pages and maps each slot to pages through a page table, so the pool can be
+sized for the EXPECTED total tokens, not slots x worst case — more
+concurrent slots per chip at the same HBM.
+
+TPU shaping (everything static under jit):
+- pool:       [layers, n_pages + 1, page_size, kv_heads, head_dim] — the
+  LAST physical page is a scratch page: writes for unmapped slots (-1 page
+  ids) land there, so masked-out writes can never collide with a live
+  page (scatter with duplicate indices has an undefined winner).
+- page_table: [slots, pages_per_slot] int32 (page ids; -1 = unmapped)
+- attention:  gather the slot's pages into a dense [slots, max_len] view
+  per layer, then run the same masked attention as the dense engine. The
+  gather is HBM-bandwidth work of the same order as attention's cache
+  read; compute cost is unchanged.
+- page allocation/free is host-side bookkeeping in the scheduler thread
+  (a free-list), exactly where the dense engine's slot bookkeeping lives.
+
+Pages for prompt + max_new_tokens are reserved at admission, so decode can
+never run out mid-generation (no preemption path needed).
+
+No reference analog: the reference has no inference engine
+(mlrun/serving/v2_serving.py calls user predict()).
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig
+from ..utils import logger
+from .llm import init_kv_cache
+from .llm_batch import ContinuousBatchingEngine
+
+
+def init_paged_pool(config: LlamaConfig, n_pages: int, page_size: int,
+                    kv_dtype: str = "native") -> dict:
+    """Page pool pytree with ``n_pages`` physical pages (callers that need
+    a scratch page pass n_pages + 1 and keep the last id out of the free
+    list). The int8 variant carries per-vector scales."""
+    if kv_dtype not in ("native", "int8"):
+        raise ValueError(f"unknown kv_dtype '{kv_dtype}' (native | int8)")
+    shape = (config.n_layers, n_pages, page_size, config.n_kv_heads,
+             config.head_dim)
+    if kv_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
+def insert_prompt_pages(pool: dict, small: dict, page_ids: jax.Array,
+                        page_size: int) -> dict:
+    """Scatter a prefilled slot-cache (``small`` from init_kv_cache with
+    batch=1, max_len a multiple of page_size) into the pool at
+    ``page_ids`` ([pages_per_slot] int32). Ids < 0 write to the scratch
+    page (last physical page) — never to a live one."""
+    scratch = pool["k"].shape[1] - 1
+    pages = page_ids.shape[0]
+
+    def body(p, pool_):
+        pid = page_ids[p]
+        pid_safe = jnp.where(pid >= 0, pid, scratch)
+        out = dict(pool_)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            if name not in pool_:
+                continue
+            row = jax.lax.dynamic_slice_in_dim(
+                small[name][:, 0], p * page_size, page_size, axis=1)
+            out[name] = jax.lax.dynamic_update_index_in_dim(
+                pool_[name], row.astype(pool_[name].dtype), pid_safe,
+                axis=1)
+        return out
+
+    return jax.lax.fori_loop(0, pages, body, pool)
+
+
+def _write_token_all_layers(pool: dict, k_tok, v_tok, page_table, pos,
+                            page_size: int, scales=None) -> dict:
+    """k_tok/v_tok: [L, slots, H, D]; write each slot's token into its
+    current page at pos % page_size. Slots with an unmapped page (id < 0,
+    e.g. inactive) write to the scratch page instead — duplicate scratch
+    writes are harmless because the scratch page is never read."""
+    scratch = pool["k"].shape[1] - 1
+    page_idx = pos // page_size
+    offset = pos % page_size
+    pid = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    pid_safe = jnp.where(pid >= 0, pid, scratch)
+
+    out = dict(pool)
+    rows = {"k": k_tok, "v": v_tok}
+    if scales is not None:
+        rows["k_scale"] = scales[0]
+        rows["v_scale"] = scales[1]
+    for name, row in rows.items():
+        if name not in pool:
+            continue
+        out[name] = out[name].at[:, pid_safe, offset].set(
+            row.astype(out[name].dtype))
+    return out
+
+
+def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
+                          tokens: jax.Array, pool: dict,
+                          page_table: jax.Array, pos: jax.Array,
+                          rng: jax.Array = None,
+                          temperature: jax.Array = None,
+                          top_k: jax.Array = None, top_p: jax.Array = None):
+    """One decode token per slot against the page pool.
+
+    Per layer: gather the slot's pages into a dense view, splice the
+    just-computed token into the view for attention (it is only written to
+    the pool once, for all layers, at the end), run the dense masked
+    attention. tokens [slots, 1]; pos [slots] absolute positions.
+    Returns (next_token, new_pool, new_pos).
+    """
+    from ..ops.norms import rms_norm
+    from ..ops.rotary import apply_rope, rope_table
+    from .llm import _cached_attention, _quantize_kv
+    from .sampling import sample_logits
+
+    b = tokens.shape[0]
+    positions = pos[:, None]
+    rows = jnp.arange(b)
+    safe_table = jnp.maximum(page_table, 0)            # [slots, pages]
+    x = params["embedding"][tokens].astype(config.dtype)
+    cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
+    quantized = "k_scale" in pool
+
+    k_new, v_new = [], []
+    for layer in range(config.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
+
+        def proj(h_in, w):
+            return jnp.einsum("bse,eh->bsh", h_in, w,
+                              preferred_element_type=jnp.float32
+                              ).astype(x.dtype)
+
+        q = proj(h, lp["wq"]).reshape(b, 1, config.n_heads, config.head_dim)
+        k = proj(h, lp["wk"]).reshape(b, 1, config.n_kv_heads,
+                                      config.head_dim)
+        v = proj(h, lp["wv"]).reshape(b, 1, config.n_kv_heads,
+                                      config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        # dense per-layer view of this slot's pages (dequantized)
+        kp = jnp.take(pool["k"][layer], safe_table, axis=0)
+        vp = jnp.take(pool["v"][layer], safe_table, axis=0)
+        s_, p_, ps_, hh, dd = kp.shape
+        kd = kp.reshape(s_, p_ * ps_, hh, dd)
+        vd = vp.reshape(s_, p_ * ps_, hh, dd)
+        if quantized:
+            ksc = jnp.take(pool["k_scale"][layer], safe_table,
+                           axis=0).reshape(s_, p_ * ps_, hh)
+            vsc = jnp.take(pool["v_scale"][layer], safe_table,
+                           axis=0).reshape(s_, p_ * ps_, hh)
+            kd = (kd.astype(jnp.float32) * ksc[..., None]).astype(
+                config.dtype)
+            vd = (vd.astype(jnp.float32) * vsc[..., None]).astype(
+                config.dtype)
+        else:
+            kd = kd.astype(config.dtype)
+            vd = vd.astype(config.dtype)
+        # splice the new token into the dense view at each slot's position
+        kd = kd.at[rows, pos].set(k[:, 0])
+        vd = vd.at[rows, pos].set(v[:, 0])
+        attn = _cached_attention(config, q, kd, vd, positions,
+                                 kd.shape[1])
+        attn = attn.reshape(b, 1, config.qkv_dim)
+        x_mid = x + proj(attn, lp["wo"])
+        h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
+        gate = proj(h2, lp["w_gate"])
+        up = proj(h2, lp["w_up"])
+        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
+        k_new.append(k[:, 0])
+        v_new.append(v[:, 0])
+
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)[:, 0]
+    if rng is None:
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_token = sample_logits(logits, rng, temperature, top_k, top_p)
+
+    # one pooled write for all layers: [L, slots, H, D]
+    k_tok = jnp.stack(k_new)
+    v_tok = jnp.stack(v_new)
+    if quantized:
+        kq, ks = _quantize_kv(k_tok)
+        vq, vs = _quantize_kv(v_tok)
+        new_pool = _write_token_all_layers(
+            pool, kq, vq, page_table, pos, page_size, scales=(ks, vs))
+    else:
+        new_pool = _write_token_all_layers(
+            pool, k_tok, v_tok, page_table, pos, page_size)
+    return next_token, new_pool, pos + 1
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a paged KV pool.
+
+    Same scheduler contract as ContinuousBatchingEngine (submit/generate/
+    start/stop/warmup/stats), but slot KV lives in a shared page pool:
+    ``n_pages`` defaults to the dense equivalent (slots x pages_per_slot);
+    size it SMALLER to oversubscribe memory when typical prompt+generation
+    lengths are below max_len. Pages for prompt+max_new are reserved at
+    admission and requests wait (in order) until enough pages are free.
+    """
+
+    def __init__(self, config: LlamaConfig, params, max_len: int = 2048,
+                 slots: int = 4, prefill_buckets: tuple = (128, 512, 1024),
+                 seed: int = 0, kv_dtype: str = "native",
+                 page_size: int = 128, n_pages: int | None = None):
+        if max_len % page_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size} (a partial last page would misalign KV rows)")
+        # set before super().__init__ — _make_cache runs during it
+        self.page_size = page_size
+        self.pages_per_slot = max_len // page_size
+        self.n_pages = n_pages or slots * self.pages_per_slot
+        super().__init__(config, params, max_len=max_len, slots=slots,
+                         prefill_buckets=prefill_buckets, seed=seed,
+                         kv_dtype=kv_dtype)
+        # +1 physical page: the scratch page for masked writes
+        self._pool = init_paged_pool(config, self.n_pages + 1, page_size,
+                                     kv_dtype)
+        self._page_table = np.full((slots, self.pages_per_slot), -1,
+                                   np.int32)
+        self._pos = np.zeros((slots,), np.int32)
+        self._free_pages: deque = deque(range(self.n_pages))
+        self._slot_pages: dict[int, list] = {}
+        self._pending: deque = deque()
+        self._decode_paged = jax.jit(
+            functools.partial(_decode_rowwise_paged, config, page_size),
+            donate_argnums=(2,))
+        self._insert_paged = jax.jit(
+            functools.partial(insert_prompt_pages, page_size=page_size),
+            donate_argnums=(0,))
+
+    def _make_cache(self):
+        return None  # slot KV lives in the page pool
+
+    def warmup(self):
+        started = time.perf_counter()
+        ids = jnp.full((self.pages_per_slot,), -1, jnp.int32)
+        for bucket in self.prefill_buckets:
+            small = init_kv_cache(self.config, 1, self.max_len,
+                                  kv_dtype=self.kv_dtype)
+            _, small = self._prefill(
+                self.params, jnp.zeros((1, bucket), jnp.int32), small)
+            _, small = self._prefill(
+                self.params, jnp.zeros((1, 1), jnp.int32), small)
+            self._pool = self._insert_paged(self._pool, small, ids)
+        step = jnp.zeros((self.slots, 1), jnp.int32)
+        table = jnp.asarray(self._page_table)
+        pos = jnp.asarray(self._pos)
+        tok, self._pool, _ = self._decode_paged(
+            self.params, step, self._pool, table, pos)
+        float(jnp.sum(tok))  # host fetch = real sync on the relay
+        tok, self._pool, _ = self._decode_paged(
+            self.params, step, self._pool, table, pos,
+            jax.random.PRNGKey(0),
+            jnp.zeros((self.slots,), jnp.float32),
+            jnp.zeros((self.slots,), jnp.int32),
+            jnp.ones((self.slots,), jnp.float32))
+        float(jnp.sum(tok))
+        logger.info("paged engine warm", slots=self.slots,
+                    pages=self.n_pages, page_size=self.page_size,
+                    warmup_s=round(time.perf_counter() - started, 2))
+
+    # -- admission with page reservation ------------------------------------
+    def _admit_one(self) -> bool:
+        free = next((i for i, s in enumerate(self._slot_state)
+                     if not s.active), None)
+        if free is None:
+            return False
+        if not self._pending:
+            try:
+                self._pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                return False
+        (request_id, prompt, max_new, eos_id, future, submitted,
+         sampling) = self._pending[0]
+        temperature, top_k, top_p = sampling
+        prompt_len = len(prompt)
+        if prompt_len + max_new > self.max_len:
+            self._pending.popleft()
+            future.set_exception(ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens {max_new} "
+                f"exceeds max_len {self.max_len}"))
+            return True
+        needed = -(-(prompt_len + max_new) // self.page_size)
+        if needed > self.n_pages:
+            # would never fit — fail fast instead of blocking the queue
+            # head forever
+            self._pending.popleft()
+            future.set_exception(ValueError(
+                f"request needs {needed} pages but the pool has only "
+                f"{self.n_pages}; raise n_pages or lower max_new_tokens"))
+            return True
+        if len(self._free_pages) < needed:
+            return False  # head-of-line waits for pages (in order)
+        self._pending.popleft()
+        page_ids = [self._free_pages.popleft() for _ in range(needed)]
+        self._slot_pages[free] = page_ids
+
+        prompt_arr = np.asarray(prompt, np.int32).reshape(1, -1)
+        bucket = self._bucket_for(prompt_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt_len] = prompt_arr
+        small = init_kv_cache(self.config, 1, self.max_len,
+                              kv_dtype=self.kv_dtype)
+        logits, small = self._prefill(self.params, jnp.asarray(padded),
+                                      small)
+        if prompt_len != bucket:
+            small["pos"] = jnp.full((1,), prompt_len - 1, jnp.int32)
+            logits, small = self._prefill(
+                self.params, jnp.asarray(prompt_arr[:, -1:]), small)
+        if temperature > 0:
+            from .sampling import sample_logits
+
+            self._rng, sub = jax.random.split(self._rng)
+            first_token = int(np.asarray(sample_logits(
+                logits, sub, jnp.full((1,), temperature, jnp.float32),
+                jnp.full((1,), top_k, jnp.int32),
+                jnp.full((1,), top_p, jnp.float32)))[0])
+        else:
+            first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+
+        ids = np.full((self.pages_per_slot,), -1, np.int32)
+        ids[:needed] = page_ids
+        self._pool = self._insert_paged(self._pool, small,
+                                        jnp.asarray(ids))
+        self._page_table[free] = ids
+        self._pos[free] = prompt_len
+
+        slot = self._slot_state[free]
+        slot.request_id = request_id
+        slot.tokens = [first_token]
+        slot.remaining = max_new - 1
+        slot.eos_id = eos_id
+        slot.future = future
+        slot.started = submitted
+        slot.ttft = time.perf_counter() - submitted
+        slot.prompt_len = prompt_len
+        slot.temperature = temperature
+        slot.top_k = top_k
+        slot.top_p = top_p
+        if (eos_id is not None and first_token == eos_id) or \
+                slot.remaining <= 0:
+            self._finish(free)
+        return True
+
+    def _release_slot_storage(self, index: int):
+        for pid in self._slot_pages.pop(index, []):
+            self._free_pages.append(pid)
+        self._page_table[index] = -1
+        self._pos[index] = 0
+
+    def _decode_tick(self):
+        active = [i for i, s in enumerate(self._slot_state) if s.active]
+        if not active:
+            return
+        last = np.zeros((self.slots, 1), np.int32)
+        for i in active:
+            last[i, 0] = self._slot_state[i].tokens[-1]
+        table = jnp.asarray(self._page_table)
+        pos = jnp.asarray(self._pos)
+        if any(self._slot_state[i].temperature > 0 for i in active):
+            temp = np.zeros((self.slots,), np.float32)
+            top_k = np.zeros((self.slots,), np.int32)
+            top_p = np.ones((self.slots,), np.float32)
+            for i in active:
+                slot = self._slot_state[i]
+                temp[i] = slot.temperature
+                top_k[i] = slot.top_k
+                top_p[i] = slot.top_p
+            self._rng, sub = jax.random.split(self._rng)
+            next_token, self._pool, _ = self._decode_paged(
+                self.params, jnp.asarray(last), self._pool, table, pos,
+                sub, jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p))
+        else:
+            next_token, self._pool, _ = self._decode_paged(
+                self.params, jnp.asarray(last), self._pool, table, pos)
+        tokens_host = np.asarray(next_token)
+        for i in active:
+            slot = self._slot_state[i]
+            token = int(tokens_host[i])
+            slot.tokens.append(token)
+            slot.remaining -= 1
+            self._pos[i] += 1
+            capacity = slot.prompt_len + len(slot.tokens) >= self.max_len
+            if (slot.eos_id is not None and token == slot.eos_id) or \
+                    slot.remaining <= 0 or capacity:
+                self._finish(i)
