@@ -32,5 +32,9 @@ class AlertConfigRecord(pydantic.BaseModel):
     reset_policy: str = "auto"  # auto | manual
     state: AlertState = AlertState.inactive
     count: int = 0
+    # silencing window: while now < silence_until (ISO timestamp) the alert
+    # evaluates but does NOT fire or notify (maintenance windows, known
+    # incidents). Cleared by writing an empty string.
+    silence_until: str = ""
 
     model_config = pydantic.ConfigDict(extra="allow")

@@ -372,6 +372,13 @@ class HTTPRunDB(RunDBInterface):
         self.api_call("POST", self._path(project, "alerts", name),
                       "store alert", json_body=config)
 
+    def silence_alert(self, name, minutes: float, project=""):
+        """Silence an alert for ``minutes`` (0 clears the window)."""
+        resp = self.api_call(
+            "POST", self._path(project, "alerts", name) + "/silence",
+            "silence alert", json_body={"minutes": minutes})
+        return resp.get("data")
+
     def get_alert_config(self, name, project=""):
         resp = self.api_call("GET", self._path(project, "alerts", name),
                              "get alert")

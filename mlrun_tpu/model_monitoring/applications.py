@@ -27,6 +27,10 @@ class MonitoringContext:
     end: str
     latencies_microsec: list = dataclasses.field(default_factory=list)
     error_count: int = 0
+    # per-feature StreamingHistogram sketches (metrics.py) — present when
+    # the stream processor folded the window into fixed-memory histograms;
+    # lets drift run on windows too large to hold as a dataframe
+    sample_histograms: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -60,10 +64,26 @@ class HistogramDataDriftApplication(ModelMonitoringApplicationBase):
         self.bins = bins
 
     def do_tracking(self, ctx: MonitoringContext) -> list[ApplicationResult]:
-        if ctx.reference_df is None or ctx.sample_df.empty:
+        if ctx.reference_df is None:
             return []
-        per_feature = drift_per_feature(ctx.sample_df, ctx.reference_df,
-                                        self.bins)
+        if not ctx.sample_df.empty:
+            per_feature = drift_per_feature(ctx.sample_df, ctx.reference_df,
+                                            self.bins)
+        elif ctx.sample_histograms:
+            # window too large to materialize (or already folded): compute
+            # drift from the streamed sketches against the reference
+            from .metrics import drift_between_histograms
+
+            per_feature = {}
+            for name, hist in ctx.sample_histograms.items():
+                if name not in ctx.reference_df.columns:
+                    continue
+                metrics = drift_between_histograms(
+                    hist, ctx.reference_df[name])
+                if metrics is not None:
+                    per_feature[name] = metrics
+        else:
+            return []
         if not per_feature:
             return []
         # headline score: mean of (tvd + hellinger)/2 across features

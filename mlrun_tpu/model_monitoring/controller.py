@@ -70,8 +70,12 @@ class MonitoringApplicationController:
 
     def __init__(self, project: str,
                  applications: list[ModelMonitoringApplicationBase]
-                 | None = None, db=None):
+                 | None = None, db=None, max_window_rows: int = 100_000):
         self.project = project
+        # windows larger than max_window_rows skip dataframe expansion and
+        # run drift from the stream processor's fixed-memory histogram
+        # sketches instead (high-cardinality / high-volume endpoints)
+        self.max_window_rows = max_window_rows
         self.applications = applications or [
             HistogramDataDriftApplication(), LatencyApplication()]
         if db is None:
@@ -117,12 +121,17 @@ class MonitoringApplicationController:
             if window.empty:
                 continue
             self._processed_rows[endpoint_id] = len(df)
-            try:
-                sample_df = _inputs_frame(window)
-            except Exception as exc:  # noqa: BLE001 - bad rows skip endpoint
-                logger.warning("could not parse inputs window",
-                               endpoint=endpoint_id, error=str(exc))
-                continue
+            if len(window) > self.max_window_rows:
+                # too big to expand row-by-row — drift runs from the
+                # streamed histogram sketches instead
+                sample_df = pd.DataFrame()
+            else:
+                try:
+                    sample_df = _inputs_frame(window)
+                except Exception as exc:  # noqa: BLE001 - bad rows skip
+                    logger.warning("could not parse inputs window",
+                                   endpoint=endpoint_id, error=str(exc))
+                    continue
             try:
                 endpoint = self.db.get_model_endpoint(self.project,
                                                       endpoint_id)
@@ -136,7 +145,11 @@ class MonitoringApplicationController:
                 start=str(window["when"].iloc[0]),
                 end=str(window["when"].iloc[-1]),
                 latencies_microsec=list(window["microsec"]),
-                error_count=int(endpoint.get("error_count", 0)))
+                error_count=int(endpoint.get("error_count", 0)),
+                # only consulted when sample_df is empty (window too big)
+                sample_histograms=(
+                    self.processor.load_histograms(endpoint_id)
+                    if sample_df.empty else {}))
             all_results: list[ApplicationResult] = []
             for app in self.applications:
                 try:
@@ -147,6 +160,8 @@ class MonitoringApplicationController:
             if all_results:
                 self.writer.write(endpoint_id, all_results)
             results_by_endpoint[endpoint_id] = all_results
+            # next window's sketches start fresh
+            self.processor.reset_histograms(endpoint_id)
         return results_by_endpoint
 
 

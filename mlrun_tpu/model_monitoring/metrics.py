@@ -71,3 +71,103 @@ def drift_per_feature(sample_df, reference_df, bins: int = 20) -> dict:
             "kld": kl_divergence(ref_hist, cur_hist),
         }
     return out
+
+
+class StreamingHistogram:
+    """Fixed-memory histogram sketch for high-cardinality / unbounded
+    feature streams: O(bins) state regardless of how many events flow
+    through, so drift can be computed without buffering raw windows.
+
+    The bin range locks after ``warmup`` values (from a buffered prefix);
+    later out-of-range values clip into the edge bins. Serializes to a
+    plain dict for persistence next to the monitoring parquet.
+    (Reference keeps full raw windows — mlrun/model_monitoring/
+    stream_processing.py aggregates into storey windows instead.)
+    """
+
+    def __init__(self, bins: int = 20, warmup: int = 1000):
+        self.bins = bins
+        self.warmup = warmup
+        self.edges: np.ndarray | None = None
+        self.counts = np.zeros(bins, dtype=np.int64)
+        self.total = 0
+        self._buffer: list = []
+
+    def update(self, values) -> None:
+        values = np.asarray(values, dtype=np.float64).ravel()
+        values = values[np.isfinite(values)]
+        if values.size == 0:
+            return
+        if self.edges is None:
+            self._buffer.extend(values.tolist())
+            if len(self._buffer) >= self.warmup:
+                self._lock_range()
+            return
+        self._add(values)
+
+    def _lock_range(self):
+        buffered = np.asarray(self._buffer, dtype=np.float64)
+        lo, hi = float(buffered.min()), float(buffered.max())
+        if lo == hi:
+            hi = lo + 1.0
+        self.edges = np.linspace(lo, hi, self.bins + 1)
+        self._buffer = []
+        self._add(buffered)
+
+    def _add(self, values: np.ndarray):
+        clipped = np.clip(values, self.edges[0], self.edges[-1])
+        idx = np.minimum(
+            np.searchsorted(self.edges, clipped, side="right") - 1,
+            self.bins - 1)
+        idx = np.maximum(idx, 0)
+        np.add.at(self.counts, idx, 1)
+        self.total += values.size
+
+    def finalize(self) -> None:
+        """Lock the range from whatever has been buffered (end of window)."""
+        if self.edges is None and self._buffer:
+            self._lock_range()
+
+    def to_dict(self) -> dict:
+        """Serialize WITHOUT finalizing: a still-buffering sketch keeps its
+        buffer, so persistence between small batches cannot prematurely
+        lock the bin range to the first batch's min/max."""
+        return {
+            "bins": self.bins,
+            "warmup": self.warmup,
+            "edges": list(self.edges) if self.edges is not None else None,
+            "counts": self.counts.tolist(),
+            "total": self.total,
+            "buffer": list(self._buffer),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "StreamingHistogram":
+        hist = cls(bins=data["bins"], warmup=data.get("warmup", 1000))
+        if data.get("edges") is not None:
+            hist.edges = np.asarray(data["edges"], dtype=np.float64)
+        hist.counts = np.asarray(data["counts"], dtype=np.int64)
+        hist.total = int(data.get("total", 0))
+        hist._buffer = list(data.get("buffer", []))
+        return hist
+
+
+def drift_between_histograms(current: "StreamingHistogram",
+                             reference_values) -> dict | None:
+    """TVD/Hellinger/KL between a streamed sketch and raw reference
+    values binned on the SKETCH's edges (so both distributions share
+    support)."""
+    current.finalize()
+    if current.edges is None or current.total == 0:
+        return None
+    ref = np.asarray(reference_values, dtype=np.float64).ravel()
+    ref = ref[np.isfinite(ref)]
+    if ref.size == 0:
+        return None
+    ref = np.clip(ref, current.edges[0], current.edges[-1])
+    ref_counts, _ = np.histogram(ref, bins=current.edges)
+    return {
+        "tvd": total_variance_distance(ref_counts, current.counts),
+        "hellinger": hellinger_distance(ref_counts, current.counts),
+        "kld": kl_divergence(ref_counts, current.counts),
+    }

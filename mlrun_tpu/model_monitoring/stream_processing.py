@@ -45,6 +45,7 @@ class EventStreamProcessor:
             db = get_run_db()
         self.db = db
         self._offset = 0
+        self._histograms: dict[str, dict] = {}
 
     def _pull(self, max_items: int = 10000) -> list[dict]:
         if hasattr(self.stream, "pull"):
@@ -94,9 +95,60 @@ class EventStreamProcessor:
                     df = pd.concat([pd.read_parquet(path), df],
                                    ignore_index=True)
                 df.to_parquet(path, index=False)
+                self._update_histograms(endpoint_id, rows)
             self._update_endpoint(endpoint_id, endpoint_events, latencies,
                                   errors)
         return len(events)
+
+    # -- streaming feature histograms ---------------------------------------
+    def load_histograms(self, endpoint_id: str) -> dict:
+        """Per-feature StreamingHistogram sketches folded since the last
+        reset (i.e. the CURRENT analysis window's data, when the
+        controller resets after each window)."""
+        return self._histograms.get(endpoint_id, {})
+
+    def reset_histograms(self, endpoint_id: str):
+        """Drop the endpoint's sketches — called by the controller after a
+        window is analyzed so the next window starts fresh (a lifetime
+        accumulation would mask drift in exactly the high-volume windows
+        the sketches exist for)."""
+        self._histograms.pop(endpoint_id, None)
+
+    def _update_histograms(self, endpoint_id: str, rows: list[dict]):
+        """Fold this batch's numeric input features into fixed-memory
+        histogram sketches (metrics.StreamingHistogram) — drift for
+        high-cardinality/unbounded streams runs from these instead of the
+        raw window. Sketches are in-memory per processor: they describe
+        the window between controller resets, not the endpoint lifetime."""
+        from .metrics import StreamingHistogram
+
+        feature_values: dict[str, list] = defaultdict(list)
+        for row in rows:
+            try:
+                batch = json.loads(row.get("inputs") or "null")
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(batch, list):
+                continue
+            for item in batch:
+                if isinstance(item, dict):
+                    named = item.items()
+                elif isinstance(item, list):
+                    named = ((f"f{i}", v) for i, v in enumerate(item))
+                else:
+                    named = (("f0", item),)
+                for name, value in named:
+                    if isinstance(value, (int, float)) and not isinstance(
+                            value, bool):
+                        feature_values[name].append(float(value))
+        if not feature_values:
+            return
+        hists = self._histograms.setdefault(endpoint_id, {})
+        for name, values in feature_values.items():
+            hist = hists.get(name)
+            if hist is None:
+                hist = hists[name] = StreamingHistogram()
+            hist.update(values)
 
     @staticmethod
     def _endpoint_id(event: dict) -> str:

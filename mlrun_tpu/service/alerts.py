@@ -22,6 +22,8 @@ def process_event(db, project: str, event_kind: str, event: dict) -> list:
         required = int(criteria.get("count", 1))
         period = float(criteria.get("period_seconds", 3600))
         since = datetime.now(timezone.utc) - timedelta(seconds=period)
+        if _silenced(config):
+            continue
         events = db.list_events(project, kind=event_kind,
                                 since=since.isoformat())
         if len(events) >= required:
@@ -39,6 +41,21 @@ def process_event(db, project: str, event_kind: str, event: dict) -> list:
             config["state"] = "inactive"
             db.store_alert_config(config.get("name"), config, project)
     return fired
+
+
+def _silenced(config: dict) -> bool:
+    """True while the config's silence window is open (silence_until ISO
+    timestamp in the future): criteria still evaluate, nothing fires."""
+    until = config.get("silence_until") or ""
+    if not until:
+        return False
+    try:
+        parsed = datetime.fromisoformat(until.replace("Z", "+00:00"))
+    except ValueError:
+        return False
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=timezone.utc)
+    return datetime.now(timezone.utc) < parsed
 
 
 def _notify(config: dict, event: dict):

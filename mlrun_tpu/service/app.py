@@ -576,6 +576,28 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         return json_response({"alerts": state.db.list_alert_configs(
             request.match_info["project"])})
 
+    @r.post(API + "/projects/{project}/alerts/{name}/silence")
+    async def silence_alert(request):
+        """Open (or clear) a silencing window on an alert config: body
+        {"minutes": N} silences for N minutes; {"minutes": 0} clears."""
+        from datetime import datetime, timedelta, timezone
+
+        project = request.match_info["project"]
+        name = request.match_info["name"]
+        body = await request.json()
+        try:
+            alert = state.db.get_alert_config(name, project)
+        except Exception:
+            return error_response(f"alert {name} not found", 404)
+        minutes = float(body.get("minutes", 0))
+        if minutes > 0:
+            until = datetime.now(timezone.utc) + timedelta(minutes=minutes)
+            alert["silence_until"] = until.isoformat()
+        else:
+            alert["silence_until"] = ""
+        state.db.store_alert_config(name, alert, project)
+        return json_response({"data": alert})
+
     @r.delete(API + "/projects/{project}/alerts/{name}")
     async def delete_alert(request):
         state.db.delete_alert_config(request.match_info["name"],

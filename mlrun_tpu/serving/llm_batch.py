@@ -283,29 +283,17 @@ class ContinuousBatchingEngine:
                 return bucket
         return self.max_len
 
-    def _admit_one(self) -> bool:
-        """Prefill one queued request into a free slot (returns True if a
-        request was admitted)."""
-        free = next((i for i, s in enumerate(self._slot_state)
-                     if not s.active), None)
-        if free is None:
-            return False
-        try:
-            (request_id, prompt, max_new, eos_id, future,
-             submitted, sampling) = self._queue.get_nowait()
-        except queue.Empty:
-            return False
-        temperature, top_k, top_p = sampling
-        prompt = np.asarray(prompt, np.int32).reshape(1, -1)
-        prompt_len = prompt.shape[1]
-        if prompt_len + max_new > self.max_len:
-            future.set_exception(ValueError(
-                f"prompt_len {prompt_len} + max_new_tokens {max_new} "
-                f"exceeds max_len {self.max_len}"))
-            return True
+    def _prefill_first_token(self, prompt: list, temperature: float,
+                             top_k: int, top_p: float):
+        """Bucketed prefill + (for non-bucket lengths) a last-token replay
+        for the real last-position logits; samples/argmaxes the first
+        generated token. Shared by the dense and paged admission paths.
+        Returns (first_token, small_cache)."""
+        prompt_arr = np.asarray(prompt, np.int32).reshape(1, -1)
+        prompt_len = prompt_arr.shape[1]
         bucket = self._bucket_for(prompt_len)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :prompt_len] = prompt
+        padded[0, :prompt_len] = prompt_arr
 
         small = init_kv_cache(self.config, 1, self.max_len,
                               kv_dtype=self.kv_dtype)
@@ -316,7 +304,7 @@ class ContinuousBatchingEngine:
             # real token for its logits (same trick as LLMEngine.generate)
             small["pos"] = jnp.full((1,), prompt_len - 1, jnp.int32)
             logits, small = self._prefill(
-                self.params, jnp.asarray(prompt[:, -1:]), small)
+                self.params, jnp.asarray(prompt_arr[:, -1:]), small)
         if temperature > 0:
             from .sampling import sample_logits
 
@@ -327,8 +315,14 @@ class ContinuousBatchingEngine:
                 jnp.full((1,), top_p, jnp.float32)))[0])
         else:
             first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-        self._cache = self._insert(self._cache, small, free, prompt_len)
+        return first_token, small
 
+    def _activate_slot(self, free: int, request_id: int, first_token: int,
+                       max_new: int, eos_id, future, submitted: float,
+                       prompt_len: int, sampling: tuple):
+        """Fill slot bookkeeping after a successful prefill (shared by the
+        dense and paged admission paths)."""
+        temperature, top_k, top_p = sampling
         slot = self._slot_state[free]
         slot.request_id = request_id
         slot.tokens = [first_token]
@@ -344,6 +338,29 @@ class ContinuousBatchingEngine:
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
+
+    def _admit_one(self) -> bool:
+        """Prefill one queued request into a free slot (returns True if a
+        request was admitted)."""
+        free = next((i for i, s in enumerate(self._slot_state)
+                     if not s.active), None)
+        if free is None:
+            return False
+        try:
+            (request_id, prompt, max_new, eos_id, future,
+             submitted, sampling) = self._queue.get_nowait()
+        except queue.Empty:
+            return False
+        prompt_len = len(prompt)
+        if prompt_len + max_new > self.max_len:
+            future.set_exception(ValueError(
+                f"prompt_len {prompt_len} + max_new_tokens {max_new} "
+                f"exceeds max_len {self.max_len}"))
+            return True
+        first_token, small = self._prefill_first_token(prompt, *sampling)
+        self._cache = self._insert(self._cache, small, free, prompt_len)
+        self._activate_slot(free, request_id, first_token, max_new, eos_id,
+                            future, submitted, prompt_len, sampling)
         return True
 
     def _finish(self, index: int):

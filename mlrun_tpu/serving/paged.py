@@ -304,7 +304,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 return False
         (request_id, prompt, max_new, eos_id, future, submitted,
          sampling) = self._pending[0]
-        temperature, top_k, top_p = sampling
         prompt_len = len(prompt)
         if prompt_len + max_new > self.max_len:
             self._pending.popleft()
@@ -327,51 +326,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         page_ids = [self._free_pages.popleft() for _ in range(needed)]
         self._slot_pages[free] = page_ids
 
-        prompt_arr = np.asarray(prompt, np.int32).reshape(1, -1)
-        bucket = self._bucket_for(prompt_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :prompt_len] = prompt_arr
-        small = init_kv_cache(self.config, 1, self.max_len,
-                              kv_dtype=self.kv_dtype)
-        logits, small = self._prefill(self.params, jnp.asarray(padded),
-                                      small)
-        if prompt_len != bucket:
-            small["pos"] = jnp.full((1,), prompt_len - 1, jnp.int32)
-            logits, small = self._prefill(
-                self.params, jnp.asarray(prompt_arr[:, -1:]), small)
-        if temperature > 0:
-            from .sampling import sample_logits
-
-            self._rng, sub = jax.random.split(self._rng)
-            first_token = int(np.asarray(sample_logits(
-                logits, sub, jnp.full((1,), temperature, jnp.float32),
-                jnp.full((1,), top_k, jnp.int32),
-                jnp.full((1,), top_p, jnp.float32)))[0])
-        else:
-            first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-
+        first_token, small = self._prefill_first_token(prompt, *sampling)
         ids = np.full((self.pages_per_slot,), -1, np.int32)
         ids[:needed] = page_ids
         self._pool = self._insert_paged(self._pool, small,
                                         jnp.asarray(ids))
         self._page_table[free] = ids
         self._pos[free] = prompt_len
-
-        slot = self._slot_state[free]
-        slot.request_id = request_id
-        slot.tokens = [first_token]
-        slot.remaining = max_new - 1
-        slot.eos_id = eos_id
-        slot.future = future
-        slot.started = submitted
-        slot.ttft = time.perf_counter() - submitted
-        slot.prompt_len = prompt_len
-        slot.temperature = temperature
-        slot.top_k = top_k
-        slot.top_p = top_p
-        if (eos_id is not None and first_token == eos_id) or \
-                slot.remaining <= 0:
-            self._finish(free)
+        self._activate_slot(free, request_id, first_token, max_new, eos_id,
+                            future, submitted, prompt_len, sampling)
         return True
 
     def _release_slot_storage(self, index: int):

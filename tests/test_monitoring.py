@@ -118,3 +118,113 @@ def test_controller_end_to_end():
     endpoint_id = next(iter(results))
     eps = mlrun_tpu.get_run_db().get_model_endpoint("monproj", endpoint_id)
     assert "latency_p50_microsec" in eps["metrics"]
+
+
+def test_streaming_histogram_matches_dense():
+    """Sketch counts equal a dense histogram on the same locked range."""
+    import numpy as np
+
+    from mlrun_tpu.model_monitoring.metrics import StreamingHistogram
+
+    rng = np.random.default_rng(0)
+    values = rng.normal(0.0, 1.0, 5000)
+    hist = StreamingHistogram(bins=20, warmup=1000)
+    for chunk in np.array_split(values, 13):  # arbitrary chunking
+        hist.update(chunk)
+    hist.finalize()
+    assert hist.total == 5000
+    dense, _ = np.histogram(np.clip(values, hist.edges[0], hist.edges[-1]),
+                            bins=hist.edges)
+    assert (hist.counts == dense).all()
+    # roundtrip
+    back = StreamingHistogram.from_dict(hist.to_dict())
+    assert (back.counts == hist.counts).all()
+
+
+def test_drift_from_sketches_agrees_with_dataframe_drift():
+    """Drift computed from streamed sketches tracks the dataframe path:
+    near zero for same-distribution data, large for shifted data."""
+    import numpy as np
+
+    from mlrun_tpu.model_monitoring.metrics import (
+        StreamingHistogram,
+        drift_between_histograms,
+    )
+
+    rng = np.random.default_rng(1)
+    ref = rng.normal(0.0, 1.0, 4000)
+    same = rng.normal(0.0, 1.0, 4000)
+    shifted = rng.normal(3.0, 1.0, 4000)
+
+    h_same = StreamingHistogram(bins=20, warmup=500)
+    h_same.update(same)
+    h_shift = StreamingHistogram(bins=20, warmup=500)
+    h_shift.update(shifted)
+
+    drift_same = drift_between_histograms(h_same, ref)
+    drift_shift = drift_between_histograms(h_shift, ref)
+    assert drift_same["tvd"] < 0.1
+    assert drift_shift["tvd"] > 0.5
+
+
+def test_alert_silence_window(tmp_path):
+    """A silenced alert evaluates but does not fire; it fires again after
+    the window clears."""
+    from datetime import datetime, timedelta, timezone
+
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.alerts import process_event
+
+    db = SQLiteRunDB(str(tmp_path / "alerts.db"))
+    config = {
+        "name": "fail-alert", "project": "p1",
+        "trigger_events": ["run_failed"],
+        "criteria": {"count": 1, "period_seconds": 3600},
+        "notifications": [{"kind": "console"}],
+    }
+    db.store_alert_config("fail-alert", config, "p1")
+
+    db.emit_event("run_failed", {"entity_id": "job1"}, "p1")
+    fired = process_event(db, "p1", "run_failed", {"entity_id": "job1"})
+    assert fired == ["fail-alert"]
+
+    # silence for 10 minutes -> evaluation happens, nothing fires
+    config = db.get_alert_config("fail-alert", "p1")
+    config["state"] = "inactive"
+    until = datetime.now(timezone.utc) + timedelta(minutes=10)
+    config["silence_until"] = until.isoformat()
+    db.store_alert_config("fail-alert", config, "p1")
+    db.emit_event("run_failed", {"entity_id": "job1"}, "p1")
+    assert process_event(db, "p1", "run_failed", {"entity_id": "job1"}) == []
+
+    # expired window -> fires again
+    config = db.get_alert_config("fail-alert", "p1")
+    past = datetime.now(timezone.utc) - timedelta(minutes=1)
+    config["silence_until"] = past.isoformat()
+    db.store_alert_config("fail-alert", config, "p1")
+    fired = process_event(db, "p1", "run_failed", {"entity_id": "job1"})
+    assert fired == ["fail-alert"]
+
+
+def test_drift_app_uses_sketches_when_window_not_materialized():
+    import numpy as np
+    import pandas as pd
+
+    from mlrun_tpu.model_monitoring.applications import (
+        HistogramDataDriftApplication,
+        MonitoringContext,
+    )
+    from mlrun_tpu.model_monitoring.metrics import StreamingHistogram
+
+    rng = np.random.default_rng(2)
+    ref_df = pd.DataFrame({"f0": rng.normal(0, 1, 2000)})
+    hist = StreamingHistogram(bins=20, warmup=200)
+    hist.update(rng.normal(4.0, 1.0, 3000))  # strongly shifted
+    ctx = MonitoringContext(
+        project="p", endpoint_id="e", model_name="m",
+        sample_df=pd.DataFrame(), reference_df=ref_df,
+        start="", end="", sample_histograms={"f0": hist})
+    results = HistogramDataDriftApplication().do_tracking(ctx)
+    drift = next(r for r in results if r.name == "data_drift_score")
+    assert drift.status == "detected"
+    assert "f0" in drift.extra["per_feature"]

@@ -136,6 +136,26 @@ def test_alert_firing(http_db):
     assert alert["state"] == "active"
 
 
+def test_alert_silence_endpoint(http_db):
+    http_db.store_alert_config(
+        "quiet-alert", {
+            "name": "quiet-alert", "project": "p2",
+            "trigger_events": ["run_failed"],
+            "criteria": {"count": 1, "period_seconds": 3600},
+            "notifications": [{"kind": "console"}],
+        }, project="p2")
+    silenced = http_db.silence_alert("quiet-alert", 15, project="p2")
+    assert silenced["silence_until"]
+    http_db.emit_event("run_failed", {"entity_id": "*"}, "p2")
+    alert = http_db.get_alert_config("quiet-alert", "p2")
+    assert alert.get("state", "inactive") == "inactive"  # did not fire
+    cleared = http_db.silence_alert("quiet-alert", 0, project="p2")
+    assert cleared["silence_until"] == ""
+    http_db.emit_event("run_failed", {"entity_id": "*"}, "p2")
+    alert = http_db.get_alert_config("quiet-alert", "p2")
+    assert alert["state"] == "active"
+
+
 def test_cron_parser():
     from datetime import datetime
 
