@@ -52,7 +52,8 @@ class HTTPRunDB(RunDBInterface):
 
     def api_call(self, method: str, path: str, error: str | None = None,
                  params: dict | None = None, body=None, json_body=None,
-                 timeout: float | None = None, json: dict | None = None):
+                 timeout: float | None = None, json: dict | None = None,
+                 raw: bool = False):
         url = f"{self.base_url}{mlconf.api_base_path}/{path.lstrip('/')}"
         headers = {}
         if self.token:
@@ -74,6 +75,8 @@ class HTTPRunDB(RunDBInterface):
             raise RunDBError(
                 f"{error or 'api call failed'}: {method} {url} "
                 f"[{resp.status_code}]: {detail}")
+        if raw:
+            return resp.content
         if resp.content:
             try:
                 return resp.json()
@@ -366,6 +369,63 @@ class HTTPRunDB(RunDBInterface):
         self.api_call("DELETE",
                       self._path(project, "model-endpoints", endpoint_id),
                       "delete model endpoint")
+
+    # -- tags (reference mlrun/db/httpdb.py:2722 tag_objects) ---------------
+    def tag_objects(self, project, tag, identifiers, kind="artifact"):
+        """Apply ``tag`` to the identified objects (artifact key[/uid])."""
+        resp = self.api_call(
+            "POST", self._path(project, "tags", tag), "tag objects",
+            json_body={"kind": kind, "identifiers": identifiers})
+        return resp.get("tagged", 0)
+
+    def delete_objects_tag(self, project, tag, identifiers,
+                           kind="artifact"):
+        resp = self.api_call(
+            "DELETE", self._path(project, "tags", tag), "untag objects",
+            json_body={"kind": kind, "identifiers": identifiers})
+        return resp.get("removed", 0)
+
+    # -- files --------------------------------------------------------------
+    def get_file(self, path, project="", size=None, offset=0) -> bytes:
+        """Read a file through the service's datastore (server-side
+        credentials/profiles apply)."""
+        params = {"path": path, "offset": str(offset)}
+        if size:
+            params["size"] = str(size)
+        return self.api_call("GET", self._path(project, "files"),
+                             "get file", params=params, raw=True)
+
+    def get_filestat(self, path, project=""):
+        return self.api_call("GET", self._path(project, "filestat"),
+                             "stat file", params={"path": path})
+
+    # -- hub admin ----------------------------------------------------------
+    def store_hub_source(self, name, source: dict, order: int = -1):
+        resp = self.api_call("PUT", f"hub/sources/{name}",
+                             "store hub source",
+                             json_body={"source": source, "order": order})
+        return resp.get("data")
+
+    def list_hub_sources(self):
+        return self.api_call("GET", "hub/sources",
+                             "list hub sources").get("sources", [])
+
+    def get_hub_source(self, name):
+        return self.api_call("GET", f"hub/sources/{name}",
+                             "get hub source").get("data")
+
+    def delete_hub_source(self, name):
+        self.api_call("DELETE", f"hub/sources/{name}", "delete hub source")
+
+    def get_hub_catalog(self, source_name: str):
+        return self.api_call(
+            "GET", f"hub/sources/{source_name}/items",
+            "hub catalog").get("catalog", [])
+
+    def get_hub_item(self, source_name: str, item: str):
+        return self.api_call(
+            "GET", f"hub/sources/{source_name}/items/{item}",
+            "hub item").get("data")
 
     # -- alerts -------------------------------------------------------------
     def store_alert_config(self, name, config, project=""):

@@ -112,7 +112,7 @@ CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 # at SCHEMA_VERSION; an existing DB replays only the missing migrations in
 # order. Version 1 is the round-1 pre-versioning schema (user_version 0
 # with a populated sqlite_master).
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 _MIGRATIONS: dict[int, str] = {
     2: """
@@ -139,6 +139,11 @@ CREATE TABLE IF NOT EXISTS pagination_cache (
 CREATE TABLE IF NOT EXISTS datastore_profiles (
     project TEXT NOT NULL, name TEXT NOT NULL, type TEXT, body TEXT,
     PRIMARY KEY (project, name)
+);
+""",
+    6: """
+CREATE TABLE IF NOT EXISTS hub_sources (
+    name TEXT PRIMARY KEY, idx INTEGER NOT NULL DEFAULT 0, body TEXT
 );
 """,
 }
@@ -450,6 +455,111 @@ class SQLiteRunDB(RunDBInterface):
 
     # -- datastore profiles (reference datastore_profile.py server side:
     # public part in the DB, private part in project secrets) --------------
+    # -- hub sources (reference analog: server/api/api/endpoints/hub.py
+    # source CRUD + catalog; backed here by the hub_sources table) ----------
+    def store_hub_source(self, name: str, source: dict, order: int = -1):
+        if order < 0:
+            existing = self._query(
+                "SELECT idx FROM hub_sources WHERE name=?", (name,))
+            if existing:
+                # update in place keeps the source's position
+                order = int(existing[0]["idx"])
+            else:
+                row = self._query(
+                    "SELECT COALESCE(MAX(idx), -1) AS m FROM hub_sources")
+                order = int(row[0]["m"]) + 1
+        source = dict(source, name=name)
+        self._execute(
+            "INSERT OR REPLACE INTO hub_sources (name, idx, body) "
+            "VALUES (?,?,?)", (name, order, json.dumps(source)))
+
+    def get_hub_source(self, name: str) -> Optional[dict]:
+        rows = self._query("SELECT body FROM hub_sources WHERE name=?",
+                           (name,))
+        return json.loads(rows[0]["body"]) if rows else None
+
+    def list_hub_sources(self) -> list[dict]:
+        rows = self._query("SELECT body FROM hub_sources ORDER BY idx")
+        return [json.loads(row["body"]) for row in rows]
+
+    def delete_hub_source(self, name: str):
+        self._execute("DELETE FROM hub_sources WHERE name=?", (name,))
+
+    # -- tags (reference analog: server/api/api/endpoints/tags.py —
+    # overwrite/append/delete a tag on a set of artifact identifiers) ------
+    def tag_artifacts(self, project: str, tag: str,
+                      identifiers: list[dict]) -> int:
+        """Apply ``tag`` to each identified artifact version (key + uid).
+        Only one uid per (project, key) owns a tag; previous holders lose
+        it. Returns how many rows were tagged."""
+        project = self._project_or_default(project)
+        tagged = 0
+        for ident in identifiers:
+            key, uid = ident.get("key"), ident.get("uid")
+            if not key:
+                continue
+            rows = self._query(
+                "SELECT uid, body FROM artifacts WHERE project=? AND key=? "
+                + ("AND uid=?" if uid else
+                   "ORDER BY updated DESC LIMIT 1"),
+                (project, key, uid) if uid else (project, key))
+            if not rows:
+                continue
+            target_uid = rows[0]["uid"]
+            self._clear_artifact_tag(project, key, tag)
+            body = json.loads(rows[0]["body"])
+            update_in(body, "metadata.tag", tag)
+            self._execute(
+                "UPDATE artifacts SET tag=?, body=? WHERE project=? "
+                "AND key=? AND uid=?",
+                (tag, json.dumps(body), project, key, target_uid))
+            tagged += 1
+        return tagged
+
+    def _clear_artifact_tag(self, project: str, key: str, tag: str):
+        """Clear ``tag`` from every holder, keeping body metadata.tag in
+        sync with the tag column (a stale body would claim a tag the row
+        no longer owns)."""
+        rows = self._query(
+            "SELECT uid, body FROM artifacts WHERE project=? AND key=? "
+            "AND tag=?", (project, key, tag))
+        for row in rows:
+            body = json.loads(row["body"])
+            update_in(body, "metadata.tag", "")
+            self._execute(
+                "UPDATE artifacts SET tag='', body=? WHERE project=? "
+                "AND key=? AND uid=?",
+                (json.dumps(body), project, key, row["uid"]))
+
+    def untag_artifacts(self, project: str, tag: str,
+                        identifiers: list[dict]) -> int:
+        """Remove ``tag`` from the identified artifacts (all versions of
+        the key holding the tag when no uid given)."""
+        project = self._project_or_default(project)
+        removed = 0
+        for ident in identifiers:
+            key = ident.get("key")
+            uid = ident.get("uid")
+            if not key:
+                continue
+            where = "project=? AND key=? AND tag=?"
+            args = [project, key, tag]
+            if uid:
+                where += " AND uid=?"
+                args.append(uid)
+            rows = self._query(
+                f"SELECT uid, body FROM artifacts WHERE {where}",
+                tuple(args))
+            for row in rows:
+                body = json.loads(row["body"])
+                update_in(body, "metadata.tag", "")
+                self._execute(
+                    "UPDATE artifacts SET tag='', body=? WHERE project=? "
+                    "AND key=? AND uid=?",
+                    (json.dumps(body), project, key, row["uid"]))
+            removed += len(rows)
+        return removed
+
     def store_datastore_profile(self, profile: dict, project: str = "",
                                 private: dict | None = None):
         project = self._project_or_default(project)

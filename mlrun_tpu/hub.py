@@ -30,9 +30,9 @@ def list_hub_sources() -> list[str]:
     env_source = os.environ.get("MLT_HUB_SOURCE")
     if env_source:
         sources.append(env_source)
-    # builtin hub shipped with the package
-    builtin = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "hub")
+    # builtin hub shipped INSIDE the package (survives pip install)
+    builtin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "hub_functions")
     if os.path.isdir(builtin):
         sources.append(builtin)
     return sources

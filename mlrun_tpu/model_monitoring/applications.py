@@ -78,8 +78,12 @@ class HistogramDataDriftApplication(ModelMonitoringApplicationBase):
             for name, hist in ctx.sample_histograms.items():
                 if name not in ctx.reference_df.columns:
                     continue
-                metrics = drift_between_histograms(
-                    hist, ctx.reference_df[name])
+                try:
+                    metrics = drift_between_histograms(
+                        hist, ctx.reference_df[name])
+                except (TypeError, ValueError):
+                    continue  # non-numeric reference column — skip, like
+                    # the dataframe path does
                 if metrics is not None:
                     per_feature[name] = metrics
         else:

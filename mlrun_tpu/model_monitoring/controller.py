@@ -125,6 +125,13 @@ class MonitoringApplicationController:
                 # too big to expand row-by-row — drift runs from the
                 # streamed histogram sketches instead
                 sample_df = pd.DataFrame()
+                if not self.processor.load_histograms(endpoint_id):
+                    # e.g. restart with a parquet backlog: sketches are
+                    # in-memory only, so this window cannot get drift
+                    logger.warning(
+                        "window exceeds max_window_rows and no sketches "
+                        "are available — drift skipped for this window",
+                        endpoint=endpoint_id, rows=len(window))
             else:
                 try:
                     sample_df = _inputs_frame(window)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 import time
 from datetime import datetime, timezone
@@ -751,6 +752,138 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         return json_response({"ok": True})
 
     # -- operations / introspection ---------------------------------------------
+    # -- tags (reference server/api/api/endpoints/tags.py) -----------------
+    @r.post(API + "/projects/{project}/tags/{tag}")
+    async def overwrite_tag(request):
+        body = await request.json()
+        if body.get("kind", "artifact") != "artifact":
+            return error_response("only artifact tagging is supported", 400)
+        tagged = state.db.tag_artifacts(
+            request.match_info["project"], request.match_info["tag"],
+            body.get("identifiers") or [])
+        return json_response({"tagged": tagged})
+
+    @r.delete(API + "/projects/{project}/tags/{tag}")
+    async def delete_tag(request):
+        body = await request.json()
+        if body.get("kind", "artifact") != "artifact":
+            return error_response("only artifact tagging is supported", 400)
+        removed = state.db.untag_artifacts(
+            request.match_info["project"], request.match_info["tag"],
+            body.get("identifiers") or [])
+        return json_response({"removed": removed})
+
+    # -- files (reference server/api/api/endpoints/files.py) ---------------
+    @r.get(API + "/projects/{project}/files")
+    async def get_file(request):
+        from aiohttp import web as aioweb
+
+        path = request.query.get("path", "")
+        if not path:
+            return error_response("path query parameter is required", 400)
+        try:
+            from ..datastore import store_manager
+
+            size = int(request.query.get("size", 0)) or None
+            offset = int(request.query.get("offset", 0))
+            body = store_manager.object(url=path).get(size=size,
+                                                      offset=offset)
+        except FileNotFoundError:
+            return error_response(f"file not found: {path}", 404)
+        except Exception as exc:  # noqa: BLE001
+            return error_response(f"failed to read {path}: {exc}", 400)
+        if isinstance(body, str):
+            body = body.encode()
+        return aioweb.Response(body=body,
+                               content_type="application/octet-stream")
+
+    @r.get(API + "/projects/{project}/filestat")
+    async def get_filestat(request):
+        path = request.query.get("path", "")
+        if not path:
+            return error_response("path query parameter is required", 400)
+        try:
+            from ..datastore import store_manager
+
+            stats = store_manager.object(url=path).stat()
+        except FileNotFoundError:
+            return error_response(f"file not found: {path}", 404)
+        except Exception as exc:  # noqa: BLE001
+            return error_response(f"failed to stat {path}: {exc}", 400)
+        return json_response({"size": stats.size, "modified": stats.modified,
+                              "content_type": getattr(stats, "content_type",
+                                                      None)})
+
+    # -- hub admin (reference server/api/api/endpoints/hub.py) -------------
+    def _hub_source_path(name: str):
+        if name == "default":
+            import mlrun_tpu
+
+            # shipped inside the package so installed dists keep it
+            return os.path.join(
+                os.path.dirname(os.path.abspath(mlrun_tpu.__file__)),
+                "hub_functions")
+        source = state.db.get_hub_source(name)
+        return (source or {}).get("path")
+
+    @r.put(API + "/hub/sources/{name}")
+    async def store_hub_source(request):
+        body = await request.json()
+        name = request.match_info["name"]
+        if name == "default":
+            return error_response("the default source is built-in", 400)
+        state.db.store_hub_source(name, body.get("source") or body,
+                                  order=int(body.get("order", -1)))
+        return json_response({"data": state.db.get_hub_source(name)})
+
+    @r.get(API + "/hub/sources")
+    async def list_hub_sources(request):
+        sources = [{"name": "default", "builtin": True}]
+        sources.extend(state.db.list_hub_sources())
+        return json_response({"sources": sources})
+
+    @r.get(API + "/hub/sources/{name}")
+    async def get_hub_source(request):
+        name = request.match_info["name"]
+        if name == "default":
+            return json_response({"data": {"name": "default",
+                                           "builtin": True}})
+        source = state.db.get_hub_source(name)
+        if source is None:
+            return error_response(f"hub source {name} not found", 404)
+        return json_response({"data": source})
+
+    @r.delete(API + "/hub/sources/{name}")
+    async def delete_hub_source(request):
+        state.db.delete_hub_source(request.match_info["name"])
+        return json_response({"ok": True})
+
+    @r.get(API + "/hub/sources/{name}/items")
+    async def hub_catalog(request):
+        path = _hub_source_path(request.match_info["name"])
+        if not path or not os.path.isdir(path):
+            return error_response("hub source has no readable path", 404)
+        items = []
+        for entry in sorted(os.listdir(path)):
+            fn_yaml = os.path.join(path, entry, "function.yaml")
+            if os.path.isfile(fn_yaml):
+                items.append({"name": entry})
+        return json_response({"catalog": items})
+
+    @r.get(API + "/hub/sources/{name}/items/{item}")
+    async def hub_item(request):
+        import yaml
+
+        path = _hub_source_path(request.match_info["name"])
+        item = request.match_info["item"]
+        if ".." in item or "/" in item or os.sep in item:
+            return error_response("invalid hub item name", 400)
+        fn_yaml = os.path.join(path or "", item, "function.yaml")
+        if not path or not os.path.isfile(fn_yaml):
+            return error_response(f"hub item {item} not found", 404)
+        with open(fn_yaml) as f:
+            return json_response({"data": yaml.safe_load(f)})
+
     @r.get(API + "/operations/memory-report")
     async def memory_report(request):
         """reference analog: server/api/utils/memory_reports.py (objgraph) —

@@ -5,6 +5,9 @@ setup(
     version="0.1.0",
     description="TPU-native MLOps orchestration framework",
     packages=find_packages(include=["mlrun_tpu", "mlrun_tpu.*"]),
+    package_data={"mlrun_tpu": ["hub_functions/*/function.yaml",
+                                "hub_functions/*/*.py"]},
+    include_package_data=True,
     python_requires=">=3.10",
     install_requires=[
         "pydantic>=2", "aiohttp", "requests", "pyyaml", "click",

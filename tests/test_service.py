@@ -313,3 +313,49 @@ def test_list_pagination(http_db):
     assert len(page) == 2
     all_runs = http_db.api_call("GET", "projects/pgp/runs")["runs"]
     assert len(all_runs) == 5
+
+
+def test_tags_files_hub_endpoints(service, http_db, tmp_path):
+    # tags: two versions of one artifact, move 'prod' between them
+    http_db.store_artifact("model-a", {"metadata": {"key": "model-a"},
+                                       "kind": "model"},
+                           uid="v1", project="p3")
+    http_db.store_artifact("model-a", {"metadata": {"key": "model-a"},
+                                       "kind": "model"},
+                           uid="v2", project="p3")
+    assert http_db.tag_objects("p3", "prod",
+                               [{"key": "model-a", "uid": "v1"}]) == 1
+    art = http_db.read_artifact("model-a", tag="prod", project="p3")
+    assert art["metadata"]["tag"] == "prod"
+    assert http_db.tag_objects("p3", "prod",
+                               [{"key": "model-a", "uid": "v2"}]) == 1
+    assert http_db.delete_objects_tag(
+        "p3", "prod", [{"key": "model-a", "uid": "v2"}]) == 1
+
+    # files: read a real file through the service datastore
+    p = tmp_path / "payload.txt"
+    p.write_text("hello mlt")
+    data = http_db.get_file(str(p), project="p3")
+    assert data == b"hello mlt"
+    stat = http_db.get_filestat(str(p), project="p3")
+    assert stat["size"] == len(b"hello mlt")
+
+    # hub admin: builtin default + a registered source with a catalog
+    sources = http_db.list_hub_sources()
+    assert any(s["name"] == "default" for s in sources)
+    catalog = http_db.get_hub_catalog("default")
+    assert catalog, "builtin hub ships functions"
+    item = http_db.get_hub_item("default", catalog[0]["name"])
+    assert item and "kind" in item
+
+    hub_dir = tmp_path / "myhub" / "fn1"
+    hub_dir.mkdir(parents=True)
+    (hub_dir / "function.yaml").write_text(
+        "kind: job\nmetadata:\n  name: fn1\n")
+    http_db.store_hub_source("myhub", {"path": str(tmp_path / "myhub")})
+    assert any(s["name"] == "myhub" for s in http_db.list_hub_sources())
+    assert http_db.get_hub_catalog("myhub") == [{"name": "fn1"}]
+    assert http_db.get_hub_item("myhub", "fn1")["kind"] == "job"
+    http_db.delete_hub_source("myhub")
+    assert not any(s["name"] == "myhub"
+                   for s in http_db.list_hub_sources())
