@@ -43,6 +43,12 @@ default_config: dict[str, Any] = {
         "timeout": 45,
         "user": "",
         "token": "",
+        # server-side: when set (or MLT_SERVICE_TOKEN), every API request
+        # must carry "Authorization: Bearer <token>" (healthz stays open)
+        "auth_token": "",
+        # server-side: optional comma-separated path prefixes the /files
+        # endpoints may read; empty = any path except service internals
+        "files_allowed_paths": "",
         "logs_poll_interval": 2.0,
     },
     "projects": {

@@ -23,10 +23,10 @@ from .base import RunDBError, RunDBInterface
 class HTTPRunDB(RunDBInterface):
     kind = "http"
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, token: str = ""):
         self.base_url = url.rstrip("/")
         self.user = mlconf.httpdb.user
-        self.token = mlconf.httpdb.token
+        self.token = token or mlconf.httpdb.token
         self._session: Optional[requests.Session] = None
         self.server_version = ""
 
@@ -369,6 +369,12 @@ class HTTPRunDB(RunDBInterface):
         self.api_call("DELETE",
                       self._path(project, "model-endpoints", endpoint_id),
                       "delete model endpoint")
+
+    def list_background_tasks(self, project=""):
+        resp = self.api_call(
+            "GET", self._path(project, "background-tasks"),
+            "list background tasks")
+        return resp.get("background_tasks", [])
 
     # -- tags (reference mlrun/db/httpdb.py:2722 tag_objects) ---------------
     def tag_objects(self, project, tag, identifiers, kind="artifact"):

@@ -103,6 +103,11 @@ CREATE TABLE IF NOT EXISTS datastore_profiles (
     project TEXT NOT NULL, name TEXT NOT NULL, type TEXT, body TEXT,
     PRIMARY KEY (project, name)
 );
+CREATE TABLE IF NOT EXISTS artifact_tags (
+    project TEXT NOT NULL, key TEXT NOT NULL, tag TEXT NOT NULL,
+    uid TEXT NOT NULL,
+    PRIMARY KEY (project, key, tag)
+);
 CREATE INDEX IF NOT EXISTS idx_runs_project_state ON runs (project, state);
 CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 """
@@ -112,7 +117,7 @@ CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 # at SCHEMA_VERSION; an existing DB replays only the missing migrations in
 # order. Version 1 is the round-1 pre-versioning schema (user_version 0
 # with a populated sqlite_master).
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 _MIGRATIONS: dict[int, str] = {
     2: """
@@ -144,6 +149,13 @@ CREATE TABLE IF NOT EXISTS datastore_profiles (
     6: """
 CREATE TABLE IF NOT EXISTS hub_sources (
     name TEXT PRIMARY KEY, idx INTEGER NOT NULL DEFAULT 0, body TEXT
+);
+""",
+    7: """
+CREATE TABLE IF NOT EXISTS artifact_tags (
+    project TEXT NOT NULL, key TEXT NOT NULL, tag TEXT NOT NULL,
+    uid TEXT NOT NULL,
+    PRIMARY KEY (project, key, tag)
 );
 """,
 }
@@ -490,8 +502,10 @@ class SQLiteRunDB(RunDBInterface):
     def tag_artifacts(self, project: str, tag: str,
                       identifiers: list[dict]) -> int:
         """Apply ``tag`` to each identified artifact version (key + uid).
-        Only one uid per (project, key) owns a tag; previous holders lose
-        it. Returns how many rows were tagged."""
+        Tags are ADDITIVE through the artifact_tags side table: one uid
+        per (project, key) holds a given tag, but tagging never disturbs
+        other tags — in particular the 'latest' pointer managed by
+        store_artifact. Returns how many versions were tagged."""
         project = self._project_or_default(project)
         tagged = 0
         for ident in identifiers:
@@ -499,20 +513,16 @@ class SQLiteRunDB(RunDBInterface):
             if not key:
                 continue
             rows = self._query(
-                "SELECT uid, body FROM artifacts WHERE project=? AND key=? "
+                "SELECT uid FROM artifacts WHERE project=? AND key=? "
                 + ("AND uid=?" if uid else
                    "ORDER BY updated DESC LIMIT 1"),
                 (project, key, uid) if uid else (project, key))
             if not rows:
                 continue
-            target_uid = rows[0]["uid"]
-            self._clear_artifact_tag(project, key, tag)
-            body = json.loads(rows[0]["body"])
-            update_in(body, "metadata.tag", tag)
             self._execute(
-                "UPDATE artifacts SET tag=?, body=? WHERE project=? "
-                "AND key=? AND uid=?",
-                (tag, json.dumps(body), project, key, target_uid))
+                "INSERT OR REPLACE INTO artifact_tags "
+                "(project, key, tag, uid) VALUES (?,?,?,?)",
+                (project, key, tag, rows[0]["uid"]))
             tagged += 1
         return tagged
 
@@ -533,8 +543,8 @@ class SQLiteRunDB(RunDBInterface):
 
     def untag_artifacts(self, project: str, tag: str,
                         identifiers: list[dict]) -> int:
-        """Remove ``tag`` from the identified artifacts (all versions of
-        the key holding the tag when no uid given)."""
+        """Remove ``tag`` from the identified artifacts (side-table tags
+        only; the store_artifact-managed 'latest' pointer is untouched)."""
         project = self._project_or_default(project)
         removed = 0
         for ident in identifiers:
@@ -547,17 +557,9 @@ class SQLiteRunDB(RunDBInterface):
             if uid:
                 where += " AND uid=?"
                 args.append(uid)
-            rows = self._query(
-                f"SELECT uid, body FROM artifacts WHERE {where}",
-                tuple(args))
-            for row in rows:
-                body = json.loads(row["body"])
-                update_in(body, "metadata.tag", "")
-                self._execute(
-                    "UPDATE artifacts SET tag='', body=? WHERE project=? "
-                    "AND key=? AND uid=?",
-                    (json.dumps(body), project, key, row["uid"]))
-            removed += len(rows)
+            cursor = self._execute(
+                f"DELETE FROM artifact_tags WHERE {where}", tuple(args))
+            removed += cursor.rowcount if cursor is not None else 0
         return removed
 
     def store_datastore_profile(self, profile: dict, project: str = "",
@@ -666,11 +668,9 @@ class SQLiteRunDB(RunDBInterface):
         update_in(artifact, "metadata.tag", tag)
         update_in(artifact, "metadata.uid", uid)
         update_in(artifact, "metadata.project", project)
-        # only one uid per (project,key) may own a tag
-        self._execute(
-            "UPDATE artifacts SET tag='' WHERE project=? AND key=? AND tag=?",
-            (project, key, tag),
-        )
+        # only one uid per (project,key) may own a tag (bodies of prior
+        # holders are re-synced so they stop claiming the tag)
+        self._clear_artifact_tag(project, key, tag)
         self._execute(
             "INSERT OR REPLACE INTO artifacts "
             "(project, key, uid, tree, iteration, tag, kind, updated, body) "
@@ -698,13 +698,25 @@ class SQLiteRunDB(RunDBInterface):
                 sql += " AND iteration=?"
                 params.append(iter)
         else:
-            sql += " AND tag=?"
-            params.append(tag or "latest")
+            wanted = tag or "latest"
+            side = self._query(
+                "SELECT uid FROM artifact_tags WHERE project=? AND key=? "
+                "AND tag=?", (project, key, wanted))
+            if side:
+                sql += " AND uid=?"
+                params.append(side[0]["uid"])
+            else:
+                sql += " AND tag=?"
+                params.append(wanted)
         sql += " ORDER BY updated DESC LIMIT 1"
         rows = self._query(sql, tuple(params))
         if not rows:
             raise RunDBError(f"artifact {project}/{key} (tag={tag}) not found")
-        return json.loads(rows[0]["body"])
+        body = json.loads(rows[0]["body"])
+        if tag:
+            # a side-table tag is a view: report the tag it was read by
+            update_in(body, "metadata.tag", tag)
+        return body
 
     def list_artifacts(self, name="", project="", tag=None, labels=None,
                        since=None, until=None, kind=None, category=None,
@@ -995,6 +1007,18 @@ class SQLiteRunDB(RunDBInterface):
             (project, name, state, now_iso(), now_iso(),
              json.dumps(body or {}, default=str)),
         )
+
+    def list_background_tasks(self, project: str = "") -> list[dict]:
+        project = self._project_or_default(project)
+        rows = self._query(
+            "SELECT name, state, body FROM background_tasks WHERE project=? "
+            "ORDER BY name", (project,))
+        out = []
+        for row in rows:
+            body = json.loads(row["body"]) if row["body"] else {}
+            body.update({"name": row["name"], "state": row["state"]})
+            out.append(body)
+        return out
 
     def get_background_task(self, name: str, project: str = "") -> Optional[dict]:
         rows = self._query(

@@ -16,6 +16,12 @@ from .utils import logger
 _hub_sources: list[str] = []
 
 
+def builtin_hub_path() -> str:
+    """The hub shipped INSIDE the package (survives pip install)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "hub_functions")
+
+
 def add_hub_source(path: str, first: bool = True):
     """Register a hub source: a directory or url prefix holding
     <name>/function.yaml entries."""
@@ -30,9 +36,7 @@ def list_hub_sources() -> list[str]:
     env_source = os.environ.get("MLT_HUB_SOURCE")
     if env_source:
         sources.append(env_source)
-    # builtin hub shipped INSIDE the package (survives pip install)
-    builtin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "hub_functions")
+    builtin = builtin_hub_path()
     if os.path.isdir(builtin):
         sources.append(builtin)
     return sources

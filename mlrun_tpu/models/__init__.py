@@ -26,3 +26,6 @@ from .moe import (  # noqa: F401
     mixtral_8x7b_like,
     tiny_moe,
 )
+from . import vit  # noqa: F401  (vit.classify/encode stay namespaced —
+# bert exports the same verb names at package level)
+from .vit import ViTConfig, tiny_vit, vit_b16, vit_l16  # noqa: F401

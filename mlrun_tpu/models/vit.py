@@ -1,0 +1,249 @@
+"""Vision Transformer — pure functional JAX, TPU-first.
+
+Design mirrors models/llama.py (stacked per-layer params scanned with
+``lax.scan``, bf16 activations, f32 norm/softmax accumulation) with the
+vision-specific pieces done the TPU way:
+
+- patchify is a reshape + ONE [B·N, P²C] x [P²C, E] matmul — no conv, so
+  the whole patch embedding is a single large MXU op instead of an
+  im2col-shaped convolution.
+- bidirectional attention through ops.attention (causal=False), which
+  dispatches to the tuned pallas flash kernel on TPU.
+- parameter names follow parallel/sharding.py DEFAULT_RULES (wq/wk/wv/wo,
+  w_up/w_down), so ViT trains under the same fsdp/tensor meshes with no
+  extra rules.
+
+No reference analog: the reference (mlrun) contains no model code; this is
+TPU-native capability behind the frameworks/serving layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from .bert import layer_norm
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    n_layers: int = 12
+    embed_dim: int = 768
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    n_classes: int = 1000
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.n_heads
+
+    def param_count(self) -> int:
+        e, m = self.embed_dim, self.mlp_dim
+        per_layer = 4 * e * e + 2 * e * m + 4 * e + e + m  # qkvo + mlp + ln
+        return (self.patch_dim * e + e + (self.n_patches + 1) * e + e
+                + self.n_layers * per_layer + 2 * e
+                + e * self.n_classes + self.n_classes)
+
+    def flops_per_image(self) -> float:
+        """Training FLOPs per image (fwd+bwd ≈ 6·matmul_params per token,
+        plus the attention quadratic term)."""
+        e, m, L = self.embed_dim, self.mlp_dim, self.n_layers
+        tokens = self.n_patches + 1
+        layer_matmul = 4 * e * e + 2 * e * m
+        attn = 4 * tokens * e            # qk^T (2·n·e) + pv (2·n·e) per token
+        per_token = 6.0 * L * (layer_matmul + attn)
+        embed = 6.0 * self.patch_dim * e * self.n_patches
+        head = 6.0 * e * self.n_classes
+        return per_token * tokens + embed + head
+
+
+def vit_b16(**overrides) -> ViTConfig:
+    return dataclasses.replace(ViTConfig(), **overrides)
+
+
+def vit_l16(**overrides) -> ViTConfig:
+    return dataclasses.replace(ViTConfig(
+        n_layers=24, embed_dim=1024, n_heads=16, mlp_dim=4096), **overrides)
+
+
+def tiny_vit(**overrides) -> ViTConfig:
+    """Tiny config for tests / dryruns."""
+    return dataclasses.replace(ViTConfig(
+        image_size=32, patch_size=8, n_layers=2, embed_dim=64, n_heads=4,
+        mlp_dim=128, n_classes=10, remat=False,
+        attention_impl="reference"), **overrides)
+
+
+def init_params(config: ViTConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, 10)
+    dtype = config.dtype
+    e, m, L = config.embed_dim, config.mlp_dim, config.n_layers
+
+    def norm_init(fan_in, shape, k):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            dtype)
+
+    return {
+        "patch_embedding": norm_init(config.patch_dim,
+                                     (config.patch_dim, e), keys[0]),
+        "patch_bias": jnp.zeros((e,), dtype),
+        "pos_embed": norm_init(e, (config.n_patches + 1, e), keys[1]),
+        "cls_token": jnp.zeros((e,), dtype),
+        "layers": {
+            "ln1_scale": jnp.ones((L, e), dtype),
+            "ln1_bias": jnp.zeros((L, e), dtype),
+            "wq": norm_init(e, (L, e, e), keys[2]),
+            "wk": norm_init(e, (L, e, e), keys[3]),
+            "wv": norm_init(e, (L, e, e), keys[4]),
+            "wo": norm_init(e, (L, e, e), keys[5]),
+            "ln2_scale": jnp.ones((L, e), dtype),
+            "ln2_bias": jnp.zeros((L, e), dtype),
+            "w_up": norm_init(e, (L, e, m), keys[6]),
+            "up_bias": jnp.zeros((L, m), dtype),
+            "w_down": norm_init(m, (L, m, e), keys[7]),
+            "down_bias": jnp.zeros((L, e), dtype),
+        },
+        "final_norm_scale": jnp.ones((e,), dtype),
+        "final_norm_bias": jnp.zeros((e,), dtype),
+        "head_w": norm_init(e, (e, config.n_classes), keys[8]),
+        "head_b": jnp.zeros((config.n_classes,), jnp.float32),
+    }
+
+
+def param_shapes(config: ViTConfig) -> Params:
+    return jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))
+
+
+def patchify(config: ViTConfig, images: jax.Array) -> jax.Array:
+    """[B, H, W, C] -> [B, N, P²C] by pure reshapes (row-major patch
+    flattening); the embedding is then one big matmul."""
+    b, h, w, c = images.shape
+    p = config.patch_size
+    gh, gw = h // p, w // p
+    x = images.reshape(b, gh, p, gw, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)         # [B, gh, gw, p, p, C]
+    return x.reshape(b, gh * gw, p * p * c)
+
+
+def _layer_body(config: ViTConfig, x, lp):
+    """Pre-LN encoder layer. x: [B, N, E]."""
+    b, n, e = x.shape
+
+    def proj(h_in, w):
+        return jnp.einsum("bne,eh->bnh", h_in, w,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+    h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], config.norm_eps)
+    q = proj(h, lp["wq"]).reshape(b, n, config.n_heads, config.head_dim)
+    k = proj(h, lp["wk"]).reshape(b, n, config.n_heads, config.head_dim)
+    v = proj(h, lp["wv"]).reshape(b, n, config.n_heads, config.head_dim)
+    attn = attention(q, k, v, causal=False, impl=config.attention_impl)
+    x = x + proj(attn.reshape(b, n, e), lp["wo"])
+
+    h = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], config.norm_eps)
+    up = proj(h, lp["w_up"]) + lp["up_bias"].astype(x.dtype)
+    x = x + (proj(jax.nn.gelu(up), lp["w_down"])
+             + lp["down_bias"].astype(x.dtype))
+    return x
+
+
+def encode(config: ViTConfig, params: Params, images: jax.Array
+           ) -> jax.Array:
+    """[B, H, W, C] images -> [B, N+1, E] encoded tokens (cls first)."""
+    b = images.shape[0]
+    patches = patchify(config, images).astype(config.dtype)
+    x = jnp.einsum("bnp,pe->bne", patches, params["patch_embedding"],
+                   preferred_element_type=jnp.float32).astype(config.dtype)
+    x = x + params["patch_bias"].astype(config.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(config.dtype),
+                           (b, 1, config.embed_dim))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(config.dtype)[None]
+
+    body = functools.partial(_layer_body, config)
+    if config.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        return body(carry, lp), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    return layer_norm(x, params["final_norm_scale"],
+                      params["final_norm_bias"], config.norm_eps)
+
+
+def classify(config: ViTConfig, params: Params, images: jax.Array
+             ) -> jax.Array:
+    """[B, H, W, C] -> [B, n_classes] logits (f32, cls-token head)."""
+    x = encode(config, params, images)
+    cls = x[:, 0]
+    return jnp.einsum("be,ec->bc", cls, params["head_w"],
+                      preferred_element_type=jnp.float32) + params["head_b"]
+
+
+def loss_fn(config: ViTConfig, params: Params, images: jax.Array,
+            labels: jax.Array) -> tuple[jax.Array, dict]:
+    logits = classify(config, params, images)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(nll)
+    accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+    return loss, {"loss": loss, "accuracy": accuracy}
+
+
+def make_train_step(config: ViTConfig, optimizer, mesh=None, rules=None):
+    """Sharded classifier train step (params sharded by DEFAULT_RULES,
+    batch over data axes); (params, opt_state, images, labels) ->
+    (params, opt_state, metrics)."""
+    from ..parallel.sharding import batch_sharding, tree_shardings
+
+    def step(params, opt_state, images, labels):
+        import optax
+
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, images, labels),
+            has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    shardings = tree_shardings(param_shapes(config), mesh, rules)
+    opt_shapes = jax.eval_shape(
+        optimizer.init, param_shapes(config))
+    opt_shardings = tree_shardings(opt_shapes, mesh, rules)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    data_sh = batch_sharding(mesh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        step,
+        in_shardings=(shardings, opt_shardings, data_sh, data_sh),
+        out_shardings=(shardings, opt_shardings, replicated),
+        donate_argnums=(0, 1))

@@ -87,12 +87,32 @@ class ServiceState:
         self.started = time.time()
 
 
+def auth_middleware():
+    """Bearer-token auth for the whole API when a service token is
+    configured (mlconf.httpdb.auth_token / MLT_SERVICE_TOKEN). healthz
+    stays open for probes. Without a token the service is open — matching
+    the reference's default in-cluster posture."""
+
+    @web.middleware
+    async def middleware(request, handler):
+        required = mlconf.httpdb.auth_token or os.environ.get(
+            "MLT_SERVICE_TOKEN", "")
+        if required and not request.path.endswith("/healthz"):
+            header = request.headers.get("Authorization", "")
+            if header != f"Bearer {required}":
+                return error_response("unauthorized", 401)
+        return await handler(request)
+
+    return middleware
+
+
 def build_app(state: ServiceState | None = None) -> web.Application:
     from .clusterization import clusterization_middleware, is_chief
 
     state = state or ServiceState()
     app = web.Application(client_max_size=64 * 1024 * 1024,
-                          middlewares=[clusterization_middleware()])
+                          middlewares=[auth_middleware(),
+                                       clusterization_middleware()])
     app["state"] = state
     app["is_chief"] = is_chief()
 
@@ -714,8 +734,18 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     async def delete_project_secrets(request):
         provider = request.query.get("provider", "kubernetes")
         keys = request.query.getall("secret", []) or None
-        state.db.delete_project_secrets(
-            request.match_info["project"], keys=keys, provider=provider)
+        project = request.match_info["project"]
+        state.db.delete_project_secrets(project, keys=keys,
+                                        provider=provider)
+        if keys is None and provider == "kubernetes":
+            # full wipe: also remove the projected k8s Secret (best-effort;
+            # the provider is gated on the kubernetes package)
+            try:
+                from .runtime_handlers import KubernetesProvider
+
+                KubernetesProvider().delete_project_secret(project)
+            except Exception:  # noqa: BLE001 - no cluster / not deployed
+                pass
         return json_response({"ok": True})
 
     # -- datastore profiles (reference: server-side datastore_profile
@@ -773,6 +803,22 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             body.get("identifiers") or [])
         return json_response({"removed": removed})
 
+    def _file_access_denied(path: str) -> str | None:
+        """Service internals are never readable through /files (the
+        sqlite DB holds project secret values); an optional allowlist
+        (mlconf.httpdb.files_allowed_paths) restricts everything else."""
+        real = os.path.realpath(path)
+        dsn = os.path.realpath(getattr(state.db, "dsn", "") or "")
+        if dsn and real in (dsn, dsn + "-wal", dsn + "-shm"):
+            return "service database is not readable through /files"
+        allowed = [p.strip() for p in str(
+            mlconf.httpdb.files_allowed_paths or "").split(",") if p.strip()]
+        if allowed and not any(
+                real.startswith(os.path.realpath(p) + os.sep)
+                or real == os.path.realpath(p) for p in allowed):
+            return "path is outside files_allowed_paths"
+        return None
+
     # -- files (reference server/api/api/endpoints/files.py) ---------------
     @r.get(API + "/projects/{project}/files")
     async def get_file(request):
@@ -781,6 +827,9 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         path = request.query.get("path", "")
         if not path:
             return error_response("path query parameter is required", 400)
+        denied = _file_access_denied(path)
+        if denied:
+            return error_response(denied, 403)
         try:
             from ..datastore import store_manager
 
@@ -802,6 +851,9 @@ def build_app(state: ServiceState | None = None) -> web.Application:
         path = request.query.get("path", "")
         if not path:
             return error_response("path query parameter is required", 400)
+        denied = _file_access_denied(path)
+        if denied:
+            return error_response(denied, 403)
         try:
             from ..datastore import store_manager
 
@@ -817,12 +869,9 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     # -- hub admin (reference server/api/api/endpoints/hub.py) -------------
     def _hub_source_path(name: str):
         if name == "default":
-            import mlrun_tpu
+            from ..hub import builtin_hub_path
 
-            # shipped inside the package so installed dists keep it
-            return os.path.join(
-                os.path.dirname(os.path.abspath(mlrun_tpu.__file__)),
-                "hub_functions")
+            return builtin_hub_path()
         source = state.db.get_hub_source(name)
         return (source or {}).get("path")
 
@@ -942,6 +991,11 @@ def build_app(state: ServiceState | None = None) -> web.Application:
                                "rows": rows}])
 
     # -- background tasks --------------------------------------------------------------------
+    @r.get(API + "/projects/{project}/background-tasks")
+    async def list_background_tasks(request):
+        return json_response({"background_tasks": state.db.list_background_tasks(
+            request.match_info["project"])})
+
     @r.get(API + "/projects/{project}/background-tasks/{name}")
     async def get_background_task(request):
         task = state.db.get_background_task(

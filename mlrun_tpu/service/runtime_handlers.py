@@ -224,27 +224,52 @@ class KubernetesProvider(Provider):
             self._core.create_namespaced_secret(self.namespace, body)
         return name
 
+    def delete_project_secret(self, project: str):
+        import kubernetes
+
+        try:
+            self._core.delete_namespaced_secret(
+                f"mlrun-tpu-secrets-{project}", self.namespace)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+
     def list_resources(self, class_label: str) -> list[tuple[str, str, str]]:
         """Discover live cluster resources by label selector (reference
         base.py:65,189 recovers handler state the same way). Returns
-        (resource_id, run_uid, project) triples."""
+        (resource_id, run_uid, project) triples. Listing is PAGINATED via
+        the k8s continue token so a large cluster can't blow one response
+        (reference paginates the same way)."""
         selector = f"mlrun-tpu/class={class_label}"
         found = []
-        pods = self._core.list_namespaced_pod(
-            self.namespace, label_selector=selector)
-        for pod in pods.items:
-            labels = pod.metadata.labels or {}
-            found.append((f"pod/{pod.metadata.name}",
-                          labels.get("mlrun-tpu/uid", ""),
-                          labels.get("mlrun-tpu/project", "")))
-        jobsets = self._custom.list_namespaced_custom_object(
-            "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
-            label_selector=selector)
-        for js in jobsets.get("items", []):
-            labels = js.get("metadata", {}).get("labels", {})
-            found.append((f"jobset/{js['metadata']['name']}",
-                          labels.get("mlrun-tpu/uid", ""),
-                          labels.get("mlrun-tpu/project", "")))
+        token = None
+        while True:
+            pods = self._core.list_namespaced_pod(
+                self.namespace, label_selector=selector, limit=500,
+                _continue=token)
+            for pod in pods.items:
+                labels = pod.metadata.labels or {}
+                found.append((f"pod/{pod.metadata.name}",
+                              labels.get("mlrun-tpu/uid", ""),
+                              labels.get("mlrun-tpu/project", "")))
+            token = getattr(pods.metadata, "_continue", None) or getattr(
+                pods.metadata, "continue_", None)
+            if not token:
+                break
+        token = None
+        while True:
+            jobsets = self._custom.list_namespaced_custom_object(
+                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+                label_selector=selector, limit=500,
+                **({"_continue": token} if token else {}))
+            for js in jobsets.get("items", []):
+                labels = js.get("metadata", {}).get("labels", {})
+                found.append((f"jobset/{js['metadata']['name']}",
+                              labels.get("mlrun-tpu/uid", ""),
+                              labels.get("mlrun-tpu/project", "")))
+            token = jobsets.get("metadata", {}).get("continue")
+            if not token:
+                break
         return [f for f in found if f[1]]
 
 

@@ -156,6 +156,20 @@ def test_alert_silence_endpoint(http_db):
     assert alert["state"] == "active"
 
 
+def test_background_task_listing(service, http_db):
+    _, state = service
+    assert http_db.list_background_tasks("p-bg") == []
+    state.db.store_background_task("deploy-fn", "running", project="p-bg")
+    state.db.store_background_task("sync-proj", "succeeded", project="p-bg")
+    tasks = http_db.list_background_tasks("p-bg")
+    assert {t["name"]: t["state"] for t in tasks} == {
+        "deploy-fn": "running", "sync-proj": "succeeded"}
+    single = http_db.api_call(
+        "GET", "projects/p-bg/background-tasks/deploy-fn",
+        "get background task")["data"]
+    assert single["state"] == "running"
+
+
 def test_cron_parser():
     from datetime import datetime
 
@@ -326,9 +340,15 @@ def test_tags_files_hub_endpoints(service, http_db, tmp_path):
     assert http_db.tag_objects("p3", "prod",
                                [{"key": "model-a", "uid": "v1"}]) == 1
     art = http_db.read_artifact("model-a", tag="prod", project="p3")
+    assert art["metadata"]["uid"] == "v1"
     assert art["metadata"]["tag"] == "prod"
+    # tags are additive: 'latest' still resolves (to v2)
+    latest = http_db.read_artifact("model-a", project="p3")
+    assert latest["metadata"]["uid"] == "v2"
     assert http_db.tag_objects("p3", "prod",
                                [{"key": "model-a", "uid": "v2"}]) == 1
+    moved = http_db.read_artifact("model-a", tag="prod", project="p3")
+    assert moved["metadata"]["uid"] == "v2"
     assert http_db.delete_objects_tag(
         "p3", "prod", [{"key": "model-a", "uid": "v2"}]) == 1
 
@@ -359,3 +379,34 @@ def test_tags_files_hub_endpoints(service, http_db, tmp_path):
     http_db.delete_hub_source("myhub")
     assert not any(s["name"] == "myhub"
                    for s in http_db.list_hub_sources())
+
+
+def test_auth_token_middleware(service, monkeypatch):
+    import requests
+
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.db.httpdb import HTTPRunDB
+
+    base_url, _ = service
+    monkeypatch.setattr(mlconf.httpdb, "auth_token", "sekret")
+    try:
+        # no token -> 401 on API, healthz stays open
+        resp = requests.get(f"{base_url}/api/v1/projects")
+        assert resp.status_code == 401
+        assert requests.get(
+            f"{base_url}/api/v1/healthz").status_code == 200
+        # right token -> OK (HTTPRunDB sends Authorization: Bearer)
+        db = HTTPRunDB(base_url, token="sekret")
+        db.api_call("GET", "projects")
+    finally:
+        monkeypatch.setattr(mlconf.httpdb, "auth_token", "")
+
+
+def test_files_endpoint_denies_service_db(service, http_db):
+    _, state = service
+    import pytest as _pytest
+
+    from mlrun_tpu.db.base import RunDBError
+
+    with _pytest.raises(RunDBError, match="403|not readable"):
+        http_db.get_file(state.db.dsn, project="px")
