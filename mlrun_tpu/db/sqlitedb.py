@@ -543,8 +543,9 @@ class SQLiteRunDB(RunDBInterface):
 
     def untag_artifacts(self, project: str, tag: str,
                         identifiers: list[dict]) -> int:
-        """Remove ``tag`` from the identified artifacts (side-table tags
-        only; the store_artifact-managed 'latest' pointer is untouched)."""
+        """Remove ``tag`` from the identified artifacts: side-table rows
+        AND a matching column tag (set by store_artifact) are both
+        cleared, with body metadata kept in sync."""
         project = self._project_or_default(project)
         removed = 0
         for ident in identifiers:
@@ -560,6 +561,17 @@ class SQLiteRunDB(RunDBInterface):
             cursor = self._execute(
                 f"DELETE FROM artifact_tags WHERE {where}", tuple(args))
             removed += cursor.rowcount if cursor is not None else 0
+            rows = self._query(
+                f"SELECT uid, body FROM artifacts WHERE {where}",
+                tuple(args))
+            for row in rows:
+                body = json.loads(row["body"])
+                update_in(body, "metadata.tag", "")
+                self._execute(
+                    "UPDATE artifacts SET tag='', body=? WHERE project=? "
+                    "AND key=? AND uid=?",
+                    (json.dumps(body), project, key, row["uid"]))
+            removed += len(rows)
         return removed
 
     def store_datastore_profile(self, profile: dict, project: str = "",
@@ -669,8 +681,12 @@ class SQLiteRunDB(RunDBInterface):
         update_in(artifact, "metadata.uid", uid)
         update_in(artifact, "metadata.project", project)
         # only one uid per (project,key) may own a tag (bodies of prior
-        # holders are re-synced so they stop claiming the tag)
+        # holders are re-synced so they stop claiming the tag); a fresh
+        # store also supersedes any side-table assignment of the same tag
         self._clear_artifact_tag(project, key, tag)
+        self._execute(
+            "DELETE FROM artifact_tags WHERE project=? AND key=? AND tag=?",
+            (project, key, tag))
         self._execute(
             "INSERT OR REPLACE INTO artifacts "
             "(project, key, uid, tree, iteration, tag, kind, updated, body) "
@@ -702,9 +718,21 @@ class SQLiteRunDB(RunDBInterface):
             side = self._query(
                 "SELECT uid FROM artifact_tags WHERE project=? AND key=? "
                 "AND tag=?", (project, key, wanted))
-            if side:
+            side_uid = side[0]["uid"] if side else None
+            if side_uid:
+                stale = not self._query(
+                    "SELECT 1 FROM artifacts WHERE project=? AND key=? "
+                    "AND uid=?", (project, key, side_uid))
+                if stale:
+                    # the tagged version was deleted — drop the stale row
+                    # and resolve through the tag column instead
+                    self._execute(
+                        "DELETE FROM artifact_tags WHERE project=? AND "
+                        "key=? AND tag=?", (project, key, wanted))
+                    side_uid = None
+            if side_uid:
                 sql += " AND uid=?"
-                params.append(side[0]["uid"])
+                params.append(side_uid)
             else:
                 sql += " AND tag=?"
                 params.append(wanted)
@@ -728,8 +756,9 @@ class SQLiteRunDB(RunDBInterface):
             sql += " AND key LIKE ?"
             params.append(f"%{name}%")
         if tag and tag != "*":
-            sql += " AND tag=?"
-            params.append(tag)
+            sql += (" AND (tag=? OR uid IN (SELECT uid FROM artifact_tags "
+                    "WHERE project=? AND key=artifacts.key AND tag=?))")
+            params.extend([tag, project, tag])
         if kind:
             sql += " AND kind=?"
             params.append(kind)
@@ -752,6 +781,19 @@ class SQLiteRunDB(RunDBInterface):
             sql += " AND tag=?"
             params.append(tag)
         self._execute(sql, tuple(params))
+        # side-table rows must not outlive their versions
+        if uid:
+            self._execute(
+                "DELETE FROM artifact_tags WHERE project=? AND key=? "
+                "AND uid=?", (project, key, uid))
+        elif tag:
+            self._execute(
+                "DELETE FROM artifact_tags WHERE project=? AND key=? "
+                "AND tag=?", (project, key, tag))
+        else:
+            self._execute(
+                "DELETE FROM artifact_tags WHERE project=? AND key=?",
+                (project, key))
 
     # -- functions ---------------------------------------------------------
     def store_function(self, function: dict, name, project="", tag="",

@@ -234,9 +234,9 @@ def make_train_step(config: ViTConfig, optimizer, mesh=None, rules=None):
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
-    shardings = tree_shardings(param_shapes(config), mesh, rules)
-    opt_shapes = jax.eval_shape(
-        optimizer.init, param_shapes(config))
+    shapes = param_shapes(config)
+    shardings = tree_shardings(shapes, mesh, rules)
+    opt_shapes = jax.eval_shape(optimizer.init, shapes)
     opt_shardings = tree_shardings(opt_shapes, mesh, rules)
     from jax.sharding import NamedSharding, PartitionSpec
 

@@ -97,7 +97,8 @@ def auth_middleware():
     async def middleware(request, handler):
         required = mlconf.httpdb.auth_token or os.environ.get(
             "MLT_SERVICE_TOKEN", "")
-        if required and not request.path.endswith("/healthz"):
+        healthz = mlconf.api_base_path.rstrip("/") + "/healthz"
+        if required and request.path.rstrip("/") != healthz:
             header = request.headers.get("Authorization", "")
             if header != f"Bearer {required}":
                 return error_response("unauthorized", 401)
@@ -806,16 +807,27 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     def _file_access_denied(path: str) -> str | None:
         """Service internals are never readable through /files (the
         sqlite DB holds project secret values); an optional allowlist
-        (mlconf.httpdb.files_allowed_paths) restricts everything else."""
-        real = os.path.realpath(path)
-        dsn = os.path.realpath(getattr(state.db, "dsn", "") or "")
-        if dsn and real in (dsn, dsn + "-wal", dsn + "-shm"):
-            return "service database is not readable through /files"
+        (mlconf.httpdb.files_allowed_paths) restricts everything else.
+        Local paths (bare or file://) are compared by realpath; remote
+        URLs (s3:// etc.) by raw prefix."""
+        scheme, _, rest = path.partition("://")
+        local = not rest or scheme == "file"
+        local_path = (rest if scheme == "file" else path) if local else None
         allowed = [p.strip() for p in str(
             mlconf.httpdb.files_allowed_paths or "").split(",") if p.strip()]
-        if allowed and not any(
-                real.startswith(os.path.realpath(p) + os.sep)
-                or real == os.path.realpath(p) for p in allowed):
+        if local:
+            real = os.path.realpath(local_path)
+            dsn = os.path.realpath(getattr(state.db, "dsn", "") or "")
+            if dsn and real in (dsn, dsn + "-wal", dsn + "-shm"):
+                return "service database is not readable through /files"
+            if allowed and not any(
+                    (not a.partition("://")[1])
+                    and (real.startswith(os.path.realpath(a) + os.sep)
+                         or real == os.path.realpath(a))
+                    for a in allowed):
+                return "path is outside files_allowed_paths"
+            return None
+        if allowed and not any(path.startswith(a) for a in allowed):
             return "path is outside files_allowed_paths"
         return None
 

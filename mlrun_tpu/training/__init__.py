@@ -1,6 +1,8 @@
 from .checkpoint import CheckpointManager, save_checkpoint_artifact  # noqa: F401
 from .data import (  # noqa: F401
+    TokenShardLoader,
     array_token_stream,
+    device_prefetch,
     per_process_batch,
     synthetic_token_stream,
     text_file_stream,

@@ -58,3 +58,147 @@ def per_process_batch(global_batch: np.ndarray, sharding):
     if jax.process_count() == 1:
         return jax.device_put(global_batch, sharding)
     return jax.make_array_from_process_local_data(sharding, global_batch)
+
+
+# -- native token-shard loader (native/data_loader.cpp) ----------------------
+
+def _native_lib_path() -> str:
+    import os
+
+    env = os.environ.get("MLT_DATA_LOADER_LIB")
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "native", "libmlt_data.so")
+
+
+class TokenShardLoader:
+    """Native prefetching loader over flat token-shard files.
+
+    Replaces the reference's DataLoader worker processes
+    (mlrun/frameworks/pytorch/mlrun_interface.py:903) with
+    native/data_loader.cpp: shards are mmapped read-only, worker threads
+    cut seeded-shuffled (seq+1)-token windows and stage whole batches in
+    a bounded ring buffer — the Python side does ONE memcpy per batch and
+    the TPU step never waits on IO. Yields (tokens, targets) int32 arrays
+    like synthetic_token_stream.
+
+    Shard format: little-endian flat token files, int32 (dtype="int32")
+    or uint16 (dtype="uint16") — the usual pretokenized .bin layout.
+    """
+
+    def __init__(self, paths, batch_size: int, seq_len: int,
+                 dtype: str = "int32", seed: int = 0, workers: int = 2,
+                 queue_depth: int = 4, lib_path: str = ""):
+        import ctypes
+        import os
+
+        if isinstance(paths, (str, bytes)):
+            paths = [paths]
+        self.paths = [str(p) for p in paths]
+        for p in self.paths:
+            if not os.path.isfile(p):
+                raise FileNotFoundError(p)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        code = {"int32": 4, "uint16": 2}.get(dtype)
+        if code is None:
+            raise ValueError(f"dtype must be int32|uint16, got {dtype}")
+
+        lib_path = lib_path or _native_lib_path()
+        self._lib = ctypes.CDLL(lib_path)
+        self._lib.mlt_loader_open.restype = ctypes.c_uint64
+        self._lib.mlt_loader_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint32,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32]
+        self._lib.mlt_loader_next.restype = ctypes.c_int
+        self._lib.mlt_loader_next.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32)]
+        self._lib.mlt_loader_total_tokens.restype = ctypes.c_uint64
+        self._lib.mlt_loader_epoch.restype = ctypes.c_uint64
+        self._lib.mlt_loader_close.argtypes = [ctypes.c_uint64]
+
+        arr = (ctypes.c_char_p * len(self.paths))(
+            *[p.encode() for p in self.paths])
+        self._handle = self._lib.mlt_loader_open(
+            arr, len(self.paths), code, batch_size, seq_len, seed,
+            workers, queue_depth)
+        if not self._handle:
+            raise RuntimeError(
+                f"mlt_loader_open failed for {self.paths} (empty shards, "
+                f"bad dtype, or shards shorter than seq_len+1)")
+        self._buf = np.empty((batch_size, seq_len + 1), np.int32)
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self._lib.mlt_loader_total_tokens(self._handle))
+
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.mlt_loader_epoch(self._handle))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple:
+        import ctypes
+
+        ok = self._lib.mlt_loader_next(
+            self._handle,
+            self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if not ok:
+            raise StopIteration
+        tokens = self._buf[:, :-1].copy()
+        targets = self._buf[:, 1:].copy()
+        return tokens, targets
+
+    def close(self):
+        if getattr(self, "_handle", 0):
+            self._lib.mlt_loader_close(self._handle)
+            self._handle = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
+def device_prefetch(stream, sharding=None, depth: int = 2):
+    """Wrap a (tokens, targets) host iterator with device-side prefetch:
+    keeps ``depth`` batches already transferred (optionally with a
+    NamedSharding) so the train step never waits on host->HBM copies."""
+    import collections
+
+    import jax
+
+    queue = collections.deque()
+
+    def put(item):
+        tokens, targets = item
+        if sharding is not None:
+            return (jax.device_put(tokens, sharding),
+                    jax.device_put(targets, sharding))
+        return jax.device_put(tokens), jax.device_put(targets)
+
+    iterator = iter(stream)
+    try:
+        for _ in range(depth):
+            queue.append(put(next(iterator)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(iterator)))
+        except StopIteration:
+            pass
+        yield out
