@@ -544,6 +544,41 @@ class HTTPRunDB(RunDBInterface):
                        "artifact_path": artifact_path})
         return resp.get("id", "")
 
+    def list_pipelines(self, project: str = "*") -> dict:
+        """Reference: mlrun/db/httpdb.py submit/list pipelines proxy."""
+        return self.api_call(
+            "GET", self._path(project or "*", "pipelines"), "list pipelines")
+
+    def get_pipeline(self, run_id: str, project: str = "*") -> dict:
+        return self.api_call(
+            "GET", self._path(project or "*", "pipelines", run_id),
+            "get pipeline")
+
+    def list_runtime_resources(self, project: str = "*",
+                               kind: str = "") -> list[dict]:
+        """Reference: mlrun/db/httpdb.py list_runtime_resources — grouped
+        per-kind cluster resources for a project ('*' = all)."""
+        params = {"kind": kind} if kind else None
+        resp = self.api_call(
+            "GET", self._path(project or "*", "runtime-resources"),
+            "list runtime resources", params=params)
+        return resp.get("runtime_resources", [])
+
+    def delete_runtime_resources(self, project: str = "*", kind: str = "",
+                                 object_id: str = "",
+                                 force: bool = False) -> list[dict]:
+        params = {}
+        if kind:
+            params["kind"] = kind
+        if object_id:
+            params["object-id"] = object_id
+        if force:
+            params["force"] = "true"
+        resp = self.api_call(
+            "DELETE", self._path(project or "*", "runtime-resources"),
+            "delete runtime resources", params=params or None)
+        return resp.get("deleted", [])
+
     def remote_builder(self, func, with_tpu: bool = False) -> dict:
         return self.api_call(
             "POST", "build/function", "remote build",

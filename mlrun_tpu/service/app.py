@@ -1016,6 +1016,77 @@ def build_app(state: ServiceState | None = None) -> web.Application:
             return error_response("background task not found", 404)
         return json_response({"data": task})
 
+    # -- runtime resources (reference: server/api/api/endpoints/
+    # runtime_resources.py — grouped listing + filtered deletion of the
+    # cluster resources a run created) -------------------------------------
+    @r.get(API + "/projects/{project}/runtime-resources")
+    async def list_runtime_resources(request):
+        project = request.match_info["project"]
+        kind = request.query.get("kind", "")
+        rows = state.db.list_runtime_resources(kind)
+        if project not in ("*", ""):
+            rows = [row for row in rows if row["project"] == project]
+        grouped: dict = {}
+        for row in rows:
+            handler = state.launcher.handler_for(row["kind"])
+            try:
+                live_state = handler.provider.state(row["resource_id"])
+            except Exception:  # noqa: BLE001 - provider may be gone
+                live_state = "unknown"
+            grouped.setdefault(row["kind"], []).append({
+                **row, "state": live_state})
+        return json_response({"runtime_resources": [
+            {"kind": kind_, "resources": res}
+            for kind_, res in sorted(grouped.items())]})
+
+    @r.delete(API + "/projects/{project}/runtime-resources")
+    async def delete_runtime_resources(request):
+        project = request.match_info["project"]
+        kind = request.query.get("kind", "")
+        object_id = request.query.get("object-id", "")
+        force = request.query.get("force", "") in ("true", "1")
+        deleted = []
+        for row in state.db.list_runtime_resources(kind):
+            if project not in ("*", "") and row["project"] != project:
+                continue
+            if object_id and row["resource_id"] != object_id:
+                continue
+            run = state.db.read_run(row["uid"], row["project"])
+            run_state = get_in(run or {}, "status.state", "")
+            if not force and run_state not in RunStates.terminal_states():
+                continue  # reference refuses to delete live runs w/o force
+            handler = state.launcher.handler_for(row["kind"])
+            try:
+                # goes through the handler so the in-memory resource map is
+                # also dropped — otherwise the next monitor tick would probe
+                # the deleted resource and mark the run failed
+                handler.delete_resources(row["uid"], row["project"],
+                                         row["resource_id"])
+            except Exception:  # noqa: BLE001 - provider may be gone; keep
+                # the mapping so a later retry can still find the resource
+                continue
+            deleted.append(row)
+        return json_response({"deleted": deleted})
+
+    # -- pipelines (reference: server/api/api/endpoints/pipelines.py — a
+    # KFP proxy; here the native workflow runner doubles as the pipeline
+    # backend, and a kfp client is proxied only when installed) ------------
+    @r.get(API + "/projects/{project}/pipelines")
+    async def list_pipelines(request):
+        project = request.match_info["project"]
+        runs = [w for w in state.workflows.values()
+                if project in ("*", "") or w.get("project") == project]
+        return json_response({"runs": sorted(
+            runs, key=lambda w: w.get("started", ""), reverse=True),
+            "total_size": len(runs)})
+
+    @r.get(API + "/projects/{project}/pipelines/{run_id}")
+    async def get_pipeline(request):
+        workflow = state.workflows.get(request.match_info["run_id"])
+        if workflow is None:
+            return error_response("pipeline run not found", 404)
+        return json_response({"run": workflow})
+
     app.add_routes(r)
     app.on_startup.append(_start_periodic)
     app.on_cleanup.append(_stop_periodic)

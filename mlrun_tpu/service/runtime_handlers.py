@@ -546,12 +546,20 @@ class BaseRuntimeHandler:
             container.setdefault("envFrom", []).append(
                 {"secretRef": {"name": secret_name}})
 
-    def delete_resources(self, uid: str):
+    def delete_resources(self, uid: str, project: str = "",
+                         resource_id: str = ""):
+        """Delete a run's cluster resource + both tracking layers (the
+        in-memory map and the DB row). ``project``/``resource_id`` serve as
+        a fallback for rows that were never adopted in-memory (e.g. listed
+        straight from the DB after a restart)."""
         with self._lock:
             entry = self._resources.get(uid)
         if entry:
             self.provider.delete(entry[0])
             self._forget(uid, entry[1])
+        elif resource_id:
+            self.provider.delete(resource_id)
+            self._forget(uid, project)
 
     def abort_run(self, uid: str, project: str):
         self.db.update_run({"status.state": RunStates.aborting}, uid, project)

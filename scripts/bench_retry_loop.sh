@@ -1,9 +1,17 @@
 #!/bin/bash
-# Retry bench.py until the TPU relay comes back, then record the result.
-# Each attempt relies on bench.py's internal 180s watchdog (no external
-# kill — killing a jax client mid-init can wedge the relay further).
+# Retry bench.py until the TPU relay comes back, then record the result and
+# follow with the serving TTFT bench. Each attempt relies on bench.py's
+# internal 180s watchdog (no external kill — killing a jax client mid-init
+# can wedge the relay further). Single-instance via a pidfile lock.
 OUT=${1:-/root/repo/BENCH_LOCAL_r2.json}
+SERVING_OUT=${2:-/root/repo/BENCH_SERVING_r2.json}
 LOG=/tmp/bench_retry.log
+LOCK=/tmp/bench_retry.pid
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
+  echo "another retry loop is running (pid $(cat "$LOCK"))" >&2
+  exit 1
+fi
+echo $$ > "$LOCK"
 for i in $(seq 1 60); do
   echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
   python /root/repo/bench.py > /tmp/bench_attempt.out 2>> "$LOG"
@@ -11,10 +19,16 @@ for i in $(seq 1 60); do
   if [ $rc -eq 0 ] && [ -s /tmp/bench_attempt.out ]; then
     cp /tmp/bench_attempt.out "$OUT"
     echo "SUCCESS on attempt $i" >> "$LOG"
+    echo "=== serving bench $(date -u +%H:%M:%S) ===" >> "$LOG"
+    python /root/repo/scripts/bench_serving.py > /tmp/bench_serving.out \
+      2>> "$LOG" && cp /tmp/bench_serving.out "$SERVING_OUT" \
+      && echo "serving bench recorded" >> "$LOG"
+    rm -f "$LOCK"
     exit 0
   fi
   echo "attempt $i rc=$rc" >> "$LOG"
   sleep 600
 done
 echo "exhausted attempts" >> "$LOG"
+rm -f "$LOCK"
 exit 1

@@ -410,3 +410,47 @@ def test_files_endpoint_denies_service_db(service, http_db):
 
     with _pytest.raises(RunDBError, match="403|not readable"):
         http_db.get_file(state.db.dsn, project="px")
+
+
+def test_runtime_resources_endpoints(service, http_db):
+    """Reference: server/api/api/endpoints/runtime_resources.py — grouped
+    listing and force-gated deletion of run-created cluster resources."""
+    _, state = service
+    state.db.store_run({"metadata": {"uid": "rr1", "project": "prr"},
+                        "status": {"state": "running"}}, "rr1", "prr")
+    state.db.store_runtime_resource("rr1", "prr", "job", "proc-999999-1",
+                                    time.time())
+    grouped = http_db.list_runtime_resources(project="prr")
+    assert grouped and grouped[0]["kind"] == "job"
+    resource = grouped[0]["resources"][0]
+    assert resource["resource_id"] == "proc-999999-1"
+    assert resource["state"]  # provider liveness resolved per-row
+
+    # run is non-terminal: delete without force must leave it in place
+    assert http_db.delete_runtime_resources(project="prr") == []
+    assert http_db.list_runtime_resources(project="prr")
+
+    deleted = http_db.delete_runtime_resources(project="prr", force=True)
+    assert [d["uid"] for d in deleted] == ["rr1"]
+    assert http_db.list_runtime_resources(project="prr") == []
+
+
+def test_pipelines_endpoints(service, http_db):
+    """Reference: server/api/api/endpoints/pipelines.py (KFP proxy) — the
+    native workflow runner backs the same list/get contract."""
+    _, state = service
+    state.workflows["wf-aaa"] = {"id": "wf-aaa", "project": "ppl",
+                                 "state": "completed", "started": "t1"}
+    state.workflows["wf-bbb"] = {"id": "wf-bbb", "project": "other",
+                                 "state": "running", "started": "t2"}
+    listing = http_db.list_pipelines(project="ppl")
+    assert [run["id"] for run in listing["runs"]] == ["wf-aaa"]
+    everything = http_db.list_pipelines(project="*")
+    assert everything["total_size"] == 2
+    # newest first by submission time
+    assert [run["id"] for run in everything["runs"]] == ["wf-bbb", "wf-aaa"]
+    assert http_db.get_pipeline("wf-aaa")["run"]["state"] == "completed"
+    from mlrun_tpu.db.base import RunDBError
+
+    with pytest.raises(RunDBError):
+        http_db.get_pipeline("missing")
