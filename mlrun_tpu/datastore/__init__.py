@@ -17,6 +17,7 @@ from .sources import (  # noqa: F401
     HttpSource,
     KafkaSource,
     ParquetSource,
+    SnowflakeSource,
     SQLSource,
     StreamSource,
 )

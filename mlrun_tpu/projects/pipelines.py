@@ -97,11 +97,18 @@ class _StepOutput:
 
 
 class PipelineContext:
-    """State for one workflow execution."""
+    """State for one workflow execution.
+
+    ``engine="kfp-compile"`` traces the workflow without executing it:
+    ``run_function`` records each step in :attr:`steps` and returns the
+    step object so workflow files can keep chaining ``.after()`` /
+    ``.output()`` exactly as they do under the local engine.
+    """
 
     def __init__(self, project=None, workflow_name: str = "", local=True,
                  watch=False, artifact_path: str = "", args: dict | None = None,
                  engine: str = "local"):
+        self.steps: list[PipelineStep] = []
         self.project = project
         self.project_name = project.name if project is not None else ""
         self.workflow_name = workflow_name
@@ -232,43 +239,164 @@ class _RemoteRunner(_PipelineRunner):
             expected_statuses=expected_statuses)
 
 
+def _step_exec_env(step: "PipelineStep", artifact_path: str,
+                   params: dict | None = None,
+                   inputs: dict | None = None) -> list[dict]:
+    """The in-pod contract env for one step (`mlrun-tpu run --from-env`
+    with MLT_EXEC_CONFIG/MLT_EXEC_CODE — the mlrun_op analog from
+    pipeline-adapters ops.py:66). Shared by the kfp-free IR compiler and
+    the kfp-SDK container-op builder so the contract can't drift."""
+    import json as jsonlib
+
+    function = step.function
+    run = {
+        "metadata": {"name": step.name,
+                     "project": function.metadata.project},
+        "spec": {"parameters": step.params if params is None else params,
+                 "inputs": step.inputs if inputs is None else inputs,
+                 "handler": step.handler or function.spec.default_handler,
+                 "output_path": step.artifact_path or artifact_path,
+                 "function": function.uri},
+    }
+    env = [{"name": "MLT_EXEC_CONFIG",
+            "value": jsonlib.dumps(run, default=str)}]
+    build = function.spec.build
+    if build and getattr(build, "functionSourceCode", ""):
+        env.append({"name": "MLT_EXEC_CODE",
+                    "value": build.functionSourceCode})
+    return env
+
+
+def compile_kfp_pipeline(project, workflow_spec=None, name: str = "",
+                         workflow_handler=None, artifact_path: str = "",
+                         args: dict | None = None) -> dict:
+    """Compile a workflow to a KFP v2 ``PipelineSpec`` IR dict WITHOUT the
+    kfp package (reference pipelines.py:542 compiles via the kfp SDK; the
+    IR schema itself is plain JSON, so emitting it directly keeps the
+    compile path executable in kfp-less environments — submission to a KFP
+    endpoint still requires the kfp client, see _KFPRunner.run).
+
+    Each step becomes an executor running the in-pod contract;
+    ``.after()`` chains and ``step.output()`` references become dag
+    dependencies. Step-output params are declared as component
+    input/output parameter definitions and injected into the exec config
+    via KFP runtime placeholders (``{{$.inputs.parameters['k']}}``) so
+    the backend substitutes the produced value at run time.
+    """
+    global _current_context
+
+    handler = workflow_handler or _load_workflow_handler(
+        workflow_spec, project)
+    context = PipelineContext(
+        project=project, workflow_name=name, local=False,
+        artifact_path=artifact_path or project.spec.artifact_path,
+        args=args, engine="kfp-compile")
+    with _context_lock:
+        _current_context = context
+    try:
+        handler(**(args or {}))
+    finally:
+        with _context_lock:
+            _current_context = None
+
+    # unique task names: duplicate step names get -2/-3… suffixes (like the
+    # kfp SDK) so later steps can't silently overwrite earlier ones
+    task_names: dict[int, str] = {}
+    used: dict[str, int] = {}
+    for step in context.steps:
+        count = used.get(step.name, 0) + 1
+        used[step.name] = count
+        task_names[id(step)] = (step.name if count == 1
+                                else f"{step.name}-{count}")
+
+    # producer steps must declare every output key a consumer references
+    produced: dict[int, set] = {}
+    for step in context.steps:
+        for value in {**step.params, **step.inputs}.values():
+            if isinstance(value, _StepOutput):
+                produced.setdefault(id(value.step), set()).add(value.key)
+
+    executors: dict = {}
+    components: dict = {}
+    tasks: dict = {}
+    for step in context.steps:
+        task_name = task_names[id(step)]
+        deps = {task_names[id(dep)] for dep in step.after_steps
+                if id(dep) in task_names}
+        task_inputs: dict = {}
+        static_params: dict = {}
+        static_inputs: dict = {}
+        for key, value, bucket in (
+                [(k, v, static_params) for k, v in step.params.items()]
+                + [(k, v, static_inputs) for k, v in step.inputs.items()]):
+            if isinstance(value, _StepOutput):
+                producer = task_names[id(value.step)]
+                deps.add(producer)
+                task_inputs[key] = {"taskOutputParameter": {
+                    "producerTask": producer,
+                    "outputParameterKey": value.key}}
+                # runtime placeholder: the backend substitutes the
+                # produced value into the exec config env
+                bucket[key] = f"{{{{$.inputs.parameters['{key}']}}}}"
+            else:
+                bucket[key] = value
+
+        executors[f"exec-{task_name}"] = {"container": {
+            "image": step.function.full_image_path(),
+            "command": ["mlrun-tpu", "run", "--from-env"],
+            "env": _step_exec_env(step, context.artifact_path,
+                                  params=static_params,
+                                  inputs=static_inputs),
+        }}
+        component: dict = {"executorLabel": f"exec-{task_name}"}
+        if task_inputs:
+            component["inputDefinitions"] = {"parameters": {
+                key: {"parameterType": "STRING"} for key in task_inputs}}
+        if produced.get(id(step)):
+            component["outputDefinitions"] = {"parameters": {
+                key: {"parameterType": "STRING"}
+                for key in sorted(produced[id(step)])}}
+        components[f"comp-{task_name}"] = component
+
+        task = {"componentRef": {"name": f"comp-{task_name}"},
+                "taskInfo": {"name": task_name}}
+        if deps:
+            task["dependentTasks"] = sorted(deps)
+        if task_inputs:
+            task["inputs"] = {"parameters": task_inputs}
+        tasks[task_name] = task
+
+    return {
+        "pipelineInfo": {"name": name or context.workflow_id},
+        "schemaVersion": "2.1.0",
+        "sdkVersion": "mlrun-tpu",
+        "deploymentSpec": {"executors": executors},
+        "components": components,
+        "root": {"dag": {"tasks": tasks}},
+    }
+
+
 class _KFPRunner(_PipelineRunner):
     """Compile the workflow to Kubeflow Pipelines when kfp is available
-    (reference pipelines.py:542 + pipeline-adapters mlrun_op, ops.py:66)."""
+    (reference pipelines.py:542 + pipeline-adapters mlrun_op, ops.py:66).
+    The kfp-free compile path is :func:`compile_kfp_pipeline`."""
 
     engine = "kfp"
+    compile = staticmethod(compile_kfp_pipeline)
 
     @staticmethod
     def _step_to_container_op(step: "PipelineStep", artifact_path: str):
         """One workflow step → a KFP container op running the in-pod
         contract (`mlrun-tpu run --from-env`), the mlrun_op analog."""
-        import json as jsonlib
-
         import kfp.dsl as dsl
 
-        function = step.function
-        run = {
-            "metadata": {"name": step.name,
-                         "project": function.metadata.project},
-            "spec": {"parameters": step.params, "inputs": step.inputs,
-                     "handler": step.handler or
-                     function.spec.default_handler,
-                     "output_path": artifact_path,
-                     "function": function.uri},
-        }
         op = dsl.ContainerOp(
             name=step.name,
-            image=function.full_image_path(),
+            image=step.function.full_image_path(),
             command=["mlrun-tpu", "run", "--from-env"],
         )
-        op.container.add_env_variable(
-            {"name": "MLT_EXEC_CONFIG",
-             "value": jsonlib.dumps(run, default=str)})
-        build = function.spec.build
-        if build and build.functionSourceCode:
-            op.container.add_env_variable(
-                {"name": "MLT_EXEC_CODE",
-                 "value": build.functionSourceCode})
+        for item in _step_exec_env(step, artifact_path):
+            op.container.add_env_variable(item)
         return op
 
     @classmethod

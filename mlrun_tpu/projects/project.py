@@ -224,12 +224,17 @@ class MlrunProject(ModelObj):
                 outputs=outputs, artifact_path=artifact_path,
                 hyperparams=hyperparams,
                 hyper_param_options=hyper_param_options, returns=returns)
-            if getattr(context, "engine", "local") == "kfp":
+            engine = getattr(context, "engine", "local")
+            if engine == "kfp":
                 # kfp tracing: emit a container op, do NOT execute
                 from .pipelines import _KFPRunner
 
                 return _KFPRunner._step_to_container_op(
                     step, context.artifact_path)
+            if engine == "kfp-compile":
+                # kfp-free IR compilation: record, do NOT execute
+                context.steps.append(step)
+                return step
             run = step.run(context)
             context.runs.append(run)
             return step

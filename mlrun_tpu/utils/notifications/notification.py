@@ -4,6 +4,7 @@ mlrun/utils/notifications/notification/*.py — console/slack/webhook/mail)."""
 from __future__ import annotations
 
 import json
+import os
 
 from ..helpers import logger, now_iso
 
@@ -105,10 +106,54 @@ class IPythonNotification(NotificationBase):
             print(f"[{severity}] {message}")
 
 
+class GitNotification(NotificationBase):
+    """Comment on a GitHub/GitLab issue or merge request (reference:
+    mlrun/utils/notifications/notification/git.py — same param contract:
+    repo, issue, token; server picked via the ``server`` param)."""
+
+    kind = "git"
+
+    def push(self, message, severity="info", runs=None):
+        import requests
+
+        repo = self.params.get("repo", "")
+        issue = self.params.get("issue", "")
+        token = (self.params.get("token")
+                 or os.environ.get("GIT_TOKEN", ""))
+        if not (repo and issue and token):
+            raise ValueError(
+                "git notification requires 'repo', 'issue' and 'token' "
+                "params (or GIT_TOKEN env)")
+        body = f"[{severity}] {message}"
+        summary = self._runs_summary(runs)
+        if summary:
+            body += "\n\n" + summary
+        server = self.params.get("server", "")
+        if self.params.get("gitlab") or "gitlab" in server:
+            url = (f"https://{server or 'gitlab.com'}/api/v4/projects/"
+                   f"{requests.utils.quote(repo, safe='')}/issues/"
+                   f"{issue}/notes")
+            headers = {"PRIVATE-TOKEN": token}
+            payload = {"body": body}
+        else:
+            # github.com API lives on its own host; GitHub Enterprise
+            # serves it under /api/v3 on the instance host
+            api_base = (f"https://{server}/api/v3" if server
+                        else "https://api.github.com")
+            url = f"{api_base}/repos/{repo}/issues/{issue}/comments"
+            headers = {"Authorization": f"token {token}",
+                       "Accept": "application/vnd.github.v3+json"}
+            payload = {"body": body}
+        response = requests.post(url, json=payload, headers=headers,
+                                 timeout=10)
+        response.raise_for_status()
+
+
 notification_types: dict[str, type] = {
     "console": ConsoleNotification,
     "slack": SlackNotification,
     "webhook": WebhookNotification,
     "mail": MailNotification,
     "ipython": IPythonNotification,
+    "git": GitNotification,
 }

@@ -85,3 +85,81 @@ def test_secrets_store():
     assert store.get("API_KEY") == "s3cret"
     # inline secrets are redacted on serialization
     assert store.to_serial() == []
+
+
+def test_git_notification(monkeypatch):
+    """Reference: mlrun/utils/notifications/notification/git.py — comment
+    payloads for github and gitlab issue endpoints."""
+    import requests as requests_mod
+
+    from mlrun_tpu.utils.notifications.notification import GitNotification
+
+    calls = []
+
+    def fake_post(url, json=None, headers=None, timeout=None):
+        calls.append({"url": url, "json": json, "headers": headers})
+
+        class _Resp:
+            def raise_for_status(self):
+                pass
+
+        return _Resp()
+
+    monkeypatch.setattr(requests_mod, "post", fake_post)
+
+    GitNotification("done", params={
+        "repo": "org/repo", "issue": "7", "token": "tkn"}).push(
+        "run finished", severity="completed")
+    assert calls[0]["url"] == (
+        "https://api.github.com/repos/org/repo/issues/7/comments")
+    assert calls[0]["headers"]["Authorization"] == "token tkn"
+    assert "[completed] run finished" in calls[0]["json"]["body"]
+
+    GitNotification("done", params={
+        "repo": "grp/proj", "issue": "3", "token": "tkn",
+        "gitlab": True}).push("mr done")
+    assert calls[1]["url"] == (
+        "https://gitlab.com/api/v4/projects/grp%2Fproj/issues/3/notes")
+    assert calls[1]["headers"]["PRIVATE-TOKEN"] == "tkn"
+
+    # GitHub Enterprise serves the API under /api/v3 on the instance host
+    GitNotification("done", params={
+        "repo": "org/repo", "issue": "9", "token": "tkn",
+        "server": "github.mycompany.com"}).push("ghe done")
+    assert calls[2]["url"] == (
+        "https://github.mycompany.com/api/v3/repos/org/repo/issues/9/"
+        "comments")
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="repo"):
+        GitNotification("x", params={}).push("no params")
+
+
+def test_snowflake_source_gated(monkeypatch):
+    """Connection-kwargs builder is testable without the connector; the
+    read path raises a clear gate error (reference sources.py:737)."""
+    import sys
+
+    import pytest as _pytest
+
+    from mlrun_tpu.datastore import SnowflakeSource
+    from mlrun_tpu.datastore.sources import get_source_from_dict
+
+    source = SnowflakeSource(
+        "sf", path="DB.SCHEMA.TBL",
+        attributes={"account": "acc", "user": "u", "warehouse": "wh",
+                    "database": "db", "schema": "sch", "query": "SELECT 1"})
+    monkeypatch.setenv("SNOWFLAKE_PASSWORD", "pw")
+    assert source.connection_kwargs() == {
+        "account": "acc", "user": "u", "warehouse": "wh",
+        "database": "db", "schema": "sch", "password": "pw"}
+    # serialization round-trips through the kind registry
+    again = get_source_from_dict(source.to_dict())
+    assert isinstance(again, SnowflakeSource)
+    assert again.attributes["account"] == "acc"
+    # block the import even where the connector happens to be installed
+    monkeypatch.setitem(sys.modules, "snowflake", None)
+    monkeypatch.setitem(sys.modules, "snowflake.connector", None)
+    with _pytest.raises(ImportError):
+        source.to_dataframe()
