@@ -90,6 +90,20 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
         template, handler=handler or template.spec.handler_name or None,
         local=from_env or local, watch=watch)
     state = run_result.state
+    # KFP v2 output parameters: the pipeline compiler points each produced
+    # key at the backend's output_file path via MLT_KFP_OUTPUTS (see
+    # projects/pipelines.py compile_kfp_pipeline); write the run results
+    # there so downstream taskOutputParameter inputs resolve
+    outputs_spec = os.environ.get("MLT_KFP_OUTPUTS")
+    if outputs_spec and state != "error":
+        results = run_result.status.results or {}
+        for key, path in json.loads(outputs_spec).items():
+            if key not in results:
+                continue
+            value = results[key]
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            pathlib.Path(path).write_text(
+                value if isinstance(value, str) else json.dumps(value))
     click.echo(f"run {run_result.metadata.uid} finished: {state}")
     if state == "error":
         click.echo(run_result.status.error or "", err=True)

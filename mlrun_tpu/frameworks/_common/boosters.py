@@ -1,0 +1,55 @@
+"""Shared booster-model logging for the gradient-boosting frameworks
+(xgboost/lightgbm): one save/log flow, per-framework importance
+extraction stays in the framework modules."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def log_importance_artifact(context, model_name: str, scores: dict,
+                            framework: str):
+    if not scores:
+        return
+    context.log_artifact(
+        f"{model_name}_feature_importance",
+        body=json.dumps(scores, indent=2),
+        format="json", labels={"framework": framework})
+
+
+def log_booster_model(context, booster, framework: str, suffix: str,
+                      model_name: str = "model", tag: str = "",
+                      metrics: dict | None = None,
+                      label_column: str | None = None):
+    """Serialize a booster (native ``save_model`` when available, pickle
+    otherwise) and log it as a model artifact; the temp file is removed
+    after the artifact upload."""
+    if not hasattr(booster, "save_model"):
+        suffix = ".pkl"
+    fd, path = tempfile.mkstemp(suffix=suffix)
+    os.close(fd)
+    try:
+        if hasattr(booster, "save_model"):
+            booster.save_model(path)
+        else:
+            import pickle
+
+            with open(path, "wb") as fp:
+                pickle.dump(booster, fp)
+        parameters = {}
+        best_iteration = getattr(booster, "best_iteration", None)
+        # lightgbm uses -1 as its "no early stopping" sentinel
+        if best_iteration is not None and int(best_iteration) >= 0:
+            parameters["best_iteration"] = int(best_iteration)
+        return context.log_model(
+            model_name, model_file=path, framework=framework,
+            algorithm=type(booster).__name__, metrics=metrics or {},
+            tag=tag, label_column=label_column,
+            parameters=parameters or None)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

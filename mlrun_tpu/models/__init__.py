@@ -29,3 +29,5 @@ from .moe import (  # noqa: F401
 from . import vit  # noqa: F401  (vit.classify/encode stay namespaced —
 # bert exports the same verb names at package level)
 from .vit import ViTConfig, tiny_vit, vit_b16, vit_l16  # noqa: F401
+from . import t5  # noqa: F401  (t5.encode/decode stay namespaced)
+from .t5 import T5Config, t5_base, t5_large, tiny_t5  # noqa: F401

@@ -341,12 +341,21 @@ def compile_kfp_pipeline(project, workflow_spec=None, name: str = "",
             else:
                 bucket[key] = value
 
+        env = _step_exec_env(step, context.artifact_path,
+                             params=static_params, inputs=static_inputs)
+        if produced.get(id(step)):
+            # tell the in-pod contract where the backend collects each
+            # output parameter (__main__.py writes run results there)
+            import json as jsonlib
+
+            env.append({"name": "MLT_KFP_OUTPUTS", "value": jsonlib.dumps({
+                key: (f"{{{{$.outputs.parameters['{key}']"
+                      f".output_file}}}}")
+                for key in sorted(produced[id(step)])})})
         executors[f"exec-{task_name}"] = {"container": {
             "image": step.function.full_image_path(),
             "command": ["mlrun-tpu", "run", "--from-env"],
-            "env": _step_exec_env(step, context.artifact_path,
-                                  params=static_params,
-                                  inputs=static_inputs),
+            "env": env,
         }}
         component: dict = {"executorLabel": f"exec-{task_name}"}
         if task_inputs:

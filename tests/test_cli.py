@@ -78,3 +78,28 @@ def test_from_env_missing_config_errors(cli_home):
     out = _cli(["run", "--from-env"], cli_home)
     assert out.returncode != 0
     assert "MLT_EXEC_CONFIG" in out.stderr + out.stdout
+
+
+def test_from_env_writes_kfp_output_parameters(tmp_path, cli_home):
+    """MLT_KFP_OUTPUTS maps result keys to KFP output_file paths; the
+    in-pod run writes each produced result there so downstream
+    taskOutputParameter inputs resolve (projects/pipelines.py compiler)."""
+    import base64
+
+    code = ("def handler(context):\n"
+            "    context.log_result('r', 7)\n"
+            "    context.log_result('s', 'text')\n")
+    out_r = tmp_path / "outs" / "r"
+    out_s = tmp_path / "outs" / "s"
+    config = {"metadata": {"name": "kfpout", "project": "default"},
+              "spec": {"handler": "handler"}}
+    env = dict(cli_home)
+    env["MLT_EXEC_CONFIG"] = json.dumps(config)
+    env["MLT_EXEC_CODE"] = base64.b64encode(code.encode()).decode()
+    env["MLT_KFP_OUTPUTS"] = json.dumps(
+        {"r": str(out_r), "s": str(out_s), "missing": str(tmp_path / "m")})
+    out = _cli(["run", "--from-env"], env, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    assert out_r.read_text() == "7"
+    assert out_s.read_text() == "text"          # strings written verbatim
+    assert not (tmp_path / "m").exists()        # unproduced keys skipped

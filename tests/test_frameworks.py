@@ -345,3 +345,91 @@ def test_plans_string_label_classifier():
     run = fn.run(local=True)
     assert run.state == "completed", run.status.error
     assert "confusion_matrix" in run.status.results["plans"]
+
+
+def test_xgboost_booster_logging(tmp_path):
+    """xgboost interface without the package: callback contract +
+    duck-typed booster logging (reference mlrun/frameworks/xgboost/)."""
+
+    class FakeBooster:
+        best_iteration = 7
+
+        def get_score(self, importance_type="gain"):
+            return {"f0": 1.5, "f1": 0.5} if importance_type == "gain" \
+                else {"f0": 3, "f1": 1}
+
+        def save_model(self, path):
+            with open(path, "w") as fp:
+                fp.write("{}")
+
+    def handler(context):
+        from mlrun_tpu.frameworks.xgboost import (
+            MLRunLoggingCallback, log_booster)
+
+        booster = FakeBooster()
+        callback = MLRunLoggingCallback(context, log_every=1)
+        evals = {"train": {"rmse": []}, "valid": {"rmse": []}}
+        for epoch in range(3):
+            evals["train"]["rmse"].append(1.0 / (epoch + 1))
+            evals["valid"]["rmse"].append(1.5 / (epoch + 1))
+            assert callback.after_iteration(booster, epoch, evals) is False
+        callback.after_training(booster)
+        log_booster(context, booster, model_name="xgb")
+
+    fn = mlrun_tpu.new_function("xgbt", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert run.status.results["valid-rmse"] == pytest.approx(0.5)
+    assert "xgb" in run.status.artifact_uris
+    assert "xgb_feature_importance" in run.status.artifact_uris
+    db = mlrun_tpu.db.get_run_db()
+    model = db.read_artifact("xgb", project=run.metadata.project)
+    assert model["spec"]["parameters"]["best_iteration"] == 7
+    importances = db.read_artifact("xgb_feature_importance",
+                                   project=run.metadata.project)
+    import json
+
+    from mlrun_tpu.datastore import store_manager
+
+    body = store_manager.object(
+        url=importances["spec"]["target_path"]).get()
+    scores = json.loads(body)
+    assert scores["gain"]["f0"] == 1.5 and scores["weight"]["f1"] == 1
+
+
+def test_lightgbm_callback_and_booster(tmp_path):
+    """lightgbm interface without the package: CallbackEnv-style callback
+    + duck-typed booster logging (reference mlrun/frameworks/lgbm/)."""
+    from collections import namedtuple
+
+    Env = namedtuple("CallbackEnv", "iteration evaluation_result_list")
+
+    class FakeBooster:
+        best_iteration = 3
+
+        def feature_name(self):
+            return ["a", "b"]
+
+        def feature_importance(self, importance_type="split"):
+            return [2, 4] if importance_type == "split" else [0.2, 0.8]
+
+        def save_model(self, path):
+            with open(path, "w") as fp:
+                fp.write("tree")
+
+    def handler(context):
+        from mlrun_tpu.frameworks.lightgbm import log_booster, mlrun_callback
+
+        callback = mlrun_callback(context, log_every=1)
+        for i in range(3):
+            callback(Env(iteration=i, evaluation_result_list=[
+                ("valid", "l2", 2.0 / (i + 1), True)]))
+        callback.finalize()
+        log_booster(context, FakeBooster(), model_name="lgbm")
+
+    fn = mlrun_tpu.new_function("lgbt", kind="local", handler=handler)
+    run = fn.run(local=True)
+    assert run.state == "completed", run.status.error
+    assert run.status.results["valid-l2"] == pytest.approx(2.0 / 3)
+    assert "lgbm" in run.status.artifact_uris
+    assert "lgbm_feature_importance" in run.status.artifact_uris
