@@ -35,11 +35,15 @@ def main():
 @click.option("--image", default="", help="container image")
 @click.option("--from-env", is_flag=True,
               help="read run spec from MLT_EXEC_CONFIG (in-pod entrypoint)")
+@click.option("--kfp-output", multiple=True,
+              help="key=path: write run result <key> to <path> after the "
+                   "run (KFP v2 output-parameter contract; paths come "
+                   "from placeholder-substituted args)")
 @click.option("--local", is_flag=True, help="force local in-process run")
 @click.option("--watch", "-w", is_flag=True, default=False)
 @click.argument("run_args", nargs=-1, type=click.UNPROCESSED)
 def run(url, name, project, handler, param, inputs, artifact_path, kind,
-        image, from_env, local, watch, run_args):
+        image, from_env, kfp_output, local, watch, run_args):
     """Execute a function/task (the in-pod contract: `run --from-env`)."""
     from .model import RunTemplate
     from .run import new_function
@@ -90,14 +94,23 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
         template, handler=handler or template.spec.handler_name or None,
         local=from_env or local, watch=watch)
     state = run_result.state
-    # KFP v2 output parameters: the pipeline compiler points each produced
-    # key at the backend's output_file path via MLT_KFP_OUTPUTS (see
-    # projects/pipelines.py compile_kfp_pipeline); write the run results
-    # there so downstream taskOutputParameter inputs resolve
-    outputs_spec = os.environ.get("MLT_KFP_OUTPUTS")
-    if outputs_spec and state != "error":
+    # KFP v2 output parameters: the pipeline compiler passes each produced
+    # key as `--kfp-output key={{$.outputs.parameters[...].output_file}}`
+    # (args, because the KFP launcher substitutes runtime placeholders in
+    # command/args only — env values arrive verbatim); write the run
+    # results there so downstream taskOutputParameter inputs resolve.
+    # MLT_KFP_OUTPUTS stays as a JSON-env fallback for non-KFP callers.
+    output_map = {}
+    env_outputs = os.environ.get("MLT_KFP_OUTPUTS")
+    if env_outputs:
+        output_map.update(json.loads(env_outputs))
+    for item in kfp_output:
+        key, _, path = item.partition("=")
+        if path:
+            output_map[key] = path
+    if output_map and state != "error":
         results = run_result.status.results or {}
-        for key, path in json.loads(outputs_spec).items():
+        for key, path in output_map.items():
             if key not in results:
                 continue
             value = results[key]

@@ -107,10 +107,17 @@ class ArtifactManager:
             item.spec.target_path = item.generate_target_path(
                 artifact_path, producer)
 
+        model_file = getattr(item, "model_file", None)
+        model_dir = getattr(item, "model_dir", None)
         should_upload = upload if upload is not None else (
             item.get_body() is not None
             or (item.spec.src_path
                 and os.path.exists(item.spec.src_path))  # file OR directory
+            # model artifacts carry their payload in model_file/model_dir,
+            # not src_path — without this the model stays a dangling local
+            # path and can never be served from another machine
+            or (model_file and os.path.isfile(model_file))
+            or (model_dir and os.path.isdir(model_dir))
         )
         if should_upload:
             try:

@@ -101,6 +101,9 @@ default_config: dict[str, Any] = {
     "model_monitoring": {
         "window_seconds": 60,
         "store": "sqlite",
+        # metric time-series retention (tsdb.py prune, applied by the
+        # controller on each window pass)
+        "tsdb_retention_days": 30.0,
     },
     "packagers": {"enabled": True},
     "background_tasks": {"default_timeout": 600},

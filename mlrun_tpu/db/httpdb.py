@@ -370,6 +370,29 @@ class HTTPRunDB(RunDBInterface):
                       self._path(project, "model-endpoints", endpoint_id),
                       "delete model endpoint")
 
+    def get_model_endpoint_metrics(self, project, endpoint_id, name="",
+                                   start: float = 0, end=None,
+                                   max_points: int = 1000) -> list[dict]:
+        """Metric time-series for an endpoint (reference: model-endpoint
+        metric-values API over the TSDB layer)."""
+        params = {"name": name, "start": start,
+                  "max_points": max_points}
+        if end is not None:
+            params["end"] = end
+        resp = self.api_call(
+            "GET",
+            self._path(project, "model-endpoints", endpoint_id, "metrics"),
+            "endpoint metrics", params=params)
+        return resp.get("series", [])
+
+    def list_model_endpoint_metric_names(self, project,
+                                         endpoint_id) -> list[str]:
+        resp = self.api_call(
+            "GET",
+            self._path(project, "model-endpoints", endpoint_id, "metrics"),
+            "endpoint metric names", params={"names_only": "true"})
+        return resp.get("metrics", [])
+
     def list_background_tasks(self, project=""):
         resp = self.api_call(
             "GET", self._path(project, "background-tasks"),

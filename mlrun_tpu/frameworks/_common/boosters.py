@@ -19,6 +19,35 @@ def log_importance_artifact(context, model_name: str, scores: dict,
         format="json", labels={"framework": framework})
 
 
+def estimator_importance_scores(estimator) -> dict:
+    """The sklearn-API branch shared by both boosting frameworks:
+    ``feature_importances_`` -> {"importance": {name: value}}."""
+    values = getattr(estimator, "feature_importances_", None)
+    if values is None:
+        return {}
+    names = (getattr(estimator, "feature_names_in_", None)
+             if getattr(estimator, "feature_names_in_", None) is not None
+             else getattr(estimator, "feature_name_", None))
+    if names is None:
+        names = [f"f{i}" for i in range(len(values))]
+    return {"importance": {str(n): float(v)
+                           for n, v in zip(names, values)}}
+
+
+def wrap_post_fit(handler, importance_fn):
+    """Chain a framework-specific importance artifact onto the sklearn
+    handler's post-fit hook (shared by the xgboost/lightgbm
+    ``apply_mlrun`` wrappers)."""
+    post_fit = handler._post_fit
+
+    def wrapped(fit_args, fit_kwargs):
+        post_fit(fit_args, fit_kwargs)
+        importance_fn(handler.context, handler.model, handler.model_name)
+
+    handler._post_fit = wrapped
+    return handler
+
+
 def log_booster_model(context, booster, framework: str, suffix: str,
                       model_name: str = "model", tag: str = "",
                       metrics: dict | None = None,

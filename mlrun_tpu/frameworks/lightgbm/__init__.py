@@ -15,7 +15,12 @@ only ``apply_mlrun`` on a real estimator requires the import.
 
 from __future__ import annotations
 
-from .._common.boosters import log_booster_model, log_importance_artifact
+from .._common.boosters import (
+    estimator_importance_scores,
+    log_booster_model,
+    log_importance_artifact,
+    wrap_post_fit,
+)
 
 
 def _importance_artifact(context, booster, model_name: str) -> dict:
@@ -24,13 +29,7 @@ def _importance_artifact(context, booster, model_name: str) -> dict:
     scores: dict = {}
     importance = getattr(booster, "feature_importance", None)
     if importance is None:  # sklearn-API estimator
-        values = getattr(booster, "feature_importances_", None)
-        if values is None:
-            return {}
-        names = getattr(booster, "feature_name_",
-                        [f"f{i}" for i in range(len(values))])
-        scores = {"importance": {str(n): float(v)
-                                 for n, v in zip(names, values)}}
+        scores = estimator_importance_scores(booster)
     else:
         names = (booster.feature_name()
                  if callable(getattr(booster, "feature_name", None))
@@ -95,15 +94,7 @@ def apply_mlrun(model=None, context=None, model_name: str = "model",
 
     handler = sklearn_apply(model=model, context=context,
                             model_name=model_name, tag=tag, **kwargs)
-    post_fit = handler._post_fit
-
-    def lgbm_post_fit(fit_args, fit_kwargs):
-        post_fit(fit_args, fit_kwargs)
-        _importance_artifact(handler.context, handler.model,
-                             handler.model_name)
-
-    handler._post_fit = lgbm_post_fit
-    return handler
+    return wrap_post_fit(handler, _importance_artifact)
 
 
 def LGBMModelServer(*args, **kwargs):

@@ -18,7 +18,12 @@ estimator requires the import.
 
 from __future__ import annotations
 
-from .._common.boosters import log_booster_model, log_importance_artifact
+from .._common.boosters import (
+    estimator_importance_scores,
+    log_booster_model,
+    log_importance_artifact,
+    wrap_post_fit,
+)
 
 try:  # real xgboost requires callbacks to subclass TrainingCallback
     from xgboost.callback import TrainingCallback as _CallbackBase
@@ -34,13 +39,7 @@ def _importance_artifact(context, booster, model_name: str) -> dict:
     scores: dict = {}
     get_score = getattr(booster, "get_score", None)
     if get_score is None:  # sklearn-API estimator
-        values = getattr(booster, "feature_importances_", None)
-        if values is None:
-            return {}
-        names = getattr(booster, "feature_names_in_",
-                        [f"f{i}" for i in range(len(values))])
-        scores = {"importance": {str(n): float(v)
-                                 for n, v in zip(names, values)}}
+        scores = estimator_importance_scores(booster)
     else:
         for importance_type in ("gain", "weight"):
             try:
@@ -111,15 +110,7 @@ def apply_mlrun(model=None, context=None, model_name: str = "model",
 
     handler = sklearn_apply(model=model, context=context,
                             model_name=model_name, tag=tag, **kwargs)
-    post_fit = handler._post_fit
-
-    def xgb_post_fit(fit_args, fit_kwargs):
-        post_fit(fit_args, fit_kwargs)
-        _importance_artifact(handler.context, handler.model,
-                             handler.model_name)
-
-    handler._post_fit = xgb_post_fit
-    return handler
+    return wrap_post_fit(handler, _importance_artifact)
 
 
 def XGBoostModelServer(*args, **kwargs):

@@ -63,6 +63,16 @@ class ModelMonitoringWriter:
         record["drift_status"] = drift_status
         record["last_analyzed"] = now_iso()
         self.db.store_model_endpoint(self.project, endpoint_id, record)
+        # append every numeric result to the metric time-series so drift /
+        # latency history is queryable with time ranges (tsdb.py)
+        try:
+            from .tsdb import get_metrics_tsdb
+
+            get_metrics_tsdb().write(
+                self.project, endpoint_id,
+                {r.name: r.value for r in results})
+        except Exception:  # noqa: BLE001 - series write is best-effort
+            pass
 
 
 class MonitoringApplicationController:
@@ -107,6 +117,17 @@ class MonitoringApplicationController:
     def run_once(self) -> dict:
         """Drain stream → window per endpoint → run apps → write results."""
         self.processor.run_once()
+        # apply series retention each pass so metrics.db stays bounded
+        try:
+            from ..config import mlconf
+            from .tsdb import get_metrics_tsdb
+
+            retention_days = float(
+                mlconf.model_monitoring.tsdb_retention_days)
+            if retention_days > 0:
+                get_metrics_tsdb().prune(retention_days * 86400.0)
+        except Exception:  # noqa: BLE001 - retention is best-effort
+            pass
         results_by_endpoint: dict[str, list] = {}
         parquet_dir = get_monitoring_parquet_dir(self.project)
         if not os.path.isdir(parquet_dir):

@@ -343,20 +343,22 @@ def compile_kfp_pipeline(project, workflow_spec=None, name: str = "",
 
         env = _step_exec_env(step, context.artifact_path,
                              params=static_params, inputs=static_inputs)
-        if produced.get(id(step)):
-            # tell the in-pod contract where the backend collects each
-            # output parameter (__main__.py writes run results there)
-            import json as jsonlib
-
-            env.append({"name": "MLT_KFP_OUTPUTS", "value": jsonlib.dumps({
-                key: (f"{{{{$.outputs.parameters['{key}']"
-                      f".output_file}}}}")
-                for key in sorted(produced[id(step)])})})
-        executors[f"exec-{task_name}"] = {"container": {
+        # output-parameter paths ride in ARGS, not env: the KFP launcher
+        # substitutes {{$...}} runtime placeholders only in command/args
+        # (__main__.py --kfp-output writes run results to those paths)
+        out_args = []
+        for key in sorted(produced.get(id(step), ())):
+            out_args += ["--kfp-output",
+                         f"{key}={{{{$.outputs.parameters['{key}']"
+                         f".output_file}}}}"]
+        container = {
             "image": step.function.full_image_path(),
             "command": ["mlrun-tpu", "run", "--from-env"],
             "env": env,
-        }}
+        }
+        if out_args:
+            container["args"] = out_args
+        executors[f"exec-{task_name}"] = {"container": container}
         component: dict = {"executorLabel": f"exec-{task_name}"}
         if task_inputs:
             component["inputDefinitions"] = {"parameters": {

@@ -574,6 +574,29 @@ def build_app(state: ServiceState | None = None) -> web.Application:
                                        request.match_info["uid"])
         return json_response({"ok": True})
 
+    @r.get(API + "/projects/{project}/model-endpoints/{uid}/metrics")
+    async def endpoint_metrics(request):
+        """Metric time-series with time-range + downsampling (reference:
+        model-endpoint metric values API over the TSDB layer)."""
+        from ..model_monitoring.tsdb import get_metrics_tsdb
+
+        q = request.query
+        try:
+            start = float(q.get("start", 0) or 0)
+            end = float(q["end"]) if q.get("end") else None
+            max_points = int(q.get("max_points", 1000))
+        except ValueError:
+            return error_response("bad time range", 400)
+        tsdb = get_metrics_tsdb()
+        project = request.match_info["project"]
+        uid = request.match_info["uid"]
+        if q.get("names_only") in ("true", "1"):
+            return json_response(
+                {"metrics": tsdb.list_metrics(project, uid)})
+        return json_response({"series": tsdb.query(
+            project, uid, metric=q.get("name", ""), start=start, end=end,
+            max_points=max_points)})
+
     # -- alerts / events -------------------------------------------------------------------
     @r.post(API + "/projects/{project}/alerts/{name}")
     async def store_alert(request):

@@ -96,9 +96,13 @@ def test_from_env_writes_kfp_output_parameters(tmp_path, cli_home):
     env = dict(cli_home)
     env["MLT_EXEC_CONFIG"] = json.dumps(config)
     env["MLT_EXEC_CODE"] = base64.b64encode(code.encode()).decode()
-    env["MLT_KFP_OUTPUTS"] = json.dumps(
-        {"r": str(out_r), "s": str(out_s), "missing": str(tmp_path / "m")})
-    out = _cli(["run", "--from-env"], env, cwd=str(tmp_path))
+    # args contract (what the KFP compiler emits — placeholders arrive
+    # substituted by the backend) + env fallback for non-KFP callers
+    env["MLT_KFP_OUTPUTS"] = json.dumps({"s": str(out_s)})
+    out = _cli(["run", "--from-env",
+                "--kfp-output", f"r={out_r}",
+                "--kfp-output", f"missing={tmp_path / 'm'}"],
+               env, cwd=str(tmp_path))
     assert out.returncode == 0, out.stderr
     assert out_r.read_text() == "7"
     assert out_s.read_text() == "text"          # strings written verbatim

@@ -1,0 +1,115 @@
+"""Systematic concurrency checks (SURVEY §5.2: the service's safety story
+is asyncio + DB locking — exercise it under real parallel clients).
+
+The reference relies on SQLAlchemy session locking; here the embedded
+SQLite (WAL) + aiohttp stack must survive parallel mutations from many
+client threads without losing writes or corrupting rows.
+"""
+
+import threading
+
+import pytest
+
+
+N_THREADS = 8
+N_OPS = 12
+
+
+def test_parallel_run_mutations(http_db):
+    """Parallel store/update/read across threads: every write lands, no
+    cross-row corruption, final states consistent."""
+    errors = []
+
+    def worker(idx: int):
+        try:
+            for op in range(N_OPS):
+                uid = f"c{idx}-{op}"
+                http_db.store_run(
+                    {"metadata": {"uid": uid, "name": f"run-{idx}",
+                                  "project": "conc"},
+                     "status": {"state": "running"}}, uid, "conc")
+                http_db.update_run(
+                    {"status.state": "completed",
+                     "status.results": {"thread": idx, "op": op}},
+                    uid, "conc")
+                fetched = http_db.read_run(uid, "conc")
+                assert fetched["status"]["results"]["thread"] == idx
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"thread {idx}: {exc!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    runs = http_db.list_runs(project="conc")
+    assert len(runs) == N_THREADS * N_OPS
+    assert all(r["status"]["state"] == "completed" for r in runs)
+
+
+def test_parallel_artifact_versions(http_db):
+    """Concurrent writers to the SAME artifact key: one winner per tag,
+    every version retained."""
+    barrier = threading.Barrier(N_THREADS)
+    errors = []
+
+    def worker(idx: int):
+        try:
+            barrier.wait(timeout=30)
+            http_db.store_artifact(
+                "shared", {"kind": "dataset",
+                           "metadata": {"key": "shared"},
+                           "spec": {"target_path": f"/tmp/v{idx}"}},
+                project="conc2", tag="latest")
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    latest = http_db.read_artifact("shared", project="conc2")
+    assert latest["spec"]["target_path"].startswith("/tmp/v")
+
+
+def test_parallel_schedule_and_secret_mutations(http_db):
+    """Mixed mutation types (schedules + project secrets) racing in
+    parallel stay individually consistent."""
+    errors = []
+
+    def schedules(idx: int):
+        try:
+            for op in range(4):
+                http_db.store_schedule(
+                    "conc3", f"s-{idx}-{op}",
+                    {"kind": "job", "name": f"s-{idx}-{op}",
+                     "cron_trigger": "*/10 * * * *"})
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    def secrets(idx: int):
+        try:
+            for op in range(4):
+                http_db.create_project_secrets(
+                    "conc3", {f"K{idx}_{op}": f"v{idx}{op}"})
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = ([threading.Thread(target=schedules, args=(i,))
+                for i in range(4)]
+               + [threading.Thread(target=secrets, args=(i,))
+                  for i in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    names = {s["name"] for s in http_db.list_schedules("conc3")}
+    assert len(names) == 16
+    keys = set(http_db.list_project_secret_keys("conc3"))
+    assert len(keys) == 16

@@ -385,6 +385,14 @@ def test_xgboost_booster_logging(tmp_path):
     db = mlrun_tpu.db.get_run_db()
     model = db.read_artifact("xgb", project=run.metadata.project)
     assert model["spec"]["parameters"]["best_iteration"] == 7
+    # the temp save file is deleted after logging — loading through the
+    # store uri proves the model payload was actually uploaded
+    from mlrun_tpu.artifacts.model import get_model
+
+    local, spec, _ = get_model(run.status.artifact_uris["xgb"])
+    with open(local) as fp:
+        assert fp.read() == "{}"
+    assert spec.model_file == local.split("/")[-1]
     importances = db.read_artifact("xgb_feature_importance",
                                    project=run.metadata.project)
     import json

@@ -163,3 +163,61 @@ def test_snowflake_source_gated(monkeypatch):
     monkeypatch.setitem(sys.modules, "snowflake.connector", None)
     with _pytest.raises(ImportError):
         source.to_dataframe()
+
+
+def test_hub_batch_inference_end_to_end(tmp_path):
+    """hub://batch_inference: pickle model + csv in, prediction set +
+    accuracy out."""
+    import pickle
+
+    import numpy as np
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(80, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    model = LogisticRegression().fit(X, y)
+    model_path = tmp_path / "model.pkl"
+    model_path.write_bytes(pickle.dumps(model))
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    df["label"] = y
+    data_path = tmp_path / "data.csv"
+    df.to_csv(data_path, index=False)
+
+    fn = mlrun_tpu.import_function("hub://batch_inference")
+    run = fn.run(local=True,
+                 inputs={"dataset": str(data_path)},
+                 params={"model_path": str(model_path),
+                         "label_column": "label"})
+    assert run.state == "completed", run.status.error
+    assert run.status.results["prediction_count"] == 80
+    assert run.status.results["accuracy"] > 0.9
+    assert "prediction_set" in run.status.artifact_uris
+
+
+def test_hub_describe_end_to_end(tmp_path):
+    """hub://describe: stats + histograms + label balance artifacts."""
+    import numpy as np
+    import pandas as pd
+
+    df = pd.DataFrame({"x": np.arange(50, dtype=float),
+                       "cat": (["a"] * 30 + ["b"] * 20)})
+    path = tmp_path / "d.csv"
+    df.to_csv(path, index=False)
+    fn = mlrun_tpu.import_function("hub://describe")
+    run = fn.run(local=True, inputs={"dataset": str(path)},
+                 params={"label_column": "cat", "bins": 5})
+    assert run.state == "completed", run.status.error
+    assert run.status.results["rows"] == 50
+    for key in ("summary_stats", "histograms", "label_balance"):
+        assert key in run.status.artifact_uris
+    import json
+
+    from mlrun_tpu.datastore import store_manager
+
+    db = mlrun_tpu.db.get_run_db()
+    art = db.read_artifact("histograms", project=run.metadata.project)
+    body = store_manager.object(url=art["spec"]["target_path"]).get()
+    hist = json.loads(body)
+    assert sum(hist["x"]["counts"]) == 50

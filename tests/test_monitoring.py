@@ -228,3 +228,52 @@ def test_drift_app_uses_sketches_when_window_not_materialized():
     drift = next(r for r in results if r.name == "data_drift_score")
     assert drift.status == "detected"
     assert "f0" in drift.extra["per_feature"]
+
+
+def test_metrics_tsdb_roundtrip(tmp_path):
+    """TSDB unit behavior: write/query with ranges, names, downsampling,
+    retention (reference: model_monitoring/db/tsdb)."""
+    from mlrun_tpu.model_monitoring.tsdb import MetricsTSDB
+
+    tsdb = MetricsTSDB(str(tmp_path / "m.db"))
+    for i in range(10):
+        tsdb.write("p", "ep1", {"drift": i / 10, "latency": 100 + i},
+                   ts=1000.0 + i)
+    tsdb.write("p", "ep2", {"drift": 0.9}, ts=1005.0)
+
+    series = tsdb.query("p", "ep1", metric="drift")
+    assert len(series) == 1 and len(series[0]["points"]) == 10
+    assert series[0]["points"][0]["value"] == 0.0
+    # time-range slicing
+    windowed = tsdb.query("p", "ep1", metric="drift", start=1003, end=1006)
+    assert [pt["ts"] for pt in windowed[0]["points"]] == [1003, 1004,
+                                                          1005, 1006]
+    # both metrics, names listing, endpoint isolation
+    assert {s["metric"] for s in tsdb.query("p", "ep1")} == {
+        "drift", "latency"}
+    assert tsdb.list_metrics("p", "ep1") == ["drift", "latency"]
+    assert tsdb.list_metrics("p", "ep2") == ["drift"]
+    # downsampling caps the returned points
+    capped = tsdb.query("p", "ep1", metric="drift", max_points=5)
+    assert len(capped[0]["points"]) <= 6
+    # retention prune drops everything (samples are old)
+    tsdb.prune(older_than_s=1.0)
+    assert tsdb.query("p", "ep1") == []
+    tsdb.close()
+
+
+def test_controller_writes_metric_series_and_rest_surface():
+    """Controller windows append to the TSDB; series come back over the
+    /model-endpoints/{uid}/metrics REST surface."""
+    import mlrun_tpu
+    from mlrun_tpu.model_monitoring import MonitoringApplicationController
+    from mlrun_tpu.model_monitoring.tsdb import get_metrics_tsdb
+
+    _serve_and_process(n_ok=4, n_err=0)
+    controller = MonitoringApplicationController("monproj")
+    results = controller.run_once()
+    assert results
+    endpoint_id = next(iter(results))
+    series = get_metrics_tsdb().query("monproj", endpoint_id)
+    names = {s["metric"] for s in series}
+    assert "latency_p50_microsec" in names

@@ -117,11 +117,10 @@ def test_kfp_compile_without_kfp(tmp_path):
         "parameters": {"r": {"parameterType": "STRING"}}}
     assert spec["components"]["comp-stepa"]["executorLabel"] == "exec-stepa"
     # the producer's container is told where the backend collects each
-    # output parameter (the in-pod contract writes results there)
-    kfp_outputs = {item["name"]: item["value"]
-                   for item in exec_a["env"]}["MLT_KFP_OUTPUTS"]
-    assert json.loads(kfp_outputs) == {
-        "r": "{{$.outputs.parameters['r'].output_file}}"}
+    # output parameter via ARGS (KFP substitutes {{$...}} placeholders in
+    # command/args only); the in-pod contract writes results there
+    assert exec_a["args"] == [
+        "--kfp-output", "r={{$.outputs.parameters['r'].output_file}}"]
 
 
 def test_kfp_compile_duplicate_names(tmp_path):

@@ -454,3 +454,18 @@ def test_pipelines_endpoints(service, http_db):
 
     with pytest.raises(RunDBError):
         http_db.get_pipeline("missing")
+
+
+def test_endpoint_metrics_rest(service, http_db):
+    """Time-series metrics REST surface over the monitoring TSDB."""
+    from mlrun_tpu.model_monitoring.tsdb import get_metrics_tsdb
+
+    tsdb = get_metrics_tsdb()
+    for i in range(5):
+        tsdb.write("pm", "epX", {"drift": 0.1 * i}, ts=2000.0 + i)
+    assert http_db.list_model_endpoint_metric_names("pm", "epX") == [
+        "drift"]
+    series = http_db.get_model_endpoint_metrics(
+        "pm", "epX", name="drift", start=2001, end=2003)
+    assert [pt["value"] for pt in series[0]["points"]] == pytest.approx(
+        [0.1, 0.2, 0.3])
