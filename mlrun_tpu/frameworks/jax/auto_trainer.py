@@ -186,12 +186,23 @@ def train(context: MLClientCtx | None = None,
         callbacks.append(ckpt_cb)
 
     interface = apply_mlrun(context=context, model_name=model_name)
+    # SIGTERM (spot-slice eviction) → final checkpoint + clean resumable
+    # exit instead of a killed run (training/preemption.py)
+    from ...training.preemption import PreemptionGuard
+
+    guard = PreemptionGuard().install()
     start = time.perf_counter()
-    final_metrics = trainer.fit(stream, steps=steps, context=context,
-                                log_every=log_every, callbacks=callbacks)
+    try:
+        final_metrics = trainer.fit(
+            stream, steps=steps, context=context, log_every=log_every,
+            callbacks=callbacks, checkpoint_manager=manager,
+            preemption_guard=guard)
+    finally:
+        guard.restore()
     elapsed = time.perf_counter() - start
 
-    final_metrics = {k: float(v) for k, v in final_metrics.items()}
+    final_metrics = {k: (v if isinstance(v, bool) else float(v))
+                     for k, v in final_metrics.items()}
     final_metrics["train_time_s"] = elapsed
     if context is not None:
         context.log_results(final_metrics)

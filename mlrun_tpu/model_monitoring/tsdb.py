@@ -23,6 +23,8 @@ CREATE TABLE IF NOT EXISTS endpoint_metrics (
 );
 CREATE INDEX IF NOT EXISTS idx_endpoint_metrics
     ON endpoint_metrics (project, endpoint, metric, ts);
+CREATE INDEX IF NOT EXISTS idx_endpoint_metrics_ts
+    ON endpoint_metrics (ts);
 """
 
 
@@ -78,9 +80,12 @@ class MetricsTSDB:
         max_points = max(1, int(max_points))
         for name, points in series.items():
             stride = max(1, -(-len(points) // max_points))  # ceil div
+            # stride from the END so the newest sample always survives
+            # downsampling (dashboards care about the latest value most)
+            kept = points[::-stride][::-1]
             out.append({"metric": name,
                         "points": [{"ts": ts, "value": value}
-                                   for ts, value in points[::stride]]})
+                                   for ts, value in kept]})
         return out
 
     def list_metrics(self, project: str, endpoint: str) -> list[str]:
@@ -116,5 +121,7 @@ def get_metrics_tsdb() -> MetricsTSDB:
     path = os.path.join(mlconf.home_dir, "monitoring", "metrics.db")
     with _default_lock:
         if _default is None or _default.path != path:
+            if _default is not None:
+                _default.close()
             _default = MetricsTSDB(path)
         return _default

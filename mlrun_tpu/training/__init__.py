@@ -8,6 +8,7 @@ from .data import (  # noqa: F401
     text_file_stream,
 )
 from .mfu import chip_peak_flops, mfu  # noqa: F401
+from .preemption import PreemptionGuard  # noqa: F401
 from .train import (  # noqa: F401
     TrainConfig,
     Trainer,

@@ -31,9 +31,18 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         import orbax.checkpoint as ocp
+        from orbax.checkpoint.checkpoint_manager import (
+            StepAlreadyExistsError,
+        )
 
-        saved = self._manager.save(
-            step, args=ocp.args.StandardSave(_to_pytree(state)), force=force)
+        try:
+            saved = self._manager.save(
+                step, args=ocp.args.StandardSave(_to_pytree(state)),
+                force=force)
+        except StepAlreadyExistsError:
+            # a forced save (e.g. the preemption path) can race a periodic
+            # save of the same step — the step being on disk IS success
+            return True
         return bool(saved)
 
     def restore(self, state_like: Any, step: int | None = None) -> Any:

@@ -348,14 +348,32 @@ class Trainer:
         return metrics
 
     def fit(self, data_iter, steps: int, context=None,
-            log_every: int = 10, callbacks: list | None = None) -> dict:
-        """Run the training loop; logs metrics to the run context rank-0-only."""
+            log_every: int = 10, callbacks: list | None = None,
+            checkpoint_manager=None, preemption_guard=None) -> dict:
+        """Run the training loop; logs metrics to the run context
+        rank-0-only. With ``preemption_guard`` + ``checkpoint_manager``, a
+        SIGTERM (TPU slice eviction) triggers one final synchronous
+        checkpoint and a clean early return with ``preempted: True`` — the
+        JobSet restart then resumes from that step (training/preemption.py)."""
         assert self.state is not None, "call init() first"
         t_start = time.perf_counter()
         tokens_seen = 0
         seq_len = None
         last = {}
         for step in range(steps):
+            if preemption_guard is not None and preemption_guard.requested:
+                logger.warning("preempted — checkpointing before exit",
+                               step=int(self.state.step))
+                if checkpoint_manager is not None:
+                    checkpoint_manager.save(int(self.state.step),
+                                            self.state, force=True)
+                    checkpoint_manager.wait()
+                last = dict(last)
+                last["preempted"] = True
+                last["step"] = int(self.state.step)
+                if context is not None:
+                    context.log_result("preempted", True)
+                return last
             tokens, targets = next(data_iter)
             seq_len = tokens.shape[1]
             metrics = self.train_step(tokens, targets)
