@@ -1,0 +1,12 @@
+#!/bin/bash
+# Build the framework images (reference analog: Makefile docker targets).
+# Usage: automation/build_images.sh [registry-prefix] [tag]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+REGISTRY=${1:-mlrun-tpu}
+TAG=${2:-$(python -c "import mlrun_tpu; print(mlrun_tpu.__version__)")}
+for image in base api tpu; do
+  docker build -t "${REGISTRY}/mlrun-tpu-${image}:${TAG}" \
+    -f "dockerfiles/${image}/Dockerfile" .
+  echo "built ${REGISTRY}/mlrun-tpu-${image}:${TAG}"
+done

@@ -1,0 +1,80 @@
+"""Release tooling (reference analog: automation/ — version bump,
+changelog generation, and a test gate, reduced to what this repo needs).
+
+Usage:
+    python automation/release.py bump 0.2.0          # rewrite versions
+    python automation/release.py changelog [since]   # markdown changelog
+    python automation/release.py check               # test gate
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+VERSION_FILES = {
+    REPO / "mlrun_tpu" / "__init__.py":
+        (r'__version__ = "[^"]+"', '__version__ = "{v}"'),
+    REPO / "setup.py": (r'version="[^"]+"', 'version="{v}"'),
+}
+VERSION_RE = re.compile(r"^\d+\.\d+\.\d+(?:[.-]?(?:rc|a|b|dev)\d*)?$")
+
+
+def current_version() -> str:
+    text = (REPO / "mlrun_tpu" / "__init__.py").read_text()
+    return re.search(r'__version__ = "([^"]+)"', text).group(1)
+
+
+def bump(version: str):
+    if not VERSION_RE.match(version):
+        raise SystemExit(f"not a valid version: {version!r}")
+    for path, (pattern, replacement) in VERSION_FILES.items():
+        text = path.read_text()
+        updated, n = re.subn(pattern, replacement.format(v=version), text)
+        if not n:
+            raise SystemExit(f"version pattern not found in {path}")
+        path.write_text(updated)
+        print(f"bumped {path.relative_to(REPO)}")
+    print(f"version: {current_version()}")
+
+
+def changelog(since: str = "") -> str:
+    """Markdown changelog from commit subjects since a ref (or all)."""
+    rev = f"{since}..HEAD" if since else "HEAD"
+    out = subprocess.run(
+        ["git", "log", "--no-merges", "--pretty=%h %s", rev],
+        cwd=REPO, capture_output=True, text=True, check=True).stdout
+    lines = [f"- {line}" for line in out.strip().splitlines()]
+    body = "\n".join([f"## {current_version()}", ""] + lines) + "\n"
+    print(body)
+    return body
+
+
+def check():
+    """Release gate: full test suite must be green."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q"], cwd=REPO)
+    if proc.returncode:
+        raise SystemExit("release gate FAILED: tests not green")
+    print("release gate OK")
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    command = sys.argv[1]
+    if command == "bump":
+        bump(sys.argv[2])
+    elif command == "changelog":
+        changelog(sys.argv[2] if len(sys.argv) > 2 else "")
+    elif command == "check":
+        check()
+    else:
+        raise SystemExit(f"unknown command {command!r}\n{__doc__}")
+
+
+if __name__ == "__main__":
+    main()

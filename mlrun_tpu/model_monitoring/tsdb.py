@@ -121,7 +121,8 @@ def get_metrics_tsdb() -> MetricsTSDB:
     path = os.path.join(mlconf.home_dir, "monitoring", "metrics.db")
     with _default_lock:
         if _default is None or _default.path != path:
-            if _default is not None:
-                _default.close()
+            # do NOT close the retired instance: other threads may still
+            # hold it (service handlers vs controller); sqlite connections
+            # close on GC once the last caller drops its reference
             _default = MetricsTSDB(path)
         return _default

@@ -61,11 +61,18 @@ class PreemptionGuard:
 
     # -- signal path -------------------------------------------------------
     def _handle(self, signum, frame):
-        logger.warning("preemption signal received", signal=int(signum))
+        # nothing but the event set may happen here: logging can hit
+        # CPython's buffered-IO reentrancy guard if the signal lands
+        # mid-write, and chaining an exiting previous handler would kill
+        # the process before the graceful checkpoint runs. The FIRST
+        # signal only latches; a SECOND signal escalates to the previous
+        # handler (supervisor semantics preserved for hard kills).
+        if self._event.is_set():
+            previous = self._previous.get(signum)
+            if callable(previous):
+                previous(signum, frame)
+            return
         self._event.set()
-        previous = self._previous.get(signum)
-        if callable(previous):
-            previous(signum, frame)
 
     def request(self):
         """Programmatic preemption (tests / external watchers)."""
@@ -74,3 +81,23 @@ class PreemptionGuard:
     @property
     def requested(self) -> bool:
         return self._event.is_set()
+
+    def agreed(self) -> bool:
+        """Cross-host agreement on the preemption latch.
+
+        SIGTERM lands on pod-slice hosts at slightly different times; if
+        one host stops stepping while another still runs the train-step
+        collectives, the slice deadlocks until SIGKILL. Under multi-host
+        JAX this reduces the local flag across processes (max), so every
+        host flips in the same step. Single-process: just the local flag.
+        """
+        import jax
+
+        if jax.process_count() <= 1:
+            return self._event.is_set()
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._event.is_set(), np.int32))
+        return bool(np.max(flags))

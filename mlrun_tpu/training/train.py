@@ -361,7 +361,9 @@ class Trainer:
         seq_len = None
         last = {}
         for step in range(steps):
-            if preemption_guard is not None and preemption_guard.requested:
+            # agreed() (not .requested): all hosts must latch in the SAME
+            # step or the ones still stepping deadlock the slice collectives
+            if preemption_guard is not None and preemption_guard.agreed():
                 logger.warning("preempted — checkpointing before exit",
                                step=int(self.state.step))
                 if checkpoint_manager is not None:

@@ -83,7 +83,8 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
 
 
 def test_preemption_guard_sigterm_real():
-    """A real SIGTERM latches the guard and chains to prior handlers."""
+    """First real SIGTERM only latches (so an exiting prior handler can't
+    kill the run before the checkpoint); a second escalates to it."""
     import os
     import signal
 
@@ -97,6 +98,9 @@ def test_preemption_guard_sigterm_real():
             assert not guard.requested
             os.kill(os.getpid(), signal.SIGTERM)
             assert guard.requested
-            assert chained == [signal.SIGTERM]  # prior handler still ran
+            assert chained == []                 # deferred, not chained
+            assert guard.agreed()                # single-process agreement
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert chained == [signal.SIGTERM]   # escalation on 2nd signal
     finally:
         signal.signal(signal.SIGTERM, previous)
