@@ -84,8 +84,12 @@ def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
 
 def _forward_with_cache(config: LlamaConfig, params: Params,
                         tokens: jax.Array, cache: dict,
-                        lora: Optional[Params] = None):
-    """Run tokens starting at cache['pos']; returns (logits_last, new_cache)."""
+                        lora: Optional[Params] = None,
+                        all_logits: bool = False):
+    """Run tokens starting at cache['pos']; returns (logits_last, new_cache).
+    ``all_logits=True`` returns [B, S, vocab] logits for every input
+    position instead of just the last (speculative verification needs the
+    target's distribution after each proposed token — serving/speculative.py)."""
     b, s = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["pos"]  # [B]
@@ -162,8 +166,8 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
-    logits = jnp.einsum("bse,ev->bsv", x[:, -1:], head,
-                        preferred_element_type=jnp.float32)
+    logits = jnp.einsum("bse,ev->bsv", x if all_logits else x[:, -1:],
+                        head, preferred_element_type=jnp.float32)
     new_cache = {
         "k": jnp.stack(new_k),
         "v": jnp.stack(new_v),
@@ -172,7 +176,7 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
     if new_ks:
         new_cache["k_scale"] = jnp.stack(new_ks)
         new_cache["v_scale"] = jnp.stack(new_vs)
-    return logits[:, 0], new_cache
+    return (logits if all_logits else logits[:, 0]), new_cache
 
 
 class LLMEngine:

@@ -1,0 +1,184 @@
+"""Speculative decoding — draft-proposes, target-verifies, EXACT greedy
+output (no reference analog: the reference delegates inference entirely;
+this is TPU-native serving capability beyond parity).
+
+Why it fits TPU: single-token decode is memory-bound (one HBM sweep of
+the weights per token). Verifying k proposed tokens costs ONE target
+forward over k+1 positions — nearly the same HBM traffic as one decode
+step — so each accepted proposal is almost-free throughput. The draft
+model runs k cheap steps; the target amortizes its sweep over the
+accepted prefix plus one bonus token.
+
+Greedy equivalence: proposals are accepted only while they match the
+target's own argmax at that position, and the first mismatch is replaced
+by the target's argmax — so given consistent target logits the emitted
+stream is IDENTICAL to plain greedy decoding of the target model,
+independent of draft quality (draft quality only changes speed via the
+acceptance rate). Caveat shared by every speculative implementation: the
+(k+1)-token verify forward and a 1-token decode forward are different
+compiled programs, so their logits can differ by float rounding (~1e-2
+with bf16 activations); an argmax whose top-2 gap is below that noise can
+tie-break differently. Trained models' confident tokens sit far above it.
+
+KV-cache rollback uses the engine's append-only layout: rejected
+positions simply rewind ``cache['pos']``; stale entries are overwritten
+by the next write before any query can attend to them (writes always
+land at ``pos`` before attention runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..utils import logger
+from .llm import _forward_with_cache, init_kv_cache
+
+Params = dict
+
+
+@dataclasses.dataclass
+class SpecStats:
+    rounds: int = 0
+    proposed: int = 0
+    accepted: int = 0
+    tokens: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.tokens / self.rounds if self.rounds else 0.0
+
+    def to_dict(self) -> dict:
+        return {"rounds": self.rounds, "proposed": self.proposed,
+                "accepted": self.accepted, "tokens": self.tokens,
+                "acceptance_rate": round(self.acceptance_rate, 4),
+                "tokens_per_round": round(self.tokens_per_round, 3),
+                "elapsed_s": round(self.elapsed_s, 4)}
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decoding with a small draft model.
+
+    Both models share the tokenizer/vocab. ``k`` is the static proposal
+    length — every round compiles to one k-step draft loop plus one
+    (k+1)-token target verify, both cached by jit after the first round.
+    """
+
+    def __init__(self, target_config: LlamaConfig, target_params: Params,
+                 draft_config: LlamaConfig, draft_params: Params,
+                 k: int = 4, max_len: int = 2048,
+                 kv_dtype: str = "native"):
+        if target_config.vocab_size != draft_config.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        self.target_config = target_config
+        self.target_params = target_params
+        self.draft_config = draft_config
+        self.draft_params = draft_params
+        self.k = int(k)
+        self.max_len = max_len
+        self.kv_dtype = kv_dtype
+
+        def draft_propose(params, token, cache):
+            """k greedy draft steps; returns ([1, k] proposals, cache)."""
+            def body(carry, _):
+                tok, c = carry
+                logits, c = _forward_with_cache(
+                    self.draft_config, params, tok[:, None], c)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, c), nxt
+
+            (_, cache), proposals = jax.lax.scan(
+                body, (token, cache), None, length=self.k)
+            return proposals.T, cache  # [1, k]
+
+        def target_verify(params, token, proposals, cache):
+            """One (k+1)-token forward; returns per-position argmaxes
+            [1, k+1] (position i = target's next-token after seeing
+            proposal i-1) and the updated cache."""
+            chunk = jnp.concatenate([token[:, None], proposals], axis=1)
+            logits, cache = _forward_with_cache(
+                self.target_config, params, chunk, cache, all_logits=True)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._draft_propose = jax.jit(draft_propose)
+        self._target_verify = jax.jit(target_verify)
+
+    def _prefill(self, params, config, tokens):
+        cache = init_kv_cache(config, 1, self.max_len,
+                              kv_dtype=self.kv_dtype)
+        logits, cache = _forward_with_cache(
+            config, params, jnp.asarray([tokens], jnp.int32), cache)
+        return logits, cache
+
+    def generate(self, prompt_tokens, max_new_tokens: int = 64,
+                 eos_id: Optional[int] = None) -> tuple[list, SpecStats]:
+        """Greedy generation, exactly equal to the target model's own
+        greedy decode; returns (tokens, stats)."""
+        prompt = [int(t) for t in prompt_tokens]
+        if len(prompt) + max_new_tokens + self.k + 1 > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        stats = SpecStats()
+        start = time.perf_counter()
+
+        t_logits, t_cache = self._prefill(
+            self.target_params, self.target_config, prompt)
+        _, d_cache = self._prefill(
+            self.draft_params, self.draft_config, prompt)
+        last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [1]
+        out = [int(last[0])]
+
+        while len(out) < max_new_tokens and (
+                eos_id is None or out[-1] != eos_id):
+            proposals, d_cache = self._draft_propose(
+                self.draft_params, last, d_cache)
+            verified, t_cache = self._target_verify(
+                self.target_params, last, proposals, t_cache)
+            proposals_h = jax.device_get(proposals)[0]
+            verified_h = jax.device_get(verified)[0]
+
+            n_accept = 0
+            while (n_accept < self.k
+                   and proposals_h[n_accept] == verified_h[n_accept]):
+                n_accept += 1
+            if n_accept == self.k:
+                # full acceptance: skip the bonus token — the draft cache
+                # has no entry for p_k, so emitting the bonus would leave
+                # an unwritten hole at p_k's position that later queries
+                # attend as zeros. k tokens this round, still exact.
+                emitted = list(proposals_h)
+            else:
+                emitted = (list(proposals_h[:n_accept])
+                           + [verified_h[n_accept]])
+            if eos_id is not None and eos_id in emitted:
+                emitted = emitted[:emitted.index(eos_id) + 1]
+            room = max_new_tokens - len(out)
+            emitted = emitted[:room]
+            out.extend(int(t) for t in emitted)
+
+            stats.rounds += 1
+            stats.proposed += self.k
+            stats.accepted += n_accept
+
+            # rewind both caches to the committed stream length:
+            # target wrote k+1 entries (last + proposals), draft wrote k
+            committed = len(prompt) + len(out) - 1  # entries BEHIND `last`
+            t_cache = dict(t_cache)
+            d_cache = dict(d_cache)
+            t_cache["pos"] = jnp.full_like(t_cache["pos"], committed)
+            d_cache["pos"] = jnp.full_like(d_cache["pos"], committed)
+            last = jnp.asarray([out[-1]], jnp.int32)
+
+        stats.tokens = len(out)
+        stats.elapsed_s = time.perf_counter() - start
+        logger.debug("speculative decode", **stats.to_dict())
+        return out, stats

@@ -1,0 +1,155 @@
+"""Speculative decoding (serving/speculative.py).
+
+Exactness is tested with a DETERMINISTIC permutation model: all
+transformer weights zero (residual passes the embedding through), untied
+lm_head set to ``scale * E[perm[v]]`` so argmax(next | t) == perm^-1-cycle
+with logit gaps of O(scale * embed_dim) — orders of magnitude above the
+jit-vs-eager float noise that makes random untrained models tie-break
+unstably across differently-shaped compiled forwards (see module
+docstring caveat). This pins down the accept/rollback/bonus bookkeeping
+bit-exactly; draft quality is controlled by how much of the draft's
+permutation agrees with the target's.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlrun_tpu.models import tiny_llama
+from mlrun_tpu.models.llama import init_params
+from mlrun_tpu.serving.llm import _forward_with_cache, init_kv_cache
+from mlrun_tpu.serving.speculative import SpeculativeDecoder
+
+
+def _perm_model(cfg, perm, scale=50.0, seed=0):
+    """Params whose greedy next-token after t is the unique v with
+    perm[v] == t (layers zeroed; head rows huge and well separated)."""
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    params = jax.tree_util.tree_map(jnp.zeros_like, params)
+    e = cfg.embed_dim
+    emb = jax.random.normal(jax.random.PRNGKey(seed + 1),
+                            (cfg.vocab_size, e), jnp.float32)
+    emb = emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+    params["embedding"] = emb.astype(cfg.dtype)
+    # norms must stay identity-ish: rms_norm scales are multiplicative
+    params["layers"]["attn_norm_scale"] = jnp.ones_like(
+        params["layers"]["attn_norm_scale"])
+    params["layers"]["mlp_norm_scale"] = jnp.ones_like(
+        params["layers"]["mlp_norm_scale"])
+    params["final_norm_scale"] = jnp.ones_like(params["final_norm_scale"])
+    # logits[v] = scale * <x, E[perm[v]]>, maximized at perm[v] == t
+    params["lm_head"] = (scale * emb[np.asarray(perm)].T).astype(cfg.dtype)
+    return params
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return dataclasses.replace(
+        tiny_llama(attention_impl="reference"), vocab_size=64,
+        tie_embeddings=False)
+
+
+def _plain_greedy(config, params, prompt, max_new, max_len=256):
+    cache = init_kv_cache(config, 1, max_len)
+    logits, cache = _forward_with_cache(
+        config, params, jnp.asarray([prompt], jnp.int32), cache)
+    out = [int(jnp.argmax(logits, -1)[0])]
+    while len(out) < max_new:
+        logits, cache = _forward_with_cache(
+            config, params, jnp.asarray([[out[-1]]], jnp.int32), cache)
+        out.append(int(jnp.argmax(logits, -1)[0]))
+    return out
+
+
+def _perms(cfg, overlap: float):
+    """Target perm + a draft perm agreeing on ``overlap`` of tokens."""
+    rng = np.random.default_rng(0)
+    target = rng.permutation(cfg.vocab_size)
+    draft = target.copy()
+    n_diff = int(cfg.vocab_size * (1 - overlap))
+    if n_diff >= 2:
+        idx = rng.choice(cfg.vocab_size, size=n_diff, replace=False)
+        draft[idx] = draft[np.roll(idx, 1)]
+    return target, draft
+
+
+def test_exact_parity_partial_draft(cfg):
+    """Draft agrees on ~70% of the permutation: mixed accept/reject
+    rounds, output exactly the target's own greedy stream."""
+    target_perm, draft_perm = _perms(cfg, overlap=0.7)
+    target = _perm_model(cfg, target_perm)
+    draft = _perm_model(cfg, draft_perm, seed=0)
+    prompt = [3, 11, 25]
+    expected = _plain_greedy(cfg, target, prompt, 30)
+    decoder = SpeculativeDecoder(cfg, target, cfg, draft, k=4, max_len=256)
+    out, stats = decoder.generate(prompt, max_new_tokens=30)
+    assert out == expected
+    assert stats.tokens == 30
+    assert 0.0 < stats.acceptance_rate < 1.0  # genuinely mixed rounds
+
+
+def test_exact_parity_perfect_draft(cfg):
+    """Identical permutations: every proposal accepted (full-accept
+    bonus-skip rollback path), output exact."""
+    target_perm, _ = _perms(cfg, overlap=1.0)
+    target = _perm_model(cfg, target_perm)
+    prompt = [7, 2]
+    expected = _plain_greedy(cfg, target, prompt, 20)
+    decoder = SpeculativeDecoder(cfg, target, cfg, target, k=4,
+                                 max_len=256)
+    out, stats = decoder.generate(prompt, max_new_tokens=20)
+    assert out == expected
+    assert stats.acceptance_rate == 1.0
+
+
+def test_exact_parity_useless_draft(cfg):
+    """Fully disjoint draft: every round rejects at position 0 and emits
+    only the target's bonus token — still exact, just slow."""
+    target_perm, _ = _perms(cfg, overlap=1.0)
+    draft_perm = np.roll(target_perm, 7)
+    target = _perm_model(cfg, target_perm)
+    draft = _perm_model(cfg, draft_perm, seed=3)
+    prompt = [5, 9]
+    expected = _plain_greedy(cfg, target, prompt, 16)
+    decoder = SpeculativeDecoder(cfg, target, cfg, draft, k=3, max_len=256)
+    out, stats = decoder.generate(prompt, max_new_tokens=16)
+    assert out == expected
+    assert stats.accepted <= stats.rounds  # near-zero acceptance
+
+
+def test_multiple_k_values_agree(cfg):
+    target_perm, draft_perm = _perms(cfg, overlap=0.6)
+    target = _perm_model(cfg, target_perm)
+    draft = _perm_model(cfg, draft_perm)
+    prompt = [1, 2, 3]
+    outs = []
+    for k in (1, 2, 5):
+        decoder = SpeculativeDecoder(cfg, target, cfg, draft, k=k,
+                                     max_len=256)
+        out, _ = decoder.generate(prompt, max_new_tokens=18)
+        outs.append(out)
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_eos_stops_stream(cfg):
+    target_perm, draft_perm = _perms(cfg, overlap=0.7)
+    target = _perm_model(cfg, target_perm)
+    draft = _perm_model(cfg, draft_perm)
+    prompt = [3, 11, 25]
+    full = _plain_greedy(cfg, target, prompt, 24)
+    eos = full[9]
+    stop = full.index(eos)  # eos may appear earlier in the cycle
+    decoder = SpeculativeDecoder(cfg, target, cfg, draft, k=3, max_len=256)
+    out, _ = decoder.generate(prompt, max_new_tokens=24, eos_id=eos)
+    assert out == full[:stop + 1]
+    assert out[-1] == eos
+
+
+def test_vocab_mismatch_rejected(cfg):
+    target = _perm_model(cfg, np.arange(cfg.vocab_size))
+    bad_cfg = dataclasses.replace(cfg, vocab_size=7)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SpeculativeDecoder(cfg, target, bad_cfg, target)
