@@ -110,13 +110,23 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
             output_map[key] = path
     if output_map and state != "error":
         results = run_result.status.results or {}
+        missing = []
         for key, path in output_map.items():
             if key not in results:
+                missing.append(key)
                 continue
             value = results[key]
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             pathlib.Path(path).write_text(
                 value if isinstance(value, str) else json.dumps(value))
+        if missing:
+            # fail HERE with the unproduced keys named — otherwise the KFP
+            # launcher fails the task later with an opaque "missing output
+            # file" that doesn't point at the handler's actual omission
+            raise click.ClickException(
+                "run finished but did not produce declared output "
+                f"parameter(s) {sorted(missing)}; available results: "
+                f"{sorted(results)}")
     click.echo(f"run {run_result.metadata.uid} finished: {state}")
     if state == "error":
         click.echo(run_result.status.error or "", err=True)

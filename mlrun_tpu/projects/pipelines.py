@@ -326,27 +326,33 @@ def compile_kfp_pipeline(project, workflow_spec=None, name: str = "",
         task_inputs: dict = {}
         static_params: dict = {}
         static_inputs: dict = {}
-        for key, value, bucket in (
-                [(k, v, static_params) for k, v in step.params.items()]
-                + [(k, v, static_inputs) for k, v in step.inputs.items()]):
+        dyn_args: list = []
+        for key, value, bucket, flag in (
+                [(k, v, static_params, "--param")
+                 for k, v in step.params.items()]
+                + [(k, v, static_inputs, "--inputs")
+                   for k, v in step.inputs.items()]):
             if isinstance(value, _StepOutput):
                 producer = task_names[id(value.step)]
                 deps.add(producer)
                 task_inputs[key] = {"taskOutputParameter": {
                     "producerTask": producer,
                     "outputParameterKey": value.key}}
-                # runtime placeholder: the backend substitutes the
-                # produced value into the exec config env
-                bucket[key] = f"{{{{$.inputs.parameters['{key}']}}}}"
+                # dynamic values ride in ARGS: the KFP launcher substitutes
+                # {{$...}} runtime placeholders in command/args only, so an
+                # env-embedded placeholder would arrive verbatim; the
+                # --from-env entrypoint merges --param/--inputs over
+                # MLT_EXEC_CONFIG (__main__.py run)
+                dyn_args += [flag,
+                             f"{key}={{{{$.inputs.parameters['{key}']}}}}"]
             else:
                 bucket[key] = value
 
         env = _step_exec_env(step, context.artifact_path,
                              params=static_params, inputs=static_inputs)
-        # output-parameter paths ride in ARGS, not env: the KFP launcher
-        # substitutes {{$...}} runtime placeholders only in command/args
+        # output-parameter paths ride in ARGS for the same reason
         # (__main__.py --kfp-output writes run results to those paths)
-        out_args = []
+        out_args = list(dyn_args)
         for key in sorted(produced.get(id(step), ())):
             out_args += ["--kfp-output",
                          f"{key}={{{{$.outputs.parameters['{key}']"

@@ -13,6 +13,7 @@ synchronous checkpoint before exiting with a resumable state.
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 
@@ -71,6 +72,13 @@ class PreemptionGuard:
             previous = self._previous.get(signum)
             if callable(previous):
                 previous(signum, frame)
+            elif previous == signal.SIG_DFL:
+                # the saved handler is usually SIG_DFL (an int, not
+                # callable) — restore it and re-raise so the default
+                # terminate semantics actually apply on escalation
+                # instead of silently swallowing every later signal
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
             return
         self._event.set()
 

@@ -129,7 +129,27 @@ class GitNotification(NotificationBase):
         if summary:
             body += "\n\n" + summary
         server = self.params.get("server", "")
-        if self.params.get("gitlab") or "gitlab" in server:
+        # the provider must be explicit for self-hosted servers: inferring
+        # it from the hostname would silently treat a GitLab on a custom
+        # domain as GitHub Enterprise and post the token to a nonexistent
+        # /api/v3 endpoint in a GitHub-style header
+        provider = self.params.get("provider", "")
+        if provider not in ("", "github", "gitlab"):
+            raise ValueError(
+                f"git notification provider must be 'github' or 'gitlab', "
+                f"got {provider!r}")
+        if not provider:
+            if self.params.get("gitlab"):  # legacy param
+                provider = "gitlab"
+            elif not server:
+                provider = "github"  # github.com default
+            elif server in ("gitlab.com", "github.com"):
+                provider = server.split(".")[0]
+            else:
+                raise ValueError(
+                    "git notification to a self-hosted server requires an "
+                    "explicit provider='github'|'gitlab' param")
+        if provider == "gitlab":
             url = (f"https://{server or 'gitlab.com'}/api/v4/projects/"
                    f"{requests.utils.quote(repo, safe='')}/issues/"
                    f"{issue}/notes")

@@ -2,16 +2,23 @@
 # Retry bench.py until the TPU relay comes back, then record the result and
 # follow with the serving TTFT bench. Each attempt relies on bench.py's
 # internal 180s watchdog (no external kill — killing a jax client mid-init
-# can wedge the relay further). Single-instance via a pidfile lock.
-OUT=${1:-/root/repo/BENCH_LOCAL_r2.json}
-SERVING_OUT=${2:-/root/repo/BENCH_SERVING_r2.json}
+# can wedge the relay further). Single-instance via an atomic mkdir lock
+# (check-then-write pidfiles race; mkdir is atomic), released on any exit.
+OUT=${1:-/root/repo/BENCH_LOCAL_r3.json}
+SERVING_OUT=${2:-/root/repo/BENCH_SERVING_r3.json}
 LOG=/tmp/bench_retry.log
-LOCK=/tmp/bench_retry.pid
-if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
-  echo "another retry loop is running (pid $(cat "$LOCK"))" >&2
-  exit 1
+LOCK=/tmp/bench_retry.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  other=$(cat "$LOCK/pid" 2>/dev/null)
+  if [ -n "$other" ] && kill -0 "$other" 2>/dev/null; then
+    echo "another retry loop is running (pid $other)" >&2
+    exit 1
+  fi
+  # stale lock from a dead loop: take it over
+  echo "stale lock (pid ${other:-unknown} gone), taking over" >&2
 fi
-echo $$ > "$LOCK"
+echo $$ > "$LOCK/pid"
+trap 'rm -rf "$LOCK"' EXIT
 for i in $(seq 1 60); do
   echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$LOG"
   python /root/repo/bench.py > /tmp/bench_attempt.out 2>> "$LOG"
@@ -23,12 +30,10 @@ for i in $(seq 1 60); do
     python /root/repo/scripts/bench_serving.py > /tmp/bench_serving.out \
       2>> "$LOG" && cp /tmp/bench_serving.out "$SERVING_OUT" \
       && echo "serving bench recorded" >> "$LOG"
-    rm -f "$LOCK"
     exit 0
   fi
   echo "attempt $i rc=$rc" >> "$LOG"
   sleep 600
 done
 echo "exhausted attempts" >> "$LOG"
-rm -f "$LOCK"
 exit 1

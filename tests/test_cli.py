@@ -100,10 +100,19 @@ def test_from_env_writes_kfp_output_parameters(tmp_path, cli_home):
     # substituted by the backend) + env fallback for non-KFP callers
     env["MLT_KFP_OUTPUTS"] = json.dumps({"s": str(out_s)})
     out = _cli(["run", "--from-env",
-                "--kfp-output", f"r={out_r}",
-                "--kfp-output", f"missing={tmp_path / 'm'}"],
+                "--kfp-output", f"r={out_r}"],
                env, cwd=str(tmp_path))
     assert out.returncode == 0, out.stderr
     assert out_r.read_text() == "7"
     assert out_s.read_text() == "text"          # strings written verbatim
-    assert not (tmp_path / "m").exists()        # unproduced keys skipped
+
+    # a DECLARED output the handler never produced fails loudly with the
+    # key named — otherwise the KFP launcher fails later with an opaque
+    # "missing output file" that doesn't point at the producer
+    out = _cli(["run", "--from-env",
+                "--kfp-output", f"r={out_r}",
+                "--kfp-output", f"missing={tmp_path / 'm'}"],
+               env, cwd=str(tmp_path))
+    assert out.returncode != 0
+    assert "missing" in out.stderr + out.stdout
+    assert not (tmp_path / "m").exists()

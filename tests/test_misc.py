@@ -122,15 +122,32 @@ def test_git_notification(monkeypatch):
         "https://gitlab.com/api/v4/projects/grp%2Fproj/issues/3/notes")
     assert calls[1]["headers"]["PRIVATE-TOKEN"] == "tkn"
 
-    # GitHub Enterprise serves the API under /api/v3 on the instance host
+    # GitHub Enterprise serves the API under /api/v3 on the instance host;
+    # a self-hosted server requires an explicit provider (hostname
+    # inference would misroute a custom-domain GitLab to the GitHub shape)
     GitNotification("done", params={
         "repo": "org/repo", "issue": "9", "token": "tkn",
-        "server": "github.mycompany.com"}).push("ghe done")
+        "provider": "github", "server": "github.mycompany.com"}).push(
+        "ghe done")
     assert calls[2]["url"] == (
         "https://github.mycompany.com/api/v3/repos/org/repo/issues/9/"
         "comments")
 
+    GitNotification("done", params={
+        "repo": "grp/proj", "issue": "4", "token": "tkn",
+        "provider": "gitlab", "server": "git.mycompany.com"}).push(
+        "self-hosted gitlab")
+    assert calls[3]["url"] == (
+        "https://git.mycompany.com/api/v4/projects/grp%2Fproj/issues/4/"
+        "notes")
+    assert calls[3]["headers"]["PRIVATE-TOKEN"] == "tkn"
+
     import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="provider"):
+        GitNotification("x", params={
+            "repo": "o/r", "issue": "1", "token": "t",
+            "server": "git.mycompany.com"}).push("ambiguous server")
 
     with _pytest.raises(ValueError, match="repo"):
         GitNotification("x", params={}).push("no params")

@@ -106,11 +106,14 @@ def test_kfp_compile_without_kfp(tmp_path):
 
     exec_config = json.loads(exec_a["env"][0]["value"])
     assert exec_config["spec"]["parameters"] == {"v": 2}
-    # step-output params become KFP runtime placeholders in the exec
-    # config, backed by input/output parameter definitions
+    # step-output params ride in ARGS (--param merged over MLT_EXEC_CONFIG
+    # by the --from-env entrypoint): KFP substitutes runtime placeholders
+    # in command/args only, so an env-embedded placeholder would arrive
+    # verbatim. The env config keeps static values only.
     exec_b = spec["deploymentSpec"]["executors"]["exec-stepb"]["container"]
-    assert json.loads(exec_b["env"][0]["value"])["spec"]["parameters"] == {
-        "v": "{{$.inputs.parameters['v']}}"}
+    assert json.loads(exec_b["env"][0]["value"])["spec"]["parameters"] == {}
+    assert exec_b["args"] == [
+        "--param", "v={{$.inputs.parameters['v']}}"]
     assert spec["components"]["comp-stepb"]["inputDefinitions"] == {
         "parameters": {"v": {"parameterType": "STRING"}}}
     assert spec["components"]["comp-stepa"]["outputDefinitions"] == {
