@@ -74,6 +74,10 @@ default_config: dict[str, Any] = {
     "function": {
         "default_image": "mlrun-tpu/base:latest",
         "tpu_image": "mlrun-tpu/tpu:latest",
+        # deploy_function blocks up to this long for the gateway to answer
+        # its readiness probe (reference: nuclio deploy polls build/rollout
+        # state the same way)
+        "gateway_ready_timeout": 30.0,
     },
     "tpu": {
         # TPU pod-slice defaults used by the tpujob runtime (replaces the reference's

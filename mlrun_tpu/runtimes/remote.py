@@ -51,7 +51,9 @@ class RemoteRuntime(KubeResource):
         return self
 
     def deploy(self, project: str = "", tag: str = "", verbose: bool = False):
-        """Deploy via the service (reference function.py:551)."""
+        """Deploy via the service and block until the gateway is live
+        (reference function.py:551 — deploy returns an invocable
+        function). Raises on a failed deploy with the gateway log tail."""
         db = self._get_db()
         resp = db.api_call(
             "POST", f"projects/{self.metadata.project or 'default'}/"
@@ -63,8 +65,22 @@ class RemoteRuntime(KubeResource):
         self.status.state = data.get("state", "ready")
         if address:
             self.status.external_invocation_urls = [address]
-        logger.info("function deployed", address=address)
+        if self.status.state == "error":
+            raise RuntimeError(
+                f"function deploy failed: {data.get('error', 'unknown')}")
+        logger.info("function deployed", address=address,
+                    state=self.status.state)
         return address
+
+    def undeploy(self, project: str = ""):
+        """Tear the live gateway down (function status flips offline)."""
+        db = self._get_db()
+        db.api_call(
+            "DELETE", f"projects/{self.metadata.project or 'default'}/"
+            f"functions/{self.metadata.name}/deploy")
+        self.status.address = ""
+        self.status.state = "offline"
+        self.status.external_invocation_urls = []
 
     def invoke(self, path: str = "/", body=None, method: str = "",
                headers: dict | None = None, dashboard: str = "",

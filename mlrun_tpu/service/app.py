@@ -20,7 +20,7 @@ from typing import Optional
 from aiohttp import web
 
 from .. import __version__
-from ..common.runtimes_constants import RunStates
+from ..common.runtimes_constants import RunStates, RuntimeKinds
 from ..config import mlconf
 from ..db.sqlitedb import SQLiteRunDB
 from ..model import RunObject
@@ -75,10 +75,13 @@ def error_response(message: str, status: int = 400):
 
 class ServiceState:
     def __init__(self, db: SQLiteRunDB | None = None, provider=None):
+        from .deployments import DeploymentManager
+
         self.db = db or SQLiteRunDB()
         self.provider = provider or LocalProcessProvider(self.db)
         self.launcher = ServerSideLauncher(self.db, self.provider)
         self.launcher.recover()  # re-adopt resources from before a restart
+        self.deployments = DeploymentManager(self.db, self.provider)
         from .projects_sync import ProjectsFollower
 
         self.projects_follower = ProjectsFollower(self.db)
@@ -303,24 +306,54 @@ def build_app(state: ServiceState | None = None) -> web.Application:
 
     @r.delete(API + "/projects/{project}/functions/{name}")
     async def delete_function(request):
+        # a live gateway dies with its function
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            None, lambda: state.deployments.teardown(
+                request.match_info["name"], request.match_info["project"],
+                store_state=False))
         state.db.delete_function(request.match_info["name"],
                                  request.match_info["project"])
         return json_response({"ok": True})
 
     @r.post(API + "/projects/{project}/functions/{name}/deploy")
     async def deploy_function(request):
-        # Nuclio replaced: deploys of serving/remote kinds mark ready; a
-        # real gateway process is started by `mlrun-tpu serve` (asgi module)
+        """Deploy = a RUNNING, addressable gateway (reference nuclio
+        function.py:551; serving.py:580). The deployment manager spawns an
+        ASGI graph-server process (local provider) or a Deployment+Service
+        (kubernetes) and answers once it's invocable."""
         body = await request.json()
         function = body.get("function", {})
-        update_in(function, "status.state", "ready")
-        address = function.get("status", {}).get("address", "")
-        state.db.store_function(
-            function, request.match_info["name"],
-            request.match_info["project"],
-            tag=function.get("metadata", {}).get("tag", "latest"))
-        return json_response({"data": {"state": "ready",
-                                       "address": address}})
+        update_in(function, "metadata.name", request.match_info["name"])
+        update_in(function, "metadata.project",
+                  request.match_info["project"])
+        kind = function.get("kind", "")
+        if kind not in (RuntimeKinds.serving, RuntimeKinds.remote,
+                        RuntimeKinds.application):
+            # batch kinds have nothing to run until submitted — deploy just
+            # resolves the image + readiness (the build path)
+            update_in(function, "status.state", "ready")
+            state.db.store_function(
+                function, request.match_info["name"],
+                request.match_info["project"],
+                tag=function.get("metadata", {}).get("tag", "latest"))
+            return json_response({"data": {"state": "ready",
+                                           "address": ""}})
+        loop = asyncio.get_event_loop()
+        info = await loop.run_in_executor(
+            None, lambda: state.deployments.deploy(function))
+        if info["state"] == "error":
+            return error_response(
+                f"function deploy failed: {info.get('error', '')}", 400)
+        return json_response({"data": info})
+
+    @r.delete(API + "/projects/{project}/functions/{name}/deploy")
+    async def undeploy_function(request):
+        loop = asyncio.get_event_loop()
+        removed = await loop.run_in_executor(
+            None, lambda: state.deployments.teardown(
+                request.match_info["name"], request.match_info["project"]))
+        return json_response({"removed": removed})
 
     # -- build ------------------------------------------------------------------
     @r.post(API + "/build/function")
@@ -1130,6 +1163,18 @@ async def _start_periodic(app: web.Application):
             await asyncio.get_event_loop().run_in_executor(
                 None, state.launcher.monitor_all)
 
+    async def gateway_monitor_loop():
+        # dead gateways flip their function status to error
+        # (service/deployments.py monitor)
+        while True:
+            await asyncio.sleep(
+                min(float(mlconf.runs.monitoring_interval), 5.0))
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, state.deployments.monitor)
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                logger.warning("gateway monitor failed", error=str(exc))
+
     async def scheduler_loop():
         fired: dict[tuple, str] = {}
         while True:
@@ -1148,6 +1193,7 @@ async def _start_periodic(app: web.Application):
 
     app["_periodic"] = [
         asyncio.create_task(monitor_loop()),
+        asyncio.create_task(gateway_monitor_loop()),
         asyncio.create_task(scheduler_loop()),
     ]
 

@@ -176,11 +176,51 @@ class KubernetesProvider(Provider):
                 "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
                 resource)
             return f"jobset/{resource['metadata']['name']}"
+        if resource.get("kind") == "Deployment":
+            # long-running gateway Deployments (service/deployments.py) —
+            # replicas come from the function's min_replicas
+            import kubernetes
+
+            kubernetes.client.AppsV1Api(
+                self._core.api_client).create_namespaced_deployment(
+                self.namespace, resource)
+            return f"deployment/{resource['metadata']['name']}"
         self._core.create_namespaced_pod(self.namespace, resource)
         return f"pod/{resource['metadata']['name']}"
 
+    def create_service(self, manifest: dict) -> str:
+        """Create/replace the Service fronting a gateway Deployment."""
+        import kubernetes
+
+        name = manifest["metadata"]["name"]
+        try:
+            self._core.replace_namespaced_service(name, self.namespace,
+                                                  manifest)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+            self._core.create_namespaced_service(self.namespace, manifest)
+        return name
+
     def state(self, resource_id: str) -> str:
         kind, _, name = resource_id.partition("/")
+        if kind == "deployment":
+            import kubernetes
+
+            dep = kubernetes.client.AppsV1Api(
+                self._core.api_client).read_namespaced_deployment(
+                name, self.namespace)
+            status = dep.status
+            if (getattr(status, "available_replicas", 0) or 0) >= 1:
+                return PodPhases.running
+            # distinguish "rolling out" from "dead": a deployment whose
+            # pods are crash-looping still reports 0 available
+            conditions = getattr(status, "conditions", None) or []
+            for cond in conditions:
+                if (getattr(cond, "type", "") == "Progressing"
+                        and getattr(cond, "status", "") == "False"):
+                    return PodPhases.failed
+            return PodPhases.pending
         if kind == "jobset":
             obj = self._custom.get_namespaced_custom_object(
                 "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
@@ -201,6 +241,18 @@ class KubernetesProvider(Provider):
             self._custom.delete_namespaced_custom_object(
                 "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
                 name)
+        elif kind == "deployment":
+            import kubernetes
+
+            kubernetes.client.AppsV1Api(
+                self._core.api_client).delete_namespaced_deployment(
+                name, self.namespace)
+            # the fronting Service shares the Deployment's name
+            try:
+                self._core.delete_namespaced_service(name, self.namespace)
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 404:
+                    raise
         else:
             self._core.delete_namespaced_pod(name, self.namespace)
 
@@ -297,6 +349,8 @@ def _extract_pod_spec(resource: dict) -> dict:
     if resource.get("kind") == "JobSet":
         return resource["spec"]["replicatedJobs"][0]["template"]["spec"][
             "template"]["spec"]
+    if resource.get("kind") == "Deployment":
+        return resource["spec"]["template"]["spec"]
     return resource.get("spec", resource)
 
 

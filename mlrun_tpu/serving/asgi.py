@@ -88,7 +88,19 @@ def server_from_env(namespace: dict | None = None) -> GraphServer:
     spec = json.loads(spec_env)
     server = GraphServer.from_dict(spec)
     context = GraphContext(server=server)
-    server.init_states(context, namespace or {})
+    # embedded user code (the reference bakes fn.with_code / code_to_function
+    # source into the image; here MLT_EXEC_CODE carries it into the gateway
+    # process and graph classes resolve from its namespace)
+    full_namespace = dict(namespace or {})
+    code = os.environ.get(mlconf.exec_code_env, "")
+    if code:
+        import base64
+
+        module_ns: dict = {}
+        exec(compile(base64.b64decode(code).decode(),  # noqa: S102
+                     "<serving-code>", "exec"), module_ns)
+        full_namespace = {**module_ns, **full_namespace}
+    server.init_states(context, full_namespace)
     return server
 
 

@@ -231,6 +231,15 @@ class RouterStep(TaskStep):
                          name, after)
         self.routes: dict[str, TaskStep] = routes or {}
 
+    def to_dict(self, exclude=None):
+        # routes hold live step objects — serialize them (the in-process
+        # mock-server path never JSON-round-trips, so only the gateway
+        # deploy path exercises this)
+        out = super().to_dict(exclude=(exclude or []) + ["routes"])
+        out["routes"] = {key: route.to_dict()
+                         for key, route in self.routes.items()}
+        return out
+
     def add_route(self, key: str, route: "TaskStep | None" = None,
                   class_name=None, handler=None, function=None,
                   **class_args) -> TaskStep:

@@ -14,8 +14,14 @@ if ! mkdir "$LOCK" 2>/dev/null; then
     echo "another retry loop is running (pid $other)" >&2
     exit 1
   fi
-  # stale lock from a dead loop: take it over
-  echo "stale lock (pid ${other:-unknown} gone), taking over" >&2
+  # stale lock from a dead loop: re-acquire ATOMICALLY (rm + one mkdir
+  # retry) — two takers both passing the liveness check must not both run
+  rm -rf "$LOCK"
+  if ! mkdir "$LOCK" 2>/dev/null; then
+    echo "lost takeover race for $LOCK" >&2
+    exit 1
+  fi
+  echo "stale lock (pid ${other:-unknown} gone), took over" >&2
 fi
 echo $$ > "$LOCK/pid"
 trap 'rm -rf "$LOCK"' EXIT

@@ -1,0 +1,143 @@
+"""deploy_function actually deploys (VERDICT r2 #2).
+
+Reference analog: `mlrun/runtimes/nuclio/serving.py:580` deploy and
+`function.py:551,887` — ``project.deploy_function()`` / ``fn.deploy()``
+must return an ADDRESS whose endpoint round-trips, and a dead gateway must
+be noticed by the monitor loop. Here the service's DeploymentManager spawns
+a real ``mlrun-tpu serve`` subprocess through the LocalProcessProvider.
+"""
+
+import base64
+import os
+import signal
+import time
+
+import pytest
+
+MODEL_CODE = """
+from mlrun_tpu.serving import V2ModelServer
+
+
+class EchoModel(V2ModelServer):
+    def load(self):
+        self.ready = True
+
+    def predict(self, request):
+        return [x * 3 for x in request["inputs"]]
+"""
+
+
+def _serving_fn(http_db, name="echosrv"):
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function(name, project="dep", kind="serving")
+    fn.spec.build.functionSourceCode = base64.b64encode(
+        MODEL_CODE.encode()).decode()
+    fn.set_topology("router")
+    fn.add_model("echo", class_name="EchoModel")
+    fn._db = http_db
+    return fn
+
+
+def _gateway_resource(state):
+    rows = state.db.list_runtime_resources(kind="gateway")
+    return rows[0] if rows else None
+
+
+def test_deploy_serving_function_e2e(service, http_db):
+    """deploy → live address → invoke round-trip → undeploy kills it."""
+    url, state = service
+    fn = _serving_fn(http_db)
+    address = fn.deploy()
+    assert address.startswith("http://127.0.0.1:")
+    assert fn.status.state == "ready"
+
+    # the function in the DB carries the live address
+    stored = http_db.get_function("echosrv", "dep", tag="latest")
+    assert stored["status"]["address"] == address
+    assert stored["status"]["state"] == "ready"
+
+    # a REAL http round-trip through the spawned gateway
+    result = fn.invoke("/v2/models/echo/infer", body={"inputs": [1, 2, 3]})
+    assert result["outputs"] == [3, 6, 9]
+
+    # the gateway is tracked as a runtime resource (restart-durable)
+    row = _gateway_resource(state)
+    assert row is not None and row["uid"] == "gateway-echosrv"
+
+    fn.undeploy()
+    assert _gateway_resource(state) is None
+    stored = http_db.get_function("echosrv", "dep", tag="latest")
+    assert stored["status"]["state"] == "offline"
+    assert stored["status"]["address"] == ""
+
+
+def test_deploy_function_via_project(service, http_db, monkeypatch,
+                                     tmp_path):
+    """project.deploy_function returns (fn, address) like the reference."""
+    import mlrun_tpu
+
+    url, state = service
+    monkeypatch.setattr(mlrun_tpu.config.mlconf, "dbpath", url)
+    from mlrun_tpu.db import get_run_db
+
+    get_run_db(url, force_reconnect=True)
+    try:
+        project = mlrun_tpu.get_or_create_project(
+            "dep", context=str(tmp_path))
+        fn = _serving_fn(http_db, name="projsrv")
+        project.set_function(fn)
+        deployed, address = project.deploy_function(fn)
+        assert address
+        assert deployed.invoke(
+            "/v2/models/echo/infer",
+            body={"inputs": [5]})["outputs"] == [15]
+        deployed.undeploy()
+    finally:
+        get_run_db("", force_reconnect=True)
+
+
+def test_gateway_death_flips_function_state(service, http_db):
+    """Monitor-loop coverage of gateway death (VERDICT r2 #2 'done ='):
+    kill -9 the gateway → monitor marks the function error and clears the
+    address."""
+    url, state = service
+    fn = _serving_fn(http_db, name="deadsrv")
+    fn.deploy()
+
+    row = _gateway_resource(state)
+    assert row is not None
+    pid = int(row["resource_id"].split("-")[1])
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        state.deployments.monitor()
+        stored = http_db.get_function("deadsrv", "dep", tag="latest")
+        if stored["status"]["state"] == "error":
+            break
+        time.sleep(0.2)
+    assert stored["status"]["state"] == "error"
+    assert stored["status"]["address"] == ""
+    assert _gateway_resource(state) is None
+
+
+def test_deploy_failure_surfaces_log_tail(service, http_db):
+    """A gateway that can't start fails the deploy with a diagnosable
+    error instead of hanging or marking ready."""
+    import mlrun_tpu
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.db.base import RunDBError
+
+    url, state = service
+    fn = mlrun_tpu.new_function("brokensrv", project="dep", kind="serving")
+    # no topology/graph → the serve process exits at startup
+    fn._db = http_db
+    old = mlconf.function.gateway_ready_timeout
+    mlconf.function.gateway_ready_timeout = 15.0
+    try:
+        with pytest.raises((RuntimeError, RunDBError),
+                           match="deploy failed"):
+            fn.deploy()
+    finally:
+        mlconf.function.gateway_ready_timeout = old
+    assert _gateway_resource(state) is None
