@@ -249,6 +249,22 @@ def serve(port, host, func_url):
     serve_graph(function=function, host=host, port=port)
 
 
+@main.command(context_settings={"ignore_unknown_options": True})
+@click.option("--requirement", "-r", multiple=True,
+              help="pip requirement (repeatable)")
+@click.option("--overlay-root", default="", help="overlay cache directory")
+@click.argument("cmd", nargs=-1, type=click.UNPROCESSED)
+def bootstrap(requirement, overlay_root, cmd):
+    """Ensure a cached requirements overlay, then exec CMD with it on
+    PYTHONPATH — the in-pod half of the build path (runtime handlers wrap
+    run commands with this when the function declares
+    build.requirements)."""
+    from .utils.bootstrap import exec_with_requirements
+
+    exec_with_requirements(list(requirement), list(cmd),
+                           overlay_root=overlay_root or None)
+
+
 @main.command()
 def version():
     from . import __version__

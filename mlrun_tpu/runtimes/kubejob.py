@@ -38,8 +38,11 @@ class KubejobRuntime(KubeResource):
 
     def deploy(self, watch: bool = True, with_tpu: bool = False,
                skip_deployed: bool = False) -> bool:
-        """Request a remote image build from the service
-        (reference kubejob.py:144; Kaniko analog server-side)."""
+        """Request a remote build from the service (reference
+        kubejob.py:144; server side is service/builder.py — a venv-cache
+        pre-warm locally or a Kaniko pod on kubernetes). With ``watch``
+        the call blocks on `/build/status` streaming the build log until
+        the build reaches a terminal state."""
         if skip_deployed and self.is_deployed:
             return True
         db = self._get_db()
@@ -47,9 +50,33 @@ class KubejobRuntime(KubeResource):
         status = resp.get("data", {}).get("status", {})
         self.spec.image = status.get("image") or self.spec.image
         state = status.get("state", "ready")
+        if watch and state == "deploying":
+            state = self._watch_build(db)
         logger.info("function build finished", image=self.spec.image,
                     state=state)
         return state == "ready"
+
+    def _watch_build(self, db, timeout: float = 1800.0) -> str:
+        import sys
+        import time
+
+        offset = 0
+        deadline = time.time() + timeout
+        state = "deploying"
+        while time.time() < deadline:
+            resp = db.get_builder_status(self, offset=offset)
+            data = resp.get("data", resp) if isinstance(resp, dict) else {}
+            log = data.get("log", "")
+            if log:
+                sys.stdout.write(log)
+                sys.stdout.flush()
+            offset = data.get("offset", offset)
+            state = data.get("state", state)
+            if state in ("ready", "error"):
+                self.spec.image = data.get("image") or self.spec.image
+                return state
+            time.sleep(1.0)
+        return state
 
     def _run(self, runobj: RunObject, execution) -> dict:
         # runs happen server-side; reaching here means misconfiguration

@@ -82,6 +82,9 @@ class ServiceState:
         self.launcher = ServerSideLauncher(self.db, self.provider)
         self.launcher.recover()  # re-adopt resources from before a restart
         self.deployments = DeploymentManager(self.db, self.provider)
+        from .builder import FunctionBuilder
+
+        self.builder = FunctionBuilder(self.db, self.provider)
         from .projects_sync import ProjectsFollower
 
         self.projects_follower = ProjectsFollower(self.db)
@@ -358,25 +361,30 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     # -- build ------------------------------------------------------------------
     @r.post(API + "/build/function")
     async def build_function(request):
-        """Image-build analog (reference Kaniko builder,
-        server/api/utils/builder.py): with prebuilt TPU images + code-in-env
-        there is nothing to bake — resolve the image and mark ready."""
+        """Real build path (reference server/api/utils/builder.py:39,144 +
+        endpoints/functions.py:272): prebuilt image + code-in-env stays a
+        no-op, but requirements/commands now trigger an actual build — a
+        venv-cache pre-warm (local provider) or a Kaniko pod (kubernetes),
+        tracked as a background task with a retrievable log."""
         body = await request.json()
         function = body.get("function", {})
         with_tpu = body.get("with_tpu", False)
-        image = get_in(function, "spec.image", "") or (
-            mlconf.function.tpu_image if with_tpu
-            else mlconf.function.default_image)
-        update_in(function, "spec.image", image)
-        update_in(function, "status.state", "ready")
-        name = get_in(function, "metadata.name", "fn")
-        project = get_in(function, "metadata.project",
-                         mlconf.default_project)
-        state.db.store_function(function, name, project,
-                                tag=get_in(function, "metadata.tag",
-                                           "latest"))
-        return json_response({"data": {"status": {"state": "ready",
-                                                  "image": image}}})
+        loop = asyncio.get_event_loop()
+        status = await loop.run_in_executor(
+            None, lambda: state.builder.build(function, with_tpu=with_tpu))
+        return json_response({"data": {"status": status}})
+
+    @r.get(API + "/build/status")
+    async def build_status(request):
+        """Build state + incremental log (reference get_builder_status)."""
+        status = state.builder.status(
+            request.query.get("name", ""),
+            request.query.get("project", "") or mlconf.default_project,
+            tag=request.query.get("tag", "latest"),
+            offset=int(request.query.get("offset", 0) or 0))
+        if status["state"] == "not_found":
+            return error_response("function not found", 404)
+        return json_response({"data": status})
 
     # -- submit ------------------------------------------------------------------
     @r.post(API + "/submit_job")

@@ -101,9 +101,13 @@ class DeploymentManager:
             return info
 
     def _gateway_env(self, function: dict, project: str) -> list[dict]:
+        # valueFrom entries (secretKeyRef/fieldRef) pass through for the
+        # kubernetes manifest; the local provider only materializes
+        # value-typed entries (it has no kubelet to resolve the refs)
         env = [dict(item) for item in
                get_in(function, "spec.env", []) or []
-               if isinstance(item, dict) and "value" in item]
+               if isinstance(item, dict)
+               and ("value" in item or "valueFrom" in item)]
         names = {item.get("name") for item in env}
         if "MLT_DBPATH" not in names:
             env.append({
@@ -318,21 +322,37 @@ class DeploymentManager:
             name = uid.split("-", 1)[1]
             try:
                 live = self.provider.state(row["resource_id"])
-            except Exception:  # noqa: BLE001
-                live = "unknown"
+            except Exception as exc:  # noqa: BLE001
+                # a 404 means the resource was deleted out-of-band
+                # (kubectl delete) — that's a dead gateway, not a blip;
+                # anything else (API hiccup) is skipped until next tick
+                if getattr(exc, "status", None) == 404 \
+                        or "not found" in str(exc).lower():
+                    live = PodPhases.failed
+                else:
+                    continue
             if live in (PodPhases.failed, PodPhases.succeeded):
-                logger.warning("gateway died", function=name,
-                               project=row["project"], state=live)
-                # delete the provider resource too: a crash-looping k8s
-                # Deployment would otherwise stay in the cluster untracked
-                # and block every future redeploy with AlreadyExists
-                try:
-                    self.provider.delete(row["resource_id"])
-                except Exception:  # noqa: BLE001 - already-gone is fine
-                    pass
-                self.db.del_runtime_resource(uid, row["project"])
-                self._set_function_state(name, row["project"],
-                                         DEPLOY_ERROR)
+                # serialize with deploy(): a concurrent redeploy may have
+                # just replaced this row — re-read under the lock and only
+                # act if the dead resource is still the tracked one
+                with self._function_lock(name, row["project"]):
+                    current = self._resource_row(uid, row["project"])
+                    if current is None or \
+                            current["resource_id"] != row["resource_id"]:
+                        continue
+                    logger.warning("gateway died", function=name,
+                                   project=row["project"], state=live)
+                    # delete the provider resource too: a crash-looping
+                    # k8s Deployment would otherwise stay in the cluster
+                    # untracked and block every future redeploy with
+                    # AlreadyExists
+                    try:
+                        self.provider.delete(row["resource_id"])
+                    except Exception:  # noqa: BLE001 - already gone
+                        pass
+                    self.db.del_runtime_resource(uid, row["project"])
+                    self._set_function_state(name, row["project"],
+                                             DEPLOY_ERROR)
 
     def _resource_row(self, uid: str, project: str) -> dict | None:
         for row in self.db.list_runtime_resources(kind=GATEWAY_KIND):

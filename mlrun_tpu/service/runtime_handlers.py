@@ -345,6 +345,21 @@ def _proc_start_ticks(pid: int) -> int:
         return 0
 
 
+def _wrap_with_bootstrap(runtime, command: list[str]) -> list[str]:
+    """Functions that declare build.requirements run under the cached
+    requirements venv: the pod command becomes `mlrun-tpu bootstrap -r ...
+    -- <command>` (the zero-registry half of the reference's Kaniko image
+    build, utils/bootstrap.py)."""
+    build = getattr(runtime.spec, "build", None)
+    requirements = list(getattr(build, "requirements", []) or [])
+    if not requirements:
+        return command
+    wrapped = ["mlrun-tpu", "bootstrap"]
+    for req in requirements:
+        wrapped += ["-r", req]
+    return wrapped + ["--"] + command
+
+
 def _extract_pod_spec(resource: dict) -> dict:
     if resource.get("kind") == "JobSet":
         return resource["spec"]["replicatedJobs"][0]["template"]["spec"][
@@ -644,6 +659,7 @@ class KubeJobHandler(BaseRuntimeHandler):
         handler = run.spec.handler_name
         if handler:
             command += ["--handler", handler]
+        command = _wrap_with_bootstrap(runtime, command)
         pod_spec = runtime.to_pod_spec(command=command, extra_env=env)
         return {
             "apiVersion": "v1",
@@ -679,6 +695,7 @@ class TpuJobHandler(BaseRuntimeHandler):
         handler = run.spec.handler_name
         if handler:
             command += ["--handler", handler]
+        command = _wrap_with_bootstrap(runtime, command)
         return runtime.generate_jobset(run, extra_env=env, command=command)
 
 

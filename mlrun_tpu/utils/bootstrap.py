@@ -1,0 +1,159 @@
+"""Requirements bootstrap — the runtime half of the image-build story.
+
+Reference analog: `server/api/utils/builder.py:39` bakes requirements into
+an image with Kaniko. On TPU clusters the base images are prebuilt and
+code rides the env (`MLT_EXEC_CODE`), so extra *python* requirements are
+satisfied at pod start instead: pip installs them ONCE into a cached
+overlay directory keyed by the requirements hash
+(``pip install --target``), and the run command re-execs with that overlay
+prepended to ``PYTHONPATH``. An overlay (not a venv) because the runtime
+image's interpreter is often itself a venv — chaining venvs would lose the
+preinstalled jax/TPU stack, while an overlay strictly adds to it.
+
+The Kaniko path still exists for kubernetes deployments
+(`service/builder.py` make_dockerfile/make_kaniko_pod); this module is the
+zero-registry fallback that works anywhere a pod can run pip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import time
+
+from ..config import mlconf
+from . import logger
+
+
+def requirements_hash(requirements: list[str], extra: str = "") -> str:
+    """Stable cache key for a requirements set (order-insensitive)."""
+    blob = "\n".join(sorted(requirements)) + "\n" + extra
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def default_overlay_root() -> str:
+    return os.path.join(mlconf.home_dir, "pkg-overlays")
+
+
+def _write_lock_owner(lock: str):
+    try:
+        with open(os.path.join(lock, "pid"), "w") as fp:
+            fp.write(str(os.getpid()))
+    except OSError:
+        pass
+
+
+def _lock_owner_dead(lock: str) -> bool:
+    try:
+        with open(os.path.join(lock, "pid")) as fp:
+            pid = int(fp.read().strip())
+    except (OSError, ValueError):
+        # owner hasn't written its pid yet (creation is a two-step
+        # mkdir+write) — give it the benefit of the doubt
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False
+    return False
+
+
+def _reclaim_lock(lock: str):
+    import shutil
+
+    shutil.rmtree(lock, ignore_errors=True)
+
+
+def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
+                   log_fp=None, timeout: float = 600.0) -> str:
+    """Create (or reuse) the cached overlay dir for ``requirements`` and
+    return its path. Concurrent callers racing on the same hash serialize
+    on an atomic mkdir lock; losers wait for the winner's ``.ready``
+    marker."""
+    overlay_root = overlay_root or default_overlay_root()
+    os.makedirs(overlay_root, exist_ok=True)
+    key = requirements_hash(requirements)
+    overlay = os.path.join(overlay_root, key)
+    ready = os.path.join(overlay, ".ready")
+    if os.path.exists(ready):
+        return overlay
+
+    lock = overlay + ".lock"
+    try:
+        os.mkdir(lock)
+    except FileExistsError:
+        # another process is building this overlay — wait for it; a lock
+        # whose recorded owner pid is dead (builder SIGKILLed mid-pip) is
+        # reclaimed so one crash can't deadlock the hash forever
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if os.path.exists(ready):
+                return overlay
+            if not os.path.isdir(lock) or _lock_owner_dead(lock):
+                _reclaim_lock(lock)
+                return ensure_overlay(requirements, overlay_root, log_fp,
+                                      timeout)
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"requirements install for {key} did not finish within "
+            f"{timeout}s")
+    _write_lock_owner(lock)
+
+    def log(line: str):
+        if log_fp is not None:
+            log_fp.write(line if line.endswith("\n") else line + "\n")
+            log_fp.flush()
+
+    try:
+        log(f"installing {len(requirements)} requirement(s) into {overlay}")
+        cmd = [sys.executable, "-m", "pip", "install",
+               "--target", overlay, "--no-warn-script-location",
+               "--disable-pip-version-check", *requirements]
+        log("$ " + " ".join(cmd))
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        for line in proc.stdout:
+            log(line)
+        code = proc.wait()
+        if code != 0:
+            raise RuntimeError(
+                f"pip install failed with exit code {code} (requirements: "
+                f"{requirements})")
+        with open(ready, "w") as fp:
+            fp.write("\n".join(requirements) + "\n")
+        log(f"requirements overlay ready: {overlay}")
+        return overlay
+    finally:
+        _reclaim_lock(lock)
+
+
+def exec_with_requirements(requirements: list[str], command: list[str],
+                           overlay_root: str | None = None, log_fp=None):
+    """Replace this process with ``command`` running with the cached
+    requirements overlay on PYTHONPATH (the in-pod `mlrun-tpu bootstrap`
+    contract)."""
+    overlay = ensure_overlay(requirements, overlay_root,
+                             log_fp if log_fp is not None else sys.stderr)
+    if not command:
+        return overlay
+    argv = list(command)
+    if argv[0] in ("mlrun-tpu", "mlrun_tpu"):
+        argv = [sys.executable, "-m", "mlrun_tpu"] + argv[1:]
+    elif argv[0] in ("python", "python3"):
+        argv = [sys.executable] + argv[1:]
+    env = os.environ.copy()
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = overlay + (os.pathsep + existing if existing
+                                   else "")
+    # overlay console scripts (pip --target puts them in bin/)
+    bin_dir = os.path.join(overlay, "bin")
+    if os.path.isdir(bin_dir):
+        env["PATH"] = bin_dir + os.pathsep + env.get("PATH", "")
+    logger.info("bootstrap exec", command=argv[0], overlay=overlay)
+    # execvPe: PATH lookup so wrapped entrypoints like `bash` resolve
+    # (including console scripts from the overlay's bin/ just prepended)
+    os.execvpe(argv[0], argv, env)

@@ -1,0 +1,161 @@
+"""The image/requirements build path (VERDICT r2 #3).
+
+Reference analog: `server/api/utils/builder.py:39` (make_dockerfile),
+`:144` (make_kaniko_pod), build endpoint
+`server/api/api/endpoints/functions.py:272`. 'Done' criterion:
+``fn.deploy(requirements=[...])`` followed by a run that imports the
+package — proven here end-to-end with an offline local package installed
+into the cached requirements overlay by the service build task, then
+imported by a run whose pod command was bootstrap-wrapped.
+"""
+
+import base64
+import textwrap
+
+
+def _make_local_pkg(tmp_path, name="mltdemo", value=3):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "setup.py").write_text(
+        "from setuptools import setup\n"
+        f"setup(name='{name}', version='0.1', py_modules=['{name}'])\n")
+    (pkg / f"{name}.py").write_text(
+        f"def triple(x):\n    return x * {value}\n")
+    return pkg
+
+
+OFFLINE_FLAGS = ["--no-index", "--no-build-isolation"]
+
+
+def test_make_dockerfile_and_kaniko_pod():
+    from mlrun_tpu.service.builder import make_dockerfile, make_kaniko_pod
+
+    dockerfile = make_dockerfile(
+        "mlrun-tpu/tpu:latest", requirements=["scipy", "fastparquet"],
+        commands=["apt-get update"])
+    assert dockerfile.startswith("FROM mlrun-tpu/tpu:latest")
+    assert "RUN apt-get update" in dockerfile
+    assert "pip install" in dockerfile and "requirements.txt" in dockerfile
+
+    pod = make_kaniko_pod("p1", "fn1", dockerfile,
+                          "registry/repo/img:tag",
+                          registry_secret="regcreds")
+    assert pod["kind"] == "Pod"
+    assert pod["spec"]["containers"][0]["image"].startswith(
+        "gcr.io/kaniko-project/executor")
+    assert any("--destination=registry/repo/img:tag" in arg
+               for arg in pod["spec"]["containers"][0]["args"])
+    # dockerfile rides the init container, no ConfigMap needed
+    init = pod["spec"]["initContainers"][0]
+    assert init["env"][0]["value"] == dockerfile
+    assert any(v["name"] == "registry-creds" for v in pod["spec"]["volumes"])
+
+
+def test_overlay_cache_and_hash(tmp_path):
+    from mlrun_tpu.utils.bootstrap import ensure_overlay, requirements_hash
+
+    pkg = _make_local_pkg(tmp_path)
+    reqs = OFFLINE_FLAGS + [str(pkg)]
+    assert requirements_hash(reqs) == requirements_hash(list(reversed(reqs)))
+
+    root = tmp_path / "overlays"
+    overlay = ensure_overlay(reqs, overlay_root=str(root))
+    assert (root / requirements_hash(reqs) / ".ready").exists()
+    # cache hit: second call returns instantly with the same dir
+    assert ensure_overlay(reqs, overlay_root=str(root)) == overlay
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c", "import mltdemo; print(mltdemo.triple(2))"],
+        env={"PYTHONPATH": overlay, "PATH": "/usr/bin:/bin"},
+        capture_output=True, text=True)
+    assert out.stdout.strip() == "6"
+
+
+def test_build_deploy_then_run_imports_package(service, http_db, tmp_path):
+    """The full loop: fn.with_requirements → fn.deploy() → submitted run
+    imports the just-installed package inside the bootstrap overlay."""
+    import mlrun_tpu
+
+    pkg = _make_local_pkg(tmp_path, value=7)
+    code = textwrap.dedent("""
+        def handler(context, x: int = 2):
+            import mltdemo
+            context.log_result("tripled", mltdemo.triple(x))
+    """)
+    fn = mlrun_tpu.new_function("bldfn", project="bld", kind="job",
+                                image="x")
+    fn.spec.build.functionSourceCode = base64.b64encode(
+        code.encode()).decode()
+    fn.spec.default_handler = "handler"
+    fn.with_requirements(OFFLINE_FLAGS + [str(pkg)])
+    fn._db = http_db
+
+    assert fn.deploy(watch=True) is True
+    stored = http_db.get_function("bldfn", "bld", tag="latest")
+    assert stored["status"]["state"] == "ready"
+
+    # build log is retrievable over /build/status
+    status = http_db.get_builder_status(fn)
+    data = status.get("data", status)
+    assert "pip install" in data["log"]
+
+    # now RUN the function: the pod command is bootstrap-wrapped, so the
+    # handler can import the package from the overlay
+    task = {"metadata": {"name": "bldrun", "project": "bld"},
+            "spec": {"handler": "handler", "parameters": {"x": 5},
+                     "function": "bld/bldfn:latest"}}
+    resp = http_db.submit_job({"function": fn.to_dict(), "task": task})
+    uid = resp["data"]["metadata"]["uid"]
+
+    import time
+
+    deadline = time.time() + 90
+    run = None
+    while time.time() < deadline:
+        run = http_db.read_run(uid, "bld")
+        if run["status"].get("state") in ("completed", "error"):
+            break
+        time.sleep(0.5)
+    assert run["status"]["state"] == "completed", \
+        http_db.get_log(uid, "bld")[1].decode(errors="replace")
+    assert run["status"]["results"]["tripled"] == 35
+
+
+def test_build_failure_has_retrievable_log(service, http_db):
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function("badbld", project="bld", kind="job",
+                                image="x")
+    fn.with_requirements(["--no-index", "definitely-not-a-package-xyz"])
+    fn._db = http_db
+    assert fn.deploy(watch=True) is False
+    stored = http_db.get_function("badbld", "bld", tag="latest")
+    assert stored["status"]["state"] == "error"
+    status = http_db.get_builder_status(fn)
+    data = status.get("data", status)
+    assert "failed" in data["log"] or "ERROR" in data["log"]
+
+
+def test_bootstrap_command_wrap():
+    """Runtime handlers wrap pod commands for functions with
+    requirements."""
+    from mlrun_tpu.service.runtime_handlers import _wrap_with_bootstrap
+
+    class _Build:
+        requirements = ["scipy", "einx"]
+
+    class _Spec:
+        build = _Build()
+
+    class _Runtime:
+        spec = _Spec()
+
+    wrapped = _wrap_with_bootstrap(_Runtime(), ["mlrun-tpu", "run",
+                                                "--from-env"])
+    assert wrapped == ["mlrun-tpu", "bootstrap", "-r", "scipy", "-r",
+                      "einx", "--", "mlrun-tpu", "run", "--from-env"]
+
+    _Build.requirements = []
+    assert _wrap_with_bootstrap(_Runtime(), ["x"]) == ["x"]
