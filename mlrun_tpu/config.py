@@ -78,6 +78,10 @@ default_config: dict[str, Any] = {
         # its readiness probe (reference: nuclio deploy polls build/rollout
         # state the same way)
         "gateway_ready_timeout": 30.0,
+        # host recorded in local-gateway addresses (status.address); set to
+        # this host's reachable name/IP when clients on other machines will
+        # read the address from the DB
+        "gateway_advertise_host": "127.0.0.1",
     },
     "tpu": {
         # TPU pod-slice defaults used by the tpujob runtime (replaces the reference's

@@ -87,7 +87,7 @@ CREATE TABLE IF NOT EXISTS hub_sources (
 );
 CREATE TABLE IF NOT EXISTS runtime_resources (
     project TEXT NOT NULL, uid TEXT NOT NULL, kind TEXT,
-    resource_id TEXT, started REAL,
+    resource_id TEXT, started REAL, tag TEXT NOT NULL DEFAULT '',
     PRIMARY KEY (project, uid)
 );
 CREATE TABLE IF NOT EXISTS project_secrets (
@@ -117,7 +117,7 @@ CREATE INDEX IF NOT EXISTS idx_artifacts_proj_key ON artifacts (project, key);
 # at SCHEMA_VERSION; an existing DB replays only the missing migrations in
 # order. Version 1 is the round-1 pre-versioning schema (user_version 0
 # with a populated sqlite_master).
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 _MIGRATIONS: dict[int, str] = {
     2: """
@@ -157,6 +157,9 @@ CREATE TABLE IF NOT EXISTS artifact_tags (
     uid TEXT NOT NULL,
     PRIMARY KEY (project, key, tag)
 );
+""",
+    8: """
+ALTER TABLE runtime_resources ADD COLUMN tag TEXT NOT NULL DEFAULT '';
 """,
 }
 
@@ -396,15 +399,17 @@ class SQLiteRunDB(RunDBInterface):
     # mapping survives service restarts in the DB and is reconciled against
     # the provider on startup) ---------------------------------------------
     def store_runtime_resource(self, uid: str, project: str, kind: str,
-                               resource_id: str, started: float):
+                               resource_id: str, started: float,
+                               tag: str = ""):
         project = self._project_or_default(project)
         self._execute(
             "INSERT OR REPLACE INTO runtime_resources "
-            "(project, uid, kind, resource_id, started) VALUES (?,?,?,?,?)",
-            (project, uid, kind, resource_id, started))
+            "(project, uid, kind, resource_id, started, tag) "
+            "VALUES (?,?,?,?,?,?)",
+            (project, uid, kind, resource_id, started, tag or ""))
 
     def list_runtime_resources(self, kind: str = "") -> list[dict]:
-        sql = ("SELECT project, uid, kind, resource_id, started "
+        sql = ("SELECT project, uid, kind, resource_id, started, tag "
                "FROM runtime_resources")
         params: tuple = ()
         if kind:

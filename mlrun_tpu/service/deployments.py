@@ -88,9 +88,10 @@ class DeploymentManager:
             self.teardown(name, project, store_state=False)
 
             if self.provider.kind == "kubernetes":
-                info = self._deploy_kubernetes(function, name, project)
+                info = self._deploy_kubernetes(function, name, project,
+                                               tag=tag)
             else:
-                info = self._deploy_local(function, name, project)
+                info = self._deploy_local(function, name, project, tag=tag)
 
             update_in(function, "status.state", info["state"])
             update_in(function, "status.address", info["address"])
@@ -148,12 +149,19 @@ class DeploymentManager:
             container.setdefault("envFrom", []).append(
                 {"secretRef": {"name": secret_name}})
 
-    def _deploy_local(self, function: dict, name: str, project: str) -> dict:
+    def _deploy_local(self, function: dict, name: str, project: str,
+                      tag: str = "latest") -> dict:
         port = _free_port()
-        address = f"http://127.0.0.1:{port}"
+        # bind locally; the *recorded* address uses the advertise host so a
+        # status row read from another machine still names a host that
+        # resolves to this gateway (mlconf.function.gateway_advertise_host,
+        # default 127.0.0.1 for single-host setups)
+        advertise = str(mlconf.function.gateway_advertise_host
+                        or "127.0.0.1")
+        address = f"http://{advertise}:{port}"
         resource = self._build_deployment(
             function, name, project, port=port, replicas=1,
-            host="127.0.0.1")
+            host="127.0.0.1" if advertise == "127.0.0.1" else "0.0.0.0")
         uid = f"gateway-{name}"
         try:
             resource_id = self.provider.create(resource, uid)
@@ -163,11 +171,17 @@ class DeploymentManager:
             return {"state": DEPLOY_ERROR, "address": "",
                     "resource_id": "", "error": str(exc)}
         self.db.store_runtime_resource(uid, project, GATEWAY_KIND,
-                                       resource_id, time.time())
-        deadline = time.time() + float(
-            mlconf.function.gateway_ready_timeout)
+                                       resource_id, time.time(), tag=tag)
+        ready_timeout = float(mlconf.function.gateway_ready_timeout)
+        if get_in(function, "spec.build.requirements", None):
+            # first boot pip-installs the overlay before the server binds
+            ready_timeout = max(ready_timeout * 3, 60.0)
+        deadline = time.time() + ready_timeout
+        # readiness always polls loopback — the gateway is a child of this
+        # service even when the advertised address names another interface
+        probe = f"http://127.0.0.1:{port}"
         while time.time() < deadline:
-            if _http_ok(f"{address}/__stats__"):
+            if _http_ok(f"{probe}/__stats__"):
                 logger.info("gateway ready", function=name,
                             address=address)
                 return {"state": DEPLOY_READY, "address": address,
@@ -192,7 +206,7 @@ class DeploymentManager:
                 "error": f"gateway did not become ready: {tail}"}
 
     def _deploy_kubernetes(self, function: dict, name: str,
-                           project: str) -> dict:
+                           project: str, tag: str = "latest") -> dict:
         port = int(get_in(function, "spec.config.http.port", 0) or 8080)
         deployment = self._build_deployment(
             function, name, project, port=port,
@@ -211,7 +225,7 @@ class DeploymentManager:
             return {"state": DEPLOY_ERROR, "address": "",
                     "resource_id": "", "error": str(exc)}
         self.db.store_runtime_resource(uid, project, GATEWAY_KIND,
-                                       resource_id, time.time())
+                                       resource_id, time.time(), tag=tag)
         address = (f"http://{service['metadata']['name']}."
                    f"{mlconf.namespace}.svc.cluster.local:{port}")
         deadline = time.time() + float(
@@ -236,12 +250,25 @@ class DeploymentManager:
             "mlrun-tpu/class": GATEWAY_KIND,
             "mlrun-tpu/function": name,
         }
+        # gateways honor build.requirements like batch runs do: the serve
+        # command bootstraps onto the cached requirements overlay first
+        # (runtime_handlers._wrap_with_bootstrap is the batch-side analog;
+        # without this a serving function declaring requirements would
+        # silently come up without them)
+        command = ["mlrun-tpu", "serve",
+                   "--port", str(port), "--host", host]
+        requirements = list(
+            get_in(function, "spec.build.requirements", []) or [])
+        if requirements:
+            wrapped = ["mlrun-tpu", "bootstrap"]
+            for req in requirements:
+                wrapped += ["-r", req]
+            command = wrapped + ["--"] + command
         container = {
             "name": "gateway",
             "image": get_in(function, "spec.image", "")
             or mlconf.function.default_image,
-            "command": ["mlrun-tpu", "serve",
-                        "--port", str(port), "--host", host],
+            "command": command,
             "env": self._gateway_env(function, project),
             "ports": [{"containerPort": port}],
             "readinessProbe": {
@@ -308,7 +335,8 @@ class DeploymentManager:
                            error=str(exc))
         self.db.del_runtime_resource(uid, project)
         if store_state:
-            self._set_function_state(name, project, "offline")
+            self._set_function_state(name, project, "offline",
+                                     tag=row.get("tag", ""))
         return True
 
     def monitor(self):
@@ -352,7 +380,8 @@ class DeploymentManager:
                         pass
                     self.db.del_runtime_resource(uid, row["project"])
                     self._set_function_state(name, row["project"],
-                                             DEPLOY_ERROR)
+                                             DEPLOY_ERROR,
+                                             tag=row.get("tag", ""))
 
     def _resource_row(self, uid: str, project: str) -> dict | None:
         for row in self.db.list_runtime_resources(kind=GATEWAY_KIND):
@@ -360,9 +389,13 @@ class DeploymentManager:
                 return row
         return None
 
-    def _set_function_state(self, name: str, project: str, state: str):
+    def _set_function_state(self, name: str, project: str, state: str,
+                            tag: str = ""):
+        # the deployed tag rides the runtime-resource row — a gateway
+        # deployed as mytag must flip mytag's stored function, not latest
+        tag = tag or "latest"
         try:
-            function = self.db.get_function(name, project, tag="latest")
+            function = self.db.get_function(name, project, tag=tag)
         except Exception:  # noqa: BLE001
             return
         if not function:
@@ -371,4 +404,4 @@ class DeploymentManager:
         if state != DEPLOY_READY:
             update_in(function, "status.address", "")
             update_in(function, "status.external_invocation_urls", [])
-        self.db.store_function(function, name, project, tag="latest")
+        self.db.store_function(function, name, project, tag=tag)

@@ -68,6 +68,23 @@ def _reclaim_lock(lock: str):
     shutil.rmtree(lock, ignore_errors=True)
 
 
+def _reclaim_stale_lock(lock: str) -> bool:
+    """Atomically take over a lock whose owner died. The taker renames the
+    lock dir aside first — os.rename fails for every loser once one waiter
+    wins — so two waiters can never both reclaim and race a fresh owner
+    that re-created the lock in between (ADVICE r3: rmtree-then-mkdir let
+    a waiter delete a *reclaimed* lock)."""
+    grave = f"{lock}.stale-{os.getpid()}-{time.monotonic_ns()}"
+    try:
+        os.rename(lock, grave)
+    except OSError:
+        return False  # someone else won the takeover (or owner finished)
+    import shutil
+
+    shutil.rmtree(grave, ignore_errors=True)
+    return True
+
+
 def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
                    log_fp=None, timeout: float = 600.0) -> str:
     """Create (or reuse) the cached overlay dir for ``requirements`` and
@@ -93,8 +110,14 @@ def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
         while time.time() < deadline:
             if os.path.exists(ready):
                 return overlay
-            if not os.path.isdir(lock) or _lock_owner_dead(lock):
-                _reclaim_lock(lock)
+            if not os.path.isdir(lock):
+                return ensure_overlay(requirements, overlay_root, log_fp,
+                                      timeout)
+            if _lock_owner_dead(lock):
+                _reclaim_stale_lock(lock)
+                # whether this waiter won the rename or lost it, the lock
+                # state just changed — retry from the top (winner rebuilds,
+                # losers wait on the new owner)
                 return ensure_overlay(requirements, overlay_root, log_fp,
                                       timeout)
             time.sleep(0.5)
