@@ -29,6 +29,10 @@ def main():
 @click.option("--project", "-p", default="", help="project name")
 @click.option("--handler", default="", help="handler function name")
 @click.option("--param", multiple=True, help="key=value parameter")
+@click.option("--str-param", multiple=True,
+              help="key=value parameter taken verbatim as a string (no "
+                   "JSON coercion; the KFP compiler routes STRING-typed "
+                   "step outputs here so '7' stays '7')")
 @click.option("--inputs", "-i", multiple=True, help="key=url input")
 @click.option("--artifact-path", default="", help="artifact output path")
 @click.option("--kind", default="", help="runtime kind")
@@ -42,8 +46,9 @@ def main():
 @click.option("--local", is_flag=True, help="force local in-process run")
 @click.option("--watch", "-w", is_flag=True, default=False)
 @click.argument("run_args", nargs=-1, type=click.UNPROCESSED)
-def run(url, name, project, handler, param, inputs, artifact_path, kind,
-        image, from_env, kfp_output, local, watch, run_args):
+def run(url, name, project, handler, param, str_param, inputs,
+        artifact_path, kind, image, from_env, kfp_output, local, watch,
+        run_args):
     """Execute a function/task (the in-pod contract: `run --from-env`)."""
     from .model import RunTemplate
     from .run import new_function
@@ -78,6 +83,9 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
         except (ValueError, TypeError):
             pass
         template.spec.parameters[key] = value
+    for pair in str_param:
+        key, _, value = pair.partition("=")
+        template.spec.parameters[key] = value
     for pair in inputs:
         key, _, value = pair.partition("=")
         template.spec.inputs[key] = value
@@ -93,7 +101,7 @@ def run(url, name, project, handler, param, inputs, artifact_path, kind,
     run_result = fn.run(
         template, handler=handler or template.spec.handler_name or None,
         local=from_env or local, watch=watch)
-    state = run_result.state
+    state = run_result.state()
     # KFP v2 output parameters: the pipeline compiler passes each produced
     # key as `--kfp-output key={{$.outputs.parameters[...].output_file}}`
     # (args, because the KFP launcher substitutes runtime placeholders in

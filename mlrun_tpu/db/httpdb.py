@@ -608,10 +608,14 @@ class HTTPRunDB(RunDBInterface):
             json_body={"function": func.to_dict(), "with_tpu": with_tpu})
 
     def get_builder_status(self, func, offset=0, logs=True):
+        # tag must travel: a function deployed as `mytag` keeps its build
+        # status under that tag — omitting it made the server default to
+        # `latest` and 404 polls on non-latest deploys (ADVICE r3/r4)
         return self.api_call(
             "GET", "build/status", "build status",
             params={"name": func.metadata.name,
-                    "project": func.metadata.project, "offset": offset})
+                    "project": func.metadata.project, "offset": offset,
+                    "tag": getattr(func.metadata, "tag", "") or "latest"})
 
     def get_background_task(self, name: str, project: str = ""):
         resp = self.api_call("GET",

@@ -58,7 +58,7 @@ class ClientRemoteLauncher(BaseLauncher):
             state, _ = db.watch_log(uid, run.metadata.project, watch=True)
             run.refresh()
             self._push_notifications(run)
-            if run.state == RunStates.error:
+            if run.status.state == RunStates.error:
                 raise RuntimeError(
                     f"run {run.metadata.name} failed: {run.status.error}")
         else:

@@ -335,9 +335,21 @@ class RunObject(RunTemplate):
     def uid(self) -> str:
         return self.metadata.uid
 
-    @property
     def state(self) -> str:
-        return (self.status.state if self.status else None) or RunStates.created
+        """Current run state — a METHOD, matching the reference contract
+        (reference model.py:1720): terminal states return directly, a
+        non-terminal state refreshes from the DB first so pollers see
+        live progress."""
+        current = (self.status.state if self.status else None)
+        if current in RunStates.terminal_states():
+            return current
+        try:
+            self.refresh()
+        except Exception:  # noqa: BLE001 - detached object (no DB): the
+            # locally-known state is still the best answer
+            pass
+        return (self.status.state if self.status else None) \
+            or RunStates.created
 
     def output(self, key: str):
         """Return a result value or artifact uri by key."""
@@ -393,18 +405,19 @@ class RunObject(RunTemplate):
         start = time.monotonic()
         while True:
             self.refresh()
-            if self.state in RunStates.terminal_states():
+            if self.status.state in RunStates.terminal_states():
                 break
             if time.monotonic() - start > timeout:
                 raise TimeoutError(
                     f"run {self.metadata.uid} did not complete within {timeout}s"
                 )
             time.sleep(sleep)
-        if raise_on_failure and self.state != RunStates.completed:
+        if raise_on_failure and self.status.state != RunStates.completed:
             raise RuntimeError(
-                f"task {self.metadata.name} did not complete (state={self.state})"
+                f"task {self.metadata.name} did not complete "
+                f"(state={self.status.state})"
             )
-        return self.state
+        return self.status.state
 
     def show(self):
         from .utils import logger

@@ -328,7 +328,7 @@ def compile_kfp_pipeline(project, workflow_spec=None, name: str = "",
         static_inputs: dict = {}
         dyn_args: list = []
         for key, value, bucket, flag in (
-                [(k, v, static_params, "--param")
+                [(k, v, static_params, "--str-param")
                  for k, v in step.params.items()]
                 + [(k, v, static_inputs, "--inputs")
                    for k, v in step.inputs.items()]):

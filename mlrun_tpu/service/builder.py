@@ -23,9 +23,12 @@ BUILD_UID_PREFIX = "build-"
 
 
 def _strip_image_tag(image: str) -> str:
-    """Drop the tag from an image ref — but only a real tag: a ':' in
-    ``registry:5000/repo`` belongs to the registry port, not a tag."""
+    """Drop the tag (and any ``@sha256:...`` digest) from an image ref —
+    but only a real tag: a ':' in ``registry:5000/repo`` belongs to the
+    registry port, not a tag. Digest-pinned refs like ``repo@sha256:abc``
+    or ``repo:tag@sha256:abc`` reduce to plain ``repo``."""
     head, _, last = image.rpartition("/")
+    last = last.split("@", 1)[0]
     if ":" in last:
         last = last.rsplit(":", 1)[0]
     return f"{head}/{last}" if head else last
@@ -186,20 +189,29 @@ class FunctionBuilder:
                     tag: str, task_name: str, log_uid: str,
                     requirements: list, commands: list):
         log = _DbLogWriter(self.db, log_uid, project)
+        error = ""
         try:
             if commands:
-                log.write("note: build commands are image-build only; the "
-                          "local overlay path runs requirements alone. "
-                          f"ignored: {commands}\n")
+                # the overlay path cannot honor docker RUN commands — a
+                # build that silently drops them would "succeed" while
+                # producing an image missing what the user asked for, so
+                # it FAILS loudly instead (use the kubernetes provider's
+                # kaniko path for command-bearing builds)
+                raise RuntimeError(
+                    "build commands require an image build; the local "
+                    "provider's overlay path installs requirements only. "
+                    f"unsupported commands: {commands}")
             ensure_overlay(requirements, log_fp=log)
             state = "ready"
             log.write("build completed\n")
         except Exception as exc:  # noqa: BLE001
             state = "error"
+            error = str(exc)
             log.write(f"build failed: {exc}\n")
             logger.warning("function build failed", function=name,
                            error=str(exc))
-        self._finish(function, name, project, tag, task_name, state)
+        self._finish(function, name, project, tag, task_name, state,
+                     error=error)
 
     # -- kubernetes: kaniko pod --------------------------------------------
     def _build_kaniko(self, function: dict, name: str, project: str,
@@ -207,6 +219,7 @@ class FunctionBuilder:
                       base_image: str, requirements: list, commands: list,
                       dest_image: str):
         log = _DbLogWriter(self.db, log_uid, project)
+        error = ""
         try:
             dockerfile = make_dockerfile(base_image, requirements, commands)
             pod = make_kaniko_pod(project, name, dockerfile, dest_image)
@@ -216,12 +229,14 @@ class FunctionBuilder:
             log.write(f"kaniko pod created: {resource_id}\n")
             deadline = time.time() + 1800
             state = "error"
+            error = "kaniko build timed out"
             while time.time() < deadline:
                 phase = self.provider.state(resource_id)
                 if phase == "Succeeded":
-                    state = "ready"
+                    state, error = "ready", ""
                     break
                 if phase == "Failed":
+                    error = "kaniko pod failed"
                     break
                 time.sleep(2.0)
             log.write(f"kaniko pod finished: {state}\n")
@@ -231,12 +246,16 @@ class FunctionBuilder:
                 pass
         except Exception as exc:  # noqa: BLE001
             state = "error"
+            error = str(exc)
             log.write(f"build failed: {exc}\n")
-        self._finish(function, name, project, tag, task_name, state)
+        self._finish(function, name, project, tag, task_name, state,
+                     error=error)
 
     def _finish(self, function: dict, name: str, project: str, tag: str,
-                task_name: str, state: str):
+                task_name: str, state: str, error: str = ""):
         update_in(function, "status.state", state)
+        if error:
+            update_in(function, "status.error", error)
         self.db.store_function(function, name, project, tag=tag)
         self.db.store_background_task(
             task_name, "succeeded" if state == "ready" else "failed",

@@ -228,8 +228,14 @@ class DeploymentManager:
                                        resource_id, time.time(), tag=tag)
         address = (f"http://{service['metadata']['name']}."
                    f"{mlconf.namespace}.svc.cluster.local:{port}")
-        deadline = time.time() + float(
-            mlconf.function.gateway_ready_timeout)
+        ready_timeout = float(mlconf.function.gateway_ready_timeout)
+        if get_in(function, "spec.build.requirements", None):
+            # first boot pip-installs the overlay before the server binds
+            # — same allowance the local path grants (ADVICE r4: without
+            # it requirement-bearing k8s gateways routinely came up
+            # DEPLOY_UNHEALTHY)
+            ready_timeout = max(ready_timeout * 3, 60.0)
+        deadline = time.time() + ready_timeout
         while time.time() < deadline:
             if self.provider.state(resource_id) == PodPhases.running:
                 return {"state": DEPLOY_READY, "address": address,
@@ -359,6 +365,23 @@ class DeploymentManager:
                     live = PodPhases.failed
                 else:
                     continue
+            if live == PodPhases.running:
+                # the rollout settled after deploy() stopped waiting —
+                # promote the function back to ready (ADVICE r4: monitor
+                # only ever demoted, so a slow first boot left the stored
+                # state 'unhealthy' forever even once the pod was up).
+                # Cheap lock-free peek first: the all-healthy steady state
+                # must not take N function locks per tick
+                if not self._is_unhealthy(name, row["project"],
+                                          tag=row.get("tag", "")):
+                    continue
+                with self._function_lock(name, row["project"]):
+                    current = self._resource_row(uid, row["project"])
+                    if current is not None and \
+                            current["resource_id"] == row["resource_id"]:
+                        self._promote_if_unhealthy(
+                            name, row["project"], tag=row.get("tag", ""))
+                continue
             if live in (PodPhases.failed, PodPhases.succeeded):
                 # serialize with deploy(): a concurrent redeploy may have
                 # just replaced this row — re-read under the lock and only
@@ -388,6 +411,34 @@ class DeploymentManager:
             if row["uid"] == uid and row["project"] == project:
                 return row
         return None
+
+    def _is_unhealthy(self, name: str, project: str,
+                      tag: str = "") -> bool:
+        try:
+            function = self.db.get_function(name, project,
+                                            tag=tag or "latest")
+        except Exception:  # noqa: BLE001
+            return False
+        return bool(function) and get_in(
+            function, "status.state", "") == DEPLOY_UNHEALTHY
+
+    def _promote_if_unhealthy(self, name: str, project: str,
+                              tag: str = ""):
+        tag = tag or "latest"
+        try:
+            function = self.db.get_function(name, project, tag=tag)
+        except Exception:  # noqa: BLE001
+            return
+        if not function or get_in(
+                function, "status.state", "") != DEPLOY_UNHEALTHY:
+            return
+        address = get_in(function, "status.address", "")
+        update_in(function, "status.state", DEPLOY_READY)
+        if address:
+            update_in(function, "status.external_invocation_urls",
+                      [address])
+        self.db.store_function(function, name, project, tag=tag)
+        logger.info("gateway recovered", function=name, project=project)
 
     def _set_function_state(self, name: str, project: str, state: str,
                             tag: str = ""):

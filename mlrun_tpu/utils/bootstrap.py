@@ -37,60 +37,19 @@ def default_overlay_root() -> str:
     return os.path.join(mlconf.home_dir, "pkg-overlays")
 
 
-def _write_lock_owner(lock: str):
-    try:
-        with open(os.path.join(lock, "pid"), "w") as fp:
-            fp.write(str(os.getpid()))
-    except OSError:
-        pass
-
-
-def _lock_owner_dead(lock: str) -> bool:
-    try:
-        with open(os.path.join(lock, "pid")) as fp:
-            pid = int(fp.read().strip())
-    except (OSError, ValueError):
-        # owner hasn't written its pid yet (creation is a two-step
-        # mkdir+write) — give it the benefit of the doubt
-        return False
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return True
-    except OSError:
-        return False
-    return False
-
-
-def _reclaim_lock(lock: str):
-    import shutil
-
-    shutil.rmtree(lock, ignore_errors=True)
-
-
-def _reclaim_stale_lock(lock: str) -> bool:
-    """Atomically take over a lock whose owner died. The taker renames the
-    lock dir aside first — os.rename fails for every loser once one waiter
-    wins — so two waiters can never both reclaim and race a fresh owner
-    that re-created the lock in between (ADVICE r3: rmtree-then-mkdir let
-    a waiter delete a *reclaimed* lock)."""
-    grave = f"{lock}.stale-{os.getpid()}-{time.monotonic_ns()}"
-    try:
-        os.rename(lock, grave)
-    except OSError:
-        return False  # someone else won the takeover (or owner finished)
-    import shutil
-
-    shutil.rmtree(grave, ignore_errors=True)
-    return True
-
-
 def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
                    log_fp=None, timeout: float = 600.0) -> str:
     """Create (or reuse) the cached overlay dir for ``requirements`` and
     return its path. Concurrent callers racing on the same hash serialize
-    on an atomic mkdir lock; losers wait for the winner's ``.ready``
-    marker."""
+    on ``flock(2)`` over a sidecar lock file: the kernel drops the lock
+    the instant its owner dies — even SIGKILLed mid-pip — so there is no
+    pid bookkeeping, no stale-lock reclaim, and no dead-check/takeover
+    race (ADVICE r3/r4: the previous mkdir+pid-file scheme could not
+    close that race). The timeout is a single fixed deadline: waiters
+    poll for the winner's ``.ready`` marker and give up when it passes,
+    regardless of how many owners come and go in between."""
+    import fcntl
+
     overlay_root = overlay_root or default_overlay_root()
     os.makedirs(overlay_root, exist_ok=True)
     key = requirements_hash(requirements)
@@ -99,39 +58,29 @@ def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
     if os.path.exists(ready):
         return overlay
 
-    lock = overlay + ".lock"
-    try:
-        os.mkdir(lock)
-    except FileExistsError:
-        # another process is building this overlay — wait for it; a lock
-        # whose recorded owner pid is dead (builder SIGKILLed mid-pip) is
-        # reclaimed so one crash can't deadlock the hash forever
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if os.path.exists(ready):
-                return overlay
-            if not os.path.isdir(lock):
-                return ensure_overlay(requirements, overlay_root, log_fp,
-                                      timeout)
-            if _lock_owner_dead(lock):
-                _reclaim_stale_lock(lock)
-                # whether this waiter won the rename or lost it, the lock
-                # state just changed — retry from the top (winner rebuilds,
-                # losers wait on the new owner)
-                return ensure_overlay(requirements, overlay_root, log_fp,
-                                      timeout)
-            time.sleep(0.5)
-        raise TimeoutError(
-            f"requirements install for {key} did not finish within "
-            f"{timeout}s")
-    _write_lock_owner(lock)
-
     def log(line: str):
         if log_fp is not None:
             log_fp.write(line if line.endswith("\n") else line + "\n")
             log_fp.flush()
 
+    deadline = time.time() + timeout
+    fd = os.open(overlay + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
     try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if os.path.exists(ready):
+                    return overlay
+                if time.time() >= deadline:
+                    raise TimeoutError(
+                        f"requirements install for {key} did not finish "
+                        f"within {timeout}s")
+                time.sleep(0.25)
+        # lock held; the previous owner may have finished while we waited
+        if os.path.exists(ready):
+            return overlay
         log(f"installing {len(requirements)} requirement(s) into {overlay}")
         cmd = [sys.executable, "-m", "pip", "install",
                "--target", overlay, "--no-warn-script-location",
@@ -151,7 +100,7 @@ def ensure_overlay(requirements: list[str], overlay_root: str | None = None,
         log(f"requirements overlay ready: {overlay}")
         return overlay
     finally:
-        _reclaim_lock(lock)
+        os.close(fd)
 
 
 def exec_with_requirements(requirements: list[str], command: list[str],

@@ -116,3 +116,20 @@ def test_from_env_writes_kfp_output_parameters(tmp_path, cli_home):
     assert out.returncode != 0
     assert "missing" in out.stderr + out.stdout
     assert not (tmp_path / "m").exists()
+
+
+def test_run_str_param_stays_string(tmp_path, cli_home):
+    """--str-param never JSON-coerces (ADVICE r3/r4): a KFP STRING output
+    like '7' must reach the handler as the string '7', while --param keeps
+    literal coercion for human CLI use."""
+    script = tmp_path / "job.py"
+    script.write_text(
+        "def handler(context, a=None, b=None):\n"
+        "    context.log_result('types', f'{type(a).__name__},"
+        "{type(b).__name__}')\n")
+    out = _cli(["run", str(script), "--handler", "handler",
+                "--param", "a=7", "--str-param", "b=7",
+                "--name", "cli-types"], cli_home)
+    assert out.returncode == 0, out.stderr
+    listed = _cli(["get", "runs"], cli_home)
+    assert "'types': 'int,str'" in listed.stdout

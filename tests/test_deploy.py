@@ -141,3 +141,28 @@ def test_deploy_failure_surfaces_log_tail(service, http_db):
     finally:
         mlconf.function.gateway_ready_timeout = old
     assert _gateway_resource(state) is None
+
+
+def test_monitor_promotes_recovered_gateway(service, http_db):
+    """ADVICE r4: deploy() can give up waiting (DEPLOY_UNHEALTHY) while
+    k8s keeps rolling out; once the resource is running the monitor must
+    promote the stored function back to ready — monitor previously only
+    ever demoted, so a slow first boot stayed 'unhealthy' forever."""
+    from mlrun_tpu.utils import update_in
+
+    url, state = service
+    fn = _serving_fn(http_db, name="slowsrv")
+    fn.deploy()
+
+    stored = http_db.get_function("slowsrv", "dep", tag="latest")
+    address = stored["status"]["address"]
+    assert stored["status"]["state"] == "ready"
+    # simulate deploy() having timed out mid-rollout
+    update_in(stored, "status.state", "unhealthy")
+    update_in(stored, "status.external_invocation_urls", [])
+    http_db.store_function(stored, "slowsrv", "dep", tag="latest")
+
+    state.deployments.monitor()
+    stored = http_db.get_function("slowsrv", "dep", tag="latest")
+    assert stored["status"]["state"] == "ready"
+    assert stored["status"]["external_invocation_urls"] == [address]

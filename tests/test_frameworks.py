@@ -24,7 +24,7 @@ def test_sklearn_apply_mlrun_autologs(tmp_path):
 
     fn = mlrun_tpu.new_function("sk", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["accuracy"] > 0.8
     assert "iris" in run.status.artifact_uris
 
@@ -54,7 +54,7 @@ def test_jax_train_handler_local():
         "lora_rank": 2, "log_every": 1,
         "mesh_shape": {"fsdp": 2},
     }, local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["loss"] > 0
     assert "tokens_per_sec_per_chip" in run.status.results
 
@@ -149,7 +149,7 @@ def test_tf_keras_apply_mlrun():
 
     fn = mlrun_tpu.new_function("k", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert "loss" in run.status.results
     assert "keras-model" in run.status.artifact_uris
 
@@ -174,7 +174,7 @@ def test_torch_train_and_serve():
 
     fn = mlrun_tpu.new_function("tt", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert "loss" in run.status.results
     assert "torch-model" in run.status.artifact_uris
 
@@ -255,7 +255,7 @@ def test_artifact_plans_classification_and_regression(tmp_path):
 
     fn = mlrun_tpu.new_function("plans", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["clf_plans"] == [
         "calibration_curve", "confusion_matrix", "feature_importance",
         "roc_curve"]
@@ -286,7 +286,7 @@ def test_sklearn_autolog_produces_plan_artifacts():
 
     fn = mlrun_tpu.new_function("ska", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert "confusion_matrix" in run.status.artifact_uris
     assert "feature_importance" in run.status.artifact_uris
 
@@ -314,7 +314,7 @@ def test_tf_keras_tensorboard_callback():
 
     fn = mlrun_tpu.new_function("tb", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert "tbm-tensorboard" in run.status.artifact_uris
     # event files actually written
     import glob
@@ -343,7 +343,7 @@ def test_plans_string_label_classifier():
 
     fn = mlrun_tpu.new_function("strlbl", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert "confusion_matrix" in run.status.results["plans"]
 
 
@@ -378,7 +378,7 @@ def test_xgboost_booster_logging(tmp_path):
 
     fn = mlrun_tpu.new_function("xgbt", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["valid-rmse"] == pytest.approx(0.5)
     assert "xgb" in run.status.artifact_uris
     assert "xgb_feature_importance" in run.status.artifact_uris
@@ -437,7 +437,7 @@ def test_lightgbm_callback_and_booster(tmp_path):
 
     fn = mlrun_tpu.new_function("lgbt", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["valid-l2"] == pytest.approx(2.0 / 3)
     assert "lgbm" in run.status.artifact_uris
     assert "lgbm_feature_importance" in run.status.artifact_uris

@@ -56,7 +56,7 @@ def test_max_errors_aborts_sweep():
                  local=True)
     # aborted after max_errors iterations, not all six
     assert len(calls) == 2
-    assert run.state == "error"
+    assert run.state() == "error"
 
 
 def test_select_best_iteration_min():

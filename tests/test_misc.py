@@ -8,7 +8,7 @@ def test_hub_import_and_run():
     assert fn.kind == "job"
     assert fn.spec.default_handler == "trainer"
     run = fn.run(local=True, params={"max_iter": 120})
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["accuracy"] > 0.8
 
 
@@ -74,7 +74,7 @@ def test_console_notification_on_run(capsys):
          "message": "run finished fine"}])
     captured = capsys.readouterr()
     assert "run finished fine" in captured.out
-    assert run.state == "completed"
+    assert run.state() == "completed"
 
 
 def test_secrets_store():
@@ -207,7 +207,7 @@ def test_hub_batch_inference_end_to_end(tmp_path):
                  inputs={"dataset": str(data_path)},
                  params={"model_path": str(model_path),
                          "label_column": "label"})
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["prediction_count"] == 80
     assert run.status.results["accuracy"] > 0.9
     assert "prediction_set" in run.status.artifact_uris
@@ -225,7 +225,7 @@ def test_hub_describe_end_to_end(tmp_path):
     fn = mlrun_tpu.import_function("hub://describe")
     run = fn.run(local=True, inputs={"dataset": str(path)},
                  params={"label_column": "cat", "bins": 5})
-    assert run.state == "completed", run.status.error
+    assert run.state() == "completed", run.status.error
     assert run.status.results["rows"] == 50
     for key in ("summary_stats", "histograms", "label_balance"):
         assert key in run.status.artifact_uris

@@ -106,14 +106,16 @@ def test_kfp_compile_without_kfp(tmp_path):
 
     exec_config = json.loads(exec_a["env"][0]["value"])
     assert exec_config["spec"]["parameters"] == {"v": 2}
-    # step-output params ride in ARGS (--param merged over MLT_EXEC_CONFIG
-    # by the --from-env entrypoint): KFP substitutes runtime placeholders
-    # in command/args only, so an env-embedded placeholder would arrive
-    # verbatim. The env config keeps static values only.
+    # step-output params ride in ARGS (--str-param merged over
+    # MLT_EXEC_CONFIG by the --from-env entrypoint): KFP substitutes
+    # runtime placeholders in command/args only, so an env-embedded
+    # placeholder would arrive verbatim. --str-param (not --param)
+    # because KFP output parameters are STRING-typed: a value like "7"
+    # must arrive as the string "7", not be JSON-coerced to an int.
     exec_b = spec["deploymentSpec"]["executors"]["exec-stepb"]["container"]
     assert json.loads(exec_b["env"][0]["value"])["spec"]["parameters"] == {}
     assert exec_b["args"] == [
-        "--param", "v={{$.inputs.parameters['v']}}"]
+        "--str-param", "v={{$.inputs.parameters['v']}}"]
     assert spec["components"]["comp-stepb"]["inputDefinitions"] == {
         "parameters": {"v": {"parameterType": "STRING"}}}
     assert spec["components"]["comp-stepa"]["outputDefinitions"] == {

@@ -14,7 +14,7 @@ def test_local_handler_run():
 
     fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
     run = fn.run(params={"x": 4}, local=True)
-    assert run.state == "completed"
+    assert run.state() == "completed"
     assert run.status.results["y"] == 8
     assert run.output("return") == 5
 
@@ -25,7 +25,7 @@ def test_handler_error_surfaces():
 
     fn = mlrun_tpu.new_function("f", kind="local", handler=handler)
     run = fn.run(local=True)
-    assert run.state == "error"
+    assert run.state() == "error"
     assert "expected failure" in (run.status.error or "")
 
 
