@@ -1,0 +1,264 @@
+"""A stateful fake ``kubernetes`` module for provider tests.
+
+The reference exercises its k8s layer the same way — an in-memory
+K8sHelperMock standing in for the cluster (reference
+tests/api/conftest.py:208-284). This fake models just enough of the
+CoreV1/AppsV1/CustomObjects API surface for ``KubernetesProvider``,
+the kaniko build flow, and the k8s deploy flow to run end-to-end
+without a cluster: objects live in a ``FakeCluster``, state reads can
+be scripted to advance through phases (a kaniko pod that goes
+Pending→Running→Succeeded across polls), and every verb lands in an
+audit trail the tests can assert on.
+"""
+
+from __future__ import annotations
+
+import base64
+import types
+
+
+class ApiException(Exception):
+    def __init__(self, status: int = 500, reason: str = ""):
+        super().__init__(f"({status}) {reason}")
+        self.status = status
+
+
+class FakeCluster:
+    """In-memory cluster state shared by all fake API clients."""
+
+    def __init__(self):
+        self.pods: dict[str, dict] = {}         # name -> manifest
+        self.pod_phases: dict[str, str] = {}    # name -> current phase
+        self.pod_scripts: dict[str, list] = {}  # name -> queued phases
+        self.deployments: dict[str, dict] = {}
+        self.deployment_status: dict[str, dict] = {}
+        self.deploy_scripts: dict[str, list] = {}
+        self.services: dict[str, dict] = {}
+        self.jobsets: dict[str, dict] = {}
+        self.jobset_conditions: dict[str, list] = {}
+        self.secrets: dict[str, dict] = {}
+        self.events: list[tuple[str, str, str]] = []  # (verb, kind, name)
+
+    # -- test control ------------------------------------------------------
+    def script_pod(self, name: str, phases: list[str]):
+        """Queue phases returned by successive state reads (last sticks)."""
+        self.pod_scripts[name] = list(phases)
+
+    def set_pod_phase(self, name: str, phase: str):
+        self.pod_scripts.pop(name, None)  # direct set overrides script
+        self.pod_phases[name] = phase
+
+    def script_deployment(self, name: str, statuses: list[dict]):
+        """Each status: {"available": int, "progressing": bool}."""
+        self.deploy_scripts[name] = list(statuses)
+
+    def set_deployment_status(self, name: str, available: int = 0,
+                              progressing: bool = True):
+        self.deploy_scripts.pop(name, None)  # direct set overrides script
+        self.deployment_status[name] = {
+            "available": available, "progressing": progressing}
+
+    def set_jobset_conditions(self, name: str, conditions: list[dict]):
+        self.jobset_conditions[name] = conditions
+
+    def _pod_phase(self, name: str) -> str:
+        script = self.pod_scripts.get(name)
+        if script:
+            phase = script.pop(0) if len(script) > 1 else script[0]
+            self.pod_phases[name] = phase
+            return phase
+        return self.pod_phases.get(name, "Pending")
+
+    def _deployment_state(self, name: str) -> dict:
+        script = self.deploy_scripts.get(name)
+        if script:
+            status = script.pop(0) if len(script) > 1 else script[0]
+            self.deployment_status[name] = status
+            return status
+        return self.deployment_status.get(
+            name, {"available": 0, "progressing": True})
+
+
+def _pod_object(name: str, manifest: dict, phase: str):
+    labels = manifest.get("metadata", {}).get("labels", {})
+    return types.SimpleNamespace(
+        metadata=types.SimpleNamespace(name=name, labels=labels),
+        status=types.SimpleNamespace(phase=phase))
+
+
+def make_fake_kubernetes(cluster: FakeCluster):
+    """Build a fake ``kubernetes`` module bound to ``cluster``."""
+
+    class CoreV1Api:
+        def __init__(self, api_client=None):
+            self.api_client = api_client or object()
+
+        # pods
+        def create_namespaced_pod(self, ns, manifest):
+            name = manifest["metadata"]["name"]
+            if name in cluster.pods:
+                raise ApiException(409, f"pod {name} exists")
+            cluster.pods[name] = manifest
+            cluster.events.append(("create", "pod", name))
+
+        def read_namespaced_pod(self, name, ns):
+            if name not in cluster.pods:
+                raise ApiException(404, f"pod {name}")
+            return _pod_object(name, cluster.pods[name],
+                               cluster._pod_phase(name))
+
+        def delete_namespaced_pod(self, name, ns):
+            if name not in cluster.pods:
+                raise ApiException(404, f"pod {name}")
+            del cluster.pods[name]
+            cluster.events.append(("delete", "pod", name))
+
+        def list_namespaced_pod(self, ns, label_selector="", limit=0,
+                                _continue=None):
+            key, _, value = label_selector.partition("=")
+            items = [
+                _pod_object(name, manifest, cluster.pod_phases.get(
+                    name, "Running"))
+                for name, manifest in cluster.pods.items()
+                if manifest.get("metadata", {}).get("labels", {}).get(
+                    key) == value]
+            return types.SimpleNamespace(
+                items=items,
+                metadata=types.SimpleNamespace(_continue=None))
+
+        # services
+        def create_namespaced_service(self, ns, manifest):
+            name = manifest["metadata"]["name"]
+            cluster.services[name] = manifest
+            cluster.events.append(("create", "service", name))
+
+        def replace_namespaced_service(self, name, ns, manifest):
+            if name not in cluster.services:
+                raise ApiException(404, f"service {name}")
+            cluster.services[name] = manifest
+            cluster.events.append(("replace", "service", name))
+
+        def delete_namespaced_service(self, name, ns):
+            if name not in cluster.services:
+                raise ApiException(404, f"service {name}")
+            del cluster.services[name]
+            cluster.events.append(("delete", "service", name))
+
+        # secrets
+        def create_namespaced_secret(self, ns, body):
+            name = body.metadata.name
+            cluster.secrets[name] = {"labels": body.metadata.labels,
+                                     "data": body.data}
+            cluster.events.append(("create", "secret", name))
+
+        def replace_namespaced_secret(self, name, ns, body):
+            if name not in cluster.secrets:
+                raise ApiException(404, f"secret {name}")
+            cluster.secrets[name] = {"labels": body.metadata.labels,
+                                     "data": body.data}
+            cluster.events.append(("replace", "secret", name))
+
+        def delete_namespaced_secret(self, name, ns):
+            if name not in cluster.secrets:
+                raise ApiException(404, f"secret {name}")
+            del cluster.secrets[name]
+            cluster.events.append(("delete", "secret", name))
+
+    class AppsV1Api:
+        def __init__(self, api_client=None):
+            self.api_client = api_client
+
+        def create_namespaced_deployment(self, ns, manifest):
+            name = manifest["metadata"]["name"]
+            if name in cluster.deployments:
+                raise ApiException(409, f"deployment {name} exists")
+            cluster.deployments[name] = manifest
+            cluster.events.append(("create", "deployment", name))
+
+        def read_namespaced_deployment(self, name, ns):
+            if name not in cluster.deployments:
+                raise ApiException(404, f"deployment {name}")
+            state = cluster._deployment_state(name)
+            conditions = []
+            if not state.get("progressing", True):
+                conditions.append(types.SimpleNamespace(
+                    type="Progressing", status="False"))
+            return types.SimpleNamespace(status=types.SimpleNamespace(
+                available_replicas=state.get("available", 0),
+                conditions=conditions))
+
+        def delete_namespaced_deployment(self, name, ns):
+            if name not in cluster.deployments:
+                raise ApiException(404, f"deployment {name}")
+            del cluster.deployments[name]
+            cluster.events.append(("delete", "deployment", name))
+
+    class CustomObjectsApi:
+        def create_namespaced_custom_object(self, group, version, ns,
+                                            plural, manifest):
+            name = manifest["metadata"]["name"]
+            if name in cluster.jobsets:
+                raise ApiException(409, f"jobset {name} exists")
+            cluster.jobsets[name] = manifest
+            cluster.events.append(("create", "jobset", name))
+
+        def get_namespaced_custom_object(self, group, version, ns, plural,
+                                         name):
+            if name not in cluster.jobsets:
+                raise ApiException(404, f"jobset {name}")
+            obj = dict(cluster.jobsets[name])
+            obj["status"] = {
+                "conditions": cluster.jobset_conditions.get(name, [])}
+            return obj
+
+        def delete_namespaced_custom_object(self, group, version, ns,
+                                            plural, name):
+            if name not in cluster.jobsets:
+                raise ApiException(404, f"jobset {name}")
+            del cluster.jobsets[name]
+            cluster.events.append(("delete", "jobset", name))
+
+        def list_namespaced_custom_object(self, group, version, ns, plural,
+                                          label_selector="", limit=0,
+                                          **kwargs):
+            key, _, value = label_selector.partition("=")
+            items = [m for m in cluster.jobsets.values()
+                     if m.get("metadata", {}).get("labels", {}).get(
+                         key) == value]
+            return {"items": items, "metadata": {}}
+
+    class V1ObjectMeta:
+        def __init__(self, name="", labels=None):
+            self.name = name
+            self.labels = labels or {}
+
+    class V1Secret:
+        def __init__(self, metadata=None, data=None):
+            self.metadata = metadata
+            self.data = data or {}
+
+    module = types.ModuleType("kubernetes")
+    module.config = types.SimpleNamespace(
+        load_incluster_config=lambda: None,
+        load_kube_config=lambda: None)
+    module.client = types.SimpleNamespace(
+        CoreV1Api=CoreV1Api, AppsV1Api=AppsV1Api,
+        CustomObjectsApi=CustomObjectsApi, V1Secret=V1Secret,
+        V1ObjectMeta=V1ObjectMeta,
+        exceptions=types.SimpleNamespace(ApiException=ApiException))
+    return module
+
+
+def decode_secret(cluster: FakeCluster, name: str) -> dict:
+    return {k: base64.b64decode(v).decode()
+            for k, v in cluster.secrets[name]["data"].items()}
+
+
+def install(monkeypatch):
+    """Install the fake module into sys.modules; returns the cluster."""
+    import sys
+
+    cluster = FakeCluster()
+    monkeypatch.setitem(sys.modules, "kubernetes",
+                        make_fake_kubernetes(cluster))
+    return cluster
