@@ -125,6 +125,11 @@ def train(context: MLClientCtx | None = None,
           mesh_shape: dict | None = None,
           context_parallel: str | None = None,
           seq_axis: str | None = None,
+          pipeline_stages: int = 0,
+          pipeline_microbatches: int = 0,
+          moe_experts: int = 0,
+          moe_top_k: int = 2,
+          moe_capacity_factor: float = 1.25,
           checkpoint_dir: str = "",
           checkpoint_every: int = 0,
           resume: bool = True,
@@ -153,11 +158,32 @@ def train(context: MLClientCtx | None = None,
     if context_parallel and not mesh_shape:
         # long-context default: all chips on the sequence axis
         mesh_shape = {seq_axis or "seq": jax.device_count()}
+    if pipeline_stages and not mesh_shape:
+        # pipeline default: stages on 'pipe', the rest on 'data'
+        n = jax.device_count()
+        if n % pipeline_stages:
+            raise ValueError(
+                f"pipeline_stages={pipeline_stages} does not divide "
+                f"{n} devices; pass mesh_shape explicitly")
+        mesh_shape = {"data": n // pipeline_stages,
+                      "pipe": pipeline_stages}
+    if moe_experts and not mesh_shape:
+        # expert default: as much of the expert dim on 'expert' as the
+        # chip count divides, the rest on 'fsdp'
+        import math
+
+        n = jax.device_count()
+        e = math.gcd(moe_experts, n)
+        mesh_shape = {"expert": e, "fsdp": n // e}
     train_config = TrainConfig(
         learning_rate=learning_rate, total_steps=steps, lora_rank=lora_rank,
         lora_alpha=lora_alpha, grad_accum=grad_accum, mesh_shape=mesh_shape,
         context_parallel=context_parallel,
-        seq_axis=seq_axis or ("seq" if context_parallel else None))
+        seq_axis=seq_axis or ("seq" if context_parallel else None),
+        pipeline_stages=pipeline_stages,
+        pipeline_microbatches=pipeline_microbatches,
+        moe_experts=moe_experts, moe_top_k=moe_top_k,
+        moe_capacity_factor=moe_capacity_factor)
     mesh = make_mesh(mesh_shape)
     trainer = Trainer(model_config, train_config, mesh=mesh)
     trainer.init(seed)

@@ -281,6 +281,18 @@ def chunked_loss(config: LlamaConfig, params: Params, tokens: jax.Array,
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
+    loss, accuracy, total = chunked_ce(x, head, targets, mask=mask,
+                                       chunk=chunk)
+    return loss, {"loss": loss, "accuracy": accuracy, "tokens": total}
+
+
+def chunked_ce(x: jax.Array, head: jax.Array, targets: jax.Array,
+               mask: jax.Array | None = None, chunk: int = 512
+               ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Cross-entropy from hidden states without materializing the full
+    [B, S, vocab] logits — shared by every model family with a dense
+    lm head (llama here, models/moe.py's MoE). Returns
+    (mean_nll, accuracy, token_count)."""
     if mask is None:
         mask = jnp.ones_like(targets, jnp.float32)
     mask = mask.astype(jnp.float32)
@@ -319,9 +331,7 @@ def chunked_loss(config: LlamaConfig, params: Params, tokens: jax.Array,
         scan_body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
                     jnp.zeros((), jnp.float32)), (xc, tc, mc))
     total = jnp.maximum(count, 1.0)
-    loss = loss_sum / total
-    return loss, {"loss": loss, "accuracy": correct_sum / total,
-                  "tokens": total}
+    return loss_sum / total, correct_sum / total, total
 
 
 def loss_fn(config: LlamaConfig, params: Params, tokens: jax.Array,

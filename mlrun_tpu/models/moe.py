@@ -59,6 +59,20 @@ class MoEConfig(LlamaConfig):
         head = 0 if self.tie_embeddings else self.vocab_size * self.embed_dim
         return embed + self.n_layers * per_layer + self.embed_dim + head
 
+    def flops_per_token(self, seq_len: int) -> float:
+        """MFU must count ACTIVE params only: each token touches top_k
+        experts, not all n_experts (dense flops_per_token would inflate
+        the denominator and understate MFU)."""
+        attn = (self.embed_dim * self.qkv_dim
+                + 2 * self.embed_dim * self.kv_dim
+                + self.qkv_dim * self.embed_dim)
+        active_moe = (self.top_k * 3 * self.embed_dim * self.mlp_dim
+                      + self.embed_dim * self.n_experts)
+        matmul = (self.n_layers * (attn + active_moe)
+                  + self.vocab_size * self.embed_dim)
+        attn_flops = 2 * self.n_layers * seq_len * self.qkv_dim
+        return 6.0 * matmul + 6.0 * attn_flops
+
 
 def tiny_moe(**overrides) -> MoEConfig:
     return dataclasses.replace(MoEConfig(
@@ -188,9 +202,9 @@ def _layer_body(config: MoEConfig, x, lp, cos, sin):
     return x + moe_out, aux
 
 
-def forward(config: MoEConfig, params: Params, tokens: jax.Array
-            ) -> tuple[jax.Array, jax.Array]:
-    """tokens [B, S] -> (logits [B, S, V] f32, aux_loss scalar)."""
+def hidden_states(config: MoEConfig, params: Params, tokens: jax.Array
+                  ) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (final hidden [B, S, E], aux_loss scalar)."""
     b, s = tokens.shape
     x = params["embedding"][tokens].astype(config.dtype)
     cos, sin = rope_table(jnp.arange(s), config.head_dim, config.rope_theta)
@@ -206,16 +220,37 @@ def forward(config: MoEConfig, params: Params, tokens: jax.Array
 
     x, aux_losses = jax.lax.scan(scan_fn, x, params["layers"])
     x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    return x, jnp.mean(aux_losses)
+
+
+def _head(params: Params) -> jax.Array:
     head = params.get("lm_head")
-    if head is None:
-        head = params["embedding"].T
-    logits = jnp.einsum("bse,ev->bsv", x, head,
+    return params["embedding"].T if head is None else head
+
+
+def forward(config: MoEConfig, params: Params, tokens: jax.Array
+            ) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (logits [B, S, V] f32, aux_loss scalar)."""
+    x, aux = hidden_states(config, params, tokens)
+    logits = jnp.einsum("bse,ev->bsv", x, _head(params),
                         preferred_element_type=jnp.float32)
-    return logits, jnp.mean(aux_losses)
+    return logits, aux
 
 
 def loss_fn(config: MoEConfig, params: Params, tokens, targets,
-            mask=None) -> tuple[jax.Array, dict]:
+            mask=None, loss_chunk: int = 0) -> tuple[jax.Array, dict]:
+    """CE + router aux loss. ``loss_chunk > 0`` runs the lm head through
+    llama's chunked CE so the full [B, S, vocab] logits never materialize
+    (same memory bound as the dense trainer's loss_chunk)."""
+    if loss_chunk:
+        from .llama import chunked_ce
+
+        x, aux_loss = hidden_states(config, params, tokens)
+        ce, accuracy, _ = chunked_ce(x, _head(params), targets, mask=mask,
+                                     chunk=loss_chunk)
+        loss = ce + config.router_aux_weight * aux_loss
+        return loss, {"loss": loss, "ce_loss": ce, "aux_loss": aux_loss,
+                      "accuracy": accuracy}
     logits, aux_loss = forward(config, params, tokens)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
@@ -226,3 +261,9 @@ def loss_fn(config: MoEConfig, params: Params, tokens, targets,
     ce = jnp.sum(nll * mask) / total
     loss = ce + config.router_aux_weight * aux_loss
     return loss, {"loss": loss, "ce_loss": ce, "aux_loss": aux_loss}
+
+
+def param_shapes(config: MoEConfig) -> Params:
+    """Shape/dtype tree without allocating (trainer sharding setup)."""
+    return jax.eval_shape(
+        functools.partial(init_params, config), jax.random.PRNGKey(0))

@@ -41,7 +41,8 @@ def split_layers_for_stages(layers: dict, n_stages: int) -> dict:
 
 def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
                           num_microbatches: int,
-                          pipe_axis: str = "pipe"):
+                          pipe_axis: str = "pipe",
+                          batch_axis: str | None = None):
     """Build fn(params, tokens) -> logits with layers pipelined over
     ``pipe_axis``. ``params["layers"]`` must be pre-split via
     split_layers_for_stages(mesh.shape[pipe_axis]).
@@ -49,6 +50,11 @@ def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
     Batch must divide into ``num_microbatches``. Embedding/unembedding run
     replicated outside the pipelined region (they are cheap relative to the
     decoder at scale; sharding them rides the other mesh axes).
+
+    ``batch_axis`` composes data parallelism with the pipeline: each
+    microbatch's batch dim is sharded over that mesh axis inside the
+    pipelined region (stage weights stay replicated across it), so a
+    ``data x pipe`` mesh runs D independent pipelines in lockstep.
     """
     n_stages = mesh.shape[pipe_axis]
 
@@ -63,8 +69,8 @@ def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(pipe_axis), P(), P(), P()),
-        out_specs=P(), check_vma=False)
+        in_specs=(P(pipe_axis), P(None, batch_axis), P(), P()),
+        out_specs=P(None, batch_axis), check_vma=False)
     def pipelined_decoder(stage_layers, x_micro, cos, sin):
         """x_micro: [M, mb, S, E] (replicated); stage_layers carries the
         leading [1, L/P, ...] shard of this device's stage."""
@@ -100,6 +106,12 @@ def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
             raise ValueError(
                 f"batch {b} not divisible by {num_microbatches} microbatches")
         mb = b // num_microbatches
+        if batch_axis and mb % mesh.shape[batch_axis]:
+            raise ValueError(
+                f"microbatch size {mb} (batch {b} / {num_microbatches} "
+                f"microbatches) must divide over the '{batch_axis}' mesh "
+                f"axis ({mesh.shape[batch_axis]}); grow the batch or "
+                "shrink the data axis")
         x = params["embedding"][tokens].astype(config.dtype)
         cos, sin = rope_table(jnp.arange(s), config.head_dim,
                               config.rope_theta)
@@ -118,10 +130,11 @@ def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
 
 
 def pipeline_loss_fn(config: LlamaConfig, mesh: Mesh,
-                     num_microbatches: int, pipe_axis: str = "pipe"):
+                     num_microbatches: int, pipe_axis: str = "pipe",
+                     batch_axis: str | None = None):
     """Cross-entropy over the pipelined forward (for train steps)."""
     forward = make_pipeline_forward(config, mesh, num_microbatches,
-                                    pipe_axis)
+                                    pipe_axis, batch_axis=batch_axis)
 
     def loss(params, tokens, targets):
         logits = forward(params, tokens)

@@ -55,6 +55,19 @@ class TrainConfig:
     # mesh may be seq-only or data x seq (fsdp/tensor can't combine with
     # CP under jax 0.9 — see make_train_step).
     context_parallel: str | None = None
+    # pipeline parallelism (parallel/pipeline.py): >1 splits the layer
+    # stack into that many GPipe stages over a 'pipe' mesh axis; composes
+    # with a 'data' axis (D independent pipelines) and grad_accum.
+    pipeline_stages: int = 0
+    # microbatches per pipeline step (0 = pipeline_stages; more shrinks
+    # the fill/drain bubble at the cost of smaller per-stage matmuls)
+    pipeline_microbatches: int = 0
+    # expert parallelism (models/moe.py): >0 swaps the dense MLP for that
+    # many routed experts (MoEConfig) sharded over an 'expert' mesh axis
+    # when present; composes with data/fsdp/tensor axes.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 class TrainState:
@@ -107,6 +120,45 @@ def accumulate_grads(compute_grads: Callable, target_tree, tokens, targets,
     return grads, metrics
 
 
+def resolve_model_config(model_config, train_config: TrainConfig):
+    """Apply TrainConfig model-shaping options: ``moe_experts`` converts a
+    dense LlamaConfig into an MoEConfig with the same backbone dims, so a
+    user reaches expert parallelism through TrainConfig exactly like
+    ``context_parallel``/``pipeline_stages`` (SURVEY §2.4)."""
+    from ..models.moe import MoEConfig
+
+    if train_config.moe_experts and not isinstance(model_config, MoEConfig):
+        model_config = MoEConfig(
+            **dataclasses.asdict(model_config),
+            n_experts=train_config.moe_experts,
+            top_k=train_config.moe_top_k,
+            capacity_factor=train_config.moe_capacity_factor)
+    return model_config
+
+
+def _model_api(model_config):
+    """(loss_fn, param_shapes, init_params, default_rules) for the
+    config's model family — the dense llama path and the MoE path share
+    the whole trainer below this indirection. Every loss adapter takes
+    the SAME signature (config, params, tokens, targets, lora=,
+    act_spec=, loss_chunk=) so the step builder has exactly one call
+    site per family decision."""
+    from ..models import moe as moe_mod
+
+    if isinstance(model_config, moe_mod.MoEConfig):
+        def moe_loss(config, params, tokens, targets, lora=None,
+                     act_spec=None, loss_chunk=0):
+            # lora is rejected up-front for MoE; act_spec only applies to
+            # Explicit-mode meshes of the dense path
+            return moe_mod.loss_fn(config, params, tokens, targets,
+                                   loss_chunk=loss_chunk)
+
+        return (moe_loss, moe_mod.param_shapes, moe_mod.init_params,
+                moe_mod.make_moe_rules())
+    return (llama_mod.loss_fn, llama_mod.param_shapes,
+            llama_mod.init_params, None)
+
+
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, config.learning_rate, config.warmup_steps,
@@ -123,9 +175,25 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
                     optimizer: optax.GradientTransformation,
                     mesh: Mesh, rules=None) -> Callable:
     """Build the jitted sharded train step: (state, tokens, targets) ->
-    (state, metrics). Works for full fine-tune and LoRA (frozen base)."""
+    (state, metrics). Works for full fine-tune and LoRA (frozen base),
+    dense and MoE (``moe_experts``), plain and pipelined
+    (``pipeline_stages``)."""
+    model_config = resolve_model_config(model_config, train_config)
+    from ..models.moe import MoEConfig
+
+    is_moe = isinstance(model_config, MoEConfig)
     is_lora = train_config.lora_rank > 0
     accum = max(1, train_config.grad_accum)
+
+    if is_moe and is_lora:
+        raise ValueError("moe_experts does not compose with lora_rank yet")
+    if is_moe and train_config.context_parallel:
+        raise ValueError(
+            "moe_experts does not compose with context_parallel yet")
+
+    if train_config.pipeline_stages > 1:
+        return _make_pp_step(model_config, train_config, optimizer, mesh,
+                             rules=rules)
 
     if train_config.context_parallel:
         seq_axis = train_config.seq_axis or "seq"
@@ -162,10 +230,12 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
             mesh,
             PartitionSpec(batch_axes, train_config.seq_axis, tensor_axis))
 
+    family_loss, shapes_fn, _, family_rules = _model_api(model_config)
+
     def loss_for(params, lora, tokens, targets):
-        return llama_mod.loss_fn(model_config, params, tokens, targets,
-                                 lora=lora, act_spec=act_spec,
-                                 loss_chunk=train_config.loss_chunk)
+        return family_loss(model_config, params, tokens, targets,
+                           lora=lora, act_spec=act_spec,
+                           loss_chunk=train_config.loss_chunk)
 
     def compute_grads(params, lora, tokens, targets):
         if is_lora:
@@ -207,8 +277,9 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
         return new_state, metrics
 
     # shardings
-    rules = rules if rules is not None else DEFAULT_RULES
-    params_shapes = llama_mod.param_shapes(model_config)
+    rules = rules if rules is not None else (
+        family_rules if family_rules is not None else DEFAULT_RULES)
+    params_shapes = shapes_fn(model_config)
     param_shardings = tree_shardings(params_shapes, mesh, rules)
     data_sh = batch_sharding(mesh, train_config.seq_axis)
     replicated = NamedSharding(mesh, PartitionSpec())
@@ -270,18 +341,143 @@ def _make_cp_step(model_config, train_config, optimizer, mesh, seq_axis,
     return step_fn
 
 
+# pipelined params: the stacked-stage layer tree [P, L/P, ...] shards its
+# stage dim over 'pipe'; everything else (embedding, head, opt scalars)
+# replicates — the pipelined region's shard_map expects exactly this
+PP_RULES: list[tuple[str, tuple]] = [
+    (r".*layers.*", ("pipe",)),
+    (r".*", ()),
+]
+
+
+def _pp_setup(model_config, train_config: TrainConfig, mesh: Mesh,
+              rules=None):
+    """Validate the mesh and build (batch_axis, split_fn, split param
+    shapes, param shardings) for pipeline-parallel training."""
+    from ..parallel.pipeline import split_layers_for_stages
+
+    if rules is not None:
+        # loud, like the lora/context_parallel compositions: the pipelined
+        # region's shard_map fixes the stage sharding, so user rules would
+        # be silently dropped if accepted
+        raise ValueError(
+            "pipeline_stages uses its own stage sharding (PP_RULES); "
+            "custom sharding rules are not supported with the pipeline "
+            "trainer")
+    stages = train_config.pipeline_stages
+    if "pipe" not in mesh.axis_names or mesh.shape["pipe"] != stages:
+        raise ValueError(
+            f"pipeline_stages={stages} needs a 'pipe' mesh axis of that "
+            f"size (mesh: {dict(mesh.shape)})")
+    if train_config.lora_rank:
+        raise ValueError(
+            "pipeline_stages does not compose with lora_rank yet")
+    if train_config.context_parallel or train_config.moe_experts:
+        raise ValueError(
+            "pipeline_stages composes with data parallelism only (not "
+            "context_parallel/moe_experts)")
+    offending = [a for a in mesh.axis_names
+                 if a not in ("pipe", "data") and mesh.shape[a] > 1]
+    if offending:
+        raise ValueError(
+            f"pipeline training runs on pipe (+ optional data) mesh axes; "
+            f"active axes {offending} are not supported inside the "
+            "pipelined region")
+    batch_axis = "data" if ("data" in mesh.axis_names
+                            and mesh.shape["data"] > 1) else None
+
+    def split(params):
+        out = dict(params)
+        out["layers"] = split_layers_for_stages(params["layers"], stages)
+        return out
+
+    shapes = jax.eval_shape(split, llama_mod.param_shapes(model_config))
+    shardings = tree_shardings(shapes, mesh, PP_RULES)
+    return batch_axis, split, shapes, shardings
+
+
+def _make_pp_step(model_config, train_config: TrainConfig, optimizer,
+                  mesh: Mesh, rules=None):
+    """GPipe train step: layers pipelined over the 'pipe' axis via
+    parallel/pipeline.py, composing with a 'data' axis (independent
+    pipelines per data shard) and with grad_accum."""
+    from ..parallel.pipeline import pipeline_loss_fn
+
+    batch_axis, _, shapes, param_shardings = _pp_setup(
+        model_config, train_config, mesh, rules=rules)
+    microbatches = (train_config.pipeline_microbatches
+                    or train_config.pipeline_stages)
+    loss = pipeline_loss_fn(model_config, mesh, microbatches, "pipe",
+                            batch_axis=batch_axis)
+    accum = max(1, train_config.grad_accum)
+
+    def compute_grads(params, tokens, targets):
+        (_, metrics), grads = jax.value_and_grad(
+            loss, has_aux=True)(params, tokens, targets)
+        return grads, metrics
+
+    def step_fn(state: TrainState, tokens, targets):
+        if accum > 1:
+            grads, metrics = accumulate_grads(
+                lambda t, g: compute_grads(state.params, t, g),
+                state.params, tokens, targets, accum)
+        else:
+            grads, metrics = compute_grads(state.params, tokens, targets)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(new_params, new_opt_state, state.step + 1,
+                          None), metrics
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    opt_shardings = tree_shardings(
+        jax.eval_shape(optimizer.init, shapes), mesh, PP_RULES)
+    state_shardings = TrainState(param_shardings, opt_shardings,
+                                 replicated, None)
+    data_sh = NamedSharding(mesh, PartitionSpec(batch_axis))
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, data_sh, data_sh),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=(0,),
+    )
+    jitted._state_shardings = state_shardings
+    jitted._data_sharding = data_sh
+    return jitted
+
+
 def init_train_state(model_config: LlamaConfig, train_config: TrainConfig,
                      optimizer, mesh: Mesh, key: jax.Array,
                      rules=None) -> TrainState:
     """Initialize params directly sharded on the mesh (jit with
     out_shardings so no host-memory staging of the full model)."""
-    rules = rules if rules is not None else DEFAULT_RULES
+    model_config = resolve_model_config(model_config, train_config)
+    if train_config.pipeline_stages > 1:
+        _, split, shapes, param_shardings = _pp_setup(
+            model_config, train_config, mesh, rules=rules)
+        params = jax.jit(
+            lambda k: split(llama_mod.init_params(model_config, k)),
+            out_shardings=param_shardings)(key)
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=tree_shardings(
+                jax.eval_shape(optimizer.init, shapes), mesh, PP_RULES),
+        )(params)
+        step = jax.device_put(jnp.zeros((), jnp.int32),
+                              NamedSharding(mesh, PartitionSpec()))
+        return TrainState(params, opt_state, step, None)
+
+    _, shapes_fn, init_fn, family_rules = _model_api(model_config)
+    rules = rules if rules is not None else (
+        family_rules if family_rules is not None else DEFAULT_RULES)
     is_lora = train_config.lora_rank > 0
-    params_shapes = llama_mod.param_shapes(model_config)
+    params_shapes = shapes_fn(model_config)
     param_shardings = tree_shardings(params_shapes, mesh, rules)
 
     init_params_sharded = jax.jit(
-        functools.partial(llama_mod.init_params, model_config),
+        functools.partial(init_fn, model_config),
         out_shardings=param_shardings)
     params = init_params_sharded(key)
 
@@ -320,14 +516,15 @@ class Trainer:
     def __init__(self, model_config: LlamaConfig,
                  train_config: TrainConfig | None = None,
                  mesh: Mesh | None = None, rules=None):
-        self.model_config = model_config
         self.train_config = train_config or TrainConfig()
+        self.model_config = resolve_model_config(model_config,
+                                                 self.train_config)
         self.mesh = mesh or make_mesh(self.train_config.mesh_shape)
         self.rules = rules
         self.optimizer = make_optimizer(self.train_config)
         self.step_fn = make_train_step(
-            model_config, self.train_config, self.optimizer, self.mesh,
-            rules)
+            self.model_config, self.train_config, self.optimizer,
+            self.mesh, rules)
         self.state: Optional[TrainState] = None
         self._metrics_history: list[dict] = []
 

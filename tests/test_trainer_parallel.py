@@ -1,0 +1,200 @@
+"""PP/EP as user-facing Trainer features (VERDICT r4 #3): a user requests
+pipeline or expert parallelism through ``TrainConfig`` / the jax
+auto-trainer exactly like ``context_parallel=`` — CPU-mesh parity tests in
+the style of test_context_parallel.py."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from mlrun_tpu.models import tiny_llama
+from mlrun_tpu.models.moe import MoEConfig
+from mlrun_tpu.parallel.mesh import make_mesh
+from mlrun_tpu.training import TrainConfig, Trainer
+
+
+def _cfg(**overrides):
+    return tiny_llama(attention_impl="reference", remat=False, **overrides)
+
+
+def _batch(cfg, batch=4, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    targets = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    return tokens, targets
+
+
+def _fit_steps(trainer, cfg, steps=6, batch=4, seq=32):
+    losses = []
+    for step in range(steps):
+        tokens, targets = _batch(cfg, batch=batch, seq=seq, seed=step % 2)
+        metrics = trainer.train_step(tokens, targets)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+# -- pipeline parallelism through TrainConfig --------------------------------
+
+def test_pipeline_trainer_data_x_pipe():
+    """TrainConfig(pipeline_stages=2) on a data x pipe mesh: the stacked
+    layer tree is stage-split and sharded over 'pipe', training composes
+    with the data axis, and the loss goes down."""
+    cfg = _cfg()
+    mesh = make_mesh({"data": 2, "pipe": 2})
+    trainer = Trainer(cfg, TrainConfig(
+        pipeline_stages=2, pipeline_microbatches=2, learning_rate=5e-3,
+        grad_clip=0.0), mesh=mesh)
+    trainer.init(0)
+    layers = trainer.state.params["layers"]
+    wq = jax.tree_util.tree_leaves(layers["wq"])[0]
+    assert wq.shape[0] == 2  # [stages, L/stages, ...]
+    assert "pipe" in str(wq.sharding.spec)
+    losses = _fit_steps(trainer, cfg)
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_pipeline_first_step_matches_dense():
+    """Same seed, same batch: the pipelined step's first loss equals the
+    dense trainer's (the pipeline is a schedule, not a different model)."""
+    cfg = _cfg()
+    tokens, targets = _batch(cfg)
+
+    dense = Trainer(cfg, TrainConfig(learning_rate=1e-3,
+                                     mesh_shape={"fsdp": 1}))
+    dense.init(0)
+    dense_loss = float(dense.train_step(tokens, targets)["loss"])
+
+    mesh = make_mesh({"pipe": 2})
+    pp = Trainer(cfg, TrainConfig(pipeline_stages=2, learning_rate=1e-3),
+                 mesh=mesh)
+    pp.init(0)
+    pp_loss = float(pp.train_step(tokens, targets)["loss"])
+    assert abs(dense_loss - pp_loss) < 2e-2, (dense_loss, pp_loss)
+
+
+def test_pipeline_composes_with_grad_accum():
+    cfg = _cfg()
+    mesh = make_mesh({"pipe": 2})
+    trainer = Trainer(cfg, TrainConfig(
+        pipeline_stages=2, grad_accum=2, learning_rate=5e-3), mesh=mesh)
+    trainer.init(0)
+    losses = _fit_steps(trainer, cfg, steps=4)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_requires_pipe_axis():
+    cfg = _cfg()
+    mesh = make_mesh({"fsdp": 4})
+    with pytest.raises(ValueError, match="pipe"):
+        Trainer(cfg, TrainConfig(pipeline_stages=2), mesh=mesh)
+
+
+def test_pipeline_rejects_lora():
+    cfg = _cfg()
+    mesh = make_mesh({"pipe": 2})
+    with pytest.raises(ValueError, match="lora"):
+        Trainer(cfg, TrainConfig(pipeline_stages=2, lora_rank=4),
+                mesh=mesh)
+
+
+# -- expert parallelism through TrainConfig ----------------------------------
+
+def test_moe_trainer_expert_x_fsdp():
+    """TrainConfig(moe_experts=4) converts the dense config to an
+    MoEConfig, shards the expert tensors over the 'expert' axis, and
+    trains (ce_loss decreases)."""
+    cfg = _cfg()
+    trainer = Trainer(cfg, TrainConfig(
+        moe_experts=4, moe_top_k=2, learning_rate=5e-3,
+        mesh_shape={"expert": 2, "fsdp": 2}))
+    assert isinstance(trainer.model_config, MoEConfig)
+    assert trainer.model_config.n_experts == 4
+    # backbone dims carried over from the dense config
+    assert trainer.model_config.embed_dim == cfg.embed_dim
+    trainer.init(0)
+    gate = trainer.state.params["layers"]["experts_gate"]
+    assert gate.shape[1] == 4  # [L, E, embed, mlp]
+    assert "expert" in str(gate.sharding.spec)
+    losses = []
+    for step in range(8):
+        tokens, targets = _batch(cfg, seed=step % 2)
+        losses.append(float(trainer.train_step(tokens, targets)["ce_loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_flops_counts_active_params_only():
+    dense = _cfg()
+    moe = Trainer(dense, TrainConfig(moe_experts=4, moe_top_k=1,
+                                     mesh_shape={"fsdp": 2})).model_config
+    all_experts = dataclasses.replace(moe, top_k=4)
+    assert moe.flops_per_token(128) < all_experts.flops_per_token(128)
+
+
+def test_moe_rejects_lora_and_cp():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="lora"):
+        Trainer(cfg, TrainConfig(moe_experts=2, lora_rank=4,
+                                 mesh_shape={"fsdp": 2}))
+    with pytest.raises(ValueError, match="context_parallel"):
+        Trainer(cfg, TrainConfig(moe_experts=2, context_parallel="ring",
+                                 mesh_shape={"seq": 2}))
+
+
+# -- through the jax auto-trainer (the user-facing handler) ------------------
+
+def test_auto_trainer_pipeline_stages():
+    from mlrun_tpu.frameworks.jax import auto_trainer
+
+    out = auto_trainer.train(
+        model="tiny", model_overrides={"attention_impl": "reference",
+                                       "remat": False},
+        batch_size=8, seq_len=32, steps=4, pipeline_stages=2,
+        pipeline_microbatches=2, log_every=2)
+    assert np.isfinite(out["loss"])
+
+
+def test_auto_trainer_moe_experts():
+    from mlrun_tpu.frameworks.jax import auto_trainer
+
+    out = auto_trainer.train(
+        model="tiny", model_overrides={"attention_impl": "reference",
+                                       "remat": False},
+        batch_size=4, seq_len=32, steps=4, moe_experts=4, moe_top_k=2,
+        log_every=2)
+    assert np.isfinite(out["loss"])
+    assert "aux_loss" in out
+
+
+def test_moe_loss_chunk_matches_full():
+    """TrainConfig.loss_chunk applies to MoE too (chunked CE over the MoE
+    hidden states): chunked and full losses agree, so the [B,S,vocab]
+    logits never need to materialize for MoE models either."""
+    import jax as _jax
+
+    from mlrun_tpu.models.moe import init_params as moe_init
+    from mlrun_tpu.models.moe import loss_fn as moe_loss
+    from mlrun_tpu.models.moe import tiny_moe
+
+    cfg = tiny_moe(attention_impl="reference")
+    params = moe_init(cfg, _jax.random.PRNGKey(0))
+    tokens, targets = _batch(cfg, batch=2, seq=48)
+    full, m_full = moe_loss(cfg, params, tokens, targets)
+    chunked, m_chunk = moe_loss(cfg, params, tokens, targets,
+                                loss_chunk=16)  # non-multiple of 48? 48%16=0
+    assert abs(float(full) - float(chunked)) < 2e-3
+    assert abs(float(m_full["aux_loss"]) - float(m_chunk["aux_loss"])) < 1e-5
+    # non-multiple chunk exercises the padded path
+    chunked2, _ = moe_loss(cfg, params, tokens, targets, loss_chunk=20)
+    assert abs(float(full) - float(chunked2)) < 2e-3
+
+
+def test_pipeline_rejects_custom_rules():
+    cfg = _cfg()
+    mesh = make_mesh({"pipe": 2})
+    with pytest.raises(ValueError, match="rules"):
+        Trainer(cfg, TrainConfig(pipeline_stages=2), mesh=mesh,
+                rules=[(r".*", ())])
