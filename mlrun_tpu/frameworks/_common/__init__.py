@@ -6,6 +6,16 @@ plots/tables; a producer selects the applicable plans and runs them inside
 the run context. Framework adapters (sklearn/xgboost/lightgbm) share it.
 """
 
+from .callbacks import (  # noqa: F401
+    Callback,
+    CallbackList,
+    CheckpointCallback,
+    EarlyStoppingCallback,
+    EvalPlanCallback,
+    FunctionCallback,
+    MetricsLoggingCallback,
+    TensorBoardCallback,
+)
 from .plans import (  # noqa: F401
     ArtifactPlan,
     CalibrationCurvePlan,

@@ -1,0 +1,350 @@
+"""Structured training callbacks — the framework-wide hook architecture.
+
+Reference analog: ``mlrun/frameworks/pytorch/callbacks/`` (callback.py:25
+Callback ABC; logging_callback.py; mlrun_logging_callback.py;
+tensorboard_logging_callback.py), driven by
+``mlrun/frameworks/pytorch/mlrun_interface.py:106,220``. Re-designed
+framework-agnostic and minus the Horovod rank machinery (the execution
+context's ``is_logging_worker()`` — ``jax.process_index() == 0`` — is the
+rank gate here):
+
+- the JAX ``Trainer.fit`` drives these hooks natively (steps, and epochs
+  when ``epoch_steps`` is set);
+- the torch/tf adapters translate their native epoch streams into the
+  SAME hooks, so one EarlyStopping/Checkpoint/TensorBoard implementation
+  serves every framework.
+
+A hook returning ``False`` from ``on_step_end``/``on_epoch_end`` stops
+training (graceful early stop — the trainer finishes bookkeeping and
+reports ``stopped_early``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+from typing import Callable, Optional, Sequence
+
+from ...utils import logger
+
+
+class Callback:
+    """Base hook set. Subclass and override what you need; ``set_state``
+    is called by the driver before ``on_train_begin`` with whatever
+    handles exist (run context, jax Trainer, torch/keras model)."""
+
+    context = None
+    trainer = None
+    model = None
+
+    def set_state(self, context=None, trainer=None, model=None):
+        self.context = context if context is not None else self.context
+        self.trainer = trainer if trainer is not None else self.trainer
+        self.model = model if model is not None else self.model
+
+    def on_train_begin(self):
+        pass
+
+    def on_epoch_begin(self, epoch: int):
+        pass
+
+    def on_step_end(self, step: int, metrics: dict) -> Optional[bool]:
+        pass
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> Optional[bool]:
+        pass
+
+    def on_train_end(self, metrics: dict):
+        pass
+
+
+class FunctionCallback(Callback):
+    """Adapter for the legacy bare-callable contract
+    ``callback(step, metrics, trainer)`` (pre-r5 Trainer.fit): fired at
+    LOG POINTS only, with the enriched metrics (tokens_per_sec/mfu/step)
+    — exactly the old cadence, so pre-existing callables keep working."""
+
+    log_points_only = True
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def on_step_end(self, step: int, metrics: dict) -> Optional[bool]:
+        return self.fn(step, metrics, self.trainer)
+
+
+class CallbackList:
+    """Dispatches one event to every callback; aggregates stop votes
+    (any explicit ``False`` stops training)."""
+
+    def __init__(self, callbacks: Sequence | None, context=None,
+                 trainer=None, model=None):
+        self.callbacks: list[Callback] = []
+        for cb in callbacks or []:
+            if isinstance(cb, Callback):
+                self.callbacks.append(cb)
+            elif callable(cb):
+                self.callbacks.append(FunctionCallback(cb))
+            else:
+                raise TypeError(
+                    f"callback {cb!r} is neither a Callback nor callable")
+        for cb in self.callbacks:
+            cb.set_state(context=context, trainer=trainer, model=model)
+
+    def _dispatch(self, event: str, *args) -> bool:
+        keep_going = True
+        for cb in self.callbacks:
+            try:
+                if getattr(cb, event)(*args) is False:
+                    keep_going = False
+            except Exception as exc:  # noqa: BLE001 - a broken callback
+                # must not kill the training run it observes
+                logger.warning("callback failed", callback=type(cb).__name__,
+                               event=event, error=str(exc))
+        return keep_going
+
+    def on_train_begin(self):
+        self._dispatch("on_train_begin")
+
+    def on_epoch_begin(self, epoch: int):
+        self._dispatch("on_epoch_begin", epoch)
+
+    def on_step_end(self, step: int, metrics: dict,
+                    log_point: bool = True) -> bool:
+        keep_going = True
+        for cb in self.callbacks:
+            if not log_point and getattr(cb, "log_points_only", False):
+                continue
+            try:
+                if cb.on_step_end(step, metrics) is False:
+                    keep_going = False
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("callback failed",
+                               callback=type(cb).__name__,
+                               event="on_step_end", error=str(exc))
+        return keep_going
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> bool:
+        return self._dispatch("on_epoch_end", epoch, metrics)
+
+    def on_train_end(self, metrics: dict):
+        self._dispatch("on_train_end", metrics)
+
+
+class MetricsLoggingCallback(Callback):
+    """Per-epoch metric logging into the run context (reference
+    mlrun_logging_callback); the jax Trainer logs per-step itself, so
+    this is mainly for the torch/tf adapters."""
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> None:
+        if self.context is not None and metrics \
+                and self.context.is_logging_worker():
+            self.context.log_metrics(
+                {k: float(v) for k, v in metrics.items()
+                 if isinstance(v, (int, float))}, step=epoch)
+
+
+class EarlyStoppingCallback(Callback):
+    """Stop when ``monitor`` hasn't improved by ``min_delta`` for
+    ``patience`` evaluations (epochs when epochs exist, else steps)."""
+
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 min_delta: float = 0.0, mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.mode = mode
+        self.best = math.inf if mode == "min" else -math.inf
+        self.stale = 0
+        self.stopped = False
+
+    def on_train_begin(self):
+        # a reused instance (e.g. stored on a keras handler and driven
+        # through several fit() calls) must start each run fresh, or the
+        # carried-over stale counter stops run 2 on its first epoch
+        self.best = math.inf if self.mode == "min" else -math.inf
+        self.stale = 0
+        self.stopped = False
+        self._epoch_driven = False
+
+    def _observe(self, metrics: dict) -> Optional[bool]:
+        value = metrics.get(self.monitor)
+        if value is None:
+            return None
+        value = float(value)
+        improved = (value < self.best - self.min_delta
+                    if self.mode == "min"
+                    else value > self.best + self.min_delta)
+        if improved:
+            self.best = value
+            self.stale = 0
+            return None
+        self.stale += 1
+        if self.stale >= self.patience:
+            self.stopped = True
+            logger.info("early stopping", monitor=self.monitor,
+                        best=self.best, patience=self.patience)
+            return False
+        return None
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> Optional[bool]:
+        return self._observe(metrics)
+
+    def on_step_end(self, step: int, metrics: dict) -> Optional[bool]:
+        # only steps drive early stop when there is no epoch structure
+        # (the jax Trainer without epoch_steps); the driver guarantees
+        # at most one of the two streams carries metrics
+        if getattr(self, "_epoch_driven", False):
+            return None
+        return self._observe(metrics)
+
+    def on_epoch_begin(self, epoch: int):
+        self._epoch_driven = True
+
+
+class CheckpointCallback(Callback):
+    """Checkpoint every N steps/epochs through a manager with
+    ``save(step, state, force=False)`` (training.CheckpointManager), or a
+    custom ``save_fn``. ``monitor`` + ``mode`` switch to best-only."""
+
+    def __init__(self, manager=None, every_steps: int = 0,
+                 every_epochs: int = 0, save_fn: Callable | None = None,
+                 monitor: str | None = None, mode: str = "min"):
+        if manager is None and save_fn is None:
+            raise ValueError("CheckpointCallback needs manager= or save_fn=")
+        self.manager = manager
+        self.every_steps = every_steps
+        self.every_epochs = every_epochs
+        self.save_fn = save_fn
+        self.monitor = monitor
+        self.mode = mode
+        self.best = math.inf if mode == "min" else -math.inf
+        self.saves = 0
+
+    def _improved(self, metrics: dict) -> bool:
+        if not self.monitor:
+            return True
+        value = metrics.get(self.monitor)
+        if value is None:
+            return False
+        value = float(value)
+        better = value < self.best if self.mode == "min" \
+            else value > self.best
+        if better:
+            self.best = value
+        return better
+
+    def _save(self, tag: int):
+        if self.save_fn is not None:
+            self.save_fn(tag)
+        else:
+            state = getattr(self.trainer, "state", None)
+            if state is None:
+                return
+            self.manager.save(int(state.step), state, force=True)
+        self.saves += 1
+
+    def on_step_end(self, step: int, metrics: dict) -> None:
+        if self.every_steps and (step + 1) % self.every_steps == 0 \
+                and self._improved(metrics):
+            self._save(step)
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> None:
+        if self.every_epochs and (epoch + 1) % self.every_epochs == 0 \
+                and self._improved(metrics):
+            self._save(epoch)
+
+
+class TensorBoardCallback(Callback):
+    """Scalar summaries per step/epoch into TensorBoard event files; the
+    log dir is registered as a run artifact at train end (reference
+    tensorboard_logging_callback.py, framework-agnostic via
+    torch.utils.tensorboard; import-gated)."""
+
+    def __init__(self, log_dir: str = "", name: str = "tensorboard"):
+        # import HERE so a missing writer fails loudly at construction
+        # (CallbackList isolates hook exceptions, so an on_train_begin
+        # ImportError would silently disable the requested feature)
+        from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+
+        self.log_dir = log_dir
+        self.name = name
+        self._writer = None
+
+    def on_train_begin(self):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self.log_dir = self.log_dir or os.path.join(
+            tempfile.mkdtemp(prefix="mlt-tb-"), "train")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._writer = SummaryWriter(self.log_dir)
+
+    def _write(self, prefix: str, tick: int, metrics: dict):
+        if self._writer is None:
+            return
+        for key, value in metrics.items():
+            if isinstance(value, (int, float)) \
+                    and not isinstance(value, bool) \
+                    and math.isfinite(float(value)):
+                self._writer.add_scalar(f"{prefix}/{key}", float(value),
+                                        tick)
+
+    def on_step_end(self, step: int, metrics: dict) -> None:
+        # no per-step flush: SummaryWriter's periodic flushing covers the
+        # steady state; explicit flushes ride the epoch/train boundaries
+        self._write("step", step, metrics)
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> None:
+        self._write("epoch", epoch, metrics)
+        if self._writer is not None:
+            self._writer.flush()
+
+    def on_train_end(self, metrics: dict):
+        if self._writer is not None:
+            self._writer.close()
+        if self.context is not None and self.log_dir \
+                and os.path.isdir(self.log_dir) \
+                and self.context.is_logging_worker():
+            try:
+                self.context.log_artifact(
+                    self.name, local_path=self.log_dir,
+                    labels={"viewer": "tensorboard"})
+            except Exception as exc:  # noqa: BLE001 - artifact best-effort
+                logger.warning("tensorboard artifact failed",
+                               error=str(exc))
+
+
+class EvalPlanCallback(Callback):
+    """Per-epoch artifact plans (confusion matrix / ROC / residuals ...)
+    from ``_common.plans`` over a user eval set: ``eval_fn(model) ->
+    (y_true, y_pred)`` runs every N epochs and at train end, each plan
+    producing a versioned artifact (reference logging_callback's dynamic
+    hyperparameter/metric artifacts generalized to the plan registry)."""
+
+    def __init__(self, eval_fn: Callable, plans: Sequence | None = None,
+                 every_epochs: int = 1, x=None):
+        self.eval_fn = eval_fn
+        self.plans = plans
+        self.every_epochs = max(1, every_epochs)
+        self.x = x
+
+    def _produce(self, tick: int | None):
+        from .plans import produce_artifacts
+
+        if self.context is None or not self.context.is_logging_worker():
+            return
+        y_true, y_pred = self.eval_fn(self.model or self.trainer)
+        suffix = "" if tick is None else f"-epoch{tick}"
+        produce_artifacts(self.context, self.model, self.x, y_true,
+                          y_pred=y_pred, plans=self.plans,
+                          key_suffix=suffix)
+
+    def on_epoch_end(self, epoch: int, metrics: dict) -> None:
+        if (epoch + 1) % self.every_epochs == 0:
+            self._produce(epoch)
+
+    def on_train_end(self, metrics: dict):
+        self._produce(None)

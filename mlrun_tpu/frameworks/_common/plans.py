@@ -208,9 +208,12 @@ DEFAULT_REGRESSION_PLANS = (ResidualsPlan, FeatureImportancePlan)
 
 
 def produce_artifacts(context, model, x, y, y_pred=None,
-                      plans: Optional[list] = None) -> list[str]:
+                      plans: Optional[list] = None,
+                      key_suffix: str = "") -> list[str]:
     """Run every applicable plan; returns the keys that produced
-    artifacts (the producer flow of the reference's _common package)."""
+    artifacts (the producer flow of the reference's _common package).
+    ``key_suffix`` distinguishes repeated productions (e.g. the
+    EvalPlanCallback's per-epoch runs: 'confusion-matrix-epoch3')."""
     if y_pred is None:
         y_pred = model.predict(x)
     if plans is None:
@@ -220,6 +223,12 @@ def produce_artifacts(context, model, x, y, y_pred=None,
         plans = [cls() for cls in classes]
     produced = []
     for plan in plans:
-        if plan.safe_produce(context, model, x, y, y_pred):
-            produced.append(plan.key)
+        original_key = plan.key
+        if key_suffix:
+            plan.key = f"{original_key}{key_suffix}"
+        try:
+            if plan.safe_produce(context, model, x, y, y_pred):
+                produced.append(plan.key)
+        finally:
+            plan.key = original_key
     return produced

@@ -133,6 +133,10 @@ def train(context: MLClientCtx | None = None,
           checkpoint_dir: str = "",
           checkpoint_every: int = 0,
           resume: bool = True,
+          epoch_steps: int = 0,
+          early_stop: dict | None = None,
+          tensorboard: bool = False,
+          callbacks: list | None = None,
           model_name: str = "model",
           log_every: int = 10,
           seed: int = 0) -> dict:
@@ -203,13 +207,23 @@ def train(context: MLClientCtx | None = None,
             logger.info("resumed from checkpoint",
                         step=int(trainer.state.step))
 
-    callbacks = []
-    if manager is not None and checkpoint_every:
-        def ckpt_cb(step, metrics, tr):
-            if (step + 1) % checkpoint_every == 0:
-                manager.save(int(tr.state.step), tr.state)
+    from .._common.callbacks import (
+        CheckpointCallback,
+        EarlyStoppingCallback,
+        TensorBoardCallback,
+    )
 
-        callbacks.append(ckpt_cb)
+    callbacks = list(callbacks or [])
+    if manager is not None and checkpoint_every:
+        callbacks.append(CheckpointCallback(manager,
+                                            every_steps=checkpoint_every))
+    if early_stop:
+        # e.g. early_stop={"monitor": "loss", "patience": 3} — JSON-able
+        # so it works as a run parameter through the handler contract
+        callbacks.append(EarlyStoppingCallback(**early_stop))
+    if tensorboard:
+        callbacks.append(TensorBoardCallback(
+            name=f"{model_name}-tensorboard"))
 
     interface = apply_mlrun(context=context, model_name=model_name)
     # SIGTERM (spot-slice eviction) → final checkpoint + clean resumable
@@ -222,7 +236,7 @@ def train(context: MLClientCtx | None = None,
         final_metrics = trainer.fit(
             stream, steps=steps, context=context, log_every=log_every,
             callbacks=callbacks, checkpoint_manager=manager,
-            preemption_guard=guard)
+            preemption_guard=guard, epoch_steps=epoch_steps)
     finally:
         guard.restore()
     elapsed = time.perf_counter() - start

@@ -18,7 +18,8 @@ def apply_mlrun(model=None, context: MLClientCtx | None = None,
                 model_name: str = "model", tag: str = "",
                 x_test=None, y_test=None, log_model: bool = True,
                 tensorboard: bool = False,
-                tensorboard_weights: bool = False, **kwargs):
+                tensorboard_weights: bool = False,
+                callbacks: list | None = None, **kwargs):
     """Patch a keras model so fit() logs per-epoch metrics and the final
     model to the run context. ``tensorboard=True`` additionally writes
     tf.summary event files (scalars per epoch; weight histograms with
@@ -32,7 +33,8 @@ def apply_mlrun(model=None, context: MLClientCtx | None = None,
                                 x_test=x_test, y_test=y_test,
                                 log_model=log_model,
                                 tensorboard=tensorboard,
-                                tensorboard_weights=tensorboard_weights)
+                                tensorboard_weights=tensorboard_weights,
+                                callbacks=callbacks)
     if model is not None:
         handler.patch()
     return handler
@@ -87,10 +89,45 @@ class TensorboardLoggingCallback:
         return _Callback()
 
 
+class _SharedCallbackBridge:
+    """Translate the keras event stream into the framework-wide
+    ``frameworks._common.Callback`` hooks, so one EarlyStopping /
+    Checkpoint / TensorBoard / EvalPlan implementation serves keras too.
+    A False vote from an epoch hook sets ``model.stop_training`` (the
+    keras-native graceful stop)."""
+
+    def __new__(cls, hooks, model):
+        from tensorflow import keras
+
+        class _Bridge(keras.callbacks.Callback):
+            def on_train_begin(self, logs=None):
+                hooks.on_train_begin()
+
+            def on_epoch_begin(self, epoch, logs=None):
+                hooks.on_epoch_begin(epoch)
+
+            def on_train_batch_end(self, batch, logs=None):
+                metrics = {k: float(v) for k, v in (logs or {}).items()}
+                if not hooks.on_step_end(batch, metrics):
+                    model.stop_training = True
+
+            def on_epoch_end(self, epoch, logs=None):
+                metrics = {k: float(v) for k, v in (logs or {}).items()}
+                if not hooks.on_epoch_end(epoch, metrics):
+                    model.stop_training = True
+
+            def on_train_end(self, logs=None):
+                hooks.on_train_end(
+                    {k: float(v) for k, v in (logs or {}).items()})
+
+        return _Bridge()
+
+
 class KerasModelHandler:
     def __init__(self, model, context, model_name="model", tag="",
                  x_test=None, y_test=None, log_model=True,
-                 tensorboard=False, tensorboard_weights=False):
+                 tensorboard=False, tensorboard_weights=False,
+                 callbacks=None):
         self.model = model
         self.context = context
         self.model_name = model_name
@@ -100,6 +137,7 @@ class KerasModelHandler:
         self._log_model = log_model
         self._tensorboard = tensorboard
         self._tensorboard_weights = tensorboard_weights
+        self._shared_callbacks = callbacks
         self._tb_dir: str | None = None
         self._patched = False
 
@@ -112,6 +150,14 @@ class KerasModelHandler:
         def wrapped_fit(*args, **kwargs):
             callbacks = list(kwargs.get("callbacks") or [])
             callbacks.append(_MLRunLoggingCallback(handler.context, handler))
+            if handler._shared_callbacks:
+                from .._common.callbacks import CallbackList
+
+                hooks = CallbackList(handler._shared_callbacks,
+                                     context=handler.context,
+                                     model=handler.model)
+                callbacks.append(
+                    _SharedCallbackBridge(hooks, handler.model))
             if handler._tensorboard:
                 handler._tb_dir = os.path.join(
                     tempfile.mkdtemp(prefix="mlt-tb-"), "train")

@@ -56,24 +56,44 @@ class TorchModelHandler:
 def train(model, loss_fn, optimizer, train_loader,
           context: MLClientCtx | None = None, epochs: int = 1,
           validation_loader=None, model_name: str = "model",
-          log_model: bool = True) -> dict:
-    """Minimal torch training loop with auto-logging
-    (reference pytorch/__init__.py:46 train analog, host-side)."""
+          log_model: bool = True, callbacks: list | None = None,
+          scheduler=None) -> dict:
+    """Torch training loop driven by the shared callback architecture
+    (reference pytorch/__init__.py:46 train +
+    mlrun_interface.py:106 _epoch loop, minus Horovod): per-epoch metric
+    logging, and any ``frameworks._common.Callback`` —
+    EarlyStopping/Checkpoint/TensorBoard/EvalPlan — plugs into the same
+    hooks the JAX trainer drives."""
     import torch
+
+    from .._common.callbacks import CallbackList
 
     handler = apply_mlrun(model, context, model_name)
     context = handler.context
+    hooks = CallbackList(callbacks, context=context, model=model)
+    hooks.on_train_begin()
     final: dict = {}
+    step = 0
     for epoch in range(epochs):
+        hooks.on_epoch_begin(epoch)
         model.train()
         total, count = 0.0, 0
+        stop = False
         for inputs, targets in train_loader:
             optimizer.zero_grad()
             loss = loss_fn(model(inputs), targets)
             loss.backward()
             optimizer.step()
-            total += float(loss.detach())
+            loss_value = float(loss.detach())
+            total += loss_value
             count += 1
+            if not hooks.on_step_end(step, {"loss": loss_value}):
+                stop = True
+            step += 1
+            if stop:
+                break
+        if scheduler is not None:
+            scheduler.step()
         metrics = {"loss": total / max(count, 1)}
         if validation_loader is not None:
             model.eval()
@@ -85,10 +105,17 @@ def train(model, loss_fn, optimizer, train_loader,
             metrics["validation_loss"] = vtotal / max(vcount, 1)
         handler.log_epoch(epoch, metrics)
         final = metrics
+        if not hooks.on_epoch_end(epoch, metrics) or stop:
+            final = dict(final)
+            final["stopped_early"] = True
+            break
+    hooks.on_train_end(final)
     if context is not None:
         context.log_results(final)
     if log_model:
-        handler.log_model(metrics=final)
+        handler.log_model(metrics={
+            k: v for k, v in final.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)})
     return final
 
 

@@ -510,6 +510,21 @@ def init_train_state(model_config: LlamaConfig, train_config: TrainConfig,
     return TrainState(params, opt_state, step, lora)
 
 
+def _all_hosts_agree(flag: bool) -> bool:
+    """Max-reduce a local boolean across hosts (PreemptionGuard.agreed's
+    construction): under multi-host JAX every host must take the same
+    stop decision in the same step, or the hosts still stepping deadlock
+    in the slice collectives. Single-process: the flag itself."""
+    if jax.process_count() <= 1:
+        return flag
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray(flag, np.int32))
+    return bool(np.max(flags))
+
+
 class Trainer:
     """High-level trainer used by the jax framework adapter and bench."""
 
@@ -546,17 +561,34 @@ class Trainer:
 
     def fit(self, data_iter, steps: int, context=None,
             log_every: int = 10, callbacks: list | None = None,
-            checkpoint_manager=None, preemption_guard=None) -> dict:
+            checkpoint_manager=None, preemption_guard=None,
+            epoch_steps: int = 0) -> dict:
         """Run the training loop; logs metrics to the run context
         rank-0-only. With ``preemption_guard`` + ``checkpoint_manager``, a
         SIGTERM (TPU slice eviction) triggers one final synchronous
         checkpoint and a clean early return with ``preempted: True`` — the
-        JobSet restart then resumes from that step (training/preemption.py)."""
+        JobSet restart then resumes from that step (training/preemption.py).
+
+        ``callbacks`` take structured ``frameworks._common.Callback``
+        objects (on_train_begin / on_step_end / on_epoch_end /
+        on_train_end; returning False from a step/epoch hook stops
+        training gracefully with ``stopped_early: True``) as well as the
+        legacy bare ``callback(step, metrics, trainer)`` callables.
+        ``epoch_steps`` groups steps into epochs for the epoch hooks
+        (0 = no epoch structure)."""
+        from ..frameworks._common.callbacks import CallbackList
+
         assert self.state is not None, "call init() first"
+        hooks = CallbackList(callbacks, context=context, trainer=self)
+        hooks.on_train_begin()
         t_start = time.perf_counter()
         tokens_seen = 0
         seq_len = None
         last = {}
+        epoch = 0
+        stopped = False
+        if epoch_steps:
+            hooks.on_epoch_begin(0)
         for step in range(steps):
             # agreed() (not .requested): all hosts must latch in the SAME
             # step or the ones still stepping deadlock the slice collectives
@@ -572,30 +604,71 @@ class Trainer:
                 last["step"] = int(self.state.step)
                 if context is not None:
                     context.log_result("preempted", True)
+                # preempted runs still finalize callbacks (close writers,
+                # log the tensorboard dir) — they matter MOST here, since
+                # the artifacts are what survives the eviction
+                hooks.on_train_end(last)
                 return last
             tokens, targets = next(data_iter)
             seq_len = tokens.shape[1]
             metrics = self.train_step(tokens, targets)
             tokens_seen += tokens.shape[0] * tokens.shape[1]
-            if (step + 1) % log_every == 0 or step == steps - 1:
-                metrics = {k: float(v) for k, v in metrics.items()}
+            log_point = (step + 1) % log_every == 0 or step == steps - 1
+            # non-log steps hand callbacks the RAW device metrics — no
+            # float() there, so the host keeps dispatching ahead of the
+            # device; a callback that reads a value pays its own sync
+            step_metrics: dict = dict(metrics)
+            if log_point:
+                step_metrics = {k: float(v) for k, v in metrics.items()}
                 elapsed = time.perf_counter() - t_start
                 tps = tokens_seen / elapsed
-                metrics["tokens_per_sec"] = tps
-                metrics["tokens_per_sec_per_chip"] = tps / jax.device_count()
-                metrics["mfu"] = mfu(
+                step_metrics["tokens_per_sec"] = tps
+                step_metrics["tokens_per_sec_per_chip"] = \
+                    tps / jax.device_count()
+                step_metrics["mfu"] = mfu(
                     tps, self.model_config.flops_per_token(seq_len))
-                metrics["step"] = int(self.state.step)
-                self._metrics_history.append(metrics)
-                last = metrics
+                step_metrics["step"] = int(self.state.step)
+                self._metrics_history.append(step_metrics)
+                last = step_metrics
                 if context is not None:
-                    context.log_metrics(metrics, step=int(self.state.step))
+                    context.log_metrics(step_metrics,
+                                        step=int(self.state.step))
                 else:
                     logger.info("train step", **{
                         k: round(v, 4) if isinstance(v, float) else v
-                        for k, v in metrics.items()})
-                for callback in callbacks or []:
-                    callback(step, metrics, self)
+                        for k, v in step_metrics.items()})
+            if hooks.callbacks:
+                local_stop = not hooks.on_step_end(step, step_metrics,
+                                                   log_point=log_point)
+                # cross-host agreement EVERY step (same construction as
+                # PreemptionGuard.agreed): a stop vote driven by
+                # host-local state must flip every host in the same step
+                # or the still-stepping hosts deadlock in the slice
+                # collectives
+                stopped = _all_hosts_agree(local_stop)
+                epoch_boundary = epoch_steps and \
+                    ((step + 1) % epoch_steps == 0 or step == steps - 1
+                     or stopped)
+                if epoch_boundary:
+                    epoch_vote = not hooks.on_epoch_end(epoch,
+                                                        step_metrics)
+                    if not stopped:
+                        # uniform participation: every host reaches this
+                        # agreement call iff `stopped` (already agreed)
+                        # is False everywhere
+                        stopped = _all_hosts_agree(epoch_vote)
+                    epoch += 1
+                    if not stopped and step < steps - 1:
+                        hooks.on_epoch_begin(epoch)
+                if stopped:
+                    if isinstance(last, dict) and last:
+                        last = dict(last)
+                    else:
+                        last = {k: float(v) for k, v in metrics.items()}
+                    last["stopped_early"] = True
+                    last.setdefault("step", int(self.state.step))
+                    break
+        hooks.on_train_end(last)
         return last
 
     @property

@@ -1,0 +1,285 @@
+"""The structured callback architecture (VERDICT r4 #4): one Callback
+base — on_train/on_epoch/on_step hooks, early-stop, checkpoint-every-N,
+tensorboard, eval artifact plans — driven natively by the JAX Trainer and
+bridged into the torch and keras adapters.
+
+Reference analog: mlrun/frameworks/pytorch/callbacks/*.py (callback.py:25
+ABC, logging/mlrun_logging/tensorboard_logging callbacks) minus Horovod.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mlrun_tpu.frameworks._common import (
+    Callback,
+    CallbackList,
+    CheckpointCallback,
+    EarlyStoppingCallback,
+    TensorBoardCallback,
+)
+
+
+class _Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def on_train_begin(self):
+        self.events.append("train_begin")
+
+    def on_epoch_begin(self, epoch):
+        self.events.append(("epoch_begin", epoch))
+
+    def on_step_end(self, step, metrics):
+        self.events.append(("step", step))
+
+    def on_epoch_end(self, epoch, metrics):
+        self.events.append(("epoch_end", epoch))
+
+    def on_train_end(self, metrics):
+        self.events.append("train_end")
+
+
+def test_callback_list_normalizes_and_votes():
+    calls = []
+    rec = _Recorder()
+    hooks = CallbackList([rec, lambda step, m, tr: calls.append(step)])
+    assert hooks.on_step_end(0, {"loss": 1.0}) is True
+    assert calls == [0] and ("step", 0) in rec.events
+
+    class _Stopper(Callback):
+        def on_step_end(self, step, metrics):
+            return False
+
+    hooks = CallbackList([_Stopper(), rec])
+    assert hooks.on_step_end(1, {}) is False
+    # a raising callback is isolated, not fatal
+    class _Broken(Callback):
+        def on_step_end(self, step, metrics):
+            raise RuntimeError("boom")
+
+    assert CallbackList([_Broken()]).on_step_end(0, {}) is True
+    with pytest.raises(TypeError):
+        CallbackList(["not a callback"])
+
+
+def test_early_stopping_min_and_max():
+    cb = EarlyStoppingCallback(monitor="loss", patience=2, mode="min")
+    assert cb.on_epoch_end(0, {"loss": 1.0}) is None
+    assert cb.on_epoch_end(1, {"loss": 0.5}) is None   # improved
+    assert cb.on_epoch_end(2, {"loss": 0.6}) is None   # stale 1
+    assert cb.on_epoch_end(3, {"loss": 0.7}) is False  # stale 2 → stop
+    assert cb.stopped
+
+    up = EarlyStoppingCallback(monitor="accuracy", patience=1, mode="max")
+    assert up.on_epoch_end(0, {"accuracy": 0.5}) is None
+    assert up.on_epoch_end(1, {"accuracy": 0.4}) is False
+    # missing monitor key is a no-op, not a crash
+    assert EarlyStoppingCallback().on_epoch_end(0, {}) is None
+
+
+def test_checkpoint_callback_cadence_and_best_only(tmp_path):
+    saves = []
+    cb = CheckpointCallback(save_fn=saves.append, every_steps=3)
+    for step in range(9):
+        cb.on_step_end(step, {})
+    assert saves == [2, 5, 8]
+
+    best = CheckpointCallback(save_fn=saves.append, every_epochs=1,
+                              monitor="loss", mode="min")
+    saves.clear()
+    best.on_epoch_end(0, {"loss": 1.0})
+    best.on_epoch_end(1, {"loss": 2.0})   # worse — skipped
+    best.on_epoch_end(2, {"loss": 0.5})
+    assert saves == [0, 2]
+
+
+# -- driven by the JAX Trainer ----------------------------------------------
+
+def _tiny_trainer(**cfg_kw):
+    from mlrun_tpu.models import tiny_llama
+    from mlrun_tpu.training import TrainConfig, Trainer
+
+    trainer = Trainer(
+        tiny_llama(attention_impl="reference", remat=False),
+        TrainConfig(mesh_shape={"fsdp": 2}, **cfg_kw))
+    trainer.init(0)
+    return trainer
+
+
+def _stream(trainer, batch=4, seq=32):
+    from mlrun_tpu.training import synthetic_token_stream
+
+    return synthetic_token_stream(batch, seq,
+                                  trainer.model_config.vocab_size)
+
+
+def test_trainer_fit_drives_hooks_with_epochs():
+    trainer = _tiny_trainer()
+    rec = _Recorder()
+    trainer.fit(_stream(trainer), steps=6, log_every=2, callbacks=[rec],
+                epoch_steps=3)
+    assert rec.events[0] == "train_begin"
+    assert rec.events[-1] == "train_end"
+    assert ("epoch_begin", 0) in rec.events
+    assert ("epoch_end", 0) in rec.events and ("epoch_end", 1) in rec.events
+    assert ("step", 5) in rec.events
+
+
+def test_trainer_early_stop_reports_stopped_early():
+    trainer = _tiny_trainer()
+
+    class _StopAt2(Callback):
+        def on_step_end(self, step, metrics):
+            if step >= 2:
+                return False
+
+    out = trainer.fit(_stream(trainer), steps=50, log_every=1,
+                      callbacks=[_StopAt2()])
+    assert out["stopped_early"] is True
+    assert int(trainer.state.step) == 3  # stopped after the third step
+
+
+def test_trainer_checkpoint_every_n_steps(tmp_path):
+    from mlrun_tpu.training import CheckpointManager
+
+    trainer = _tiny_trainer()
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    cb = CheckpointCallback(manager, every_steps=2)
+    trainer.fit(_stream(trainer), steps=4, log_every=2, callbacks=[cb])
+    manager.wait()
+    assert cb.saves == 2
+    assert manager.latest_step() == 4
+    manager.close()
+
+
+def test_trainer_tensorboard_artifact(tmp_path, monkeypatch):
+    pytest.importorskip("torch.utils.tensorboard")
+    import mlrun_tpu
+
+    context = mlrun_tpu.get_or_create_ctx(
+        "tbrun", spec={"metadata": {"project": "cbp"},
+                       "spec": {"output_path": str(tmp_path / "arts")}})
+    trainer = _tiny_trainer()
+    tb = TensorBoardCallback(log_dir=str(tmp_path / "tb"))
+    trainer.fit(_stream(trainer), steps=2, log_every=1, context=context,
+                callbacks=[tb])
+    events = [f for f in os.listdir(tb.log_dir)
+              if f.startswith("events.out.tfevents")]
+    assert events, os.listdir(tb.log_dir)
+    keys = [a["metadata"]["key"]
+            for a in context.to_dict()["status"].get("artifacts", [])]
+    assert "tensorboard" in keys
+
+
+# -- bridged into the torch adapter ------------------------------------------
+
+def _torch_bits():
+    torch = pytest.importorskip("torch")
+    model = torch.nn.Linear(4, 1)
+    xs = torch.randn(32, 4)
+    ys = xs.sum(dim=1, keepdim=True)
+    loader = list(zip(xs.split(8), ys.split(8)))
+    return torch, model, loader
+
+
+def test_torch_train_callbacks_and_early_stop(tmp_path):
+    import mlrun_tpu
+    from mlrun_tpu.frameworks.torch import train
+
+    torch, model, loader = _torch_bits()
+    context = mlrun_tpu.get_or_create_ctx(
+        "torchcb", spec={"metadata": {"project": "cbp"},
+                         "spec": {"output_path": str(tmp_path / "arts")}})
+    rec = _Recorder()
+    stopper = EarlyStoppingCallback(monitor="loss", patience=1,
+                                    min_delta=100.0)  # stops on epoch 2
+    out = train(model, torch.nn.functional.mse_loss,
+                torch.optim.SGD(model.parameters(), lr=0.05), loader,
+                context=context, epochs=10, callbacks=[rec, stopper],
+                log_model=False)
+    assert out["stopped_early"] is True
+    epochs_seen = [e for e in rec.events
+                   if isinstance(e, tuple) and e[0] == "epoch_end"]
+    assert len(epochs_seen) < 10
+    assert rec.events[-1] == "train_end"
+
+
+def test_keras_bridge_early_stop(tmp_path):
+    keras = pytest.importorskip("tensorflow.keras")
+    import numpy as _np
+
+    import mlrun_tpu
+    from mlrun_tpu.frameworks.tf_keras import apply_mlrun
+
+    context = mlrun_tpu.get_or_create_ctx(
+        "kerascb", spec={"metadata": {"project": "cbp"},
+                         "spec": {"output_path": str(tmp_path / "arts")}})
+    model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+    model.compile(optimizer="sgd", loss="mse")
+    stopper = EarlyStoppingCallback(monitor="loss", patience=1,
+                                    min_delta=100.0)
+    apply_mlrun(model, context=context, log_model=False,
+                callbacks=[stopper])
+    x = _np.random.randn(32, 4).astype("float32")
+    y = x.sum(axis=1, keepdims=True).astype("float32")
+    history = model.fit(x, y, epochs=10, verbose=0)
+    assert len(history.history["loss"]) < 10  # stop_training honored
+
+
+def test_eval_plan_callback_produces_epoch_artifacts(tmp_path):
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.linear_model import LogisticRegression
+
+    import mlrun_tpu
+    from mlrun_tpu.frameworks._common import (
+        ConfusionMatrixPlan,
+        EvalPlanCallback,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3))
+    y = (x.sum(axis=1) > 0).astype(int)
+    model = LogisticRegression().fit(x, y)
+    context = mlrun_tpu.get_or_create_ctx(
+        "plancb", spec={"metadata": {"project": "cbp"},
+                        "spec": {"output_path": str(tmp_path / "arts")}})
+    cb = EvalPlanCallback(lambda m: (y, m.predict(x)),
+                          plans=[ConfusionMatrixPlan()], x=x)
+    hooks = CallbackList([cb], context=context, model=model)
+    hooks.on_epoch_end(0, {})
+    hooks.on_train_end({})
+    keys = [a["metadata"]["key"]
+            for a in context.to_dict()["status"].get("artifacts", [])]
+    assert any(k.endswith("-epoch0") for k in keys), keys
+    assert any(not k.endswith("-epoch0") for k in keys), keys
+
+
+def test_legacy_callable_fires_at_log_points_only():
+    """The pre-r5 bare-callable contract is preserved exactly: fired at
+    log points with the enriched metrics (tokens_per_sec/mfu/step),
+    never on intermediate steps with raw device scalars."""
+    trainer = _tiny_trainer()
+    seen = []
+    trainer.fit(_stream(trainer), steps=6, log_every=3,
+                callbacks=[lambda step, m, tr: seen.append((step, m))])
+    assert [s for s, _ in seen] == [2, 5]
+    for _, metrics in seen:
+        assert "tokens_per_sec" in metrics and "step" in metrics
+
+
+def test_preempted_run_still_finalizes_callbacks(tmp_path):
+    """Callback teardown (writer close, artifact logging) runs on the
+    preemption path too — preempted runs are where the artifacts matter
+    most."""
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    trainer = _tiny_trainer()
+    rec = _Recorder()
+    guard = PreemptionGuard()
+    guard.request()  # latched before the first step
+    out = trainer.fit(_stream(trainer), steps=5, log_every=1,
+                      callbacks=[rec], preemption_guard=guard)
+    assert out["preempted"] is True
+    assert rec.events[-1] == "train_end"
