@@ -70,8 +70,11 @@ class FunctionCallback(Callback):
     def __init__(self, fn: Callable):
         self.fn = fn
 
-    def on_step_end(self, step: int, metrics: dict) -> Optional[bool]:
-        return self.fn(step, metrics, self.trainer)
+    def on_step_end(self, step: int, metrics: dict) -> None:
+        # the old loop DISCARDED return values — keep that: a callable
+        # returning something falsy (e.g. CheckpointManager.save's bool)
+        # must not be read as a stop vote
+        self.fn(step, metrics, self.trainer)
 
 
 class CallbackList:

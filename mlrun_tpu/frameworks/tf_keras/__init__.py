@@ -100,6 +100,9 @@ class _SharedCallbackBridge:
         from tensorflow import keras
 
         class _Bridge(keras.callbacks.Callback):
+            _global_step = 0  # keras batch indexes reset per epoch; the
+            # shared hooks (checkpoint-every-N etc.) need a monotonic step
+
             def on_train_begin(self, logs=None):
                 hooks.on_train_begin()
 
@@ -108,8 +111,9 @@ class _SharedCallbackBridge:
 
             def on_train_batch_end(self, batch, logs=None):
                 metrics = {k: float(v) for k, v in (logs or {}).items()}
-                if not hooks.on_step_end(batch, metrics):
+                if not hooks.on_step_end(self._global_step, metrics):
                     model.stop_training = True
+                self._global_step += 1
 
             def on_epoch_end(self, epoch, logs=None):
                 metrics = {k: float(v) for k, v in (logs or {}).items()}

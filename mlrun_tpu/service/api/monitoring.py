@@ -1,0 +1,103 @@
+"""Model endpoints, metric time-series, and the grafana proxy
+(reference: crud/model_monitoring/; endpoints/grafana_proxy.py —
+simpleJSON datasource contract)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ...config import mlconf
+from ..http_utils import API, error_response, json_response
+
+
+def register(r: web.RouteTableDef, state):
+    @r.post(API + "/projects/{project}/model-endpoints/{uid}")
+    async def store_endpoint(request):
+        body = await request.json()
+        state.db.store_model_endpoint(request.match_info["project"],
+                                      request.match_info["uid"], body)
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/model-endpoints/{uid}")
+    async def get_endpoint(request):
+        from ...db.base import RunDBError
+
+        try:
+            endpoint = state.db.get_model_endpoint(
+                request.match_info["project"], request.match_info["uid"])
+        except RunDBError as exc:
+            return error_response(str(exc), 404)
+        return json_response({"data": endpoint})
+
+    @r.get(API + "/projects/{project}/model-endpoints")
+    async def list_endpoints(request):
+        endpoints = state.db.list_model_endpoints(
+            request.match_info["project"],
+            model=request.query.get("model", ""),
+            function=request.query.get("function", ""),
+            state=request.query.get("state", ""))
+        return json_response({"endpoints": endpoints})
+
+    @r.delete(API + "/projects/{project}/model-endpoints/{uid}")
+    async def delete_endpoint(request):
+        state.db.delete_model_endpoint(request.match_info["project"],
+                                       request.match_info["uid"])
+        return json_response({"ok": True})
+
+    @r.get(API + "/projects/{project}/model-endpoints/{uid}/metrics")
+    async def endpoint_metrics(request):
+        """Metric time-series with time-range + downsampling (reference:
+        model-endpoint metric values API over the TSDB layer)."""
+        from ...model_monitoring.tsdb import get_metrics_tsdb
+
+        q = request.query
+        try:
+            start = float(q.get("start", 0) or 0)
+            end = float(q["end"]) if q.get("end") else None
+            max_points = int(q.get("max_points", 1000))
+        except ValueError:
+            return error_response("bad time range", 400)
+        tsdb = get_metrics_tsdb()
+        project = request.match_info["project"]
+        uid = request.match_info["uid"]
+        if q.get("names_only") in ("true", "1"):
+            return json_response(
+                {"metrics": tsdb.list_metrics(project, uid)})
+        return json_response({"series": tsdb.query(
+            project, uid, metric=q.get("name", ""), start=start, end=end,
+            max_points=max_points)})
+
+    # -- grafana proxy ------------------------------------------------------
+    @r.get(API + "/grafana-proxy/model-endpoints")
+    async def grafana_health(request):
+        return json_response({"status": "ok"})
+
+    @r.post(API + "/grafana-proxy/model-endpoints/search")
+    async def grafana_search(request):
+        body = await request.json() if request.can_read_body else {}
+        project = (body.get("target") or "").split(":")[0] \
+            or mlconf.default_project
+        endpoints = state.db.list_model_endpoints(project)
+        return json_response([e.get("uid") for e in endpoints])
+
+    @r.post(API + "/grafana-proxy/model-endpoints/query")
+    async def grafana_query(request):
+        body = await request.json()
+        rows = []
+        columns = [{"text": "endpoint_id", "type": "string"},
+                   {"text": "model", "type": "string"},
+                   {"text": "requests", "type": "number"},
+                   {"text": "avg_latency_microsec", "type": "number"},
+                   {"text": "drift_status", "type": "string"}]
+        for target in body.get("targets", [{}]):
+            spec = (target.get("target") or "")
+            project = spec.split(":")[0] or mlconf.default_project
+            for endpoint in state.db.list_model_endpoints(project):
+                metrics = endpoint.get("metrics", {})
+                rows.append([
+                    endpoint.get("uid"), endpoint.get("name"),
+                    metrics.get("requests", 0),
+                    metrics.get("avg_latency_microsec", 0),
+                    endpoint.get("drift_status", "")])
+        return json_response([{"type": "table", "columns": columns,
+                               "rows": rows}])

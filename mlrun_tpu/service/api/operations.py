@@ -1,0 +1,118 @@
+"""Health, specs, introspection, background tasks, and runtime
+resources (reference: endpoints/healthz.py, client_spec,
+frontend_spec, background_tasks.py, runtime_resources.py,
+utils/memory_reports.py)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ... import __version__
+from ...common.runtimes_constants import RunStates
+from ...config import mlconf
+from ...utils import get_in
+from ..http_utils import API, error_response, json_response
+
+
+def register(r: web.RouteTableDef, state):
+    @r.get(f"{API}/healthz")
+    async def healthz(request):
+        return json_response({"status": "ok", "version": __version__})
+
+    @r.get(f"{API}/client-spec")
+    async def client_spec(request):
+        return json_response({
+            "version": __version__,
+            "namespace": mlconf.namespace,
+            "default_project": mlconf.default_project,
+            "tpu_defaults": mlconf.tpu.to_dict(),
+            "config_overrides": {},
+        })
+
+    @r.get(API + "/frontend-spec")
+    async def frontend_spec(request):
+        from ...common.runtimes_constants import RuntimeKinds
+
+        return json_response({
+            "feature_flags": {"tpujob": True, "serving": True,
+                              "feature_store": True,
+                              "model_monitoring": True},
+            "default_artifact_path": mlconf.resolve_artifact_path(
+                "{project}"),
+            "runtime_kinds": RuntimeKinds.all(),
+        })
+
+    @r.get(API + "/operations/memory-report")
+    async def memory_report(request):
+        """reference analog: server/api/utils/memory_reports.py (objgraph) —
+        here host RSS + device HBM via the profiler util."""
+        from ...utils.profiler import memory_report as report
+
+        return json_response({"data": report()})
+
+    # -- background tasks ---------------------------------------------------
+    @r.get(API + "/projects/{project}/background-tasks")
+    async def list_background_tasks(request):
+        return json_response(
+            {"background_tasks": state.db.list_background_tasks(
+                request.match_info["project"])})
+
+    @r.get(API + "/projects/{project}/background-tasks/{name}")
+    async def get_background_task(request):
+        task = state.db.get_background_task(
+            request.match_info["name"], request.match_info["project"])
+        if task is None:
+            return error_response("background task not found", 404)
+        return json_response({"data": task})
+
+    # -- runtime resources (reference: endpoints/runtime_resources.py —
+    # grouped listing + filtered deletion of the cluster resources a run
+    # created) --------------------------------------------------------------
+    @r.get(API + "/projects/{project}/runtime-resources")
+    async def list_runtime_resources(request):
+        project = request.match_info["project"]
+        kind = request.query.get("kind", "")
+        rows = state.db.list_runtime_resources(kind)
+        if project not in ("*", ""):
+            rows = [row for row in rows if row["project"] == project]
+        grouped: dict = {}
+        for row in rows:
+            handler = state.launcher.handler_for(row["kind"])
+            try:
+                live_state = handler.provider.state(row["resource_id"])
+            except Exception:  # noqa: BLE001 - provider may be gone
+                live_state = "unknown"
+            grouped.setdefault(row["kind"], []).append({
+                **row, "state": live_state})
+        return json_response({"runtime_resources": [
+            {"kind": kind_, "resources": res}
+            for kind_, res in sorted(grouped.items())]})
+
+    @r.delete(API + "/projects/{project}/runtime-resources")
+    async def delete_runtime_resources(request):
+        project = request.match_info["project"]
+        kind = request.query.get("kind", "")
+        object_id = request.query.get("object-id", "")
+        force = request.query.get("force", "") in ("true", "1")
+        deleted = []
+        for row in state.db.list_runtime_resources(kind):
+            if project not in ("*", "") and row["project"] != project:
+                continue
+            if object_id and row["resource_id"] != object_id:
+                continue
+            run = state.db.read_run(row["uid"], row["project"])
+            run_state = get_in(run or {}, "status.state", "")
+            if not force and run_state not in RunStates.terminal_states():
+                continue  # reference refuses to delete live runs w/o force
+            handler = state.launcher.handler_for(row["kind"])
+            try:
+                # goes through the handler so the in-memory resource map is
+                # also dropped — otherwise the next monitor tick would probe
+                # the deleted resource and mark the run failed
+                handler.delete_resources(row["uid"], row["project"],
+                                         row["resource_id"])
+            except Exception:  # noqa: BLE001 - provider may be gone; keep
+                # the mapping so a later retry can still find the resource
+                continue
+            deleted.append(row)
+        return json_response({"deleted": deleted})

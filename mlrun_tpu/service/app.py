@@ -5,72 +5,36 @@ server/api/api/api.py, reduced to the same REST contract the SDK's HTTPRunDB
 speaks. FastAPI/SQLAlchemy are replaced by aiohttp + the embedded SQLite DB.
 Periodic tasks mirror main.py:608 (runs monitoring) and the APScheduler-based
 Scheduler (utils/scheduler.py) is replaced by service/cron.py.
+
+This module keeps only the app assembly: ServiceState, middleware, the
+periodic loops, and run_app. Every route lives in a per-resource module
+under ``service/api/`` (the reference's endpoints/+crud/ layout).
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
 import os
-import threading
 import time
 from datetime import datetime, timezone
-from typing import Optional
 
 from aiohttp import web
 
 from .. import __version__
-from ..common.runtimes_constants import RunStates, RuntimeKinds
 from ..config import mlconf
 from ..db.sqlitedb import SQLiteRunDB
 from ..model import RunObject
-from ..utils import generate_uid, get_in, logger, now_iso, update_in
+from ..utils import generate_uid, logger
 from .cron import CronSchedule
+from .http_utils import (  # noqa: F401 - re-exported for compat
+    API,
+    error_response,
+    json_response,
+    paginate,
+    token_paginated_response,
+)
 from .launcher import ServerSideLauncher, rebuild_function
 from .runtime_handlers import LocalProcessProvider
-
-API = mlconf.api_base_path.rstrip("/")
-
-
-def token_paginated_response(state, request, method: str, key: str,
-                             filters: dict):
-    """Token-pagination branch shared by list endpoints: parse page
-    params, delegate to the DB pagination cache, shape the response."""
-    from ..db.base import RunDBError
-
-    q = request.query
-    try:
-        items, token = state.db.paginated_list(
-            method, page_size=int(q.get("page_size", 20)),
-            page_token=q.get("page_token", ""), **filters)
-    except (RunDBError, ValueError) as exc:
-        return error_response(str(exc), 400)
-    return json_response({key: items,
-                          "pagination": {"page_token": token}})
-
-
-def paginate(items: list, request) -> list:
-    """limit/offset slicing for list endpoints (reference pagination
-    analog — token-based pagination cache is R2)."""
-    try:
-        offset = int(request.query.get("offset", 0))
-        limit = int(request.query.get("limit", 0))
-    except ValueError:
-        return items
-    if offset:
-        items = items[offset:]
-    if limit:
-        items = items[:limit]
-    return items
-
-
-def json_response(data, status: int = 200):
-    return web.json_response(data, status=status, dumps=lambda d: json.dumps(
-        d, default=str))
-
-
-def error_response(message: str, status: int = 400):
-    return web.json_response({"detail": message}, status=status)
 
 
 class ServiceState:
@@ -114,6 +78,7 @@ def auth_middleware():
 
 
 def build_app(state: ServiceState | None = None) -> web.Application:
+    from .api import REGISTRARS
     from .clusterization import clusterization_middleware, is_chief
 
     state = state or ServiceState()
@@ -124,1033 +89,8 @@ def build_app(state: ServiceState | None = None) -> web.Application:
     app["is_chief"] = is_chief()
 
     r = web.RouteTableDef()
-
-    # -- health / spec ------------------------------------------------------
-    @r.get(f"{API}/healthz")
-    async def healthz(request):
-        return json_response({"status": "ok", "version": __version__})
-
-    @r.get(f"{API}/client-spec")
-    async def client_spec(request):
-        return json_response({
-            "version": __version__,
-            "namespace": mlconf.namespace,
-            "default_project": mlconf.default_project,
-            "tpu_defaults": mlconf.tpu.to_dict(),
-            "config_overrides": {},
-        })
-
-    # -- runs ----------------------------------------------------------------
-    @r.post(API + "/projects/{project}/runs/{uid}")
-    async def store_run(request):
-        body = await request.json()
-        state.db.store_run(body, request.match_info["uid"],
-                           request.match_info["project"],
-                           iter=int(request.query.get("iter", 0)))
-        return json_response({"ok": True})
-
-    @r.patch(API + "/projects/{project}/runs/{uid}")
-    async def update_run(request):
-        body = await request.json()
-        state.db.update_run(body, request.match_info["uid"],
-                            request.match_info["project"],
-                            iter=int(request.query.get("iter", 0)))
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/runs/{uid}")
-    async def read_run(request):
-        run = state.db.read_run(request.match_info["uid"],
-                                request.match_info["project"],
-                                iter=int(request.query.get("iter", 0)))
-        if run is None:
-            return error_response("run not found", 404)
-        return json_response({"data": run})
-
-    @r.get(API + "/projects/{project}/runs")
-    async def list_runs(request):
-        q = request.query
-        filters = dict(
-            name=q.get("name", ""), project=request.match_info["project"],
-            state=q.get("state", ""), labels=q.getall("label", None),
-            last=int(q.get("last", 0)), iter=bool(int(q.get("iter", 0))),
-            uid=q.getall("uid", None))
-        if "page_size" in q or "page_token" in q:
-            return token_paginated_response(state, request, "list_runs",
-                                            "runs", filters)
-        runs = state.db.list_runs(**filters)
-        return json_response({"runs": paginate(runs, request)})
-
-    @r.delete(API + "/projects/{project}/runs/{uid}")
-    async def del_run(request):
-        state.db.del_run(request.match_info["uid"],
-                         request.match_info["project"],
-                         iter=int(request.query.get("iter", 0)))
-        return json_response({"ok": True})
-
-    @r.post(API + "/projects/{project}/runs/{uid}/abort")
-    async def abort_run(request):
-        uid = request.match_info["uid"]
-        project = request.match_info["project"]
-        run = state.db.read_run(uid, project)
-        if run is None:
-            return error_response("run not found", 404)
-        kind = get_in(run, "metadata.labels.kind", "job")
-        try:
-            handler = state.launcher.handler_for(kind)
-            handler.abort_run(uid, project)
-        except ValueError:
-            state.db.abort_run(uid, project)
-        state.db.emit_event("run_aborted", {"uid": uid}, project)
-        return json_response({"ok": True})
-
-    # -- logs ----------------------------------------------------------------
-    @r.post(API + "/projects/{project}/logs/{uid}")
-    async def store_log(request):
-        body = await request.read()
-        state.db.store_log(request.match_info["uid"],
-                           request.match_info["project"], body,
-                           append=bool(int(request.query.get("append", 1))))
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/logs/{uid}")
-    async def get_log(request):
-        log_state, data = state.db.get_log(
-            request.match_info["uid"], request.match_info["project"],
-            offset=int(request.query.get("offset", 0)),
-            size=int(request.query.get("size", -1)))
-        return web.Response(body=data, headers={
-            "x-mlt-run-state": log_state or "unknown"})
-
-    @r.get(API + "/projects/{project}/logs/{uid}/size")
-    async def get_log_size(request):
-        size = state.db.get_log_size(request.match_info["uid"],
-                                     request.match_info["project"])
-        return json_response({"size": size})
-
-    # -- artifacts ------------------------------------------------------------
-    @r.post(API + "/projects/{project}/artifacts/{key}")
-    async def store_artifact(request):
-        body = await request.json()
-        q = request.query
-        state.db.store_artifact(
-            request.match_info["key"], body, uid=q.get("uid"),
-            iter=int(q.get("iter") or 0), tag=q.get("tag", ""),
-            project=request.match_info["project"], tree=q.get("tree"))
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/artifacts/{key}")
-    async def read_artifact(request):
-        from ..db.base import RunDBError
-
-        q = request.query
-        try:
-            artifact = state.db.read_artifact(
-                request.match_info["key"], tag=q.get("tag"),
-                iter=int(q.get("iter") or 0) if q.get("iter") else None,
-                project=request.match_info["project"], tree=q.get("tree"),
-                uid=q.get("uid"))
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"data": artifact})
-
-    @r.get(API + "/projects/{project}/artifacts")
-    async def list_artifacts(request):
-        q = request.query
-        filters = dict(
-            name=q.get("name", ""), project=request.match_info["project"],
-            tag=q.get("tag"), labels=q.getall("label", None),
-            kind=q.get("kind"), tree=q.get("tree"))
-        if "page_size" in q or "page_token" in q:
-            return token_paginated_response(
-                state, request, "list_artifacts", "artifacts", filters)
-        artifacts = state.db.list_artifacts(**filters)
-        return json_response(
-            {"artifacts": paginate(artifacts, request)})
-
-    @r.delete(API + "/projects/{project}/artifacts/{key}")
-    async def del_artifact(request):
-        state.db.del_artifact(
-            request.match_info["key"], tag=request.query.get("tag"),
-            project=request.match_info["project"],
-            uid=request.query.get("uid"))
-        return json_response({"ok": True})
-
-    # -- functions -------------------------------------------------------------
-    @r.post(API + "/projects/{project}/functions/{name}")
-    async def store_function(request):
-        body = await request.json()
-        hash_key = state.db.store_function(
-            body, request.match_info["name"], request.match_info["project"],
-            tag=request.query.get("tag", ""),
-            versioned=bool(int(request.query.get("versioned", 0))))
-        return json_response({"hash_key": hash_key})
-
-    @r.get(API + "/projects/{project}/functions/{name}")
-    async def get_function(request):
-        from ..db.base import RunDBError
-
-        try:
-            func = state.db.get_function(
-                request.match_info["name"], request.match_info["project"],
-                tag=request.query.get("tag", ""),
-                hash_key=request.query.get("hash_key", ""))
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"func": func})
-
-    @r.get(API + "/projects/{project}/functions")
-    async def list_functions(request):
-        funcs = state.db.list_functions(
-            name=request.query.get("name", ""),
-            project=request.match_info["project"],
-            tag=request.query.get("tag", ""),
-            labels=request.query.getall("label", None))
-        return json_response({"funcs": paginate(funcs, request)})
-
-    @r.delete(API + "/projects/{project}/functions/{name}")
-    async def delete_function(request):
-        # a live gateway dies with its function
-        loop = asyncio.get_event_loop()
-        await loop.run_in_executor(
-            None, lambda: state.deployments.teardown(
-                request.match_info["name"], request.match_info["project"],
-                store_state=False))
-        state.db.delete_function(request.match_info["name"],
-                                 request.match_info["project"])
-        return json_response({"ok": True})
-
-    @r.post(API + "/projects/{project}/functions/{name}/deploy")
-    async def deploy_function(request):
-        """Deploy = a RUNNING, addressable gateway (reference nuclio
-        function.py:551; serving.py:580). The deployment manager spawns an
-        ASGI graph-server process (local provider) or a Deployment+Service
-        (kubernetes) and answers once it's invocable."""
-        body = await request.json()
-        function = body.get("function", {})
-        update_in(function, "metadata.name", request.match_info["name"])
-        update_in(function, "metadata.project",
-                  request.match_info["project"])
-        kind = function.get("kind", "")
-        if kind not in (RuntimeKinds.serving, RuntimeKinds.remote,
-                        RuntimeKinds.application):
-            # batch kinds have nothing to run until submitted — deploy just
-            # resolves the image + readiness (the build path)
-            update_in(function, "status.state", "ready")
-            state.db.store_function(
-                function, request.match_info["name"],
-                request.match_info["project"],
-                tag=function.get("metadata", {}).get("tag", "latest"))
-            return json_response({"data": {"state": "ready",
-                                           "address": ""}})
-        loop = asyncio.get_event_loop()
-        info = await loop.run_in_executor(
-            None, lambda: state.deployments.deploy(function))
-        if info["state"] == "error":
-            return error_response(
-                f"function deploy failed: {info.get('error', '')}", 400)
-        return json_response({"data": info})
-
-    @r.delete(API + "/projects/{project}/functions/{name}/deploy")
-    async def undeploy_function(request):
-        loop = asyncio.get_event_loop()
-        removed = await loop.run_in_executor(
-            None, lambda: state.deployments.teardown(
-                request.match_info["name"], request.match_info["project"]))
-        return json_response({"removed": removed})
-
-    # -- build ------------------------------------------------------------------
-    @r.post(API + "/build/function")
-    async def build_function(request):
-        """Real build path (reference server/api/utils/builder.py:39,144 +
-        endpoints/functions.py:272): prebuilt image + code-in-env stays a
-        no-op, but requirements/commands now trigger an actual build — a
-        venv-cache pre-warm (local provider) or a Kaniko pod (kubernetes),
-        tracked as a background task with a retrievable log."""
-        body = await request.json()
-        function = body.get("function", {})
-        with_tpu = body.get("with_tpu", False)
-        loop = asyncio.get_event_loop()
-        status = await loop.run_in_executor(
-            None, lambda: state.builder.build(function, with_tpu=with_tpu))
-        return json_response({"data": {"status": status}})
-
-    @r.get(API + "/build/status")
-    async def build_status(request):
-        """Build state + incremental log (reference get_builder_status)."""
-        status = state.builder.status(
-            request.query.get("name", ""),
-            request.query.get("project", "") or mlconf.default_project,
-            tag=request.query.get("tag", "latest"),
-            offset=int(request.query.get("offset", 0) or 0))
-        if status["state"] == "not_found":
-            return error_response("function not found", 404)
-        return json_response({"data": status})
-
-    # -- submit ------------------------------------------------------------------
-    @r.post(API + "/submit_job")
-    async def submit_job(request):
-        """The core submission path (reference endpoints/submit.py:40 →
-        api/utils.py:207 submit_run)."""
-        body = await request.json()
-        function_dict = body.get("function")
-        task = body.get("task") or {"metadata": body.get("metadata", {}),
-                                    "spec": body.get("spec", {})}
-        schedule = body.get("schedule")
-        if not function_dict:
-            # resolve from the db via task.spec.function uri
-            uri = get_in(task, "spec.function", "")
-            if not uri:
-                return error_response("missing function")
-            project_part, _, rest = uri.partition("/")
-            name, _, tag = rest.partition(":")
-            tag, _, hash_key = tag.partition("@")
-            function_dict = state.db.get_function(
-                name, project_part, tag=tag or "latest")
-
-        run = RunObject.from_dict(
-            {"metadata": task.get("metadata", {}),
-             "spec": task.get("spec", {})})
-        run.metadata.uid = run.metadata.uid or generate_uid()
-        run.metadata.project = (run.metadata.project
-                                or mlconf.default_project)
-        runtime = rebuild_function(function_dict)
-        run.metadata.labels.setdefault("kind", runtime.kind)
-        # notification secret-params never reach the stored run or the
-        # resource env (reference api/utils.py:221 mask_notification_params)
-        from .secrets import mask_notification_params
-
-        mask_notification_params(state.db, run)
-
-        if schedule:
-            record = {
-                "name": run.metadata.name, "project": run.metadata.project,
-                "kind": "job", "cron_trigger": schedule,
-                "scheduled_object": {"function": function_dict,
-                                     "task": run.to_dict()},
-                "creation_time": now_iso(),
-            }
-            try:
-                cron = CronSchedule(schedule)
-            except ValueError as exc:
-                return error_response(f"bad schedule: {exc}")
-            if cron.min_interval_seconds() < \
-                    mlconf.scheduler.min_allowed_interval_seconds:
-                return error_response("schedule interval below minimum")
-            record["next_run_time"] = str(
-                cron.next_after(datetime.now(timezone.utc)))
-            state.db.store_schedule(run.metadata.project, run.metadata.name,
-                                    record)
-            return json_response({"data": {"schedule": schedule,
-                                           "metadata":
-                                           run.to_dict()["metadata"]}})
-
-        loop = asyncio.get_event_loop()
-        try:
-            await loop.run_in_executor(
-                None, lambda: state.launcher.launch(runtime, run))
-        except Exception as exc:  # noqa: BLE001
-            return error_response(f"launch failed: {exc}", 500)
-        return json_response({"data": run.to_dict()})
-
-    # -- schedules -----------------------------------------------------------------
-    @r.post(API + "/projects/{project}/schedules/{name}")
-    async def store_schedule(request):
-        body = await request.json()
-        try:
-            CronSchedule(body.get("cron_trigger", ""))
-        except ValueError as exc:
-            return error_response(f"bad cron: {exc}")
-        state.db.store_schedule(request.match_info["project"],
-                                request.match_info["name"], body)
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/schedules/{name}")
-    async def get_schedule(request):
-        from ..db.base import RunDBError
-
-        try:
-            schedule = state.db.get_schedule(request.match_info["project"],
-                                             request.match_info["name"])
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"data": schedule})
-
-    @r.get(API + "/projects/{project}/schedules")
-    async def list_schedules(request):
-        return json_response({"schedules": state.db.list_schedules(
-            request.match_info["project"])})
-
-    @r.delete(API + "/projects/{project}/schedules/{name}")
-    async def delete_schedule(request):
-        state.db.delete_schedule(request.match_info["project"],
-                                 request.match_info["name"])
-        return json_response({"ok": True})
-
-    # -- projects ---------------------------------------------------------------------
-    @r.post(API + "/projects/{name}")
-    async def store_project(request):
-        body = await request.json()
-        name = request.match_info["name"]
-        if state.projects_follower.enabled:
-            # leader-first (reference follower.py create/store flow)
-            loop = asyncio.get_event_loop()
-            try:
-                stored = await loop.run_in_executor(
-                    None,
-                    lambda: state.projects_follower.forward_store(name,
-                                                                  body))
-            except Exception as exc:  # noqa: BLE001
-                return error_response(f"project leader rejected: {exc}",
-                                      502)
-            return json_response({"data": stored})
-        stored = state.db.store_project(name, body)
-        return json_response({"data": stored})
-
-    @r.get(API + "/projects/{name}")
-    async def get_project(request):
-        project = state.db.get_project(request.match_info["name"])
-        if project is None:
-            return error_response("project not found", 404)
-        return json_response({"data": project})
-
-    @r.get(API + "/projects")
-    async def list_projects(request):
-        return json_response({"projects": state.db.list_projects(
-            state=request.query.get("state"))})
-
-    @r.delete(API + "/projects/{name}")
-    async def delete_project(request):
-        from ..db.base import RunDBError
-
-        name = request.match_info["name"]
-        strategy = request.query.get("deletion_strategy", "restricted")
-        try:
-            if state.projects_follower.enabled:
-                loop = asyncio.get_event_loop()
-                await loop.run_in_executor(
-                    None,
-                    lambda: state.projects_follower.forward_delete(
-                        name, deletion_strategy=strategy))
-            else:
-                state.db.delete_project(name, deletion_strategy=strategy)
-        except RunDBError as exc:
-            return error_response(str(exc), 412)
-        return json_response({"ok": True})
-
-    # -- feature store -------------------------------------------------------------------
-    def _fs_routes(kind: str, store, get, list_, delete):
-        @r.post(API + "/projects/{project}/" + kind + "/{name}")
-        async def _store(request):
-            body = await request.json()
-            uid = store(body, name=request.match_info["name"],
-                        project=request.match_info["project"],
-                        tag=request.query.get("tag"),
-                        uid=request.query.get("uid"))
-            return json_response({"uid": uid})
-
-        @r.get(API + "/projects/{project}/" + kind + "/{name}")
-        async def _get(request):
-            from ..db.base import RunDBError
-
-            try:
-                obj = get(request.match_info["name"],
-                          project=request.match_info["project"],
-                          tag=request.query.get("tag"),
-                          uid=request.query.get("uid"))
-            except RunDBError as exc:
-                return error_response(str(exc), 404)
-            return json_response({"data": obj})
-
-        @r.get(API + "/projects/{project}/" + kind)
-        async def _list(request):
-            objs = list_(project=request.match_info["project"],
-                         name=request.query.get("name", ""),
-                         tag=request.query.get("tag"))
-            return json_response({kind.replace("-", "_"): objs})
-
-        @r.delete(API + "/projects/{project}/" + kind + "/{name}")
-        async def _delete(request):
-            delete(request.match_info["name"],
-                   project=request.match_info["project"])
-            return json_response({"ok": True})
-
-    _fs_routes("feature-sets", state.db.store_feature_set,
-               state.db.get_feature_set, state.db.list_feature_sets,
-               state.db.delete_feature_set)
-    _fs_routes("feature-vectors", state.db.store_feature_vector,
-               state.db.get_feature_vector, state.db.list_feature_vectors,
-               state.db.delete_feature_vector)
-
-    # -- model endpoints --------------------------------------------------------------------
-    @r.post(API + "/projects/{project}/model-endpoints/{uid}")
-    async def store_endpoint(request):
-        body = await request.json()
-        state.db.store_model_endpoint(request.match_info["project"],
-                                      request.match_info["uid"], body)
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/model-endpoints/{uid}")
-    async def get_endpoint(request):
-        from ..db.base import RunDBError
-
-        try:
-            endpoint = state.db.get_model_endpoint(
-                request.match_info["project"], request.match_info["uid"])
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"data": endpoint})
-
-    @r.get(API + "/projects/{project}/model-endpoints")
-    async def list_endpoints(request):
-        endpoints = state.db.list_model_endpoints(
-            request.match_info["project"],
-            model=request.query.get("model", ""),
-            function=request.query.get("function", ""),
-            state=request.query.get("state", ""))
-        return json_response({"endpoints": endpoints})
-
-    @r.delete(API + "/projects/{project}/model-endpoints/{uid}")
-    async def delete_endpoint(request):
-        state.db.delete_model_endpoint(request.match_info["project"],
-                                       request.match_info["uid"])
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/model-endpoints/{uid}/metrics")
-    async def endpoint_metrics(request):
-        """Metric time-series with time-range + downsampling (reference:
-        model-endpoint metric values API over the TSDB layer)."""
-        from ..model_monitoring.tsdb import get_metrics_tsdb
-
-        q = request.query
-        try:
-            start = float(q.get("start", 0) or 0)
-            end = float(q["end"]) if q.get("end") else None
-            max_points = int(q.get("max_points", 1000))
-        except ValueError:
-            return error_response("bad time range", 400)
-        tsdb = get_metrics_tsdb()
-        project = request.match_info["project"]
-        uid = request.match_info["uid"]
-        if q.get("names_only") in ("true", "1"):
-            return json_response(
-                {"metrics": tsdb.list_metrics(project, uid)})
-        return json_response({"series": tsdb.query(
-            project, uid, metric=q.get("name", ""), start=start, end=end,
-            max_points=max_points)})
-
-    # -- alerts / events -------------------------------------------------------------------
-    @r.post(API + "/projects/{project}/alerts/{name}")
-    async def store_alert(request):
-        body = await request.json()
-        state.db.store_alert_config(request.match_info["name"], body,
-                                    request.match_info["project"])
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/alerts/{name}")
-    async def get_alert(request):
-        from ..db.base import RunDBError
-
-        try:
-            alert = state.db.get_alert_config(request.match_info["name"],
-                                              request.match_info["project"])
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"data": alert})
-
-    @r.get(API + "/projects/{project}/alerts")
-    async def list_alerts(request):
-        return json_response({"alerts": state.db.list_alert_configs(
-            request.match_info["project"])})
-
-    @r.post(API + "/projects/{project}/alerts/{name}/silence")
-    async def silence_alert(request):
-        """Open (or clear) a silencing window on an alert config: body
-        {"minutes": N} silences for N minutes; {"minutes": 0} clears."""
-        from datetime import datetime, timedelta, timezone
-
-        project = request.match_info["project"]
-        name = request.match_info["name"]
-        body = await request.json()
-        try:
-            alert = state.db.get_alert_config(name, project)
-        except Exception:
-            return error_response(f"alert {name} not found", 404)
-        minutes = float(body.get("minutes", 0))
-        if minutes > 0:
-            until = datetime.now(timezone.utc) + timedelta(minutes=minutes)
-            alert["silence_until"] = until.isoformat()
-        else:
-            alert["silence_until"] = ""
-        state.db.store_alert_config(name, alert, project)
-        return json_response({"data": alert})
-
-    @r.delete(API + "/projects/{project}/alerts/{name}")
-    async def delete_alert(request):
-        state.db.delete_alert_config(request.match_info["name"],
-                                     request.match_info["project"])
-        return json_response({"ok": True})
-
-    @r.post(API + "/projects/{project}/events/{kind}")
-    async def emit_event(request):
-        body = await request.json()
-        project = request.match_info["project"]
-        kind = request.match_info["kind"]
-        state.db.emit_event(kind, body, project)
-        from .alerts import process_event
-
-        fired = process_event(state.db, project, kind, body)
-        return json_response({"ok": True, "alerts_fired": fired})
-
-    # -- workflows -----------------------------------------------------------------------
-    @r.post(API + "/projects/{project}/workflows/submit")
-    async def submit_workflow(request):
-        body = await request.json()
-        workflow_id = generate_uid()
-        project = request.match_info["project"]
-        state.workflows[workflow_id] = {
-            "id": workflow_id, "project": project,
-            "state": RunStates.running, "spec": body, "started": now_iso(),
-        }
-
-        def run_workflow():
-            try:
-                from ..projects.pipelines import load_and_run
-
-                # workflow spec carries the project source + workflow path
-                pipeline = body.get("pipeline", {})
-                from ..projects import load_project
-
-                proj = load_project(
-                    context=pipeline.get("context", "./"),
-                    name=project, save=False)
-                status = proj.run(
-                    name=pipeline.get("name", ""),
-                    workflow_path=pipeline.get("path", ""),
-                    arguments=body.get("arguments"),
-                    artifact_path=body.get("artifact_path", ""),
-                    engine="local")
-                state.workflows[workflow_id]["state"] = status.state
-            except Exception as exc:  # noqa: BLE001
-                state.workflows[workflow_id]["state"] = RunStates.error
-                state.workflows[workflow_id]["error"] = str(exc)
-
-        threading.Thread(target=run_workflow, daemon=True).start()
-        return json_response({"id": workflow_id})
-
-    @r.get(API + "/projects/{project}/workflows/{workflow_id}")
-    async def workflow_status(request):
-        workflow = state.workflows.get(request.match_info["workflow_id"])
-        if workflow is None:
-            return error_response("workflow not found", 404)
-        return json_response({"state": workflow["state"],
-                              "error": workflow.get("error")})
-
-    # -- api gateways (stored as api-gateway kind function objects) -------------
-    @r.post(API + "/projects/{project}/api-gateways/{name}")
-    async def store_api_gateway(request):
-        body = await request.json()
-        gateway = body.get("data", body)
-        gateway["kind"] = "api-gateway"
-        state.db.store_function(gateway, request.match_info["name"],
-                                request.match_info["project"],
-                                tag="latest")
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/api-gateways/{name}")
-    async def get_api_gateway(request):
-        from ..db.base import RunDBError
-
-        try:
-            gateway = state.db.get_function(
-                request.match_info["name"], request.match_info["project"])
-        except RunDBError as exc:
-            return error_response(str(exc), 404)
-        return json_response({"data": gateway})
-
-    @r.get(API + "/projects/{project}/api-gateways")
-    async def list_api_gateways(request):
-        funcs = state.db.list_functions(
-            project=request.match_info["project"])
-        return json_response({"api_gateways": [
-            f for f in funcs if f.get("kind") == "api-gateway"]})
-
-    # -- project secrets (reference: server/api/api/endpoints/secrets.py;
-    # values are write/delete-only over REST — the list surface returns
-    # keys alone) ----------------------------------------------------------
-    @r.post(API + "/projects/{project}/secrets")
-    async def store_project_secrets(request):
-        body = await request.json()
-        provider = body.get("provider", "kubernetes")
-        secrets = body.get("secrets") or {}
-        if not isinstance(secrets, dict):
-            return error_response("secrets must be a mapping")
-        state.db.store_project_secrets(
-            request.match_info["project"], secrets, provider=provider)
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/secret-keys")
-    async def list_project_secret_keys(request):
-        provider = request.query.get("provider", "kubernetes")
-        keys = state.db.list_project_secret_keys(
-            request.match_info["project"], provider=provider)
-        return json_response({"secret_keys": keys})
-
-    @r.delete(API + "/projects/{project}/secrets")
-    async def delete_project_secrets(request):
-        provider = request.query.get("provider", "kubernetes")
-        keys = request.query.getall("secret", []) or None
-        project = request.match_info["project"]
-        state.db.delete_project_secrets(project, keys=keys,
-                                        provider=provider)
-        if keys is None and provider == "kubernetes":
-            # full wipe: also remove the projected k8s Secret (best-effort;
-            # the provider is gated on the kubernetes package)
-            try:
-                from .runtime_handlers import KubernetesProvider
-
-                KubernetesProvider().delete_project_secret(project)
-            except Exception:  # noqa: BLE001 - no cluster / not deployed
-                pass
-        return json_response({"ok": True})
-
-    # -- datastore profiles (reference: server-side datastore_profile
-    # endpoints; private fields go to the project-secret store and are
-    # never returned) ------------------------------------------------------
-    @r.put(API + "/projects/{project}/datastore-profiles/{name}")
-    async def store_datastore_profile(request):
-        body = await request.json()
-        profile = body.get("profile") or {}
-        profile["name"] = request.match_info["name"]
-        state.db.store_datastore_profile(
-            profile, request.match_info["project"],
-            private=body.get("private") or None)
-        return json_response({"ok": True})
-
-    @r.get(API + "/projects/{project}/datastore-profiles/{name}")
-    async def get_datastore_profile(request):
-        profile = state.db.get_datastore_profile(
-            request.match_info["name"], request.match_info["project"])
-        if profile is None:
-            return error_response("datastore profile not found", 404)
-        return json_response({"data": profile})
-
-    @r.get(API + "/projects/{project}/datastore-profiles")
-    async def list_datastore_profiles(request):
-        return json_response({"datastore_profiles":
-                              state.db.list_datastore_profiles(
-                                  request.match_info["project"])})
-
-    @r.delete(API + "/projects/{project}/datastore-profiles/{name}")
-    async def delete_datastore_profile(request):
-        state.db.delete_datastore_profile(
-            request.match_info["name"], request.match_info["project"])
-        return json_response({"ok": True})
-
-    # -- operations / introspection ---------------------------------------------
-    # -- tags (reference server/api/api/endpoints/tags.py) -----------------
-    @r.post(API + "/projects/{project}/tags/{tag}")
-    async def overwrite_tag(request):
-        body = await request.json()
-        if body.get("kind", "artifact") != "artifact":
-            return error_response("only artifact tagging is supported", 400)
-        tagged = state.db.tag_artifacts(
-            request.match_info["project"], request.match_info["tag"],
-            body.get("identifiers") or [])
-        return json_response({"tagged": tagged})
-
-    @r.delete(API + "/projects/{project}/tags/{tag}")
-    async def delete_tag(request):
-        body = await request.json()
-        if body.get("kind", "artifact") != "artifact":
-            return error_response("only artifact tagging is supported", 400)
-        removed = state.db.untag_artifacts(
-            request.match_info["project"], request.match_info["tag"],
-            body.get("identifiers") or [])
-        return json_response({"removed": removed})
-
-    def _file_access_denied(path: str) -> str | None:
-        """Service internals are never readable through /files (the
-        sqlite DB holds project secret values); an optional allowlist
-        (mlconf.httpdb.files_allowed_paths) restricts everything else.
-        Local paths (bare or file://) are compared by realpath; remote
-        URLs (s3:// etc.) by raw prefix."""
-        scheme, _, rest = path.partition("://")
-        local = not rest or scheme == "file"
-        local_path = (rest if scheme == "file" else path) if local else None
-        allowed = [p.strip() for p in str(
-            mlconf.httpdb.files_allowed_paths or "").split(",") if p.strip()]
-        if local:
-            real = os.path.realpath(local_path)
-            dsn = os.path.realpath(getattr(state.db, "dsn", "") or "")
-            if dsn and real in (dsn, dsn + "-wal", dsn + "-shm"):
-                return "service database is not readable through /files"
-            if allowed and not any(
-                    (not a.partition("://")[1])
-                    and (real.startswith(os.path.realpath(a) + os.sep)
-                         or real == os.path.realpath(a))
-                    for a in allowed):
-                return "path is outside files_allowed_paths"
-            return None
-        if allowed and not any(path.startswith(a) for a in allowed):
-            return "path is outside files_allowed_paths"
-        return None
-
-    # -- files (reference server/api/api/endpoints/files.py) ---------------
-    @r.get(API + "/projects/{project}/files")
-    async def get_file(request):
-        from aiohttp import web as aioweb
-
-        path = request.query.get("path", "")
-        if not path:
-            return error_response("path query parameter is required", 400)
-        denied = _file_access_denied(path)
-        if denied:
-            return error_response(denied, 403)
-        try:
-            from ..datastore import store_manager
-
-            size = int(request.query.get("size", 0)) or None
-            offset = int(request.query.get("offset", 0))
-            body = store_manager.object(url=path).get(size=size,
-                                                      offset=offset)
-        except FileNotFoundError:
-            return error_response(f"file not found: {path}", 404)
-        except Exception as exc:  # noqa: BLE001
-            return error_response(f"failed to read {path}: {exc}", 400)
-        if isinstance(body, str):
-            body = body.encode()
-        return aioweb.Response(body=body,
-                               content_type="application/octet-stream")
-
-    @r.get(API + "/projects/{project}/filestat")
-    async def get_filestat(request):
-        path = request.query.get("path", "")
-        if not path:
-            return error_response("path query parameter is required", 400)
-        denied = _file_access_denied(path)
-        if denied:
-            return error_response(denied, 403)
-        try:
-            from ..datastore import store_manager
-
-            stats = store_manager.object(url=path).stat()
-        except FileNotFoundError:
-            return error_response(f"file not found: {path}", 404)
-        except Exception as exc:  # noqa: BLE001
-            return error_response(f"failed to stat {path}: {exc}", 400)
-        return json_response({"size": stats.size, "modified": stats.modified,
-                              "content_type": getattr(stats, "content_type",
-                                                      None)})
-
-    # -- hub admin (reference server/api/api/endpoints/hub.py) -------------
-    def _hub_source_path(name: str):
-        if name == "default":
-            from ..hub import builtin_hub_path
-
-            return builtin_hub_path()
-        source = state.db.get_hub_source(name)
-        return (source or {}).get("path")
-
-    @r.put(API + "/hub/sources/{name}")
-    async def store_hub_source(request):
-        body = await request.json()
-        name = request.match_info["name"]
-        if name == "default":
-            return error_response("the default source is built-in", 400)
-        state.db.store_hub_source(name, body.get("source") or body,
-                                  order=int(body.get("order", -1)))
-        return json_response({"data": state.db.get_hub_source(name)})
-
-    @r.get(API + "/hub/sources")
-    async def list_hub_sources(request):
-        sources = [{"name": "default", "builtin": True}]
-        sources.extend(state.db.list_hub_sources())
-        return json_response({"sources": sources})
-
-    @r.get(API + "/hub/sources/{name}")
-    async def get_hub_source(request):
-        name = request.match_info["name"]
-        if name == "default":
-            return json_response({"data": {"name": "default",
-                                           "builtin": True}})
-        source = state.db.get_hub_source(name)
-        if source is None:
-            return error_response(f"hub source {name} not found", 404)
-        return json_response({"data": source})
-
-    @r.delete(API + "/hub/sources/{name}")
-    async def delete_hub_source(request):
-        state.db.delete_hub_source(request.match_info["name"])
-        return json_response({"ok": True})
-
-    @r.get(API + "/hub/sources/{name}/items")
-    async def hub_catalog(request):
-        path = _hub_source_path(request.match_info["name"])
-        if not path or not os.path.isdir(path):
-            return error_response("hub source has no readable path", 404)
-        items = []
-        for entry in sorted(os.listdir(path)):
-            fn_yaml = os.path.join(path, entry, "function.yaml")
-            if os.path.isfile(fn_yaml):
-                items.append({"name": entry})
-        return json_response({"catalog": items})
-
-    @r.get(API + "/hub/sources/{name}/items/{item}")
-    async def hub_item(request):
-        import yaml
-
-        path = _hub_source_path(request.match_info["name"])
-        item = request.match_info["item"]
-        if ".." in item or "/" in item or os.sep in item:
-            return error_response("invalid hub item name", 400)
-        fn_yaml = os.path.join(path or "", item, "function.yaml")
-        if not path or not os.path.isfile(fn_yaml):
-            return error_response(f"hub item {item} not found", 404)
-        with open(fn_yaml) as f:
-            return json_response({"data": yaml.safe_load(f)})
-
-    @r.get(API + "/operations/memory-report")
-    async def memory_report(request):
-        """reference analog: server/api/utils/memory_reports.py (objgraph) —
-        here host RSS + device HBM via the profiler util."""
-        from ..utils.profiler import memory_report as report
-
-        return json_response({"data": report()})
-
-    @r.get(API + "/frontend-spec")
-    async def frontend_spec(request):
-        from ..common.runtimes_constants import RuntimeKinds
-
-        return json_response({
-            "feature_flags": {"tpujob": True, "serving": True,
-                              "feature_store": True,
-                              "model_monitoring": True},
-            "default_artifact_path": mlconf.resolve_artifact_path(
-                "{project}"),
-            "runtime_kinds": RuntimeKinds.all(),
-        })
-
-    # -- grafana proxy (reference: server/api/api/endpoints/grafana_proxy.py,
-    # crud/model_monitoring/grafana.py — simpleJSON datasource contract) ----
-    @r.get(API + "/grafana-proxy/model-endpoints")
-    async def grafana_health(request):
-        return json_response({"status": "ok"})
-
-    @r.post(API + "/grafana-proxy/model-endpoints/search")
-    async def grafana_search(request):
-        body = await request.json() if request.can_read_body else {}
-        project = (body.get("target") or "").split(":")[0] \
-            or mlconf.default_project
-        endpoints = state.db.list_model_endpoints(project)
-        return json_response([e.get("uid") for e in endpoints])
-
-    @r.post(API + "/grafana-proxy/model-endpoints/query")
-    async def grafana_query(request):
-        body = await request.json()
-        rows = []
-        columns = [{"text": "endpoint_id", "type": "string"},
-                   {"text": "model", "type": "string"},
-                   {"text": "requests", "type": "number"},
-                   {"text": "avg_latency_microsec", "type": "number"},
-                   {"text": "drift_status", "type": "string"}]
-        for target in body.get("targets", [{}]):
-            spec = (target.get("target") or "")
-            project = spec.split(":")[0] or mlconf.default_project
-            for endpoint in state.db.list_model_endpoints(project):
-                metrics = endpoint.get("metrics", {})
-                rows.append([
-                    endpoint.get("uid"), endpoint.get("name"),
-                    metrics.get("requests", 0),
-                    metrics.get("avg_latency_microsec", 0),
-                    endpoint.get("drift_status", "")])
-        return json_response([{"type": "table", "columns": columns,
-                               "rows": rows}])
-
-    # -- background tasks --------------------------------------------------------------------
-    @r.get(API + "/projects/{project}/background-tasks")
-    async def list_background_tasks(request):
-        return json_response({"background_tasks": state.db.list_background_tasks(
-            request.match_info["project"])})
-
-    @r.get(API + "/projects/{project}/background-tasks/{name}")
-    async def get_background_task(request):
-        task = state.db.get_background_task(
-            request.match_info["name"], request.match_info["project"])
-        if task is None:
-            return error_response("background task not found", 404)
-        return json_response({"data": task})
-
-    # -- runtime resources (reference: server/api/api/endpoints/
-    # runtime_resources.py — grouped listing + filtered deletion of the
-    # cluster resources a run created) -------------------------------------
-    @r.get(API + "/projects/{project}/runtime-resources")
-    async def list_runtime_resources(request):
-        project = request.match_info["project"]
-        kind = request.query.get("kind", "")
-        rows = state.db.list_runtime_resources(kind)
-        if project not in ("*", ""):
-            rows = [row for row in rows if row["project"] == project]
-        grouped: dict = {}
-        for row in rows:
-            handler = state.launcher.handler_for(row["kind"])
-            try:
-                live_state = handler.provider.state(row["resource_id"])
-            except Exception:  # noqa: BLE001 - provider may be gone
-                live_state = "unknown"
-            grouped.setdefault(row["kind"], []).append({
-                **row, "state": live_state})
-        return json_response({"runtime_resources": [
-            {"kind": kind_, "resources": res}
-            for kind_, res in sorted(grouped.items())]})
-
-    @r.delete(API + "/projects/{project}/runtime-resources")
-    async def delete_runtime_resources(request):
-        project = request.match_info["project"]
-        kind = request.query.get("kind", "")
-        object_id = request.query.get("object-id", "")
-        force = request.query.get("force", "") in ("true", "1")
-        deleted = []
-        for row in state.db.list_runtime_resources(kind):
-            if project not in ("*", "") and row["project"] != project:
-                continue
-            if object_id and row["resource_id"] != object_id:
-                continue
-            run = state.db.read_run(row["uid"], row["project"])
-            run_state = get_in(run or {}, "status.state", "")
-            if not force and run_state not in RunStates.terminal_states():
-                continue  # reference refuses to delete live runs w/o force
-            handler = state.launcher.handler_for(row["kind"])
-            try:
-                # goes through the handler so the in-memory resource map is
-                # also dropped — otherwise the next monitor tick would probe
-                # the deleted resource and mark the run failed
-                handler.delete_resources(row["uid"], row["project"],
-                                         row["resource_id"])
-            except Exception:  # noqa: BLE001 - provider may be gone; keep
-                # the mapping so a later retry can still find the resource
-                continue
-            deleted.append(row)
-        return json_response({"deleted": deleted})
-
-    # -- pipelines (reference: server/api/api/endpoints/pipelines.py — a
-    # KFP proxy; here the native workflow runner doubles as the pipeline
-    # backend, and a kfp client is proxied only when installed) ------------
-    @r.get(API + "/projects/{project}/pipelines")
-    async def list_pipelines(request):
-        project = request.match_info["project"]
-        runs = [w for w in state.workflows.values()
-                if project in ("*", "") or w.get("project") == project]
-        return json_response({"runs": sorted(
-            runs, key=lambda w: w.get("started", ""), reverse=True),
-            "total_size": len(runs)})
-
-    @r.get(API + "/projects/{project}/pipelines/{run_id}")
-    async def get_pipeline(request):
-        workflow = state.workflows.get(request.match_info["run_id"])
-        if workflow is None:
-            return error_response("pipeline run not found", 404)
-        return json_response({"run": workflow})
-
+    for register in REGISTRARS:
+        register(r, state)
     app.add_routes(r)
     app.on_startup.append(_start_periodic)
     app.on_cleanup.append(_stop_periodic)

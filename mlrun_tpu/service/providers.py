@@ -1,0 +1,346 @@
+"""Execution-resource providers (reference analog:
+server/api/utils/singletons/k8s.py K8sHelper + the fake local tier the
+reference tests with K8sHelperMock, tests/api/conftest.py:208).
+
+Providers decouple "what resource to create" from "where": the
+``KubernetesProvider`` creates pods/JobSets/Deployments via the k8s API
+(gated on the kubernetes package); the ``LocalProcessProvider`` executes
+the same `mlrun-tpu run --from-env` contract as subprocesses so the full
+submit -> pod -> run -> logs path works on a single machine.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+from ..common.runtimes_constants import (
+    JobSetConditions,
+    PodPhases,
+    RunStates,
+)
+from ..config import mlconf
+
+
+def _extract_pod_spec(resource: dict) -> dict:
+    if resource.get("kind") == "JobSet":
+        return resource["spec"]["replicatedJobs"][0]["template"]["spec"][
+            "template"]["spec"]
+    if resource.get("kind") == "Deployment":
+        return resource["spec"]["template"]["spec"]
+    return resource.get("spec", resource)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def _proc_start_ticks(pid: int) -> int:
+    """Kernel start time (jiffies since boot, /proc/<pid>/stat field 22) —
+    a stable process identity that survives pid reuse. 0 when unavailable
+    (non-linux), which degrades to pid-only liveness."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode(errors="replace")
+        return int(stat.rsplit(") ", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+
+class Provider:
+    """Creates/inspects/deletes execution resources."""
+
+    kind = "base"
+
+    def create(self, resource: dict, run_uid: str) -> str:
+        raise NotImplementedError
+
+    def state(self, resource_id: str) -> str:
+        raise NotImplementedError
+
+    def delete(self, resource_id: str):
+        raise NotImplementedError
+
+    def logs(self, resource_id: str, offset: int = 0) -> bytes:
+        return b""
+
+
+class LocalProcessProvider(Provider):
+    """Runs the pod command as a local subprocess (dev/single-host mode)."""
+
+    kind = "local-process"
+
+    def __init__(self, db):
+        self._db = db
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._threads: dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+
+    def create(self, resource: dict, run_uid: str) -> str:
+        pod_spec = _extract_pod_spec(resource)
+        container = pod_spec["containers"][0]
+        env = dict(os.environ)
+        for item in container.get("env", []):
+            if "value" in item:
+                env[item["name"]] = str(item["value"])
+        # single-process resource = rank 0 (skips jax probing in the ctx)
+        env.setdefault("MLT_WORKER_RANK", "0")
+        # execution happens in-process-tree: swap the container entry for
+        # the same CLI contract
+        command = container.get("command") or ["mlrun-tpu", "run",
+                                               "--from-env"]
+        if command[0] in ("mlrun-tpu", "mlrun_tpu"):
+            command = [sys.executable, "-m", "mlrun_tpu"] + command[1:]
+        args = container.get("args", [])
+        project = resource.get("metadata", {}).get("labels", {}).get(
+            "mlrun-tpu/project", "")
+
+        proc = subprocess.Popen(
+            command + list(args), env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, cwd=container.get("workingDir") or None)
+        # fingerprint with the kernel start time so a recovered resource id
+        # can never be confused with a recycled pid
+        resource_id = f"proc-{proc.pid}-{_proc_start_ticks(proc.pid)}"
+        with self._lock:
+            self._procs[resource_id] = proc
+
+        def pump():
+            for line in proc.stdout:
+                try:
+                    self._db.store_log(run_uid, project, line)
+                except Exception:  # noqa: BLE001
+                    pass
+            proc.wait()
+
+        thread = threading.Thread(target=pump, daemon=True)
+        thread.start()
+        self._threads[resource_id] = thread
+        return resource_id
+
+    def state(self, resource_id: str) -> str:
+        proc = self._procs.get(resource_id)
+        if proc is None:
+            # recovered resource from a previous service process: the Popen
+            # handle is gone, but pid + start-time fingerprint tell us
+            # whether the same process still runs (the run itself reports
+            # its state over HTTP, so liveness is all the monitor needs)
+            if self._recovered_alive(resource_id):
+                return PodPhases.running
+            return PodPhases.failed
+        code = proc.poll()
+        if code is None:
+            return PodPhases.running
+        return PodPhases.succeeded if code == 0 else PodPhases.failed
+
+    def delete(self, resource_id: str):
+        proc = self._procs.pop(resource_id, None)
+        if proc is not None:
+            if proc.poll() is None:
+                proc.terminate()
+            return
+        if self._recovered_alive(resource_id):
+            pid, _ = self._pid_of(resource_id)
+            try:
+                os.kill(pid, 15)
+            except OSError:
+                pass
+
+    @classmethod
+    def _recovered_alive(cls, resource_id: str) -> bool:
+        """True only when the pid is alive AND (when recorded) its kernel
+        start time matches — a recycled pid never counts as the run."""
+        pid, ticks = cls._pid_of(resource_id)
+        if not pid or not _pid_alive(pid):
+            return False
+        return ticks == 0 or _proc_start_ticks(pid) == ticks
+
+    @staticmethod
+    def _pid_of(resource_id: str) -> tuple[int, int]:
+        if resource_id.startswith("proc-"):
+            parts = resource_id[5:].split("-")
+            try:
+                pid = int(parts[0])
+                ticks = int(parts[1]) if len(parts) > 1 else 0
+                return pid, ticks
+            except ValueError:
+                return 0, 0
+        return 0, 0
+
+
+class KubernetesProvider(Provider):
+    """Creates real pods / JobSet CRDs (requires the kubernetes package)."""
+
+    kind = "kubernetes"
+
+    def __init__(self, namespace: str | None = None):
+        import kubernetes  # gated import
+
+        kubernetes.config.load_incluster_config() \
+            if os.environ.get("KUBERNETES_SERVICE_HOST") \
+            else kubernetes.config.load_kube_config()
+        self._core = kubernetes.client.CoreV1Api()
+        self._custom = kubernetes.client.CustomObjectsApi()
+        self.namespace = namespace or mlconf.namespace
+
+    def create(self, resource: dict, run_uid: str) -> str:
+        if resource.get("kind") == "JobSet":
+            self._custom.create_namespaced_custom_object(
+                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+                resource)
+            return f"jobset/{resource['metadata']['name']}"
+        if resource.get("kind") == "Deployment":
+            # long-running gateway Deployments (service/deployments.py) —
+            # replicas come from the function's min_replicas
+            import kubernetes
+
+            kubernetes.client.AppsV1Api(
+                self._core.api_client).create_namespaced_deployment(
+                self.namespace, resource)
+            return f"deployment/{resource['metadata']['name']}"
+        self._core.create_namespaced_pod(self.namespace, resource)
+        return f"pod/{resource['metadata']['name']}"
+
+    def create_service(self, manifest: dict) -> str:
+        """Create/replace the Service fronting a gateway Deployment."""
+        import kubernetes
+
+        name = manifest["metadata"]["name"]
+        try:
+            self._core.replace_namespaced_service(name, self.namespace,
+                                                  manifest)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+            self._core.create_namespaced_service(self.namespace, manifest)
+        return name
+
+    def state(self, resource_id: str) -> str:
+        kind, _, name = resource_id.partition("/")
+        if kind == "deployment":
+            import kubernetes
+
+            dep = kubernetes.client.AppsV1Api(
+                self._core.api_client).read_namespaced_deployment(
+                name, self.namespace)
+            status = dep.status
+            if (getattr(status, "available_replicas", 0) or 0) >= 1:
+                return PodPhases.running
+            # distinguish "rolling out" from "dead": a deployment whose
+            # pods are crash-looping still reports 0 available
+            conditions = getattr(status, "conditions", None) or []
+            for cond in conditions:
+                if (getattr(cond, "type", "") == "Progressing"
+                        and getattr(cond, "status", "") == "False"):
+                    return PodPhases.failed
+            return PodPhases.pending
+        if kind == "jobset":
+            obj = self._custom.get_namespaced_custom_object(
+                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+                name)
+            run_state = JobSetConditions.to_run_state(
+                obj.get("status", {}).get("conditions", []))
+            return {
+                RunStates.completed: PodPhases.succeeded,
+                RunStates.error: PodPhases.failed,
+                RunStates.pending: PodPhases.pending,
+            }.get(run_state, PodPhases.running)
+        pod = self._core.read_namespaced_pod(name, self.namespace)
+        return pod.status.phase
+
+    def delete(self, resource_id: str):
+        kind, _, name = resource_id.partition("/")
+        if kind == "jobset":
+            self._custom.delete_namespaced_custom_object(
+                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+                name)
+        elif kind == "deployment":
+            import kubernetes
+
+            kubernetes.client.AppsV1Api(
+                self._core.api_client).delete_namespaced_deployment(
+                name, self.namespace)
+            # the fronting Service shares the Deployment's name
+            try:
+                self._core.delete_namespaced_service(name, self.namespace)
+            except kubernetes.client.exceptions.ApiException as exc:
+                if exc.status != 404:
+                    raise
+        else:
+            self._core.delete_namespaced_pod(name, self.namespace)
+
+    def ensure_project_secret(self, project: str, secrets: dict) -> str:
+        """Create/replace the project's k8s Secret and return its name."""
+        import base64
+
+        import kubernetes
+
+        name = f"mlrun-tpu-secrets-{project}"
+        body = kubernetes.client.V1Secret(
+            metadata=kubernetes.client.V1ObjectMeta(
+                name=name, labels={"mlrun-tpu/project": project}),
+            data={k: base64.b64encode(str(v).encode()).decode()
+                  for k, v in secrets.items()})
+        try:
+            self._core.replace_namespaced_secret(name, self.namespace, body)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+            self._core.create_namespaced_secret(self.namespace, body)
+        return name
+
+    def delete_project_secret(self, project: str):
+        import kubernetes
+
+        try:
+            self._core.delete_namespaced_secret(
+                f"mlrun-tpu-secrets-{project}", self.namespace)
+        except kubernetes.client.exceptions.ApiException as exc:
+            if exc.status != 404:
+                raise
+
+    def list_resources(self, class_label: str) -> list[tuple[str, str, str]]:
+        """Discover live cluster resources by label selector (reference
+        base.py:65,189 recovers handler state the same way). Returns
+        (resource_id, run_uid, project) triples. Listing is PAGINATED via
+        the k8s continue token so a large cluster can't blow one response
+        (reference paginates the same way)."""
+        selector = f"mlrun-tpu/class={class_label}"
+        found = []
+        token = None
+        while True:
+            pods = self._core.list_namespaced_pod(
+                self.namespace, label_selector=selector, limit=500,
+                _continue=token)
+            for pod in pods.items:
+                labels = pod.metadata.labels or {}
+                found.append((f"pod/{pod.metadata.name}",
+                              labels.get("mlrun-tpu/uid", ""),
+                              labels.get("mlrun-tpu/project", "")))
+            token = getattr(pods.metadata, "_continue", None) or getattr(
+                pods.metadata, "continue_", None)
+            if not token:
+                break
+        token = None
+        while True:
+            jobsets = self._custom.list_namespaced_custom_object(
+                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
+                label_selector=selector, limit=500,
+                **({"_continue": token} if token else {}))
+            for js in jobsets.get("items", []):
+                labels = js.get("metadata", {}).get("labels", {})
+                found.append((f"jobset/{js['metadata']['name']}",
+                              labels.get("mlrun-tpu/uid", ""),
+                              labels.get("mlrun-tpu/project", "")))
+            token = jobsets.get("metadata", {}).get("continue")
+            if not token:
+                break
+        return [f for f in found if f[1]]
+
+

@@ -587,6 +587,7 @@ class Trainer:
         last = {}
         epoch = 0
         stopped = False
+        local_stop = False  # pending stop vote, acted on at uniform points
         if epoch_steps:
             hooks.on_epoch_begin(0)
         for step in range(steps):
@@ -638,25 +639,40 @@ class Trainer:
                         k: round(v, 4) if isinstance(v, float) else v
                         for k, v in step_metrics.items()})
             if hooks.callbacks:
-                local_stop = not hooks.on_step_end(step, step_metrics,
-                                                   log_point=log_point)
-                # cross-host agreement EVERY step (same construction as
-                # PreemptionGuard.agreed): a stop vote driven by
-                # host-local state must flip every host in the same step
-                # or the still-stepping hosts deadlock in the slice
-                # collectives
-                stopped = _all_hosts_agree(local_stop)
+                multihost = jax.process_count() > 1
+                if not hooks.on_step_end(step, step_metrics,
+                                         log_point=log_point):
+                    local_stop = True
+                if not multihost:
+                    stopped = stopped or local_stop
+                elif log_point:
+                    # multi-host: a stop vote driven by host-local state
+                    # must flip every host in the SAME step or the
+                    # still-stepping hosts deadlock in the slice
+                    # collectives (PreemptionGuard.agreed construction).
+                    # Agreement runs only at log points — deterministic
+                    # step indices every host reaches — so pure-observer
+                    # callbacks don't cost an allgather per step; a vote
+                    # takes effect within log_every steps.
+                    stopped = _all_hosts_agree(local_stop)
                 epoch_boundary = epoch_steps and \
                     ((step + 1) % epoch_steps == 0 or step == steps - 1
                      or stopped)
                 if epoch_boundary:
-                    epoch_vote = not hooks.on_epoch_end(epoch,
-                                                        step_metrics)
-                    if not stopped:
-                        # uniform participation: every host reaches this
-                        # agreement call iff `stopped` (already agreed)
-                        # is False everywhere
-                        stopped = _all_hosts_agree(epoch_vote)
+                    # epoch hooks always see host-readable floats — a
+                    # boundary off the log cadence would otherwise hand
+                    # TensorBoard/metrics logging raw device arrays
+                    epoch_view = step_metrics if log_point else \
+                        {k: float(v) for k, v in metrics.items()}
+                    epoch_vote = not hooks.on_epoch_end(epoch, epoch_view)
+                    local_stop = local_stop or epoch_vote
+                    if not multihost:
+                        stopped = stopped or epoch_vote
+                    elif not stopped:
+                        # uniform: every host reaches this iff `stopped`
+                        # (agreed) is False everywhere, and the boundary
+                        # condition itself is step-index-deterministic
+                        stopped = _all_hosts_agree(local_stop)
                     epoch += 1
                     if not stopped and step < steps - 1:
                         hooks.on_epoch_begin(epoch)
