@@ -199,6 +199,42 @@ class DataItem:
         return self._store.as_df(self._path, columns=columns,
                                  df_module=df_module, format=format, **kwargs)
 
+    # -- reference-contract parity (mlrun/datastore/base.py DataItem) ------
+    @property
+    def store(self) -> "DataStore":
+        return self._store
+
+    def ls(self) -> list[str]:
+        """Alias of listdir (reference base.py ls)."""
+        return self.listdir()
+
+    def open(self, mode: str = "rb"):
+        """Open the (locally materialized) item as a file object
+        (reference base.py open)."""
+        return open(self.local(), mode)
+
+    def upload(self, src_path: str):
+        """Upload a local file into this item's target (reference
+        base.py upload)."""
+        self._store.upload(self._path, src_path)
+
+    def remove_local(self):
+        """Drop the temp copy created by local() (reference
+        base.py remove_local); no-op for file-store items."""
+        if self._local_path and self._store.kind != "file":
+            if os.path.isdir(self._local_path):
+                import shutil
+
+                shutil.rmtree(self._local_path, ignore_errors=True)
+            elif os.path.exists(self._local_path):
+                os.remove(self._local_path)
+            self._local_path = ""
+
+    def get_artifact_type(self) -> Optional[str]:
+        """Artifact kind when this item resolves a store:// uri
+        (reference base.py get_artifact_type)."""
+        return self._meta.get("kind") if self._meta else None
+
     def show(self):
         from ..utils import logger
 

@@ -104,7 +104,9 @@ class StoreManager:
                         artifact_url=artifact_url)
 
     def _resolve_store_resource(self, url: str, project: str = "") -> Optional[dict]:
-        """store://artifacts/<project>/<key>[:tag][@uid] or store://<project>/<key>."""
+        """store://artifacts/<project>/<key>[#iter][:tag][@uid] or
+        store://<project>/<key> (same grammar as the reference store
+        uris — ``#iter`` addresses a hyper-run iteration's artifact)."""
         body = url[len("store://"):]
         for prefix in ("artifacts/", "datasets/", "models/"):
             if body.startswith(prefix) and body.count("/") >= 2:
@@ -116,13 +118,21 @@ class StoreManager:
         tag = None
         if ":" in body:
             body, tag = body.rsplit(":", 1)
+        iteration = None
+        if "#" in body:
+            body, _, iter_part = body.rpartition("#")
+            try:
+                iteration = int(iter_part)
+            except ValueError:
+                body = f"{body}#{iter_part}"  # '#' was part of the key
         parts = body.split("/", 1)
         if len(parts) == 2:
             project, key = parts
         else:
             key = parts[0]
         db = self._get_db()
-        return db.read_artifact(key, tag=tag, project=project or None, tree=tree)
+        return db.read_artifact(key, tag=tag, project=project or None,
+                                tree=tree, iter=iteration)
 
 
 store_manager = StoreManager()

@@ -175,6 +175,19 @@ class MLClientCtx:
     def get_cached_artifact(self, key: str):
         return self._artifacts_manager.artifacts.get(key)
 
+    def update_artifact(self, artifact):
+        """Re-store an already-logged artifact after a spec mutation
+        (e.g. the packagers manager recording unpackaging instructions)."""
+        manager = self._artifacts_manager
+        if manager.artifact_db:
+            meta = artifact.metadata
+            manager.artifact_db.store_artifact(
+                artifact.spec.db_key or artifact.key, artifact.to_dict(),
+                uid=meta.uid, iter=meta.iter, tag=meta.tag,
+                project=meta.project, tree=meta.tree)
+        manager.artifacts[artifact.key] = artifact
+        self._update_db()
+
     def get_dataitem(self, url: str):
         return self.get_store_resource(url)
 

@@ -351,6 +351,51 @@ class RunObject(RunTemplate):
         return (self.status.state if self.status else None) \
             or RunStates.created
 
+    @property
+    def error(self) -> str:
+        """Error string when the run failed/aborted, else ''
+        (reference model.py:1504)."""
+        if self.status and self.status.state in (
+                RunStates.error, RunStates.aborted, RunStates.aborting):
+            return (self.status.error or self.status.reason
+                    or self.status.status_text
+                    or ("run was aborted"
+                        if self.status.state != RunStates.error
+                        else "unknown error"))
+        return ""
+
+    @property
+    def ui_url(self) -> str:
+        """UI URL when a frontend is attached (reference model.py:1566)."""
+        return (self.status.ui_url if self.status else "") or ""
+
+    def abort(self):
+        """Abort the run server-side (reference model.py:1831)."""
+        self._run_db().abort_run(self.metadata.uid, self.metadata.project)
+
+    @staticmethod
+    def create_uri(project: str, uid: str, iteration, tag: str = "") -> str:
+        """<project>@<uid>#<iteration>[:tag] (reference model.py:1837)."""
+        suffix = f":{tag}" if tag else ""
+        return f"{project}@{uid}#{iteration}{suffix}"
+
+    @staticmethod
+    def parse_uri(uri: str) -> tuple:
+        """Parse <project>@<uid>#<iteration>[:tag] back to its parts
+        (reference model.py:1844)."""
+        import re
+
+        match = re.match(
+            r"^(?P<project>[^@]+)@(?P<uid>[^#]+)#(?P<iteration>[^:]+)"
+            r"(:(?P<tag>.+))?$", uri)
+        if not match:
+            raise ValueError(
+                "uri not in supported format "
+                "<project>@<uid>#<iteration>[:tag]")
+        groups = match.groupdict()
+        return (groups["project"], groups["uid"], groups["iteration"],
+                groups["tag"] or "")
+
     def output(self, key: str):
         """Return a result value or artifact uri by key."""
         if self.status.results and key in self.status.results:

@@ -17,6 +17,7 @@ from .pandas_packagers import (  # noqa: F401
 from .python_standard_library import (  # noqa: F401
     BytesPackager,
     CollectionPackager,
+    DataclassPackager,
     DatetimePackager,
     PathPackager,
     PrimitivePackager,
@@ -32,6 +33,7 @@ DEFAULT_PACKAGERS = (
     NumpyArrayListPackager,
     JaxArrayPackager,
     JaxPytreePackager,
+    DataclassPackager,
     DatetimePackager,
     PathPackager,
     BytesPackager,

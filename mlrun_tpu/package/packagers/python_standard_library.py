@@ -87,6 +87,62 @@ class PathPackager(DefaultPackager):
         return pathlib.Path(data_item.local())
 
 
+class DataclassPackager(DefaultPackager):
+    """Dataclasses round-trip as json artifacts: pack via asdict; unpack
+    reconstructs the hinted (or instruction-recorded) dataclass type.
+    Nested dataclass fields are re-inflated when the field annotation is
+    itself a dataclass."""
+
+    priority = 3  # before CollectionPackager would see asdict-able types
+
+    def can_pack(self, obj):
+        import dataclasses
+
+        return dataclasses.is_dataclass(obj) and not isinstance(obj, type)
+
+    def can_unpack(self, hint):
+        import dataclasses
+
+        return isinstance(hint, type) and dataclasses.is_dataclass(hint)
+
+    def pack(self, context, obj, key, artifact_type="", **cfg):
+        import dataclasses
+
+        context.log_artifact(
+            key, body=json.dumps(dataclasses.asdict(obj), default=str),
+            format="json")
+
+    def unpack(self, data_item, hint):
+        raw = data_item.get()
+        data = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        return self.unpack_dict(data, hint)
+
+    @classmethod
+    def unpack_dict(cls, data: dict, hint):
+        import dataclasses
+        import typing
+
+        try:
+            # field.type is a plain STRING under PEP 563 (`from __future__
+            # import annotations`) — resolve through get_type_hints so
+            # nested dataclasses re-inflate either way
+            resolved = typing.get_type_hints(hint)
+        except Exception:  # noqa: BLE001 - unresolvable forward refs
+            resolved = {}
+        kwargs = {}
+        for field in dataclasses.fields(hint):
+            if field.name not in data:
+                continue
+            value = data[field.name]
+            field_type = resolved.get(field.name, field.type)
+            if isinstance(field_type, type) \
+                    and dataclasses.is_dataclass(field_type) \
+                    and isinstance(value, dict):
+                value = cls.unpack_dict(value, field_type)
+            kwargs[field.name] = value
+        return hint(**kwargs)
+
+
 class DatetimePackager(DefaultPackager):
     handled_types = (datetime.datetime, datetime.date, datetime.time)
     default_artifact_type = "result"

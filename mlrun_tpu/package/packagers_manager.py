@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from ..utils import logger
 from .packagers import DEFAULT_PACKAGERS
 from .packagers.default import DefaultPackager
 from .type_hints import reduce_hint
@@ -66,17 +67,48 @@ class PackagersManager:
                                       artifact_type=artifact_type, **cfg)
                     finally:
                         packager.cleanup()
+                    self._record_instructions(context, packager, obj, key,
+                                              artifact_type)
                     return
             except ImportError:
                 continue
         # fallback: stringify into a result
         context.log_result(key, str(obj))
 
+    @staticmethod
+    def _record_instructions(context, packager, obj, key: str,
+                             artifact_type: str):
+        """Stamp unpackaging instructions into the logged artifact's spec
+        (reference packagers_manager records the same so a downstream
+        handler can receive the ORIGINAL type without a type hint)."""
+        artifact = getattr(context, "get_cached_artifact",
+                           lambda _key: None)(key)
+        if artifact is None:
+            return  # packed into a result — nothing to stamp
+        obj_type = type(obj)
+        artifact.spec.unpackaging_instructions = {
+            "packager": type(packager).__name__,
+            "object_type": f"{obj_type.__module__}.{obj_type.__qualname__}",
+            "artifact_type": artifact_type or "",
+        }
+        try:
+            context.update_artifact(artifact)
+        except Exception:  # noqa: BLE001 - instruction stamping must not
+            # fail the pack; hint-driven unpack still works without it
+            pass
+
     def unpack(self, data_item, hint):
         from ..datastore.base import DataItem
 
         candidates = reduce_hint(hint)
-        if not candidates or DataItem in candidates:
+        if not candidates:
+            # no hint: honor recorded unpackaging instructions, so the
+            # handler receives the ORIGINAL packed type end-to-end
+            unpacked = self._unpack_by_instructions(data_item)
+            if unpacked is not _NO_INSTRUCTIONS:
+                return unpacked
+            return data_item
+        if DataItem in candidates:
             return data_item
         if str in candidates and data_item.kind == "file":
             # mirror the reference convention: str hint on an input = local
@@ -91,6 +123,33 @@ class PackagersManager:
                     continue
         return data_item
 
+    def _unpack_by_instructions(self, data_item):
+        """Reconstruct the packed object from the artifact spec's recorded
+        unpackaging_instructions (written by ``_record_instructions``)."""
+        meta = getattr(data_item, "meta", None) or {}
+        instructions = (meta.get("spec") or {}).get(
+            "unpackaging_instructions") or {}
+        obj_path = instructions.get("object_type", "")
+        if not obj_path:
+            return _NO_INSTRUCTIONS
+        obj_type = _resolve_type(obj_path)
+        if obj_type is None:
+            logger.warning("unpackaging instructions name an unresolvable "
+                           "type — handing back the DataItem",
+                           object_type=obj_path)
+            return _NO_INSTRUCTIONS
+        # prefer the recorded packager; fall back to can_unpack dispatch
+        name = instructions.get("packager", "")
+        ordered = sorted(self._packagers,
+                         key=lambda p: type(p).__name__ != name)
+        for packager in ordered:
+            try:
+                if packager.can_unpack(obj_type):
+                    return packager.unpack(data_item, obj_type)
+            except ImportError:
+                continue
+        return _NO_INSTRUCTIONS
+
 
 def _jsonable(obj) -> bool:
     import json
@@ -100,3 +159,16 @@ def _jsonable(obj) -> bool:
         return True
     except (TypeError, ValueError):
         return False
+
+
+_NO_INSTRUCTIONS = object()  # sentinel: no usable recorded instructions
+
+
+def _resolve_type(path: str):
+    """'module.Qualified.Name' -> type via the shared string-hint
+    resolver (type_hints.parse_string_hint handles shorthand modules and
+    nested classes for both paths)."""
+    from .type_hints import parse_string_hint
+
+    resolved = parse_string_hint(path)
+    return resolved if isinstance(resolved, type) else None

@@ -23,17 +23,34 @@ _SHORTHAND_MODULES = {
 
 def parse_string_hint(hint: str):
     """Resolve "module.Type" / builtin-name strings to the actual type.
-    Returns None when the module is unavailable or the name is unknown."""
+    Handles shorthand module names and nested classes
+    ("module.Outer.Inner" — the walk drops path segments from the right
+    until a module imports). Returns None when nothing resolves; only
+    already-importable modules load, so a hint string cannot trigger
+    arbitrary code beyond the named module's import."""
     hint = hint.strip()
     if "." not in hint:
         return getattr(builtins, hint, None)
     module_name, _, attr = hint.rpartition(".")
     module_name = _SHORTHAND_MODULES.get(module_name, module_name)
-    try:
-        module = importlib.import_module(module_name)
-    except ImportError:
-        return None
-    return getattr(module, attr, None)
+    while module_name:
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError:
+            # peel one segment off the module path onto the qualname
+            # (nested class case)
+            if "." not in module_name:
+                return None
+            module_name, _, head = module_name.rpartition(".")
+            attr = f"{head}.{attr}"
+            continue
+        obj = module
+        for part in attr.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return None
+        return obj
+    return None
 
 
 def reduce_hint(hint: Any) -> list:

@@ -416,6 +416,354 @@ class MlrunProject(ModelObj):
         return status
 
     # -- persistence -------------------------------------------------------
+    # -- reference-contract parity (mlrun/projects/project.py) -------------
+    # spec/metadata bridges: ported user code reads these directly off the
+    # project object
+    @property
+    def description(self) -> str:
+        return self.spec.description or ""
+
+    @description.setter
+    def description(self, value: str):
+        self.spec.description = value
+
+    @property
+    def params(self) -> dict:
+        return self.spec.params
+
+    @params.setter
+    def params(self, value: dict):
+        self.spec.params = value or {}
+
+    @property
+    def source(self) -> str:
+        return self.spec.source or ""
+
+    @source.setter
+    def source(self, value: str):
+        self.spec.source = value
+
+    @property
+    def context(self) -> str:
+        return self.spec.context or "./"
+
+    @property
+    def mountdir(self) -> str:
+        return getattr(self.spec, "mountdir", "") or ""
+
+    @property
+    def workflows(self) -> list:
+        return self.spec.workflows
+
+    @property
+    def artifacts(self) -> list:
+        return self.spec.artifacts
+
+    @property
+    def default_image(self) -> str:
+        return self.spec.default_image or ""
+
+    def set_default_image(self, image: str):
+        self.spec.default_image = image
+
+    @property
+    def notifiers(self):
+        from ..utils.notifications import NotificationPusher
+
+        return NotificationPusher([])
+
+    def with_secrets(self, kind: str = "env", source=None) -> "MlrunProject":
+        """Reference with_secrets: env-file path or dict of values."""
+        if isinstance(source, dict):
+            self.set_secrets(source)
+        elif isinstance(source, str):
+            self.set_secrets(file_path=source)
+        return self
+
+    # build
+    def build_config(self, image: str = "", set_as_default: bool = False,
+                     base_image: str = "", commands: list | None = None,
+                     requirements: list | None = None, **kwargs):
+        """Record the project-level build spec (reference build_config)."""
+        from ..model import ImageBuilder
+
+        build = self.spec.build or ImageBuilder()
+        if isinstance(build, dict):
+            build = ImageBuilder.from_dict(build)
+        build.image = image or build.image
+        build.base_image = base_image or build.base_image
+        if commands:
+            build.commands = list(build.commands or []) + [
+                c for c in commands if c not in (build.commands or [])]
+        if requirements:
+            build.requirements = list(build.requirements or []) + [
+                q for q in requirements
+                if q not in (build.requirements or [])]
+        self.spec.build = build
+        if set_as_default and image:
+            self.set_default_image(image)
+        return build
+
+    def build_image(self, image: str = "", base_image: str = "",
+                    commands: list | None = None,
+                    requirements: list | None = None,
+                    set_as_default: bool = True, with_tpu: bool = False):
+        """Build the project image from the recorded/passed build config
+        (reference build_image — backed by the build service)."""
+        from ..run import new_function
+
+        build = self.build_config(image=image, base_image=base_image,
+                                  commands=commands,
+                                  requirements=requirements)
+        fn = new_function(f"{self.name}-image", project=self.name,
+                          kind="job", image=build.image or "")
+        fn.spec.build = build
+        deployed = fn.deploy(watch=True, with_tpu=with_tpu)
+        if deployed and set_as_default and fn.spec.image:
+            self.set_default_image(fn.spec.image)
+        return deployed
+
+    # artifacts / store
+    def get_artifact_uri(self, key: str, category: str = "artifact",
+                         tag: str = "", iter: int | None = None) -> str:
+        """store://<category>s/<project>/<key>[:tag] (reference
+        get_artifact_uri)."""
+        uri = f"store://{category}s/{self.name}/{key}"
+        if iter is not None:
+            uri = f"{uri}#{iter}"
+        if tag:
+            uri = f"{uri}:{tag}"
+        return uri
+
+    def get_store_resource(self, uri: str):
+        from ..datastore import store_manager
+
+        return store_manager.object(url=uri, project=self.name)
+
+    def get_item_absolute_path(self, url: str) -> str:
+        """Resolve a context-relative path against the project context
+        (reference get_item_absolute_path)."""
+        if "://" in url or os.path.isabs(url):
+            return url
+        return os.path.join(self.spec.context or "./",
+                            self.spec.subpath or "", url)
+
+    def set_artifact(self, key: str, artifact=None, target_path: str = "",
+                     tag: str = ""):
+        """Register an artifact in the project spec (imported on load;
+        reference set_artifact)."""
+        entry = {"key": key, "target_path": target_path, "tag": tag}
+        if isinstance(artifact, dict):
+            entry.update(artifact)
+        elif artifact is not None:
+            entry.update(getattr(artifact, "to_dict", lambda: {})())
+        self.spec.artifacts = [a for a in self.spec.artifacts
+                               if a.get("key") != key] + [entry]
+
+    def import_artifact(self, item_path: str, new_key: str = ""):
+        """Load an exported artifact spec (yaml/json) and log it under
+        this project (reference import_artifact)."""
+        import yaml
+
+        from ..artifacts.manager import dict_to_artifact
+
+        with open(item_path) as f:
+            struct = yaml.safe_load(f)
+        artifact = dict_to_artifact(struct)
+        if new_key:
+            artifact.metadata.key = new_key
+        return self.log_artifact(artifact)
+
+    def delete_artifact(self, key: str, tag: str = ""):
+        self._get_db().del_artifact(key, tag=tag, project=self.name)
+
+    # datastore profiles
+    def register_datastore_profile(self, profile,
+                                   private: dict | None = None):
+        struct = profile if isinstance(profile, dict) else profile.to_dict()
+        self._get_db().store_datastore_profile(struct, project=self.name,
+                                               private=private)
+
+    def get_datastore_profile(self, name: str):
+        return self._get_db().get_datastore_profile(name, project=self.name)
+
+    def list_datastore_profiles(self) -> list:
+        return self._get_db().list_datastore_profiles(project=self.name)
+
+    def delete_datastore_profile(self, name: str):
+        self._get_db().delete_datastore_profile(name, project=self.name)
+
+    # alerts
+    def store_alert_config(self, name: str, config: dict):
+        return self._get_db().store_alert_config(name, config,
+                                                 project=self.name)
+
+    def get_alert_config(self, name: str) -> dict:
+        return self._get_db().get_alert_config(name, project=self.name)
+
+    def list_alerts_configs(self) -> list:
+        return self._get_db().list_alert_configs(project=self.name)
+
+    def delete_alert_config(self, name: str):
+        self._get_db().delete_alert_config(name, project=self.name)
+
+    def reset_alert_config(self, name: str):
+        """Clear an alert's silencing window + fired state (reference
+        reset_alert_config)."""
+        alert = self.get_alert_config(name)
+        alert["silence_until"] = ""
+        alert.pop("last_fired", None)
+        self.store_alert_config(name, alert)
+
+    # model monitoring (reference enable/disable_model_monitoring +
+    # set_model_monitoring_function; apps are MonitoringApplicationBase
+    # subclasses driven by the windowed controller)
+    def enable_model_monitoring(self, default_apps: bool = True,
+                                **kwargs) -> "MlrunProject":
+        self.spec.params["model_monitoring_enabled"] = True
+        if default_apps:
+            apps = self.spec.params.setdefault(
+                "model_monitoring_apps", [])
+            for default in ("HistogramDataDriftApplication",
+                            "LatencyApplication"):
+                if default not in apps:
+                    apps.append(default)
+        return self
+
+    def disable_model_monitoring(self) -> "MlrunProject":
+        self.spec.params["model_monitoring_enabled"] = False
+        return self
+
+    def set_model_monitoring_function(self, name: str,
+                                      application_class: str = "",
+                                      **kwargs):
+        apps = self.spec.params.setdefault("model_monitoring_apps", [])
+        entry = application_class or name
+        if entry not in apps:
+            apps.append(entry)
+        return entry
+
+    def list_model_monitoring_functions(self) -> list:
+        return list(self.spec.params.get("model_monitoring_apps", []))
+
+    def remove_model_monitoring_function(self, name: str):
+        apps = self.spec.params.get("model_monitoring_apps", [])
+        if name in apps:
+            apps.remove(name)
+
+    # api gateways
+    def list_api_gateways(self) -> list:
+        db = self._get_db()
+        lister = getattr(db, "list_api_gateways", None)
+        if lister:
+            return lister(self.name)
+        return [f for f in db.list_functions(project=self.name)
+                if f.get("kind") == "api-gateway"]
+
+    # git remotes (reference create_remote/set_remote/remove_remote/
+    # pull/push over the project context's git repo)
+    def _git(self, *args, check: bool = True):
+        import subprocess
+
+        return subprocess.run(["git", "-C", self.spec.context or "./",
+                               *args], check=check, capture_output=True,
+                              text=True)
+
+    def create_remote(self, url: str, name: str = "origin",
+                      branch: str = ""):
+        self._git("remote", "add", name, url)
+        self.spec.origin_url = url
+
+    def set_remote(self, url: str, name: str = "origin", overwrite=True):
+        existing = self._git("remote", check=False).stdout.split()
+        if name in existing:
+            if not overwrite:
+                raise ValueError(f"remote {name} exists")
+            self._git("remote", "set-url", name, url)
+        else:
+            self._git("remote", "add", name, url)
+        self.spec.origin_url = url
+
+    def remove_remote(self, name: str):
+        self._git("remote", "remove", name)
+
+    def pull(self, remote: str = "origin", branch: str = ""):
+        self._git("pull", remote, *( [branch] if branch else [] ))
+
+    def push(self, branch: str, message: str = "", update: bool = True,
+             remote: str = "origin", add: list | None = None):
+        if add:
+            self._git("add", *add)
+        if update:
+            self.save()
+            self._git("add", "project.yaml", check=False)
+        if message:
+            self._git("commit", "-m", message, check=False)
+        self._git("push", remote, branch)
+
+    # lifecycle
+    def save_to_db(self, store: bool = True):
+        return self.save(store=store)
+
+    def save_workflow(self, name: str, target: str, artifact_path: str = "",
+                      ttl=None):
+        """Export a named workflow spec to a file (reference
+        save_workflow)."""
+        import yaml
+
+        workflow = self.spec.get_workflow(name)
+        if workflow is None:
+            raise ValueError(f"workflow {name} not found in project spec")
+        with open(target, "w") as f:
+            yaml.safe_dump(dict(workflow), f)
+
+    def reload(self, sync: bool = False, context: str = "",
+               ) -> "MlrunProject":
+        """Re-load the project from its context dir (reference reload)."""
+        project = load_project(context=context or self.spec.context or "./",
+                               name=self.name, save=False,
+                               sync_functions=sync)
+        self.spec = project.spec
+        self.status = project.status
+        return self
+
+    def setup(self, save: bool = True) -> "MlrunProject":
+        """Run the project_setup.py hook from the context dir (reference
+        setup): a `setup(project) -> project` function customizing the
+        loaded project."""
+        setup_file = os.path.join(self.spec.context or "./",
+                                  self.spec.subpath or "",
+                                  "project_setup.py")
+        if not os.path.isfile(setup_file):
+            return self
+        import importlib.util
+
+        module_spec = importlib.util.spec_from_file_location(
+            "project_setup", setup_file)
+        module = importlib.util.module_from_spec(module_spec)
+        module_spec.loader.exec_module(module)
+        if hasattr(module, "setup"):
+            project = module.setup(self)
+            if project is not None and save:
+                project.save()
+            return project or self
+        return self
+
+    def get_function_objects(self) -> dict:
+        """Initialized function objects by name (reference
+        get_function_objects)."""
+        self.sync_functions()
+        return dict(self._function_objects)
+
+    def get_run_status(self, run, timeout: float = 600,
+                       expected_statuses=None):
+        """Wait for a workflow/pipeline run and return it (reference
+        get_run_status)."""
+        wait = getattr(run, "wait_for_completion", None)
+        if wait:
+            wait(timeout=timeout)
+        return run
+
     def save(self, filepath: str = "", store: bool = True):
         self.metadata.created = self.metadata.created or now_iso()
         filepath = filepath or os.path.join(

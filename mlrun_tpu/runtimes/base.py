@@ -123,6 +123,52 @@ class BaseRuntime(ModelObj):
             self.spec.build.origin_filename = from_file
         return self
 
+    def with_commands(self, commands: list[str],
+                      overwrite: bool = False) -> "BaseRuntime":
+        """Add image-build shell commands (reference base.py
+        with_commands; the kubernetes provider's kaniko build runs them —
+        the local overlay build FAILS loudly instead of dropping them)."""
+        current = [] if overwrite else list(self.spec.build.commands or [])
+        self.spec.build.commands = current + [
+            c for c in commands if c not in current]
+        return self
+
+    def requires_build(self) -> bool:
+        """True when deploy must run an actual build (reference
+        base.py requires_build)."""
+        build = self.spec.build
+        return bool(build.commands or build.requirements
+                    or build.source or build.extra)
+
+    def set_db_connection(self, db):
+        """Pin the run DB this function talks to (reference
+        base.py set_db_connection)."""
+        self._db = db
+
+    def store_run(self, runobj: "RunObject"):
+        """Persist a run object through the function's DB (reference
+        base.py store_run)."""
+        self._get_db().store_run(
+            runobj.to_dict(), runobj.metadata.uid,
+            runobj.metadata.project or mlconf.default_project)
+
+    def prepare_image_for_deploy(self):
+        """Resolve the image a deploy will use: an explicit image wins;
+        a build spec keeps its target; otherwise the configured default
+        (reference base.py prepare_image_for_deploy)."""
+        if self.spec.image:
+            return
+        if self.spec.build.image:
+            self.spec.image = self.spec.build.image
+        elif not self.requires_build():
+            self.spec.image = mlconf.function.default_image
+
+    def clean_build_params(self) -> "BaseRuntime":
+        """Drop credentials from the build spec before export/share
+        (reference base.py clean_build_params)."""
+        self.spec.build.secret = None
+        return self
+
     def with_requirements(self, requirements: list[str]):
         self.spec.build.requirements = list(requirements)
         return self

@@ -1,0 +1,174 @@
+"""API-surface parity with the reference public contracts (VERDICT r4
+#8): RunObject, MlrunProject, BaseRuntime, and DataItem expose the
+members ported user code calls (reference mlrun/model.py:1454,
+projects/project.py, runtimes/base.py, datastore/base.py:424)."""
+
+import os
+import subprocess
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.model import RunObject
+
+
+def test_runobject_contract(tmp_path):
+    assert RunObject.create_uri("p", "u", 3, "t") == "p@u#3:t"
+    assert RunObject.parse_uri("p@u#3:t") == ("p", "u", "3", "t")
+    assert RunObject.parse_uri("p@u#0") == ("p", "u", "0", "")
+    with pytest.raises(ValueError):
+        RunObject.parse_uri("not-a-run-uri")
+
+    run = RunObject()
+    assert run.error == ""
+    run.status.state = "error"
+    run.status.error = "boom"
+    assert run.error == "boom"
+    run.status.state = "aborted"
+    run.status.error = None
+    assert "aborted" in run.error
+    assert run.ui_url == ""
+    # state() is a METHOD (reference model.py:1720) — terminal returns
+    # directly without a DB roundtrip
+    run.status.state = "completed"
+    assert run.state() == "completed"
+
+
+def test_runobject_abort_roundtrip():
+    import time
+
+    def handler(context):
+        time.sleep(30)
+
+    fn = mlrun_tpu.new_function("abortme", kind="local", handler=handler)
+    # run asynchronously via a thread so we can abort mid-flight? local
+    # runs are synchronous — abort against the stored run instead
+    run = RunObject()
+    run.metadata.uid = "abc123abort"
+    run.metadata.project = "default"
+    db = mlrun_tpu.get_run_db()
+    db.store_run({"metadata": {"name": "a", "uid": run.metadata.uid,
+                               "project": "default"},
+                  "status": {"state": "running"}},
+                 run.metadata.uid, "default")
+    run._db = db
+    run.abort()
+    stored = db.read_run(run.metadata.uid, "default")
+    assert stored["status"]["state"] in ("aborted", "aborting")
+
+
+def test_base_runtime_contract():
+    fn = mlrun_tpu.new_function("rt", kind="job", image="img")
+    assert not fn.requires_build()
+    fn.with_commands(["apt-get update"])
+    fn.with_commands(["apt-get update", "pip install x"])  # dedup
+    assert fn.spec.build.commands == ["apt-get update", "pip install x"]
+    assert fn.requires_build()
+    fn.with_commands(["only"], overwrite=True)
+    assert fn.spec.build.commands == ["only"]
+
+    fn2 = mlrun_tpu.new_function("rt2", kind="job")
+    fn2.prepare_image_for_deploy()
+    assert fn2.spec.image  # default image resolved
+    fn2.spec.build.secret = "regcreds"
+    fn2.clean_build_params()
+    assert fn2.spec.build.secret is None
+
+    run = RunObject()
+    run.metadata.uid = "storeme123"
+    run.metadata.project = "default"
+    fn2.store_run(run)
+    assert mlrun_tpu.get_run_db().read_run("storeme123", "default")
+
+
+def test_dataitem_contract(tmp_path):
+    from mlrun_tpu.datastore import store_manager
+
+    src = tmp_path / "data.txt"
+    src.write_text("hello")
+    item = store_manager.object(url=str(src))
+    with item.open("r") as f:
+        assert f.read() == "hello"
+    assert item.store.kind == "file"
+    assert item.get_artifact_type() is None
+    # directory listing parity
+    dir_item = store_manager.object(url=str(tmp_path))
+    assert "data.txt" in dir_item.ls()
+    # upload writes through the store
+    src2 = tmp_path / "new.txt"
+    src2.write_text("payload")
+    target = store_manager.object(url=str(tmp_path / "uploaded.txt"))
+    target.upload(str(src2))
+    assert (tmp_path / "uploaded.txt").read_text() == "payload"
+    target.remove_local()  # no-op for file store, must not raise
+
+
+def test_project_contract(tmp_path):
+    ctx = tmp_path / "proj"
+    ctx.mkdir()
+    project = mlrun_tpu.new_project("paritypr", context=str(ctx),
+                                    save=False)
+    # spec bridges
+    project.description = "demo"
+    assert project.spec.description == "demo"
+    project.params = {"lr": 0.1}
+    assert project.get_param("lr") == 0.1
+    project.set_default_image("img:1")
+    assert project.default_image == "img:1"
+    # artifact helpers
+    assert project.get_artifact_uri("m", category="model", tag="v2") == \
+        "store://models/paritypr/m:v2"
+    project.set_artifact("data", target_path="/tmp/x.csv", tag="v1")
+    project.set_artifact("data", target_path="/tmp/y.csv")  # replaces
+    assert len([a for a in project.artifacts
+                if a["key"] == "data"]) == 1
+    assert project.get_item_absolute_path("sub/f.txt") == \
+        os.path.join(str(ctx), "sub/f.txt")
+    assert project.get_item_absolute_path("s3://bkt/f") == "s3://bkt/f"
+    # build config accumulates
+    project.build_config(base_image="base:1", requirements=["scipy"])
+    project.build_config(requirements=["scipy", "einx"])
+    assert project.spec.build.requirements == ["scipy", "einx"]
+    # monitoring toggles ride the spec
+    project.enable_model_monitoring()
+    assert "HistogramDataDriftApplication" in \
+        project.list_model_monitoring_functions()
+    project.remove_model_monitoring_function(
+        "HistogramDataDriftApplication")
+    assert "HistogramDataDriftApplication" not in \
+        project.list_model_monitoring_functions()
+
+
+def test_project_setup_hook_and_reload(tmp_path):
+    ctx = tmp_path / "proj"
+    ctx.mkdir()
+    (ctx / "project_setup.py").write_text(
+        "def setup(project):\n"
+        "    project.spec.params['from_setup'] = 1\n"
+        "    return project\n")
+    project = mlrun_tpu.new_project("setuppr", context=str(ctx), save=False)
+    project = project.setup(save=False)
+    assert project.get_param("from_setup") == 1
+    # save + reload round-trips the spec from project.yaml
+    project.save(store=False)
+    project.spec.params["from_setup"] = 999
+    project.reload()
+    assert project.get_param("from_setup") == 1
+
+
+def test_project_git_remotes(tmp_path):
+    ctx = tmp_path / "gitpr"
+    ctx.mkdir()
+    subprocess.run(["git", "init", str(ctx)], check=True,
+                   capture_output=True)
+    project = mlrun_tpu.new_project("gitpr", context=str(ctx), save=False)
+    project.create_remote("https://example.com/a.git")
+    assert project.spec.origin_url == "https://example.com/a.git"
+    project.set_remote("https://example.com/b.git")  # overwrite
+    out = subprocess.run(["git", "-C", str(ctx), "remote", "get-url",
+                          "origin"], capture_output=True, text=True)
+    assert out.stdout.strip() == "https://example.com/b.git"
+    project.remove_remote("origin")
+    out = subprocess.run(["git", "-C", str(ctx), "remote"],
+                         capture_output=True, text=True)
+    assert out.stdout.strip() == ""
