@@ -68,6 +68,7 @@ class ArtifactManager:
                      artifact_path: str | None = None, format: str | None = None,
                      upload: bool | None = None, labels: dict | None = None,
                      db_key: str | None = None, is_retained_producer=None,
+                     unpackaging_instructions: dict | None = None,
                      **kwargs) -> Artifact:
         if isinstance(item, str):
             key = item
@@ -92,6 +93,10 @@ class ArtifactManager:
         item.spec.src_path = local_path or item.spec.src_path
         item.spec.db_key = db_key or key
         item.spec.producer = producer.get_meta()
+        if unpackaging_instructions:
+            # stamped on the FIRST store (the packagers manager records
+            # how to reconstruct the packed object without a type hint)
+            item.spec.unpackaging_instructions = unpackaging_instructions
 
         item.before_log()
 

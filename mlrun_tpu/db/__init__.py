@@ -5,7 +5,11 @@ from __future__ import annotations
 import threading
 
 from ..config import mlconf
-from .base import RunDBError, RunDBInterface  # noqa: F401
+from .base import (  # noqa: F401
+    RunDBError,
+    RunDBInterface,
+    sql_dialect_for_dsn,
+)
 from .nopdb import NopDB  # noqa: F401
 from .sqlitedb import SQLiteRunDB  # noqa: F401
 
@@ -28,6 +32,11 @@ def get_run_db(url: str = "", secrets: dict | None = None,
             _run_db = HTTPRunDB(url).connect(secrets)
         elif url == "nop":
             _run_db = NopDB()
+        elif sql_dialect_for_dsn(url):
+            # server-grade shared store for clusterized deployments
+            from .sqldb import SQLServerRunDB
+
+            _run_db = SQLServerRunDB(url)
         else:
             _run_db = SQLiteRunDB(url if url.endswith(".sqlite") else "")
         return _run_db

@@ -14,6 +14,19 @@ class RunDBError(Exception):
     pass
 
 
+def sql_dialect_for_dsn(dsn: str) -> str | None:
+    """'postgresql' / 'mysql' when the dsn selects the server-grade SQL
+    backend (db/sqldb.py), else None — the ONE place the scheme list
+    lives (get_run_db, ServiceState, and SQLServerRunDB all dispatch
+    through it)."""
+    scheme = (dsn or "").partition("://")[0].split("+")[0]
+    if scheme in ("postgresql", "postgres"):
+        return "postgresql"
+    if scheme in ("mysql", "mariadb"):
+        return "mysql"
+    return None
+
+
 class RunDBInterface(ABC):
     kind = ""
 

@@ -709,15 +709,23 @@ class SQLiteRunDB(RunDBInterface):
         project = self._project_or_default(project)
         sql = "SELECT body FROM artifacts WHERE project=? AND key=?"
         params: list = [project, key]
+        if iter is not None and not uid:
+            # iteration addressing applies in EVERY resolution mode
+            # (store://...#iter without @tree must not fall through to
+            # whichever iteration last claimed the tag)
+            sql += " AND iteration=?"
+            params.append(iter)
         if uid:
             sql += " AND uid=?"
             params.append(uid)
         elif tree:
             sql += " AND tree=?"
             params.append(tree)
-            if iter is not None:
-                sql += " AND iteration=?"
-                params.append(iter)
+        elif iter is not None and tag is None:
+            # pure iteration addressing (store://...#N): the newest
+            # producer's iteration N — hyper-run children don't carry the
+            # parent's tag, so a tag filter here would always miss
+            pass
         else:
             wanted = tag or "latest"
             side = self._query(

@@ -248,12 +248,15 @@ class MLClientCtx:
                      artifact_path: str = "", tag: str = "", viewer: str = "",
                      target_path: str = "", format: str | None = None,
                      upload: bool | None = None, labels: dict | None = None,
-                     db_key: str | None = None, **kwargs):
+                     db_key: str | None = None,
+                     unpackaging_instructions: dict | None = None,
+                     **kwargs):
         artifact = self._artifacts_manager.log_artifact(
             self._producer(), item, body=body, local_path=local_path,
             artifact_path=artifact_path or self.artifact_path, tag=tag,
             viewer=viewer, target_path=target_path, format=format,
-            upload=upload, labels=labels, db_key=db_key, **kwargs)
+            upload=upload, labels=labels, db_key=db_key,
+            unpackaging_instructions=unpackaging_instructions, **kwargs)
         self._update_db()
         return artifact
 

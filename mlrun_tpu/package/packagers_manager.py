@@ -62,40 +62,28 @@ class PackagersManager:
         for packager in self._packagers:
             try:
                 if packager.can_pack(obj):
+                    # unpackaging instructions ride the artifact's FIRST
+                    # store (reference packagers_manager records the same,
+                    # so a hint-free downstream handler gets the original
+                    # type back); the stamping proxy injects them into the
+                    # packager's log_artifact call — no re-store
+                    obj_type = type(obj)
+                    stamping = _StampingContext(context, {
+                        "packager": type(packager).__name__,
+                        "object_type": f"{obj_type.__module__}."
+                                       f"{obj_type.__qualname__}",
+                        "artifact_type": artifact_type or "",
+                    })
                     try:
-                        packager.pack(context, obj, key,
+                        packager.pack(stamping, obj, key,
                                       artifact_type=artifact_type, **cfg)
                     finally:
                         packager.cleanup()
-                    self._record_instructions(context, packager, obj, key,
-                                              artifact_type)
                     return
             except ImportError:
                 continue
         # fallback: stringify into a result
         context.log_result(key, str(obj))
-
-    @staticmethod
-    def _record_instructions(context, packager, obj, key: str,
-                             artifact_type: str):
-        """Stamp unpackaging instructions into the logged artifact's spec
-        (reference packagers_manager records the same so a downstream
-        handler can receive the ORIGINAL type without a type hint)."""
-        artifact = getattr(context, "get_cached_artifact",
-                           lambda _key: None)(key)
-        if artifact is None:
-            return  # packed into a result — nothing to stamp
-        obj_type = type(obj)
-        artifact.spec.unpackaging_instructions = {
-            "packager": type(packager).__name__,
-            "object_type": f"{obj_type.__module__}.{obj_type.__qualname__}",
-            "artifact_type": artifact_type or "",
-        }
-        try:
-            context.update_artifact(artifact)
-        except Exception:  # noqa: BLE001 - instruction stamping must not
-            # fail the pack; hint-driven unpack still works without it
-            pass
 
     def unpack(self, data_item, hint):
         from ..datastore.base import DataItem
@@ -159,6 +147,22 @@ def _jsonable(obj) -> bool:
         return True
     except (TypeError, ValueError):
         return False
+
+
+class _StampingContext:
+    """Context proxy: adds the unpackaging instructions to artifacts the
+    wrapped packager logs (everything else passes straight through)."""
+
+    def __init__(self, context, instructions: dict):
+        self._context = context
+        self._instructions = instructions
+
+    def log_artifact(self, *args, **kwargs):
+        kwargs.setdefault("unpackaging_instructions", self._instructions)
+        return self._context.log_artifact(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._context, name)
 
 
 _NO_INSTRUCTIONS = object()  # sentinel: no usable recorded instructions

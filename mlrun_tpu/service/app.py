@@ -41,7 +41,17 @@ class ServiceState:
     def __init__(self, db: SQLiteRunDB | None = None, provider=None):
         from .deployments import DeploymentManager
 
-        self.db = db or SQLiteRunDB()
+        if db is None:
+            from ..db.base import sql_dialect_for_dsn
+
+            dsn = str(mlconf.httpdb.dsn or "")
+            if sql_dialect_for_dsn(dsn):
+                from ..db.sqldb import SQLServerRunDB
+
+                db = SQLServerRunDB(dsn)
+            else:
+                db = SQLiteRunDB()
+        self.db = db
         self.provider = provider or LocalProcessProvider(self.db)
         self.launcher = ServerSideLauncher(self.db, self.provider)
         self.launcher.recover()  # re-adopt resources from before a restart

@@ -1,0 +1,91 @@
+"""A psycopg2-shaped fake driver over sqlite, for exercising
+SQLServerRunDB's postgres dialect plumbing without a server (the same
+tier as fake_k8s: the translation layer, placeholders, upsert rewrite,
+schema_version table, and dict-row plumbing all run for real — sqlite
+natively executes the generated ``INSERT ... ON CONFLICT ... DO UPDATE
+SET c=EXCLUDED.c`` statements, so the postgres-dialect SQL itself is
+validated, not just string-compared)."""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+import types
+
+DATA_DIR = "/tmp"  # tests point this at a tmp_path
+
+
+class FakePgCursor:
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+        self._cur: sqlite3.Cursor | None = None
+
+    def execute(self, sql: str, params=()):
+        sql = sql.replace("%s", "?")
+        # pg DDL spellings sqlite lacks
+        sql = sql.replace("SERIAL PRIMARY KEY",
+                          "INTEGER PRIMARY KEY AUTOINCREMENT")
+        sql = sql.replace("DOUBLE PRECISION", "REAL")
+        self._cur = self._conn.execute(sql, tuple(params))
+        return self._cur
+
+    def fetchone(self):
+        return self._cur.fetchone() if self._cur else None
+
+    def fetchall(self):
+        return self._cur.fetchall() if self._cur else []
+
+    @property
+    def description(self):
+        return self._cur.description if self._cur else None
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount if self._cur else -1
+
+    def close(self):
+        if self._cur:
+            self._cur.close()
+
+
+class FakePgConnection:
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, timeout=30,
+                                     check_same_thread=False)
+
+    def cursor(self):
+        return FakePgCursor(self._conn)
+
+    def commit(self):
+        self._conn.commit()
+
+    def rollback(self):
+        self._conn.rollback()
+
+    def close(self):
+        self._conn.close()
+
+
+def make_module():
+    module = types.ModuleType("psycopg2")
+    calls = []
+
+    def connect(host="", port=0, user="", password="", dbname=""):
+        calls.append({"host": host, "port": port, "user": user,
+                      "dbname": dbname})
+        safe = re.sub(r"\W", "_", dbname or "mlrun")
+        return FakePgConnection(f"{DATA_DIR}/{safe}.pgfake.sqlite")
+
+    module.connect = connect
+    module._calls = calls
+    return module
+
+
+def install(monkeypatch, data_dir: str):
+    import sys
+
+    global DATA_DIR
+    DATA_DIR = str(data_dir)
+    module = make_module()
+    monkeypatch.setitem(sys.modules, "psycopg2", module)
+    return module

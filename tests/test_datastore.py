@@ -144,3 +144,27 @@ def test_ds_url_resolves_project_profile(service, http_db, tmp_path):
     manager = StoreManager(db=state.db)
     item = manager.object(url="ds://projstore/z.txt", project="dsp3")
     assert item.get().decode() == "proj-profile"
+
+
+def test_store_uri_iteration_addressing(tmp_path):
+    """store://...#iter resolves THAT iteration's artifact in every
+    resolution mode (review r5: without @tree the iter filter was
+    silently dropped and the tag winner came back instead)."""
+    import mlrun_tpu
+    from mlrun_tpu.datastore import store_manager
+
+    db = mlrun_tpu.get_run_db()
+    for iteration in (1, 2):
+        path = tmp_path / f"it{iteration}.txt"
+        path.write_text(f"payload-{iteration}")
+        db.store_artifact(
+            "hyper", {"kind": "artifact",
+                      "metadata": {"key": "hyper", "project": "itproj",
+                                   "iter": iteration},
+                      "spec": {"target_path": str(path)}},
+            uid=f"uid{iteration}", iter=iteration, tag="latest",
+            project="itproj")
+    item = store_manager.object(url="store://artifacts/itproj/hyper#1")
+    assert item.get(encoding="utf-8") == "payload-1"
+    item2 = store_manager.object(url="store://artifacts/itproj/hyper#2")
+    assert item2.get(encoding="utf-8") == "payload-2"

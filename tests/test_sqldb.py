@@ -1,0 +1,174 @@
+"""Server-mode SQL backend specifics (VERDICT r4 #9): dialect
+translation, generalized migrations, config wiring into the service.
+The full RunDBInterface conformance suite runs against this backend in
+test_sqlitedb.py (parameterized fixture)."""
+
+import pytest
+
+from mlrun_tpu.db.base import RunDBError
+
+from . import fake_pg
+
+
+@pytest.fixture()
+def pg_db(tmp_path, monkeypatch):
+    fake_pg.install(monkeypatch, tmp_path)
+    from mlrun_tpu.db.sqldb import SQLServerRunDB
+
+    return SQLServerRunDB("postgresql://svc:pw@dbhost:5499/mlt",
+                          logs_dir=str(tmp_path / "logs"))
+
+
+def test_dsn_parsing_and_driver_args(pg_db, monkeypatch):
+    import sys
+
+    calls = sys.modules["psycopg2"]._calls
+    assert calls[0] == {"host": "dbhost", "port": 5499, "user": "svc",
+                       "dbname": "mlt"}
+
+
+def test_unsupported_scheme_rejected():
+    from mlrun_tpu.db.sqldb import SQLServerRunDB
+
+    with pytest.raises(RunDBError, match="scheme"):
+        SQLServerRunDB("oracle://h/db")
+
+
+def test_missing_driver_is_clear_error(monkeypatch, tmp_path):
+    import builtins
+    import sys
+
+    monkeypatch.setitem(sys.modules, "psycopg2", None)
+    real_import = builtins.__import__
+
+    def no_pg(name, *args, **kwargs):
+        if name == "psycopg2":
+            raise ImportError("nope")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_pg)
+    from mlrun_tpu.db.sqldb import SQLServerRunDB
+
+    with pytest.raises(RunDBError, match="psycopg2"):
+        SQLServerRunDB("postgresql://h/db")
+
+
+def test_postgres_upsert_translation(pg_db):
+    sql = pg_db._translate(
+        "INSERT OR REPLACE INTO functions (project, name, tag, hash_key, "
+        "updated, body) VALUES (?,?,?,?,?,?)")
+    assert sql.startswith("INSERT INTO functions")
+    assert "ON CONFLICT (project, name, tag)" in sql
+    assert "DO UPDATE SET hash_key=EXCLUDED.hash_key" in sql
+    assert "?" not in sql and sql.count("%s") == 6
+    # all-PK upsert degrades to DO NOTHING
+    sql2 = pg_db._translate(
+        "INSERT OR REPLACE INTO artifact_tags (project, key, tag) "
+        "VALUES (?,?,?)")
+    assert "DO NOTHING" in sql2
+
+
+def test_mysql_dialect_translation(tmp_path, monkeypatch):
+    # no driver needed: translation is engine-independent; build the
+    # object without connecting by patching _init_schema
+    from mlrun_tpu.db import sqldb
+
+    monkeypatch.setattr(sqldb.SQLServerRunDB, "_init_schema",
+                        lambda self: None)
+    db = sqldb.SQLServerRunDB("mysql://u:p@h/mlt")
+    assert db.dialect == "mysql"
+    sql = db._translate(
+        "INSERT OR REPLACE INTO projects (name, state, created, body) "
+        "VALUES (?,?,?,?)")
+    assert sql.startswith("REPLACE INTO projects")
+    assert sql.count("%s") == 4
+    # indexed TEXT keys become bounded VARCHARs; payloads stay unbounded
+    ddl = db._translate_ddl(
+        "CREATE TABLE IF NOT EXISTS runs (project TEXT NOT NULL, "
+        "uid TEXT NOT NULL, body TEXT, PRIMARY KEY (project, uid))")
+    assert "project VARCHAR(255)" in ddl
+    assert "body MEDIUMTEXT" in ddl
+    ddl2 = db._translate_ddl(
+        "CREATE TABLE IF NOT EXISTS events (id INTEGER PRIMARY KEY "
+        "AUTOINCREMENT, project TEXT, body TEXT)")
+    assert "AUTO_INCREMENT" in ddl2
+
+
+def test_primary_keys_parsed_from_schema():
+    from mlrun_tpu.db.sqldb import _PRIMARY_KEYS
+
+    assert _PRIMARY_KEYS["runs"] == ["project", "uid", "iteration"]
+    assert _PRIMARY_KEYS["projects"] == ["name"]
+    assert _PRIMARY_KEYS["hub_sources"] == ["name"]
+    assert _PRIMARY_KEYS["project_secrets"] == ["project", "provider",
+                                                "name"]
+    # every upsertable table resolves (events is insert-only)
+    assert set(_PRIMARY_KEYS) >= {
+        "runs", "artifacts", "functions", "function_versions", "projects",
+        "schedules", "feature_sets", "feature_vectors", "model_endpoints",
+        "background_tasks", "alert_configs", "hub_sources",
+        "runtime_resources", "project_secrets", "pagination_cache",
+        "datastore_profiles", "artifact_tags"}
+
+
+def test_migrations_ride_schema_version_table(tmp_path, monkeypatch):
+    """A server DB at an older schema version migrates through the SAME
+    ordered migration scripts as sqlite, tracked in schema_version."""
+    fake_pg.install(monkeypatch, tmp_path)
+    from mlrun_tpu.db.sqldb import SQLServerRunDB
+    from mlrun_tpu.db.sqlitedb import SCHEMA_VERSION
+
+    db = SQLServerRunDB("postgresql://u@h/mig", logs_dir=str(tmp_path))
+    assert db.schema_version == SCHEMA_VERSION
+    # wind the version back and reconnect with a stub migration script:
+    # the generalized loop walks it forward through schema_version
+    cur = db._conn.cursor()
+    cur.execute("UPDATE schema_version SET version=%s",
+                (SCHEMA_VERSION - 1,))
+    db._conn.commit()
+    from mlrun_tpu.db import sqlitedb
+
+    monkeypatch.setitem(
+        sqlitedb._MIGRATIONS, SCHEMA_VERSION,
+        "CREATE TABLE IF NOT EXISTS migration_probe (x INTEGER);")
+    db2 = SQLServerRunDB("postgresql://u@h/mig", logs_dir=str(tmp_path))
+    assert db2.schema_version == SCHEMA_VERSION
+    probe = db2._conn.cursor()
+    probe.execute("SELECT * FROM migration_probe")  # table exists
+    # a FUTURE version refuses to run (same contract as sqlite)
+    cur.execute("UPDATE schema_version SET version=%s",
+                (SCHEMA_VERSION + 5,))
+    db._conn.commit()
+    with pytest.raises(RunDBError, match="newer"):
+        SQLServerRunDB("postgresql://u@h/mig", logs_dir=str(tmp_path))
+
+
+def test_service_uses_sql_dsn_from_config(tmp_path, monkeypatch):
+    """mlconf.httpdb.dsn switches the whole service onto the shared SQL
+    store — the clusterization HA path."""
+    fake_pg.install(monkeypatch, tmp_path)
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.service.app import ServiceState
+
+    monkeypatch.setattr(mlconf.httpdb, "dsn",
+                        "postgresql://svc@dbhost/shared")
+    state = ServiceState()
+    assert type(state.db).__name__ == "SQLServerRunDB"
+    uid = "sqldsn0001"
+    state.db.store_run({"metadata": {"name": "r", "uid": uid,
+                                     "project": "p"},
+                        "status": {"state": "completed"}}, uid, "p")
+    # a SECOND ServiceState (another replica) sees the same row through
+    # the shared store
+    state2 = ServiceState()
+    assert state2.db.read_run(uid, "p")["status"]["state"] == "completed"
+
+
+def test_get_run_db_dispatches_sql_scheme(tmp_path, monkeypatch):
+    fake_pg.install(monkeypatch, tmp_path)
+    import mlrun_tpu.db as dbmod
+
+    monkeypatch.setattr(dbmod, "_run_db", None)
+    db = dbmod.get_run_db("postgresql://u@h/viaurl", force_reconnect=True)
+    assert type(db).__name__ == "SQLServerRunDB"
+    dbmod.set_run_db(None)

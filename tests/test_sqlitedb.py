@@ -1,15 +1,27 @@
-"""SQLite run DB tests (reference analog: tests/api sqldb tests)."""
+"""Run DB tests, parameterized over BOTH engines: the embedded SQLite
+backend and the server-mode SQL backend's postgres dialect (via the
+psycopg2-shaped fake driver — the generated ON CONFLICT upserts and
+schema_version flow execute for real). Reference analog: tests/api
+sqldb tests, which run against SQLite-or-MySQL the same way."""
 
 import pytest
 
 from mlrun_tpu.db.base import RunDBError
 from mlrun_tpu.db.sqlitedb import SQLiteRunDB
 
+from . import fake_pg
 
-@pytest.fixture()
-def db(tmp_path):
-    return SQLiteRunDB(str(tmp_path / "db.sqlite"),
-                       logs_dir=str(tmp_path / "logs"))
+
+@pytest.fixture(params=["sqlite", "postgresql"])
+def db(tmp_path, request, monkeypatch):
+    if request.param == "sqlite":
+        return SQLiteRunDB(str(tmp_path / "db.sqlite"),
+                           logs_dir=str(tmp_path / "logs"))
+    fake_pg.install(monkeypatch, tmp_path)
+    from mlrun_tpu.db.sqldb import SQLServerRunDB
+
+    return SQLServerRunDB("postgresql://svc:pw@dbhost/mlrun",
+                          logs_dir=str(tmp_path / "logs"))
 
 
 def test_run_crud(db):
