@@ -35,6 +35,11 @@ default_config: dict[str, Any] = {
     # mlrun/model.py:1451)
     "exec_config_env": "MLT_EXEC_CONFIG",
     "exec_code_env": "MLT_EXEC_CODE",
+    "redis": {
+        # shared online-feature / KV store for serving fleets
+        # (datastore/redis.py + RedisNoSqlTarget); MLT_REDIS__URL
+        "url": "redis://localhost:6379",
+    },
     "httpdb": {
         "port": 8787,
         "host": "0.0.0.0",

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .base import DataItem, DataStore, parse_url
+from .redis import RedisStore
 from .stores import FileStore, FsspecStore, HttpStore, InMemoryStore
 
 schema_to_store: dict[str, type] = {
@@ -22,8 +23,12 @@ schema_to_store: dict[str, type] = {
     "az": FsspecStore,
     "abfs": FsspecStore,
     "hdfs": FsspecStore,
+    "dbfs": FsspecStore,
+    "oss": FsspecStore,
     "http": HttpStore,
     "https": HttpStore,
+    "redis": RedisStore,
+    "rediss": RedisStore,
 }
 
 

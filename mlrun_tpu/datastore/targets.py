@@ -129,18 +129,83 @@ class NoSqlTarget(BaseTarget):
 
 
 class RedisNoSqlTarget(NoSqlTarget):
+    """Online KV target on redis (reference datastore/redis.py backs the
+    same role): rows live as redis HASHes under
+    ``mlt:{project}:{feature_set}:{entity-key}`` so the online feature
+    service reads single rows with one HGETALL — the low-latency path a
+    shared serving fleet needs (the sqlite NoSqlTarget is single-host)."""
+
     kind = "redisnosql"
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._prefix = ""
+        self._cached_client = None
+
+    def _client(self):
+        if self._cached_client is None:
+            try:
+                import redis  # gated
+            except ImportError as exc:
+                raise ImportError(
+                    "RedisNoSqlTarget requires redis-py") from exc
+            self._cached_client = redis.from_url(
+                self.path or str(mlconf.redis.url))
+        return self._cached_client
+
+    def close(self):
+        if self._cached_client is not None:
+            # actually release the pool's sockets (redis-py keeps them
+            # until GC otherwise); close() exists on redis>=4, fall back
+            # to the pool disconnect
+            client = self._cached_client
+            closer = getattr(client, "close", None) or getattr(
+                getattr(client, "connection_pool", None), "disconnect",
+                None)
+            if closer:
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
+        self._cached_client = None
+
+    def set_namespace(self, project: str, feature_set: str):
+        """Key namespace — set on EVERY ingest (a user-supplied redis url
+        must not make two feature sets share un-prefixed row keys)."""
+        self._prefix = f"mlt:{project}:{feature_set}"
+
+    def default_path(self, project: str, feature_set: str) -> str:
+        self.set_namespace(project, feature_set)
+        return str(mlconf.redis.url)
+
+    def _row_key(self, key_values: list) -> str:
+        key = "|".join(str(v) for v in key_values)
+        return f"{self._prefix}:{key}" if self._prefix else key
+
     def write_dataframe(self, df, key_columns=None, timestamp_key=None) -> str:
-        try:
-            import redis  # gated
-        except ImportError as exc:
-            raise ImportError("RedisNoSqlTarget requires redis-py") from exc
-        client = redis.from_url(self.path)
+        if not key_columns:
+            raise ValueError("redis target requires key columns (entities)")
+        client = self._client()
         for _, row in df.iterrows():
-            key = "|".join(str(row[k]) for k in key_columns or [])
-            client.set(key, json.dumps(row.to_dict(), default=str))
-        return self.path
+            key = self._row_key([row[k] for k in key_columns])
+            client.hset(key, mapping={
+                k: json.dumps(v, default=str)
+                for k, v in row.to_dict().items()})
+        return self.path or str(mlconf.redis.url)
+
+    def get(self, key_values: list) -> Optional[dict]:
+        raw = self._client().hgetall(self._row_key(key_values))
+        if not raw:
+            return None
+        return {
+            (k.decode() if isinstance(k, bytes) else k):
+            json.loads(v.decode() if isinstance(v, bytes) else v)
+            for k, v in raw.items()}
+
+    def status_record(self) -> dict:
+        record = super().status_record()
+        record["prefix"] = self._prefix
+        return record
 
 
 class StreamTarget(BaseTarget):

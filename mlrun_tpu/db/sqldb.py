@@ -120,15 +120,37 @@ class SQLServerRunDB(SQLiteRunDB):
 
     def _execute(self, sql: str, params: tuple = ()):
         cur = self._conn.cursor()
-        cur.execute(self._translate(sql), tuple(params))
+        try:
+            cur.execute(self._translate(sql), tuple(params))
+        except Exception:
+            # a failed statement must not poison the cached per-thread
+            # connection (postgres raises InFailedSqlTransaction on every
+            # later statement of an aborted transaction otherwise)
+            self._rollback_quietly()
+            raise
         self._conn.commit()
         return cur
 
     def _query(self, sql: str, params: tuple = ()) -> list[dict]:
         cur = self._conn.cursor()
-        cur.execute(self._translate(sql), tuple(params))
-        columns = [d[0] for d in cur.description or []]
-        return [dict(zip(columns, row)) for row in cur.fetchall()]
+        try:
+            cur.execute(self._translate(sql), tuple(params))
+            columns = [d[0] for d in cur.description or []]
+            rows = [dict(zip(columns, row)) for row in cur.fetchall()]
+        except Exception:
+            self._rollback_quietly()
+            raise
+        # END the read transaction: without this, mysql's REPEATABLE READ
+        # pins the thread's snapshot at its first SELECT forever and a
+        # replica stops seeing other replicas' writes
+        self._rollback_quietly()
+        return rows
+
+    def _rollback_quietly(self):
+        try:
+            self._conn.rollback()
+        except Exception:  # noqa: BLE001 - connection already gone
+            pass
 
     # -- dialect translation -----------------------------------------------
     def _translate(self, sql: str) -> str:

@@ -721,10 +721,12 @@ class SQLiteRunDB(RunDBInterface):
         elif tree:
             sql += " AND tree=?"
             params.append(tree)
-        elif iter is not None and tag is None:
-            # pure iteration addressing (store://...#N): the newest
-            # producer's iteration N — hyper-run children don't carry the
-            # parent's tag, so a tag filter here would always miss
+        elif iter is not None:
+            # iteration addressing (store://...#N): the newest producer's
+            # iteration N. The iteration WINS over any tag part — hyper-run
+            # children don't carry the parent's tag, and the tag side-table
+            # maps a tag to ONE uid, which can't coexist with an explicit
+            # iteration filter
             pass
         else:
             wanted = tag or "latest"

@@ -109,6 +109,12 @@ def ingest(featureset: Union[FeatureSet, str], source,
         if isinstance(target, str) and target == "parquet":
             continue
         target_obj = resolve_target(target)
+        namespacer = getattr(target_obj, "set_namespace", None)
+        if namespacer:
+            # always namespaced — a user-supplied shared url (e.g. one
+            # redis for the whole cluster) must not collide row keys
+            # across feature sets
+            namespacer(project, fset.name)
         if not target_obj.path:
             target_obj.path = target_obj.default_path(project, fset.name)
         target_obj.write_dataframe(source, key_columns=entities,
@@ -287,17 +293,51 @@ class OnlineVectorService:
         self.vector = vector
         self.impute_policy = impute_policy or {}
         self._tables: list[tuple[list[str], pd.DataFrame]] = []
+        self._targets: list[tuple] = []  # (entities, wanted, columns, target)
         self._initialize()
 
     def _initialize(self):
         project = getattr(self.vector.metadata, "project", "") or ""
+        by_set: dict[str, list[str]] = {}
         for set_name, feature in self.vector.parse_features():
+            by_set.setdefault(set_name, []).append(feature)
+        for set_name, wanted in by_set.items():
             fset = _resolve_feature_set(set_name, project=project)
-            df = fset.to_dataframe()
             entities = fset.entity_names
-            if feature != "*":
-                df = df[entities + [feature]]
+            features = ["*"] if "*" in wanted else wanted
+            target = self._online_target(fset)
+            if target is not None:
+                # key-value lookups ride the ingested ONLINE target
+                # (sqlite kv single-host; redis for a shared serving
+                # fleet) instead of loading the offline frame in memory.
+                # ONE target per feature set: multi-feature vectors do a
+                # single row fetch, not one per feature. Known columns
+                # seed NaN placeholders when a row is missing so the
+                # impute policy fires like the in-memory path.
+                columns = (features if "*" not in features
+                           else [f["name"] if isinstance(f, dict)
+                                 else f.name
+                                 for f in fset.spec.features or []])
+                self._targets.append((entities, features, columns, target))
+                continue
+            df = fset.to_dataframe()
+            if "*" not in features:
+                df = df[entities + features]
             self._tables.append((entities, df.set_index(entities)))
+
+    @staticmethod
+    def _online_target(fset):
+        from ..datastore.targets import resolve_target
+
+        for record in (getattr(fset.status, "targets", None) or []):
+            if record.get("kind") in ("nosql", "redisnosql"):
+                target = resolve_target(
+                    {"kind": record["kind"],
+                     "path": record.get("path", "")})
+                if record.get("prefix"):
+                    target._prefix = record["prefix"]
+                return target
+        return None
 
     @property
     def status(self):
@@ -308,6 +348,22 @@ class OnlineVectorService:
         out = []
         for row in entity_rows:
             features: dict = {}
+            for entities, wanted, columns, target in self._targets:
+                try:
+                    record = target.get([row[e] for e in entities])
+                except KeyError:
+                    record = None
+                if record:
+                    if "*" not in wanted:
+                        record = {k: v for k, v in record.items()
+                                  if k in wanted}
+                    features.update({k: v for k, v in record.items()
+                                     if k not in entities})
+                else:
+                    # missing row: NaN placeholders (like the in-memory
+                    # path) so the impute policy below can fill them
+                    for col in columns:
+                        features.setdefault(col, float("nan"))
             for entities, table in self._tables:
                 try:
                     key = tuple(row[e] for e in entities)
@@ -334,6 +390,11 @@ class OnlineVectorService:
 
     def close(self):
         self._tables = []
+        for _, _, _, target in self._targets:
+            closer = getattr(target, "close", None)
+            if closer:
+                closer()
+        self._targets = []
 
 
 def get_online_feature_service(feature_vector: Union[str, FeatureVector],

@@ -161,6 +161,12 @@ class _StampingContext:
         kwargs.setdefault("unpackaging_instructions", self._instructions)
         return self._context.log_artifact(*args, **kwargs)
 
+    def log_dataset(self, *args, **kwargs):
+        # pandas packagers log through log_dataset — it forwards **kwargs
+        # to the artifact manager, so dataset artifacts get stamped too
+        kwargs.setdefault("unpackaging_instructions", self._instructions)
+        return self._context.log_dataset(*args, **kwargs)
+
     def __getattr__(self, name):
         return getattr(self._context, name)
 

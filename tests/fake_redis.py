@@ -1,0 +1,73 @@
+"""A dict-backed fake ``redis`` module (strings + hashes + scan), for
+exercising the redis datastore driver and the redis online target
+without a server — same tier as fake_k8s/fake_pg."""
+
+from __future__ import annotations
+
+import fnmatch
+import types
+
+
+class FakeRedisClient:
+    def __init__(self):
+        self.strings: dict[str, bytes] = {}
+        self.hashes: dict[str, dict[bytes, bytes]] = {}
+
+    # strings
+    def set(self, key, value):
+        self.strings[key] = value.encode() if isinstance(value, str) \
+            else bytes(value)
+
+    def append(self, key, value):
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        self.strings[key] = self.strings.get(key, b"") + data
+
+    def get(self, key):
+        return self.strings.get(key)
+
+    def strlen(self, key):
+        return len(self.strings.get(key, b""))
+
+    def exists(self, *keys):
+        return sum(1 for k in keys
+                   if k in self.strings or k in self.hashes)
+
+    def delete(self, *keys):
+        for key in keys:
+            self.strings.pop(key, None)
+            self.hashes.pop(key, None)
+
+    def scan_iter(self, match="*"):
+        for key in sorted(set(self.strings) | set(self.hashes)):
+            if fnmatch.fnmatchcase(key, match):
+                yield key.encode()
+
+    # hashes
+    def hset(self, key, mapping=None):
+        bucket = self.hashes.setdefault(key, {})
+        for k, v in (mapping or {}).items():
+            bucket[k.encode() if isinstance(k, str) else k] = \
+                v.encode() if isinstance(v, str) else bytes(v)
+
+    def hgetall(self, key):
+        return dict(self.hashes.get(key, {}))
+
+
+def make_module():
+    module = types.ModuleType("redis")
+    clients: dict[str, FakeRedisClient] = {}
+
+    def from_url(url, **kwargs):
+        return clients.setdefault(url, FakeRedisClient())
+
+    module.from_url = from_url
+    module._clients = clients
+    return module
+
+
+def install(monkeypatch):
+    import sys
+
+    module = make_module()
+    monkeypatch.setitem(sys.modules, "redis", module)
+    return module

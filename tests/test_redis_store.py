@@ -1,0 +1,167 @@
+"""Redis datastore driver + redis online feature path (VERDICT r4 #10:
+reference datastore/redis.py:25 backs the reference's online lookups)
+and the deepened render surface."""
+
+import pandas as pd
+import pytest
+
+from . import fake_redis
+
+
+@pytest.fixture()
+def redis_mod(monkeypatch):
+    return fake_redis.install(monkeypatch)
+
+
+def test_redis_store_roundtrip(redis_mod):
+    from mlrun_tpu.datastore import store_manager
+
+    item = store_manager.object(url="redis://cache:6379/models/weights.bin")
+    item.put(b"\x00\x01\x02")
+    assert item.get() == b"\x00\x01\x02"
+    assert item.exists()
+    stats = item.stat()
+    assert stats.size == 3 and stats.modified is not None
+    item.put(b"\x03", append=True)
+    assert item.get() == b"\x00\x01\x02\x03"
+    assert item.get(size=2, offset=1) == b"\x01\x02"
+
+    sibling = store_manager.object(url="redis://cache:6379/models/extra.txt")
+    sibling.put("x")
+    listing = store_manager.object(url="redis://cache:6379/models").ls()
+    assert listing == ["extra.txt", "weights.bin"]
+
+    item.delete()
+    assert not item.exists()
+    with pytest.raises(FileNotFoundError):
+        item.get()
+
+
+def test_redis_store_gated_without_package(monkeypatch):
+    import builtins
+    import sys
+
+    monkeypatch.setitem(sys.modules, "redis", None)
+    real_import = builtins.__import__
+
+    def no_redis(name, *args, **kwargs):
+        if name == "redis":
+            raise ImportError("nope")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_redis)
+    from mlrun_tpu.datastore import store_manager
+
+    item = store_manager.object(url="redis://elsewhere:6379/k")
+    with pytest.raises(ImportError, match="redis"):
+        item.get()
+
+
+def test_redis_online_feature_path(redis_mod, tmp_path):
+    """ingest with RedisNoSqlTarget → the online service reads rows from
+    redis hashes (not an in-memory frame), namespaced per project/set."""
+    import mlrun_tpu.feature_store as fstore
+    from mlrun_tpu.datastore.targets import RedisNoSqlTarget
+
+    df = pd.DataFrame({"ticker": ["GOOG", "MSFT"],
+                       "price": [100.0, 200.0],
+                       "volume": [10, 20]})
+    fset = fstore.FeatureSet("stocks-redis", entities=["ticker"])
+    fset.metadata.project = "rds"
+    fstore.ingest(fset, df, targets=["parquet", RedisNoSqlTarget()])
+    kinds = {t["kind"] for t in fset.status.targets}
+    assert "redisnosql" in kinds
+
+    # direct row lookup through the target
+    target = [t for t in fset.status.targets
+              if t["kind"] == "redisnosql"][0]
+    assert target["prefix"] == "mlt:rds:stocks-redis"
+
+    vector = fstore.FeatureVector("v", features=["stocks-redis.*"])
+    vector.metadata.project = "rds"
+    service = fstore.get_online_feature_service(vector)
+    assert service._targets and not service._tables  # redis-backed
+    rows = service.get([{"ticker": "GOOG"}, {"ticker": "MSFT"}])
+    assert rows[0]["price"] == 100.0 and rows[0]["volume"] == 10
+    assert rows[1]["price"] == 200.0
+    service.close()
+
+    # the rows physically live in the fake redis as hashes
+    client = list(redis_mod._clients.values())[0]
+    assert any(k.startswith("mlt:rds:stocks-redis:")
+               for k in client.hashes)
+
+
+def test_run_detail_html_and_repr(tmp_path):
+    import mlrun_tpu
+
+    plot = tmp_path / "chart.html"
+    plot.write_text("<html><body><b>plot!</b></body></html>")
+
+    def handler(context):
+        context.log_result("score", 0.9)
+        context.log_artifact("chart", local_path=str(plot), format="html")
+
+    run = mlrun_tpu.new_function("render", kind="local",
+                                 handler=handler).run(
+        params={"alpha": 2}, local=True)
+    html = run._repr_html_()
+    assert "render" in html and "score" in html and "0.9" in html
+    assert "alpha" in html
+    assert "<iframe" in html and "plot!" in html  # embedded html artifact
+    assert "<a href=" in html  # artifact link
+    # XSS hygiene: values are escaped
+    run.status.results["evil"] = "<script>alert(1)</script>"
+    assert "<script>" not in run._repr_html_()
+
+
+def test_redis_online_missing_row_imputes(redis_mod):
+    """A missing entity row seeds NaN placeholders for the declared
+    columns so the impute policy fires (parity with the in-memory
+    path)."""
+    import math
+
+    import mlrun_tpu.feature_store as fstore
+    from mlrun_tpu.datastore.targets import RedisNoSqlTarget
+
+    df = pd.DataFrame({"user": ["a"], "score": [5.0]})
+    fset = fstore.FeatureSet("scores", entities=["user"])
+    fset.metadata.project = "rds2"
+    fstore.ingest(fset, df, targets=[RedisNoSqlTarget()])
+    vector = fstore.FeatureVector("v2", features=["scores.*"])
+    vector.metadata.project = "rds2"
+    service = fstore.get_online_feature_service(
+        vector, impute_policy={"*": -1})
+    rows = service.get([{"user": "a"}, {"user": "missing"}])
+    assert rows[0]["score"] == 5.0
+    assert rows[1]["score"] == -1  # imputed, not absent
+    service.close()
+
+
+def test_redis_targets_namespaced_with_explicit_path(redis_mod):
+    """Two feature sets pointed at the SAME user-supplied redis url must
+    not collide row keys (review r5: explicit paths skipped the
+    namespace)."""
+    import mlrun_tpu.feature_store as fstore
+    from mlrun_tpu.datastore.targets import RedisNoSqlTarget
+
+    url = "redis://shared:6379"
+    fs1 = fstore.FeatureSet("one", entities=["k"])
+    fs1.metadata.project = "np"
+    fstore.ingest(fs1, pd.DataFrame({"k": ["x"], "a": [1]}),
+                  targets=[RedisNoSqlTarget(path=url)])
+    fs2 = fstore.FeatureSet("two", entities=["k"])
+    fs2.metadata.project = "np"
+    fstore.ingest(fs2, pd.DataFrame({"k": ["x"], "b": [2]}),
+                  targets=[RedisNoSqlTarget(path=url)])
+    client = redis_mod._clients[url]
+    assert "mlt:np:one:x" in client.hashes
+    assert "mlt:np:two:x" in client.hashes
+    # no blending: set one's row has no column from set two
+    t1 = [t for t in fs1.status.targets if t["kind"] == "redisnosql"][0]
+    from mlrun_tpu.datastore.targets import resolve_target
+
+    target = resolve_target({"kind": "redisnosql", "path": t1["path"]})
+    target._prefix = t1["prefix"]
+    row = target.get(["x"])
+    assert row["a"] == 1 and "b" not in row
