@@ -1,0 +1,130 @@
+"""CPU-mesh attention-kernel comparison at growing sequence lengths
+(VERDICT r4 #1, the non-relay half): our pallas flash kernels vs the
+plain XLA reference at seq 2k/8k/32k, plus the VMEM-footprint model that
+documents the v1 full-KV-in-VMEM scaling wall and why the production
+path (flash_attention_mlt / the `attention` dispatcher) rides the
+grid-pipelined v2 kernel instead.
+
+On CPU, pallas runs in INTERPRET mode — wall-clock there measures the
+interpreter, not the TPU kernel, so the numbers reported are:
+- correctness (max |err| vs reference) per kernel per seq;
+- XLA-reference wall-clock (a real CPU number, the baseline curve);
+- the analytic per-program VMEM bytes for v1 vs v2 against the ~16MB/core
+  budget — the actual scaling-wall evidence.
+
+Writes one JSON line per row and a summary file (BENCH_ATTN_CPU.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mlrun_tpu.ops.attention import (  # noqa: E402
+    _flash_fwd,
+    _flash_fwd_v2,
+    _repeat_kv,
+    attention_reference,
+)
+
+VMEM_BUDGET = 16 * 1024 * 1024  # bytes/core (v4/v5 class)
+
+
+def vmem_model(seq_k: int, d: int, block_q: int, block_k: int,
+               kernel: str, dtype_bytes: int = 4) -> int:
+    """Per-program VMEM bytes (inputs+outputs+scratch the kernel holds)."""
+    if kernel == "v1":
+        # q block + FULL kv + o block + lse block
+        return dtype_bytes * (block_q * d + 2 * seq_k * d
+                              + block_q * d + block_q * 8)
+    # v2: q block + one kv block tile + o/lse + scratch (m/l/acc)
+    return dtype_bytes * (block_q * d + 2 * block_k * d + block_q * d
+                          + block_q * 8 + block_q * (2 + d))
+
+
+def timeit(fn, *args, reps: int = 3) -> float:
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
+        else fn(*args).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        (out[0] if isinstance(out, tuple) else out).block_until_ready()
+    return (time.perf_counter() - start) / reps
+
+
+def run():
+    rows = []
+    cases = [
+        # (seq, batch, q_heads, kv_heads, d, run_v1, run_v2)
+        (2048, 1, 4, 2, 64, True, True),
+        (8192, 1, 2, 1, 64, True, True),
+        (32768, 1, 1, 1, 64, False, True),  # v1 interpret too slow here
+    ]
+    for seq, b, h, hkv, d, run_v1, run_v2 in cases:
+        key = jax.random.PRNGKey(seq)
+        kq, kk, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, seq, h, d), jnp.float32) * 0.3
+        k = jax.random.normal(kk, (b, seq, hkv, d), jnp.float32) * 0.3
+        v = jax.random.normal(kv_, (b, seq, hkv, d), jnp.float32) * 0.3
+        ref = jax.jit(attention_reference)(q, k, v)
+        ref_ms = timeit(jax.jit(attention_reference), q, k, v) * 1e3
+        n_rep = h // hkv
+        kr, vr = _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+
+        for name, fn, enabled, bq, bk in (
+                ("flash_v1", _flash_fwd, run_v1, 256, 256),
+                ("flash_v2", _flash_fwd_v2, run_v2, 512, 512)):
+            bytes_needed = vmem_model(seq, d, bq, bk,
+                                      "v1" if name == "flash_v1" else "v2")
+            row = {
+                "kernel": name, "seq": seq, "heads": h, "d": d,
+                "vmem_bytes_per_program": bytes_needed,
+                "fits_vmem_budget": bytes_needed < VMEM_BUDGET,
+                "ref_xla_cpu_ms": round(ref_ms, 2),
+            }
+            if enabled:
+                start = time.perf_counter()
+                out, _ = fn(q, kr, vr, causal=True, interpret=True)
+                out.block_until_ready()
+                row["interpret_s"] = round(time.perf_counter() - start, 2)
+                row["max_err_vs_reference"] = float(
+                    jnp.max(jnp.abs(out - ref)))
+            else:
+                row["skipped"] = "interpret-mode cost; correctness " \
+                    "covered at shorter seqs, VMEM model still applies"
+            rows.append(row)
+            print(json.dumps(row))
+
+    # the scaling wall, stated plainly: the longest seq the v1 kernel can
+    # serve from VMEM at production head dim (128) vs v2's flat footprint
+    d_prod = 128
+    wall = next(s for s in (2048, 4096, 8192, 16384, 32768, 65536)
+                if vmem_model(s, d_prod, 256, 256, "v1") >= VMEM_BUDGET)
+    summary = {
+        "metric": "attention_kernel_comparison_cpu",
+        "rows": rows,
+        "v1_vmem_wall_seq_at_d128": wall,
+        "v2_vmem_bytes_flat_d128": vmem_model(0, d_prod, 512, 512, "v2"),
+        "production_path": "flash_attention_mlt -> _flash_fwd_v2 "
+                           "(grid-pipelined; KV streamed per block, "
+                           "seq bounded by HBM not VMEM)",
+    }
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "BENCH_ATTN_CPU.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({"summary": {k: v for k, v in summary.items()
+                                  if k != "rows"}}))
+
+
+if __name__ == "__main__":
+    run()
