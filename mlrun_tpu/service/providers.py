@@ -24,6 +24,16 @@ from ..common.runtimes_constants import (
 from ..config import mlconf
 
 
+# CRD kinds the kubernetes provider speaks: kind -> (group, version,
+# plural); _CRD_BY_LOWER keys by the resource-id prefix
+_CRD_KINDS = {
+    "JobSet": ("jobset.x-k8s.io", "v1alpha2", "jobsets"),
+    "SparkApplication": ("sparkoperator.k8s.io", "v1beta2",
+                         "sparkapplications"),
+}
+_CRD_BY_LOWER = {k.lower(): v for k, v in _CRD_KINDS.items()}
+
+
 def _extract_pod_spec(resource: dict) -> dict:
     if resource.get("kind") == "JobSet":
         return resource["spec"]["replicatedJobs"][0]["template"]["spec"][
@@ -189,12 +199,19 @@ class KubernetesProvider(Provider):
         self._custom = kubernetes.client.CustomObjectsApi()
         self.namespace = namespace or mlconf.namespace
 
+    # the ONE registry of CRD kinds the provider speaks (create/state/
+    # delete/list all read it): kind -> (group, version, plural).
+    # SparkApplication is the spark-operator contract
+    # (runtimes/sparkjob.py generate_spark_application)
+    CRD_KINDS = _CRD_KINDS
+
     def create(self, resource: dict, run_uid: str) -> str:
-        if resource.get("kind") == "JobSet":
+        kind = resource.get("kind")
+        if kind in self.CRD_KINDS:
+            group, version, plural = self.CRD_KINDS[kind]
             self._custom.create_namespaced_custom_object(
-                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
-                resource)
-            return f"jobset/{resource['metadata']['name']}"
+                group, version, self.namespace, plural, resource)
+            return f"{kind.lower()}/{resource['metadata']['name']}"
         if resource.get("kind") == "Deployment":
             # long-running gateway Deployments (service/deployments.py) —
             # replicas come from the function's min_replicas
@@ -241,9 +258,9 @@ class KubernetesProvider(Provider):
                     return PodPhases.failed
             return PodPhases.pending
         if kind == "jobset":
+            group, version, plural = _CRD_BY_LOWER["jobset"]
             obj = self._custom.get_namespaced_custom_object(
-                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
-                name)
+                group, version, self.namespace, plural, name)
             run_state = JobSetConditions.to_run_state(
                 obj.get("status", {}).get("conditions", []))
             return {
@@ -251,15 +268,34 @@ class KubernetesProvider(Provider):
                 RunStates.error: PodPhases.failed,
                 RunStates.pending: PodPhases.pending,
             }.get(run_state, PodPhases.running)
+        if kind == "sparkapplication":
+            group, version, plural = _CRD_BY_LOWER["sparkapplication"]
+            obj = self._custom.get_namespaced_custom_object(
+                group, version, self.namespace, plural, name)
+            # spark-operator applicationState.state contract
+            app_state = (obj.get("status", {})
+                         .get("applicationState", {})
+                         .get("state", "")).upper()
+            return {
+                "COMPLETED": PodPhases.succeeded,
+                "FAILED": PodPhases.failed,
+                "SUBMISSION_FAILED": PodPhases.failed,
+                "FAILING": PodPhases.failed,
+                "": PodPhases.pending,
+                "NEW": PodPhases.pending,
+                "SUBMITTED": PodPhases.pending,
+                "PENDING_RERUN": PodPhases.pending,
+            }.get(app_state, PodPhases.running)
         pod = self._core.read_namespaced_pod(name, self.namespace)
         return pod.status.phase
 
     def delete(self, resource_id: str):
         kind, _, name = resource_id.partition("/")
-        if kind == "jobset":
+        crd = _CRD_BY_LOWER.get(kind)
+        if crd:
+            group, version, plural = crd
             self._custom.delete_namespaced_custom_object(
-                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
-                name)
+                group, version, self.namespace, plural, name)
         elif kind == "deployment":
             import kubernetes
 
@@ -327,20 +363,22 @@ class KubernetesProvider(Provider):
                 pods.metadata, "continue_", None)
             if not token:
                 break
-        token = None
-        while True:
-            jobsets = self._custom.list_namespaced_custom_object(
-                "jobset.x-k8s.io", "v1alpha2", self.namespace, "jobsets",
-                label_selector=selector, limit=500,
-                **({"_continue": token} if token else {}))
-            for js in jobsets.get("items", []):
-                labels = js.get("metadata", {}).get("labels", {})
-                found.append((f"jobset/{js['metadata']['name']}",
-                              labels.get("mlrun-tpu/uid", ""),
-                              labels.get("mlrun-tpu/project", "")))
-            token = jobsets.get("metadata", {}).get("continue")
-            if not token:
-                break
+        for crd_kind, (group, version, plural) in _CRD_KINDS.items():
+            token = None
+            while True:
+                objs = self._custom.list_namespaced_custom_object(
+                    group, version, self.namespace, plural,
+                    label_selector=selector, limit=500,
+                    **({"_continue": token} if token else {}))
+                for obj in objs.get("items", []):
+                    labels = obj.get("metadata", {}).get("labels", {})
+                    found.append(
+                        (f"{crd_kind.lower()}/{obj['metadata']['name']}",
+                         labels.get("mlrun-tpu/uid", ""),
+                         labels.get("mlrun-tpu/project", "")))
+                token = objs.get("metadata", {}).get("continue")
+                if not token:
+                    break
         return [f for f in found if f[1]]
 
 

@@ -295,10 +295,18 @@ class BaseRuntimeHandler:
         if not secrets:
             return
         secret_name = ensure(project, secrets)
+        ref = {"secretRef": {"name": secret_name}}
+        if resource.get("kind") == "SparkApplication":
+            # spark-operator takes envFrom on the driver/executor specs,
+            # not a containers list — without this branch spark runs got
+            # NO project secrets at all
+            for role in ("driver", "executor"):
+                section = resource["spec"].setdefault(role, {})
+                section.setdefault("envFrom", []).append(dict(ref))
+            return
         pod_spec = _extract_pod_spec(resource)
         for container in pod_spec.get("containers", []):
-            container.setdefault("envFrom", []).append(
-                {"secretRef": {"name": secret_name}})
+            container.setdefault("envFrom", []).append(dict(ref))
 
     def delete_resources(self, uid: str, project: str = "",
                          resource_id: str = ""):

@@ -52,13 +52,15 @@ def vmem_model(seq_k: int, d: int, block_q: int, block_k: int,
                           + block_q * 8 + block_q * (2 + d))
 
 
+def _ready(out):
+    (out[0] if isinstance(out, tuple) else out).block_until_ready()
+
+
 def timeit(fn, *args, reps: int = 3) -> float:
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
-        else fn(*args).block_until_ready()
+    _ready(fn(*args))  # warmup/compile
     start = time.perf_counter()
     for _ in range(reps):
-        out = fn(*args)
-        (out[0] if isinstance(out, tuple) else out).block_until_ready()
+        _ready(fn(*args))
     return (time.perf_counter() - start) / reps
 
 

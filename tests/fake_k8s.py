@@ -34,8 +34,11 @@ class FakeCluster:
         self.deployment_status: dict[str, dict] = {}
         self.deploy_scripts: dict[str, list] = {}
         self.services: dict[str, dict] = {}
-        self.jobsets: dict[str, dict] = {}
+        # custom resources per plural; 'jobsets' kept as a named alias
+        # for the existing jobset tests
+        self.customs: dict[str, dict[str, dict]] = {"jobsets": {}}
         self.jobset_conditions: dict[str, list] = {}
+        self.custom_status: dict[tuple, dict] = {}  # (plural,name)->status
         self.secrets: dict[str, dict] = {}
         self.events: list[tuple[str, str, str]] = []  # (verb, kind, name)
 
@@ -60,6 +63,13 @@ class FakeCluster:
 
     def set_jobset_conditions(self, name: str, conditions: list[dict]):
         self.jobset_conditions[name] = conditions
+
+    def set_custom_status(self, plural: str, name: str, status: dict):
+        self.custom_status[(plural, name)] = status
+
+    @property
+    def jobsets(self) -> dict:
+        return self.customs["jobsets"]
 
     def _pod_phase(self, name: str) -> str:
         script = self.pod_scripts.get(name)
@@ -194,35 +204,46 @@ def make_fake_kubernetes(cluster: FakeCluster):
             cluster.events.append(("delete", "deployment", name))
 
     class CustomObjectsApi:
+        @staticmethod
+        def _bucket(plural):
+            return cluster.customs.setdefault(plural, {})
+
         def create_namespaced_custom_object(self, group, version, ns,
                                             plural, manifest):
+            bucket = self._bucket(plural)
             name = manifest["metadata"]["name"]
-            if name in cluster.jobsets:
-                raise ApiException(409, f"jobset {name} exists")
-            cluster.jobsets[name] = manifest
-            cluster.events.append(("create", "jobset", name))
+            if name in bucket:
+                raise ApiException(409, f"{plural}/{name} exists")
+            bucket[name] = manifest
+            cluster.events.append(("create", plural[:-1], name))
 
         def get_namespaced_custom_object(self, group, version, ns, plural,
                                          name):
-            if name not in cluster.jobsets:
-                raise ApiException(404, f"jobset {name}")
-            obj = dict(cluster.jobsets[name])
-            obj["status"] = {
-                "conditions": cluster.jobset_conditions.get(name, [])}
+            bucket = self._bucket(plural)
+            if name not in bucket:
+                raise ApiException(404, f"{plural}/{name}")
+            obj = dict(bucket[name])
+            if plural == "jobsets":
+                obj["status"] = {
+                    "conditions": cluster.jobset_conditions.get(name, [])}
+            else:
+                obj["status"] = cluster.custom_status.get(
+                    (plural, name), {})
             return obj
 
         def delete_namespaced_custom_object(self, group, version, ns,
                                             plural, name):
-            if name not in cluster.jobsets:
-                raise ApiException(404, f"jobset {name}")
-            del cluster.jobsets[name]
-            cluster.events.append(("delete", "jobset", name))
+            bucket = self._bucket(plural)
+            if name not in bucket:
+                raise ApiException(404, f"{plural}/{name}")
+            del bucket[name]
+            cluster.events.append(("delete", plural[:-1], name))
 
         def list_namespaced_custom_object(self, group, version, ns, plural,
                                           label_selector="", limit=0,
                                           **kwargs):
             key, _, value = label_selector.partition("=")
-            items = [m for m in cluster.jobsets.values()
+            items = [m for m in self._bucket(plural).values()
                      if m.get("metadata", {}).get("labels", {}).get(
                          key) == value]
             return {"items": items, "metadata": {}}
