@@ -1,0 +1,90 @@
+"""A databricks-sdk-shaped fake (WorkspaceClient/jobs.submit/result),
+so the databricks runtime's submit flow executes for real — payload
+construction, SDK object mapping, waiter result, success/failure state
+handling — without a workspace. Same tier as fake_k8s/fake_pg/
+fake_redis."""
+
+from __future__ import annotations
+
+import sys
+import types
+
+
+class _Waiter:
+    def __init__(self, run):
+        self._run = run
+
+    def result(self):
+        return self._run
+
+
+class FakeJobsAPI:
+    def __init__(self, workspace):
+        self._workspace = workspace
+
+    def submit(self, run_name="", tasks=None):
+        self._workspace.submissions.append(
+            {"run_name": run_name, "tasks": list(tasks or [])})
+        run = types.SimpleNamespace(
+            run_id=7700 + len(self._workspace.submissions),
+            run_page_url=f"https://dbx.example/#job/{run_name}",
+            state=types.SimpleNamespace(
+                result_state=self._workspace.next_result_state,
+                state_message=self._workspace.next_state_message))
+        return _Waiter(run)
+
+
+class FakeWorkspace:
+    def __init__(self):
+        self.submissions: list[dict] = []
+        self.next_result_state = "SUCCESS"
+        self.next_state_message = ""
+
+
+def install(monkeypatch):
+    workspace = FakeWorkspace()
+
+    class WorkspaceClient:
+        def __init__(self, *args, **kwargs):
+            self.jobs = FakeJobsAPI(workspace)
+
+    class SparkPythonTask:
+        def __init__(self, python_file="", parameters=None):
+            self.python_file = python_file
+            self.parameters = parameters or []
+
+    class ClusterSpec:
+        def __init__(self, **kwargs):
+            self.spec = kwargs
+
+        @classmethod
+        def from_dict(cls, struct):
+            return cls(**struct)
+
+    class SubmitTask:
+        def __init__(self, task_key="", spark_python_task=None,
+                     existing_cluster_id=None, new_cluster=None,
+                     timeout_seconds=None):
+            self.task_key = task_key
+            self.spark_python_task = spark_python_task
+            self.existing_cluster_id = existing_cluster_id
+            self.new_cluster = new_cluster
+            self.timeout_seconds = timeout_seconds
+
+    sdk = types.ModuleType("databricks.sdk")
+    sdk.WorkspaceClient = WorkspaceClient
+    service = types.ModuleType("databricks.sdk.service")
+    jobs = types.ModuleType("databricks.sdk.service.jobs")
+    jobs.SparkPythonTask = SparkPythonTask
+    jobs.ClusterSpec = ClusterSpec
+    jobs.SubmitTask = SubmitTask
+    service.jobs = jobs
+    sdk.service = service
+    databricks = types.ModuleType("databricks")
+    databricks.sdk = sdk
+    for name, module in (("databricks", databricks),
+                         ("databricks.sdk", sdk),
+                         ("databricks.sdk.service", service),
+                         ("databricks.sdk.service.jobs", jobs)):
+        monkeypatch.setitem(sys.modules, name, module)
+    return workspace
