@@ -53,17 +53,37 @@ class TorchModelHandler:
             tag=self.tag)
 
 
+def _metric_names(metrics: list) -> list[str]:
+    """Unique reporting keys for metric callables: collisions (two
+    lambdas, partials, or a metric shadowing 'loss'/'lr') get numeric
+    suffixes instead of silently summing into one bucket."""
+    names: list[str] = []
+    taken = {"loss", "lr"}
+    for metric in metrics:
+        base = getattr(metric, "__name__", None) or type(metric).__name__
+        name, n = base, 1
+        while name in taken:
+            n += 1
+            name = f"{base}_{n}"
+        taken.add(name)
+        names.append(name)
+    return names
+
+
 def train(model, loss_fn, optimizer, train_loader,
           context: MLClientCtx | None = None, epochs: int = 1,
           validation_loader=None, model_name: str = "model",
           log_model: bool = True, callbacks: list | None = None,
-          scheduler=None) -> dict:
+          scheduler=None, metrics: list | None = None) -> dict:
     """Torch training loop driven by the shared callback architecture
     (reference pytorch/__init__.py:46 train +
     mlrun_interface.py:106 _epoch loop, minus Horovod): per-epoch metric
-    logging, and any ``frameworks._common.Callback`` —
-    EarlyStopping/Checkpoint/TensorBoard/EvalPlan — plugs into the same
-    hooks the JAX trainer drives."""
+    logging, user ``metrics`` callables ``m(y_pred, y_true) -> float``
+    averaged over train and validation epochs (reference
+    logging_callback metric functions), and any
+    ``frameworks._common.Callback`` — EarlyStopping/Checkpoint/
+    TensorBoard/EvalPlan — plugs into the same hooks the JAX trainer
+    drives."""
     import torch
 
     from .._common.callbacks import CallbackList
@@ -72,20 +92,27 @@ def train(model, loss_fn, optimizer, train_loader,
     context = handler.context
     hooks = CallbackList(callbacks, context=context, model=model)
     hooks.on_train_begin()
+    metrics = metrics or []
+    metric_names = _metric_names(metrics)
     final: dict = {}
     step = 0
     for epoch in range(epochs):
         hooks.on_epoch_begin(epoch)
         model.train()
-        total, count = 0.0, 0
+        sums = {"loss": 0.0, **{name: 0.0 for name in metric_names}}
+        count = 0
         stop = False
         for inputs, targets in train_loader:
             optimizer.zero_grad()
-            loss = loss_fn(model(inputs), targets)
+            outputs = model(inputs)
+            loss = loss_fn(outputs, targets)
             loss.backward()
             optimizer.step()
             loss_value = float(loss.detach())
-            total += loss_value
+            sums["loss"] += loss_value
+            with torch.no_grad():
+                for name, metric in zip(metric_names, metrics):
+                    sums[name] += float(metric(outputs, targets))
             count += 1
             if not hooks.on_step_end(step, {"loss": loss_value}):
                 stop = True
@@ -94,18 +121,17 @@ def train(model, loss_fn, optimizer, train_loader,
                 break
         if scheduler is not None:
             scheduler.step()
-        metrics = {"loss": total / max(count, 1)}
+        epoch_metrics = {k: v / max(count, 1) for k, v in sums.items()}
+        if optimizer.param_groups:
+            epoch_metrics["lr"] = float(
+                optimizer.param_groups[0].get("lr", 0.0))
         if validation_loader is not None:
-            model.eval()
-            vtotal, vcount = 0.0, 0
-            with torch.no_grad():
-                for inputs, targets in validation_loader:
-                    vtotal += float(loss_fn(model(inputs), targets))
-                    vcount += 1
-            metrics["validation_loss"] = vtotal / max(vcount, 1)
-        handler.log_epoch(epoch, metrics)
-        final = metrics
-        if not hooks.on_epoch_end(epoch, metrics) or stop:
+            epoch_metrics.update(evaluate(
+                model, loss_fn, validation_loader, metrics=metrics,
+                prefix="validation_"))
+        handler.log_epoch(epoch, epoch_metrics)
+        final = epoch_metrics
+        if not hooks.on_epoch_end(epoch, epoch_metrics) or stop:
             final = dict(final)
             final["stopped_early"] = True
             break
@@ -119,18 +145,25 @@ def train(model, loss_fn, optimizer, train_loader,
     return final
 
 
-def evaluate(model, loss_fn, loader, context: MLClientCtx | None = None
-             ) -> dict:
-    """Evaluation loop (reference pytorch/__init__.py:212 analog)."""
+def evaluate(model, loss_fn, loader, context: MLClientCtx | None = None,
+             metrics: list | None = None, prefix: str = "eval_") -> dict:
+    """Evaluation loop with the same metric callables as train()
+    (reference pytorch/__init__.py:212 analog)."""
     import torch
 
     model.eval()
-    total, count = 0.0, 0
+    metrics = metrics or []
+    metric_names = _metric_names(metrics)
+    sums = {"loss": 0.0, **{name: 0.0 for name in metric_names}}
+    count = 0
     with torch.no_grad():
         for inputs, targets in loader:
-            total += float(loss_fn(model(inputs), targets))
+            outputs = model(inputs)
+            sums["loss"] += float(loss_fn(outputs, targets))
+            for name, metric in zip(metric_names, metrics):
+                sums[name] += float(metric(outputs, targets))
             count += 1
-    results = {"eval_loss": total / max(count, 1)}
+    results = {f"{prefix}{k}": v / max(count, 1) for k, v in sums.items()}
     if context is not None:
         context.log_results(results)
     return results

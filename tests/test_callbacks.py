@@ -283,3 +283,42 @@ def test_preempted_run_still_finalizes_callbacks(tmp_path):
                       callbacks=[rec], preemption_guard=guard)
     assert out["preempted"] is True
     assert rec.events[-1] == "train_end"
+
+
+def test_torch_train_metric_functions(tmp_path):
+    """User metric callables m(y_pred, y_true) averaged over train and
+    validation epochs (reference logging_callback metric functions)."""
+    import mlrun_tpu
+    from mlrun_tpu.frameworks.torch import evaluate, train
+
+    torch, model, loader = _torch_bits()
+
+    def mae(y_pred, y_true):
+        return (y_pred - y_true).abs().mean()
+
+    context = mlrun_tpu.get_or_create_ctx(
+        "torchmet", spec={"metadata": {"project": "cbp"},
+                          "spec": {"output_path": str(tmp_path / "a")}})
+    out = train(model, torch.nn.functional.mse_loss,
+                torch.optim.SGD(model.parameters(), lr=0.05), loader,
+                context=context, epochs=3, validation_loader=loader,
+                metrics=[mae], log_model=False)
+    assert "mae" in out and out["mae"] >= 0
+    assert "validation_mae" in out and "validation_loss" in out
+    assert "lr" in out and out["lr"] == 0.05
+
+    ev = evaluate(model, torch.nn.functional.mse_loss, loader,
+                  metrics=[mae])
+    assert "eval_loss" in ev and "eval_mae" in ev
+
+
+def test_torch_metric_name_collisions_get_suffixes():
+    from mlrun_tpu.frameworks.torch import _metric_names
+
+    names = _metric_names([lambda p, t: 0, lambda p, t: 1])
+    assert names == ["<lambda>", "<lambda>_2"]
+
+    def loss(p, t):
+        return 0
+
+    assert _metric_names([loss]) == ["loss_2"]  # never shadows the loss
