@@ -12,10 +12,24 @@ Reference analog for this module: /root/reference/mlrun/__init__.py
 
 __version__ = "0.1.0"
 
+import os
+
+environ = os.environ  # reference re-exports os.environ at top level
+
 from .config import mlconf  # noqa: F401
 from .datastore import DataItem, store_manager  # noqa: F401
 from .db import get_run_db  # noqa: F401
+from .errors import (  # noqa: F401
+    MLRunBaseError,
+    MLRunConflictError,
+    MLRunInvalidArgumentError,
+    MLRunNotFoundError,
+    MLRunRuntimeError,
+    MLRunTimeoutError,
+)
 from .execution import MLClientCtx  # noqa: F401
+from .platforms import auto_mount, mount_pvc  # noqa: F401
+from .secrets import get_secret_or_env  # noqa: F401
 from .model import (  # noqa: F401
     HyperParamOptions,
     Notification,
@@ -33,7 +47,7 @@ from .run import (  # noqa: F401
     wait_for_pipeline_completion,
 )
 
-import os as _os
+_os = os  # single os import; legacy alias kept for the helpers below
 
 
 def set_environment(api_path: str | None = None, artifact_path: str = "",
@@ -99,6 +113,118 @@ def get_current_project(silent: bool = False):
     from .projects import get_current_project as _get_current_project
 
     return _get_current_project(silent)
+
+
+def get_dataitem(url: str, secrets: dict | None = None) -> "DataItem":
+    """Resolve any url (file/gs/s3/redis/store://...) into a DataItem
+    (reference mlrun/run.py get_dataitem)."""
+    return store_manager.object(url=url, secrets=secrets)
+
+
+def get_object(url: str, secrets: dict | None = None,
+               size: int | None = None, offset: int = 0) -> bytes:
+    """Read an object's bytes from any datastore url (reference
+    get_object)."""
+    return get_dataitem(url, secrets=secrets).get(size=size, offset=offset)
+
+
+def get_pipeline(run_id: str, project: str = ""):
+    """Fetch a workflow/pipeline run record from the service (reference
+    get_pipeline — a KFP proxy there, the native workflow backend
+    here)."""
+    db = get_run_db()
+    getter = getattr(db, "get_pipeline", None)
+    if getter:
+        return getter(run_id, project=project)
+    raise MLRunInvalidArgumentError(
+        "the configured run DB does not expose pipeline runs "
+        "(connect to the service with MLT_DBPATH)")
+
+
+class _PipelineContextProxy:
+    """Attribute-access proxy over the ACTIVE workflow context (the
+    reference's top-level ``pipeline_context`` is an object —
+    ``pipeline_context.project`` — not a callable). Attributes resolve
+    against the current context; None-safe outside a workflow."""
+
+    def _current(self):
+        from .projects.pipelines import pipeline_context as _context
+
+        return _context()
+
+    def __getattr__(self, name):
+        current = self._current()
+        if current is None:
+            if name in ("project", "workflow", "workflow_id"):
+                return None
+            raise AttributeError(
+                f"no active pipeline context (attribute {name!r})")
+        return getattr(current, name)
+
+    def __bool__(self):
+        return self._current() is not None
+
+
+pipeline_context = _PipelineContextProxy()
+
+
+def run_function(function, *args, **kwargs):
+    """Run a function through the CURRENT project (reference top-level
+    run_function — project-scope sugar)."""
+    return get_current_project(silent=False).run_function(
+        function, *args, **kwargs)
+
+
+def build_function(function, *args, **kwargs):
+    """Build a function's image through the current project (reference
+    build_function)."""
+    return get_current_project(silent=False).build_function(
+        function, *args, **kwargs)
+
+
+def deploy_function(function, *args, **kwargs):
+    """Deploy a serving function through the current project (reference
+    deploy_function)."""
+    return get_current_project(silent=False).deploy_function(
+        function, *args, **kwargs)
+
+
+class Version:
+    """Version info provider (reference mlrun/utils/version)."""
+
+    @staticmethod
+    def get() -> dict:
+        return {"version": __version__}
+
+
+class ArtifactType:
+    """Log-hint artifact types (reference mlrun/package ArtifactType)."""
+
+    result = "result"
+    artifact = "artifact"
+    dataset = "dataset"
+    model = "model"
+    file = "file"
+    plot = "plot"
+
+
+# heavier symbols resolve lazily so `import mlrun_tpu` stays light
+_LAZY_EXPORTS = {
+    "ProjectMetadata": ("mlrun_tpu.projects.project", "ProjectMetadata"),
+    "MlrunProject": ("mlrun_tpu.projects.project", "MlrunProject"),
+    "DefaultPackager": ("mlrun_tpu.package.packagers.default",
+                        "DefaultPackager"),
+    "Packager": ("mlrun_tpu.package.packagers_manager", "Packager"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY_EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'mlrun_tpu' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])
 
 
 def handler(labels: dict | None = None, outputs: list | None = None,

@@ -1,0 +1,29 @@
+"""Error hierarchy (reference analog: mlrun/errors.py — the subset the
+SDK surface raises/catches; HTTP mapping mirrors the reference's
+err_to_status_code convention)."""
+
+from __future__ import annotations
+
+
+class MLRunBaseError(Exception):
+    """Root of the framework's error hierarchy."""
+
+
+class MLRunInvalidArgumentError(MLRunBaseError, ValueError):
+    """Bad user input (maps to HTTP 400)."""
+
+
+class MLRunNotFoundError(MLRunBaseError, KeyError):
+    """Requested object does not exist (maps to HTTP 404)."""
+
+
+class MLRunConflictError(MLRunBaseError):
+    """State conflict, e.g. resource already exists (HTTP 409)."""
+
+
+class MLRunTimeoutError(MLRunBaseError, TimeoutError):
+    """Deadline exceeded waiting on a run/deploy/build."""
+
+
+class MLRunRuntimeError(MLRunBaseError, RuntimeError):
+    """Execution-side failure."""

@@ -69,3 +69,22 @@ class SecretsStore:
         # inline secrets are redacted when serialized back (like the reference's
         # masking in server/api/api/utils.py:221-300)
         return list(self._hidden_sources)
+
+
+def get_secret_or_env(key: str, secret_provider=None, default: str = "",
+                      prefix: str = "") -> str:
+    """Resolve a secret by key (reference mlrun/secrets.py:188
+    get_secret_or_env — same module path, precedence, and prefix
+    separator): explicit provider first, then the PLAIN env var, then
+    the injected project-secret env (MLT_SECRET_<key>, key verbatim —
+    the exact name service runtime_handlers._secret_env injects)."""
+    if prefix:
+        key = f"{prefix}_{key}"
+    if secret_provider is not None:
+        value = secret_provider(key) if callable(secret_provider) \
+            else secret_provider.get(key)
+        if value:
+            return value
+    return (os.environ.get(key)
+            or os.environ.get("MLT_SECRET_" + key)
+            or default)

@@ -172,3 +172,58 @@ def test_project_git_remotes(tmp_path):
     out = subprocess.run(["git", "-C", str(ctx), "remote"],
                          capture_output=True, text=True)
     assert out.stdout.strip() == ""
+
+
+def test_top_level_exports_parity(tmp_path):
+    """Top-level names ported user code imports (reference
+    mlrun/__init__.py): dataitem/object helpers, project-scope sugar,
+    errors, packagers, mounts, version."""
+    import mlrun_tpu
+
+    for name in ("get_dataitem", "get_object", "get_pipeline",
+                 "pipeline_context", "run_function", "build_function",
+                 "deploy_function", "auto_mount", "mount_pvc",
+                 "get_secret_or_env", "environ", "Version",
+                 "ArtifactType", "MLRunInvalidArgumentError",
+                 "MLRunNotFoundError", "ProjectMetadata",
+                 "DefaultPackager", "Packager", "handler"):
+        assert hasattr(mlrun_tpu, name), name
+
+    blob = tmp_path / "b.txt"
+    blob.write_text("payload")
+    item = mlrun_tpu.get_dataitem(str(blob))
+    assert item.get(encoding="utf-8") == "payload"
+    assert mlrun_tpu.get_object(str(blob)) == b"payload"
+    assert mlrun_tpu.Version.get()["version"]
+    assert issubclass(mlrun_tpu.MLRunNotFoundError, KeyError)
+    # pipeline_context is an OBJECT (reference: pipeline_context.project)
+    assert mlrun_tpu.pipeline_context.project is None  # outside a workflow
+    assert not mlrun_tpu.pipeline_context
+
+    # project-scope sugar rides the current project
+    project = mlrun_tpu.new_project("toplevel", context=str(tmp_path),
+                                    save=False)
+    def h(context):
+        context.log_result("ok", 11)
+    project.set_function(name="hfn", handler=h, kind="local")
+    run = mlrun_tpu.run_function("hfn", local=True)
+    assert run.status.results["ok"] == 11
+
+
+def test_get_secret_or_env(monkeypatch):
+    # reference module path: mlrun.secrets.get_secret_or_env
+    from mlrun_tpu.secrets import get_secret_or_env
+
+    monkeypatch.setenv("MLT_SECRET_myTok", "from-secret")
+    monkeypatch.setenv("PLAIN", "from-env")
+    assert get_secret_or_env("myTok") == "from-secret"  # verbatim case
+    assert get_secret_or_env("PLAIN") == "from-env"
+    # plain env WINS over the injected secret (reference precedence)
+    monkeypatch.setenv("myTok", "plain-wins")
+    assert get_secret_or_env("myTok") == "plain-wins"
+    assert get_secret_or_env("NOPE", default="d") == "d"
+    assert get_secret_or_env("K", secret_provider={"K": "v"}) == "v"
+    assert get_secret_or_env("K", secret_provider=lambda k: k * 2) == "KK"
+    # prefix joins with an underscore (reference secrets.py:188)
+    monkeypatch.setenv("AWS_KEY", "ak")
+    assert get_secret_or_env("KEY", prefix="AWS") == "ak"
