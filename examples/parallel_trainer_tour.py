@@ -1,0 +1,62 @@
+"""Tour of the parallelism + callback surface of the trainer.
+
+Runs anywhere (virtual CPU mesh): the same TrainConfig knobs scale to
+real pods — pipeline stages over a `pipe` axis, mixture-of-experts over
+an `expert` axis, early stopping and checkpoint-every-N through the
+structured callback architecture.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/parallel_trainer_tour.py
+"""
+
+import os
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mlrun_tpu
+
+
+def handler(context):
+    from mlrun_tpu.frameworks.jax import auto_trainer
+
+    overrides = {"attention_impl": "reference", "remat": False}
+
+    # 1) pipeline parallelism: 2 GPipe stages x data parallelism
+    pp = auto_trainer.train(
+        context=context, model="tiny", model_overrides=overrides,
+        batch_size=8, seq_len=64, steps=6, log_every=3,
+        pipeline_stages=2, pipeline_microbatches=2, model_name="pp-demo")
+    context.log_result("pp_loss", float(pp["loss"]))
+
+    # 2) expert parallelism: the dense MLP becomes 4 routed experts
+    ep = auto_trainer.train(
+        context=context, model="tiny", model_overrides=overrides,
+        batch_size=4, seq_len=64, steps=6, log_every=3,
+        moe_experts=4, moe_top_k=2, model_name="moe-demo")
+    context.log_result("moe_aux_loss", float(ep["aux_loss"]))
+
+    # 3) callbacks: early stopping + checkpoint every 2 steps
+    ckpt_dir = os.path.join(tempfile.mkdtemp(), "ckpts")
+    es = auto_trainer.train(
+        context=context, model="tiny", model_overrides=overrides,
+        batch_size=8, seq_len=64, steps=50, log_every=1, epoch_steps=4,
+        early_stop={"monitor": "loss", "patience": 1, "min_delta": 100.0},
+        checkpoint_dir=ckpt_dir, checkpoint_every=2,
+        model_name="es-demo")
+    context.log_result("stopped_early", bool(es.get("stopped_early")))
+
+
+if __name__ == "__main__":
+    run = mlrun_tpu.new_function(
+        "parallel-tour", kind="local", handler=handler).run(local=True)
+    assert run.state() == "completed", run.status.error
+    print("results:", {k: v for k, v in run.status.results.items()
+                       if k in ("pp_loss", "moe_aux_loss",
+                                "stopped_early")})
