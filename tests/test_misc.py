@@ -238,3 +238,30 @@ def test_hub_describe_end_to_end(tmp_path):
     body = store_manager.object(url=art["spec"]["target_path"]).get()
     hist = json.loads(body)
     assert sum(hist["x"]["counts"]) == 50
+
+
+def test_hub_drift_analysis(tmp_path):
+    """hub://drift_analysis: per-feature drift table + overall status."""
+    import numpy as np
+    import pandas as pd
+
+    import mlrun_tpu
+
+    rng = np.random.default_rng(0)
+    ref = tmp_path / "ref.csv"
+    cur = tmp_path / "cur.csv"
+    pd.DataFrame({"a": rng.normal(0, 1, 600),
+                  "b": rng.normal(0, 1, 600)}).to_csv(ref, index=False)
+    pd.DataFrame({"a": rng.normal(0, 1, 600),       # unchanged
+                  "b": rng.normal(4, 1, 600)}).to_csv(cur, index=False)
+
+    fn = mlrun_tpu.import_function("hub://drift_analysis")
+    run = fn.run(inputs={"sample_set": str(cur),
+                         "reference_set": str(ref)}, local=True)
+    assert run.state() == "completed", run.status.error
+    assert run.status.results["drift_status"] == "DRIFT_DETECTED"
+    assert run.status.results["drifted_features"] >= 1
+    table = run.artifact("drift_table").as_df()
+    verdicts = dict(zip(table["feature"], table["verdict"]))
+    assert verdicts["b"] == "DRIFT_DETECTED"
+    assert verdicts["a"] == "NO_DRIFT"
