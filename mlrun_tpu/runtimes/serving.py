@@ -103,14 +103,32 @@ class ServingRuntime(RemoteRuntime):
     def graph(self):
         return self.spec.graph
 
-    def _router(self) -> RouterStep:
+    def _router(self, router_step: str | None = None) -> RouterStep:
         graph = self.spec.graph
         if graph is None:
             return self.set_topology("router")
+        if router_step:
+            # an explicitly named router wins — required when a flow
+            # carries several routers, validated always (a bad name must
+            # error, not silently attach to whichever router exists)
+            step = (getattr(graph, "steps", None) or {}).get(router_step)
+            if not isinstance(step, RouterStep):
+                raise ValueError(
+                    f"step {router_step!r} is not a router in the graph")
+            return step
         if hasattr(graph, "_router"):
             return graph._router
         if isinstance(graph, RouterStep):
             return graph
+        # deserialized graphs (hub:// yaml, db round-trips) lose the
+        # transient _router handle set_topology stashed — recover it from
+        # a lone router step so add_model works on re-loaded functions
+        steps = getattr(graph, "steps", None) or {}
+        routers = [step for step in steps.values()
+                   if isinstance(step, RouterStep)]
+        if len(routers) == 1:
+            graph._router = routers[0]
+            return routers[0]
         raise ValueError("graph topology is not a router")
 
     def add_model(self, key: str, model_path: str | None = None,
@@ -118,7 +136,7 @@ class ServingRuntime(RemoteRuntime):
                   handler: str | None = None, router_step: str | None = None,
                   **class_args) -> TaskStep:
         """Register a model on the router (serving.py:356)."""
-        router = self._router()
+        router = self._router(router_step)
         if model_path:
             class_args = dict(class_args)
             class_args["model_path"] = model_path

@@ -265,3 +265,30 @@ def test_hub_drift_analysis(tmp_path):
     verdicts = dict(zip(table["feature"], table["verdict"]))
     assert verdicts["b"] == "DRIFT_DETECTED"
     assert verdicts["a"] == "NO_DRIFT"
+
+
+def test_hub_model_server(tmp_path):
+    """hub://model_server: generic serving router import + mock serve."""
+    import pickle
+
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+
+    import mlrun_tpu
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 3))
+    y = (x.sum(axis=1) > 0).astype(int)
+    model_file = tmp_path / "clf.pkl"
+    model_file.write_bytes(pickle.dumps(LogisticRegression().fit(x, y)))
+
+    fn = mlrun_tpu.import_function("hub://model_server")
+    assert fn.kind == "serving"
+    fn.add_model(
+        "clf",
+        class_name="mlrun_tpu.frameworks.sklearn.SKLearnModelServer",
+        model_path=str(model_file))
+    server = fn.to_mock_server()
+    out = server.test("/v2/models/clf/infer",
+                      body={"inputs": x[:4].tolist()})
+    assert len(out["outputs"]) == 4

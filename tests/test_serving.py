@@ -185,3 +185,26 @@ def test_join_step_merges_branches():
     # second event: buffer must not leak state between events
     out2 = server.test(body=2)
     assert out2 == {"plus": 3, "times": 4}
+
+
+def test_add_model_named_router_step():
+    """router_step= selects a named router (and a bad name errors) —
+    review r5: the parameter was accepted but silently ignored."""
+    import pytest
+
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function("multi", kind="serving")
+    graph = fn.set_topology("flow")
+    router_a = graph.add_step("$router", name="router_a")
+    router_a.responder = True
+    graph.add_step("$router", name="router_b")
+    fn.add_model("m1", class_name="V2ModelServer", router_step="router_a")
+    fn.add_model("m2", class_name="V2ModelServer", router_step="router_b")
+    assert "m1" in fn.spec.graph.steps["router_a"].routes
+    assert "m2" in fn.spec.graph.steps["router_b"].routes
+    with pytest.raises(ValueError, match="not a router"):
+        fn.add_model("m3", router_step="nope")
+    # unnamed add on a multi-router flow is ambiguous -> loud error
+    with pytest.raises(ValueError, match="router"):
+        fn.add_model("m4")
