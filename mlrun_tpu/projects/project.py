@@ -607,6 +607,30 @@ class MlrunProject(ModelObj):
     def delete_alert_config(self, name: str):
         self._get_db().delete_alert_config(name, project=self.name)
 
+    def get_alert_template(self, name: str) -> dict:
+        """A builtin alert template (reference get_alert_template)."""
+        from ..service.alerts import get_alert_template
+
+        return get_alert_template(name)
+
+    def list_alert_templates(self) -> list:
+        from ..service.alerts import list_alert_templates
+
+        return list_alert_templates()
+
+    def create_alert_from_template(self, name: str, template: str,
+                                   entity_id: str = "*",
+                                   notifications: list | None = None):
+        """Instantiate a builtin template as this project's alert config
+        (the reference's template->config flow)."""
+        config = self.get_alert_template(template)
+        config["name"] = name
+        config["entity_id"] = entity_id
+        if notifications:
+            config["notifications"] = notifications
+        self.store_alert_config(name, config)
+        return config
+
     def reset_alert_config(self, name: str):
         """Clear an alert's silencing window + fired state (reference
         reset_alert_config)."""

@@ -127,7 +127,9 @@ class ServingRuntime(RemoteRuntime):
         routers = [step for step in steps.values()
                    if isinstance(step, RouterStep)]
         if len(routers) == 1:
-            graph._router = routers[0]
+            # NOT cached: a later add_step could introduce a second
+            # router, and a stale cached handle would make the ambiguity
+            # check order-dependent (or outlive a removed step)
             return routers[0]
         raise ValueError("graph topology is not a router")
 

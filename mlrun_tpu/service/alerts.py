@@ -73,3 +73,59 @@ def _notify(config: dict, event: dict):
                 severity=config.get("severity", "medium"))
         except Exception as exc:  # noqa: BLE001
             logger.warning("alert notification failed", error=str(exc))
+
+
+# -- builtin alert templates (reference alert_templates: JobFailed /
+# DataDriftDetected / SystemPerformance pre-baked configs a project
+# instantiates with its own entity + notifications) -----------------------
+ALERT_TEMPLATES: dict[str, dict] = {
+    "JobFailed": {
+        "description": "a run failed",
+        "trigger_events": ["run_failed", "run_aborted"],
+        "severity": "high",
+        "criteria": {"count": 1, "period_seconds": 600},
+        "reset_policy": "auto",
+    },
+    "DataDriftDetected": {
+        "description": "model monitoring detected data drift",
+        "trigger_events": ["data_drift_detected"],
+        "severity": "high",
+        "criteria": {"count": 1, "period_seconds": 3600},
+        "reset_policy": "manual",
+    },
+    "DataDriftSuspected": {
+        "description": "model monitoring suspects data drift",
+        "trigger_events": ["data_drift_suspected"],
+        "severity": "medium",
+        "criteria": {"count": 3, "period_seconds": 3600},
+        "reset_policy": "auto",
+    },
+    "SystemPerformance": {
+        "description": "serving latency over threshold",
+        "trigger_events": ["latency_high"],
+        "severity": "medium",
+        "criteria": {"count": 5, "period_seconds": 600},
+        "reset_policy": "auto",
+    },
+}
+
+
+def get_alert_template(name: str) -> dict:
+    import copy
+
+    template = ALERT_TEMPLATES.get(name)
+    if template is None:
+        raise KeyError(
+            f"unknown alert template {name!r} "
+            f"(available: {sorted(ALERT_TEMPLATES)})")
+    # deep copy: nested criteria/trigger_events must not alias the
+    # module-global registry (a caller mutation would corrupt every
+    # later instantiation process-wide)
+    return copy.deepcopy(template)
+
+
+def list_alert_templates() -> list[dict]:
+    import copy
+
+    return [{"name": name, **copy.deepcopy(template)}
+            for name, template in ALERT_TEMPLATES.items()]

@@ -227,3 +227,29 @@ def test_get_secret_or_env(monkeypatch):
     # prefix joins with an underscore (reference secrets.py:188)
     monkeypatch.setenv("AWS_KEY", "ak")
     assert get_secret_or_env("KEY", prefix="AWS") == "ak"
+
+
+def test_alert_templates(tmp_path):
+    project = mlrun_tpu.new_project("alerts-tpl", context=str(tmp_path),
+                                    save=False)
+    names = {t["name"] for t in project.list_alert_templates()}
+    assert {"JobFailed", "DataDriftDetected"} <= names
+    config = project.create_alert_from_template(
+        "train-fail", "JobFailed", entity_id="trainer",
+        notifications=[{"kind": "console"}])
+    assert config["trigger_events"] == ["run_failed", "run_aborted"]
+    stored = project.get_alert_config("train-fail")
+    assert stored["entity_id"] == "trainer"
+    with pytest.raises(KeyError, match="unknown alert template"):
+        project.get_alert_template("nope")
+
+
+def test_alert_templates_are_isolated_copies(tmp_path):
+    from mlrun_tpu.service.alerts import ALERT_TEMPLATES, get_alert_template
+
+    template = get_alert_template("JobFailed")
+    template["trigger_events"].append("CORRUPTED")
+    template["criteria"]["count"] = 99
+    clean = ALERT_TEMPLATES["JobFailed"]
+    assert "CORRUPTED" not in clean["trigger_events"]
+    assert clean["criteria"]["count"] == 1

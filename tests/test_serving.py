@@ -208,3 +208,19 @@ def test_add_model_named_router_step():
     # unnamed add on a multi-router flow is ambiguous -> loud error
     with pytest.raises(ValueError, match="router"):
         fn.add_model("m4")
+
+
+def test_add_model_ambiguity_is_order_independent():
+    """Recovery must not cache: adding a second router AFTER an unnamed
+    add_model still makes later unnamed adds ambiguous (review r5)."""
+    import pytest
+
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function("multi2", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.add_step("$router", name="router_a")
+    fn.add_model("m1", class_name="V2ModelServer")  # lone router: fine
+    graph.add_step("$router", name="router_b")
+    with pytest.raises(ValueError, match="router"):
+        fn.add_model("m2")  # now ambiguous — must not ride a stale cache
