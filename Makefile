@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast native bench bench-serving dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -15,6 +15,9 @@ test-fast:       ## skip the slow jax-compile-heavy suites
 	  --ignore=tests/test_bert.py --ignore=tests/test_moe.py \
 	  --ignore=tests/test_checkpoint.py --ignore=tests/test_ops.py \
 	  --ignore=tests/test_llm_engine.py
+
+chaos:           ## fault-injection subset (docs/fault_tolerance.md)
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
 
 native:          ## build the C++ log collector (mlt-logd)
 	$(MAKE) -C native

@@ -50,7 +50,7 @@ def run(url, name, project, handler, param, str_param, inputs,
         artifact_path, kind, image, from_env, kfp_output, local, watch,
         run_args):
     """Execute a function/task (the in-pod contract: `run --from-env`)."""
-    from .model import RunTemplate
+    from .model import RunObject
     from .run import new_function
 
     struct = {}
@@ -71,7 +71,10 @@ def run(url, name, project, handler, param, str_param, inputs,
             pathlib.Path(url).write_text(
                 base64.b64decode(code).decode())
 
-    template = RunTemplate.from_dict(struct) if struct else RunTemplate()
+    # a RunObject, not a RunTemplate: the exec config of a RESUBMITTED
+    # resource carries status (retry_count, checkpoint) that the in-run
+    # ctx must round-trip instead of erasing on its first store_run
+    template = RunObject.from_dict(struct) if struct else RunObject()
     if name:
         template.metadata.name = name
     if project:

@@ -1,0 +1,28 @@
+"""Chaos-injection layer: break any layer on demand, deterministically.
+
+See registry.py for the design; docs/fault_tolerance.md for usage. Quick
+tour::
+
+    from mlrun_tpu.chaos import chaos, fail_nth, fail_with_prob
+
+    with chaos.inject("datastore.read", fail_nth(2),
+                      error=IOError("injected")):
+        ...  # second datastore read raises
+
+Chaos-marked tests (``pytest -m chaos`` / ``make chaos``) exercise the
+fault points end-to-end against the fake cluster.
+"""
+
+from .registry import (  # noqa: F401
+    ChaosRegistry,
+    FaultPoints,
+    Injection,
+    Schedule,
+    always,
+    chaos,
+    fail_after,
+    fail_first,
+    fail_nth,
+    fail_with_prob,
+    fire,
+)

@@ -1,0 +1,258 @@
+"""Deterministic, seedable fault-injection registry.
+
+The paper's robustness claim (SURVEY §5.3: the reference fails the whole
+run on a single worker failure, while preemptible TPU pod-slices make
+eviction the *common* case) is only provable if every layer can be broken
+on demand. This registry is the one switchboard: production code calls
+``fire(point, **context)`` at named fault points and tests/staging arm
+those points with schedules (``fail_nth``/``fail_with_prob``/...) and
+effects (raise, delay, callback) scoped by context managers.
+
+Design constraints:
+
+- **Zero cost when dark.** ``fire`` is a single attribute check when no
+  injection is armed — the hooks stay in production code permanently.
+- **Deterministic.** ``fail_with_prob`` draws from its own seeded RNG; a
+  chaos test that passed once passes forever. No global ``random`` use.
+- **No mlrun_tpu imports.** The registry sits below every other layer
+  (datastore, db, service all hook it) so it must not import any of them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class FaultPoints:
+    """Named fault points threaded through the codebase. A point name is
+    matched exactly or by ``prefix.*`` wildcard at injection time."""
+
+    # k8s API verbs (tests/fake_k8s.py fires these from the fake cluster;
+    # KubernetesProvider fires the provider.* tier above them)
+    k8s_create = "k8s.create"
+    k8s_read = "k8s.read"
+    k8s_delete = "k8s.delete"
+    # execution-resource providers (service/providers.py)
+    provider_create = "provider.create"
+    provider_state = "provider.state"
+    provider_delete = "provider.delete"
+    # datastore reads/writes (datastore/base.py DataItem/DataStore)
+    datastore_read = "datastore.read"
+    datastore_write = "datastore.write"
+    # HTTP run-DB client calls (db/httpdb.py api_call)
+    httpdb_request = "httpdb.request"
+    # in-run context commits — a delay() here models a stalled step
+    execution_commit = "execution.commit"
+
+    @staticmethod
+    def all() -> list[str]:
+        return [
+            FaultPoints.k8s_create, FaultPoints.k8s_read,
+            FaultPoints.k8s_delete, FaultPoints.provider_create,
+            FaultPoints.provider_state, FaultPoints.provider_delete,
+            FaultPoints.datastore_read, FaultPoints.datastore_write,
+            FaultPoints.httpdb_request, FaultPoints.execution_commit,
+        ]
+
+
+# -- schedules ---------------------------------------------------------------
+class Schedule:
+    """Decides, per matching call, whether the effect fires. ``count`` is
+    the 1-based number of calls that reached this injection."""
+
+    def should_fire(self, count: int) -> bool:
+        raise NotImplementedError
+
+
+class _Always(Schedule):
+    def should_fire(self, count: int) -> bool:
+        return True
+
+
+class _Nth(Schedule):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def should_fire(self, count: int) -> bool:
+        return count == self.n
+
+
+class _First(Schedule):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def should_fire(self, count: int) -> bool:
+        return count <= self.n
+
+
+class _After(Schedule):
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def should_fire(self, count: int) -> bool:
+        return count > self.n
+
+
+class _Prob(Schedule):
+    """Deterministic Bernoulli: the k-th call fires iff the k-th draw of
+    ``Random(seed)`` is below p — independent of wall clock, process, or
+    interleaving with other injections."""
+
+    def __init__(self, p: float, seed: int = 0):
+        import random
+
+        self.p = float(p)
+        self._rng = random.Random(seed)
+        self._draws: list[float] = []
+
+    def should_fire(self, count: int) -> bool:
+        while len(self._draws) < count:
+            self._draws.append(self._rng.random())
+        return self._draws[count - 1] < self.p
+
+
+def always() -> Schedule:
+    return _Always()
+
+
+def fail_nth(n: int) -> Schedule:
+    """Fire only on the n-th call (1-based)."""
+    return _Nth(n)
+
+
+def fail_first(n: int = 1) -> Schedule:
+    """Fire on the first n calls, then go quiet (transient fault)."""
+    return _First(n)
+
+
+def fail_after(n: int) -> Schedule:
+    """Quiet for the first n calls, then fire on every one."""
+    return _After(n)
+
+
+def fail_with_prob(p: float, seed: int = 0) -> Schedule:
+    """Fire with probability p per call, from a seeded deterministic RNG."""
+    return _Prob(p, seed)
+
+
+# -- injections --------------------------------------------------------------
+class Injection:
+    """One armed fault: point (+ optional wildcard), schedule, effect.
+    Usable as a context manager for scoping, or left armed until
+    ``remove()`` / ``ChaosRegistry.clear()``."""
+
+    def __init__(self, registry: "ChaosRegistry", point: str,
+                 schedule: Schedule, *, error=None, delay: float = 0.0,
+                 action=None, match=None):
+        self._registry = registry
+        self.point = point
+        self.schedule = schedule
+        self.error = error
+        self.delay = float(delay or 0.0)
+        self.action = action
+        self.match = match
+        self.calls = 0   # calls that reached this injection
+        self.fired = 0   # calls where the effect actually fired
+
+    def matches(self, point: str, context: dict) -> bool:
+        if self.point.endswith(".*"):
+            if not point.startswith(self.point[:-1]):
+                return False
+        elif point != self.point:
+            return False
+        if self.match is not None and not self.match(context):
+            return False
+        return True
+
+    def apply(self, point: str, context: dict):
+        self.calls += 1
+        if not self.schedule.should_fire(self.calls):
+            return
+        self.fired += 1
+        if self.delay > 0:
+            time.sleep(self.delay)
+        if self.action is not None:
+            self.action(point, context)
+        if self.error is not None:
+            raise self.error() if isinstance(self.error, type) \
+                else self.error
+
+    def remove(self):
+        self._registry._remove(self)
+
+    def __enter__(self) -> "Injection":
+        return self
+
+    def __exit__(self, *exc_info):
+        self.remove()
+        return False
+
+
+class ChaosRegistry:
+    """Process-wide fault switchboard. ``enabled`` is the fast-path gate:
+    the production hooks pay one attribute read when no fault is armed."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._injections: list[Injection] = []
+        self.enabled = False
+
+    def inject(self, point: str, schedule: Schedule | None = None, *,
+               error=None, delay: float = 0.0, action=None,
+               match=None) -> Injection:
+        """Arm a fault at ``point``. Returns the Injection — use it as a
+        context manager to scope the fault to a block:
+
+            with chaos.inject("k8s.delete", fail_nth(1),
+                              error=ApiException(500)):
+                ...
+
+        ``error`` is an exception instance or class raised when the
+        schedule fires; ``delay`` sleeps first (stall simulation);
+        ``action(point, context)`` runs arbitrary test code (e.g. kill a
+        pod out from under the service); ``match(context) -> bool``
+        narrows the fault to specific calls (one pod name, one url).
+        """
+        injection = Injection(self, point, schedule or always(),
+                              error=error, delay=delay, action=action,
+                              match=match)
+        with self._lock:
+            self._injections.append(injection)
+            self.enabled = True
+        return injection
+
+    def _remove(self, injection: Injection):
+        with self._lock:
+            if injection in self._injections:
+                self._injections.remove(injection)
+            self.enabled = bool(self._injections)
+
+    def clear(self):
+        with self._lock:
+            self._injections.clear()
+            self.enabled = False
+
+    def fire(self, point: str, **context):
+        """Hook call from production code. No-op unless a matching armed
+        injection's schedule fires — then its effect applies (raise/
+        delay/action). Injections are applied in arming order."""
+        if not self.enabled:
+            return
+        with self._lock:
+            matching = [i for i in self._injections
+                        if i.matches(point, context)]
+        for injection in matching:
+            injection.apply(point, context)
+
+    def injections(self) -> list[Injection]:
+        with self._lock:
+            return list(self._injections)
+
+
+# the process-wide registry production hooks fire into
+chaos = ChaosRegistry()
+
+
+def fire(point: str, **context):
+    chaos.fire(point, **context)

@@ -6,6 +6,14 @@ The reference's MPIJob CRD constants are replaced by TPU JobSet constants.
 from __future__ import annotations
 
 
+# checkpoint-resume env contract for resubmitted runs: the service monitor
+# writes these into the replacement resource (service/runtime_handlers.py)
+# and training/checkpoint.py resume_directive reads them — one definition
+# so the two sides cannot drift
+RESUME_CHECKPOINT_ENV = "MLT_RESUME_FROM_CHECKPOINT"
+RESUME_STEP_ENV = "MLT_RESUME_STEP"
+
+
 class RunStates:
     created = "created"
     pending = "pending"

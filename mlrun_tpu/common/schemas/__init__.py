@@ -3,7 +3,7 @@ from .background_task import BackgroundTask, BackgroundTaskState  # noqa: F401
 from .client_spec import ClientSpec  # noqa: F401
 from .function import FunctionRecord, FunctionState  # noqa: F401
 from .project import ProjectOut, ProjectRecord, ProjectState  # noqa: F401
-from .run import RunIdentifier, RunRecord  # noqa: F401
+from .run import RetryPolicy, RunIdentifier, RunRecord  # noqa: F401
 from .schedule import ScheduleKinds, ScheduleRecord  # noqa: F401
 from .artifact import ArtifactRecord  # noqa: F401
 from .model_endpoint import ModelEndpoint  # noqa: F401

@@ -72,6 +72,26 @@ default_config: dict[str, Any] = {
     },
     "runs": {
         "monitoring_interval": 30.0,
+        # service-side retry defaults for failed resources; a run's
+        # spec.retry_policy overlays these (common/retry.py
+        # resolve_retry_policy). max_retries=0 keeps the reference
+        # behavior (fail once, stay failed) unless a run opts in.
+        "retries": {
+            "max_retries": 0,
+            "backoff": 5.0,
+            "backoff_factor": 2.0,
+            "backoff_max": 300.0,
+            "jitter": 0.1,
+        },
+        # stall watchdog: runs silent (no status.last_heartbeat update)
+        # past stall_timeout seconds are escalated per on_stall
+        # ("abort" | "resubmit"); <= 0 disables. interval rate-limits the
+        # in-run heartbeat writes (execution.py).
+        "heartbeat": {
+            "interval": 30.0,
+            "stall_timeout": -1,
+            "on_stall": "abort",
+        },
         # per-state stuck thresholds in seconds (reference: state_thresholds,
         # mlrun/config.py function.spec.state_thresholds)
         "state_thresholds": {

@@ -12,6 +12,8 @@ import tempfile
 from typing import Any, Optional
 from urllib.parse import urlparse
 
+from ..chaos import fire as chaos_fire
+
 
 class FileStats:
     def __init__(self, size: int | None = None, modified: float | None = None,
@@ -66,10 +68,12 @@ class DataStore:
 
     # -- derived helpers ---------------------------------------------------
     def upload(self, key: str, src_path: str):
+        chaos_fire("datastore.write", kind=self.kind, key=key)
         with open(src_path, "rb") as fp:
             self.put(key, fp.read())
 
     def download(self, key: str, target_path: str):
+        chaos_fire("datastore.read", kind=self.kind, key=key)
         data = self.get(key)
         os.makedirs(os.path.dirname(target_path) or ".", exist_ok=True)
         with open(target_path, "wb") as fp:
@@ -146,12 +150,16 @@ class DataItem:
         return ext
 
     def get(self, size=None, offset=0, encoding: str | None = None) -> Any:
+        chaos_fire("datastore.read", kind=self.kind, key=self._path,
+                   url=self._url)
         body = self._store.get(self._path, size=size, offset=offset)
         if encoding and isinstance(body, bytes):
             body = body.decode(encoding)
         return body
 
     def put(self, data, append: bool = False):
+        chaos_fire("datastore.write", kind=self.kind, key=self._path,
+                   url=self._url)
         self._store.put(self._path, data, append=append)
 
     def delete(self):

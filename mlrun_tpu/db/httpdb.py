@@ -15,6 +15,7 @@ from urllib.parse import quote
 import requests
 import requests.adapters
 
+from ..chaos import fire as chaos_fire
 from ..config import mlconf
 from ..utils import logger
 from .base import RunDBError, RunDBInterface
@@ -59,6 +60,9 @@ class HTTPRunDB(RunDBInterface):
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         try:
+            # chaos fault point: an injected requests.RequestException
+            # simulates a dead/5xx-ing control plane after client retries
+            chaos_fire("httpdb.request", method=method, path=path, url=url)
             resp = self.session.request(
                 method, url, params=params, data=body,
                 json=json_body if json_body is not None else json,

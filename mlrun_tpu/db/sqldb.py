@@ -30,6 +30,7 @@ from typing import Optional
 from urllib.parse import urlparse
 
 from ..config import mlconf
+from ..utils import logger
 from .base import RunDBError, sql_dialect_for_dsn
 from .sqlitedb import _MIGRATIONS, _SCHEMA, SCHEMA_VERSION, SQLiteRunDB
 
@@ -280,11 +281,20 @@ class SQLServerRunDB(SQLiteRunDB):
         translated = self._translate_ddl(statement)
         try:
             cur.execute(translated)
-        except Exception:
+        except Exception as exc:
             # mysql lacks CREATE INDEX IF NOT EXISTS — a duplicate index
-            # on re-init is expected, everything else re-raises
+            # on re-init (ER_DUP_KEYNAME, 1061) is expected and silent.
+            # Any OTHER CREATE INDEX failure is surfaced with the
+            # statement instead of silently dropping the index; the
+            # migration itself continues (indexes are performance, not
+            # correctness). Everything else re-raises.
             if self.dialect == "mysql" and \
                     translated.lstrip().upper().startswith("CREATE INDEX"):
+                if _mysql_error_code(exc) == 1061:
+                    return
+                logger.warning("CREATE INDEX failed — continuing without "
+                               "the index", statement=translated,
+                               error=str(exc))
                 return
             raise
 
@@ -294,6 +304,18 @@ class SQLServerRunDB(SQLiteRunDB):
         cur.execute("SELECT version FROM schema_version")
         row = cur.fetchone()
         return row[0] if row else 0
+
+
+def _mysql_error_code(exc: Exception) -> int | None:
+    """MySQL error number from a driver exception. pymysql/mysqlclient
+    both carry ``args == (errno, message)``; some wrappers expose
+    ``.errno`` instead."""
+    errno = getattr(exc, "errno", None)
+    if isinstance(errno, int):
+        return errno
+    if exc.args and isinstance(exc.args[0], int):
+        return exc.args[0]
+    return None
 
 
 def _split_statements(script: str) -> list[str]:

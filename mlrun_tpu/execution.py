@@ -10,10 +10,12 @@ runs log exactly once.
 from __future__ import annotations
 
 import os
+import time
 import traceback
 from typing import Any, Optional
 
 from .artifacts import ArtifactManager, ArtifactProducer, DatasetArtifact, ModelArtifact
+from .chaos import fire as chaos_fire
 from .common.runtimes_constants import RunStates
 from .config import mlconf
 from .model import ModelObj, RunObject
@@ -49,8 +51,16 @@ class MLClientCtx:
         self._host = None
         self._start_time = now_date()
         self._last_update = now_date()
+        self._last_heartbeat = now_date()
+        self._heartbeat_wall = 0.0  # rate-limit for lightweight pushes
+        self._checkpoint: Optional[dict] = None
         self._iteration_results = None
         self._state_thresholds = {}
+        # carried through to_dict: the ctx's store_run replaces the whole
+        # run doc, and dropping the policy (or the monitor-recorded retry
+        # status) would silently disarm the service-side retry engine
+        self._retry_policy = {}
+        self._status_carry: dict = {}
         self._notifications = []
         self._logger = logger
         self._log_stream = log_stream
@@ -77,6 +87,16 @@ class MLClientCtx:
         ctx.in_path = spec.get("input_path", "")
         ctx._function_uri = spec.get("function", "")
         ctx._state_thresholds = spec.get("state_thresholds", {})
+        ctx._retry_policy = spec.get("retry_policy", {})
+        # a resubmitted resource's exec config carries the retry status the
+        # monitor recorded (runtime_handlers._build_retry_manifest); the
+        # ctx's full-doc store_run must not erase it
+        status = attrs.get("status", {}) or {}
+        ctx._status_carry = {
+            k: status[k] for k in ("retry_count", "failure_class")
+            if k in status}
+        if status.get("checkpoint") and not ctx._checkpoint:
+            ctx._checkpoint = dict(status["checkpoint"])
         ctx._notifications = spec.get("notifications", [])
         ctx._secrets_manager = SecretsStore.from_list(spec.get("secret_sources"))
         ctx.artifact_path = template_artifact_path(
@@ -231,6 +251,54 @@ class MLClientCtx:
         append to the metrics stream artifact."""
         for key, value in metrics.items():
             self._results[key] = _cast_result(value)
+        self.heartbeat()
+
+    def heartbeat(self, force: bool = False):
+        """Push ``status.last_heartbeat`` so the service's stall watchdog
+        (runtime_handlers._check_stalled) can tell a slow run from a hung
+        one. Rate-limited to mlconf.runs.heartbeat.interval so per-step
+        metric logging doesn't turn into per-step DB writes; a failed
+        push never breaks the training loop."""
+        self._last_heartbeat = now_date()
+        interval = float(getattr(mlconf.runs.heartbeat, "interval", 30.0))
+        now = time.monotonic()
+        if not force and now - self._heartbeat_wall < interval:
+            return
+        self._heartbeat_wall = now
+        self._push_status_fields(
+            {"status.last_heartbeat": str(self._last_heartbeat)})
+
+    def _push_status_fields(self, fields: dict):
+        """Best-effort lightweight status write (no full-doc commit) —
+        shared by heartbeat() and log_checkpoint(); a failed push never
+        breaks the training loop."""
+        if self._db is None or not self.is_logging_worker():
+            return
+        updater = getattr(self._db, "update_run", None)
+        if updater is None:
+            return
+        try:
+            updater(fields, self._uid, self.project, iter=self.iteration)
+        except Exception:  # noqa: BLE001 - status push is best-effort
+            pass
+
+    def log_checkpoint(self, path: str, step: int | None = None,
+                       commit: bool = False):
+        """Record the latest resumable checkpoint on ``status.checkpoint``
+        — the service monitor reads it when resubmitting a preempted TPU
+        run so the replacement JobSet resumes from this step instead of
+        restarting (runtime_handlers.TpuJobHandler). Without ``commit``
+        the checkpoint still reaches the DB as a lightweight field update:
+        it is exactly what a hard-killed run needs recorded, so it must
+        not wait for the next full-doc commit that may never come."""
+        self._checkpoint = {"path": str(path),
+                            "step": int(step) if step is not None else None,
+                            "time": now_iso()}
+        if commit:
+            self.commit()
+            return
+        self._push_status_fields(
+            {"status.checkpoint": dict(self._checkpoint)})
 
     def log_iteration_results(self, best: int, summary: list, task: dict,
                               commit: bool = False):
@@ -323,6 +391,7 @@ class MLClientCtx:
                 "output_path": self.artifact_path,
                 "input_path": self.in_path,
                 "state_thresholds": self._state_thresholds,
+                "retry_policy": self._retry_policy,
                 "notifications": self._notifications,
                 "secret_sources": self._secrets_manager.to_serial(),
             },
@@ -331,12 +400,16 @@ class MLClientCtx:
                 "results": self._results,
                 "start_time": str(self._start_time),
                 "last_update": str(self._last_update),
+                "last_heartbeat": str(self._last_heartbeat),
                 "artifacts": self._artifacts_manager.artifact_list(full=True)
                 if self._artifacts_manager else [],
                 "artifact_uris": dict(self._artifacts_manager.artifact_uris)
                 if self._artifacts_manager else {},
             },
         }
+        struct["status"].update(self._status_carry)
+        if self._checkpoint:
+            struct["status"]["checkpoint"] = dict(self._checkpoint)
         if self._error:
             struct["status"]["error"] = self._error
         if self._host:
@@ -361,6 +434,11 @@ class MLClientCtx:
         if completed:
             self._state = RunStates.completed
         self._last_update = now_date()
+        # every commit doubles as a heartbeat (the full doc carries
+        # last_heartbeat); the named fault point lets chaos tests stall or
+        # fail the in-run status path on demand
+        self._last_heartbeat = self._last_update
+        chaos_fire("execution.commit", uid=self._uid, project=self.project)
         if self._db and self.is_logging_worker():
             self._db.store_run(self.to_dict(), self._uid, self.project,
                                iter=self.iteration)

@@ -248,6 +248,14 @@ class CheckpointCallback(Callback):
             if state is None:
                 return
             self.manager.save(int(state.step), state, force=True)
+            # record the resumable point on status.checkpoint: a
+            # hard-killed run (no deliverable SIGTERM) is resubmitted with
+            # whatever the service finds here — the graceful-preemption
+            # branch in Trainer.fit never runs in that scenario
+            if self.context is not None and \
+                    hasattr(self.context, "log_checkpoint"):
+                self.context.log_checkpoint(
+                    self.manager.directory, step=int(state.step))
         self.saves += 1
 
     def on_step_end(self, step: int, metrics: dict) -> None:

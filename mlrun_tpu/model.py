@@ -200,7 +200,7 @@ class RunSpec(ModelObj):
         "parameters", "hyperparams", "hyper_param_options", "inputs", "outputs",
         "input_path", "output_path", "function", "secret_sources", "data_stores",
         "handler", "scrape_metrics", "verbose", "notifications", "state_thresholds",
-        "returns", "allow_empty_resources",
+        "returns", "allow_empty_resources", "retry_policy",
     ]
     _nested_fields = {"hyper_param_options": HyperParamOptions}
 
@@ -208,7 +208,8 @@ class RunSpec(ModelObj):
                  inputs=None, outputs=None, input_path=None, output_path=None,
                  function=None, secret_sources=None, data_stores=None, handler=None,
                  scrape_metrics=None, verbose=None, notifications=None,
-                 state_thresholds=None, returns=None, allow_empty_resources=None):
+                 state_thresholds=None, returns=None, allow_empty_resources=None,
+                 retry_policy=None):
         self.parameters = parameters or {}
         self.hyperparams = hyperparams or {}
         self.hyper_param_options = hyper_param_options or HyperParamOptions()
@@ -226,6 +227,9 @@ class RunSpec(ModelObj):
         self.state_thresholds = state_thresholds or {}
         self.returns = returns or []
         self.allow_empty_resources = allow_empty_resources
+        # run-level fault tolerance (common/schemas/run.py RetryPolicy;
+        # enforced by service/runtime_handlers.py monitor_runs)
+        self.retry_policy = retry_policy or {}
 
     @property
     def handler_name(self) -> str:
@@ -245,13 +249,15 @@ class RunStatus(ModelObj):
     _dict_fields = [
         "state", "error", "host", "commit", "status_text", "results", "artifacts",
         "artifact_uris", "start_time", "last_update", "end_time", "iterations",
-        "ui_url", "reason", "notifications",
+        "ui_url", "reason", "notifications", "retry_count", "failure_class",
+        "checkpoint", "last_heartbeat",
     ]
 
     def __init__(self, state=None, error=None, host=None, commit=None,
                  status_text=None, results=None, artifacts=None, artifact_uris=None,
                  start_time=None, last_update=None, end_time=None, iterations=None,
-                 ui_url=None, reason=None, notifications=None):
+                 ui_url=None, reason=None, notifications=None, retry_count=None,
+                 failure_class=None, checkpoint=None, last_heartbeat=None):
         self.state = state or RunStates.created
         self.error = error
         self.host = host
@@ -267,6 +273,11 @@ class RunStatus(ModelObj):
         self.ui_url = ui_url
         self.reason = reason
         self.notifications = notifications or {}
+        # fault-tolerance bookkeeping (service monitor + in-run ctx)
+        self.retry_count = retry_count
+        self.failure_class = failure_class
+        self.checkpoint = checkpoint
+        self.last_heartbeat = last_heartbeat
 
     def is_failed(self) -> Optional[bool]:
         if self.state in RunStates.error_states():
@@ -309,6 +320,24 @@ class RunTemplate(ModelObj):
 
     def with_secrets(self, kind, source):
         self.spec.secret_sources.append({"kind": kind, "source": source})
+        return self
+
+    def with_retry(self, max_retries: int = 3, backoff: float = 5.0,
+                   backoff_factor: float = 2.0, backoff_max: float = 300.0,
+                   jitter: float = 0.1, retry_on: list | None = None,
+                   stall_timeout: float = -1.0, on_stall: str = "abort"):
+        """Opt this run into service-side resubmission on infra failures
+        (preemption, image-pull backoff, node drain, 5xx) — user-code
+        errors are never retried. ``stall_timeout``/``on_stall`` arm the
+        heartbeat watchdog. See docs/fault_tolerance.md."""
+        from .common.schemas.run import RetryPolicy
+
+        policy = RetryPolicy(
+            max_retries=max_retries, backoff=backoff,
+            backoff_factor=backoff_factor, backoff_max=backoff_max,
+            jitter=jitter, retry_on=retry_on, stall_timeout=stall_timeout,
+            on_stall=on_stall)
+        self.spec.retry_policy = policy.model_dump(exclude_none=True)
         return self
 
     def set_label(self, key, value):

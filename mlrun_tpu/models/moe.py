@@ -260,7 +260,12 @@ def loss_fn(config: MoEConfig, params: Params, tokens, targets,
     total = jnp.maximum(jnp.sum(mask), 1.0)
     ce = jnp.sum(nll * mask) / total
     loss = ce + config.router_aux_weight * aux_loss
-    return loss, {"loss": loss, "ce_loss": ce, "aux_loss": aux_loss}
+    # same metric surface as the chunked path, so callbacks monitoring
+    # "accuracy" behave identically for loss_chunk=0 and loss_chunk>0
+    accuracy = jnp.sum(
+        (jnp.argmax(logits, axis=-1) == targets) * mask) / total
+    return loss, {"loss": loss, "ce_loss": ce, "aux_loss": aux_loss,
+                  "accuracy": accuracy}
 
 
 def param_shapes(config: MoEConfig) -> Params:

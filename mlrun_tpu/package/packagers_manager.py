@@ -120,7 +120,7 @@ class PackagersManager:
         obj_path = instructions.get("object_type", "")
         if not obj_path:
             return _NO_INSTRUCTIONS
-        obj_type = _resolve_type(obj_path)
+        obj_type = _resolve_type(obj_path, trusted=False)
         if obj_type is None:
             logger.warning("unpackaging instructions name an unresolvable "
                            "type — handing back the DataItem",
@@ -174,11 +174,34 @@ class _StampingContext:
 _NO_INSTRUCTIONS = object()  # sentinel: no usable recorded instructions
 
 
-def _resolve_type(path: str):
+def _allowed_instruction_module(path: str) -> bool:
+    """Unpackaging instructions are *artifact metadata* — attacker-shaped
+    input, unlike handler type hints the user wrote. Restrict the module
+    an instruction may name to builtins, ``mlrun_tpu`` itself, and
+    modules this process ALREADY imported, so a crafted artifact spec
+    cannot trigger an arbitrary import (and its module-level code)."""
+    import sys
+
+    if "." not in path:
+        return True  # bare builtin name; parse_string_hint checks builtins
+    from .type_hints import _SHORTHAND_MODULES
+
+    root = path.split(".", 1)[0]
+    root = _SHORTHAND_MODULES.get(root, root).split(".", 1)[0]
+    return root == "mlrun_tpu" or root in sys.modules
+
+
+def _resolve_type(path: str, trusted: bool = True):
     """'module.Qualified.Name' -> type via the shared string-hint
     resolver (type_hints.parse_string_hint handles shorthand modules and
-    nested classes for both paths)."""
+    nested classes for both paths). ``trusted=False`` applies the
+    instruction-metadata allowlist first."""
     from .type_hints import parse_string_hint
 
+    if not trusted and not _allowed_instruction_module(path):
+        logger.warning("unpackaging instructions name a module outside "
+                       "the allowlist — refusing to import it",
+                       object_type=path)
+        return None
     resolved = parse_string_hint(path)
     return resolved if isinstance(resolved, type) else None

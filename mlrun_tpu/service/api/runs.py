@@ -10,7 +10,7 @@ from aiohttp import web
 
 from ...config import mlconf
 from ...model import RunObject
-from ...utils import generate_uid, get_in, now_iso
+from ...utils import generate_uid, get_in, logger, now_iso
 from ..cron import CronSchedule
 from ..http_utils import (
     API,
@@ -130,6 +130,16 @@ def register(r: web.RouteTableDef, state):
             function_dict = state.db.get_function(
                 name, project_part, tag=tag or "latest")
 
+        retry_spec = get_in(task, "spec.retry_policy")
+        if retry_spec:
+            # reject typo'd policies at the door — a misspelled key or
+            # failure class would otherwise silently disable retries
+            from ...common.schemas.run import RetryPolicy
+
+            try:
+                RetryPolicy(**retry_spec)
+            except Exception as exc:  # noqa: BLE001 - pydantic details vary
+                return error_response(f"bad retry_policy: {exc}")
         run = RunObject.from_dict(
             {"metadata": task.get("metadata", {}),
              "spec": task.get("spec", {})})
@@ -138,6 +148,18 @@ def register(r: web.RouteTableDef, state):
                                 or mlconf.default_project)
         runtime = rebuild_function(function_dict)
         run.metadata.labels.setdefault("kind", runtime.kind)
+        # persist the (possibly inline) function: retries after a service
+        # restart rebuild the resource from the stored function via
+        # spec.function (runtime_handlers._rebuild_from_function), and the
+        # reference stores every submitted function the same way
+        try:
+            state.db.store_function(
+                function_dict, runtime.metadata.name,
+                runtime.metadata.project or run.metadata.project,
+                tag=runtime.metadata.tag or "latest")
+        except Exception as exc:  # noqa: BLE001 - submission still valid
+            logger.warning("could not persist submitted function",
+                           error=str(exc))
         # notification secret-params never reach the stored run or the
         # resource env (reference api/utils.py:221 mask_notification_params)
         from ..secrets import mask_notification_params

@@ -16,6 +16,7 @@ import subprocess
 import sys
 import threading
 
+from ..chaos import fire as chaos_fire
 from ..common.runtimes_constants import (
     JobSetConditions,
     PodPhases,
@@ -94,6 +95,8 @@ class LocalProcessProvider(Provider):
         self._lock = threading.Lock()
 
     def create(self, resource: dict, run_uid: str) -> str:
+        chaos_fire("provider.create", kind=self.kind, run_uid=run_uid,
+                   resource=resource)
         pod_spec = _extract_pod_spec(resource)
         container = pod_spec["containers"][0]
         env = dict(os.environ)
@@ -135,6 +138,8 @@ class LocalProcessProvider(Provider):
         return resource_id
 
     def state(self, resource_id: str) -> str:
+        chaos_fire("provider.state", kind=self.kind,
+                   resource_id=resource_id)
         proc = self._procs.get(resource_id)
         if proc is None:
             # recovered resource from a previous service process: the Popen
@@ -150,6 +155,8 @@ class LocalProcessProvider(Provider):
         return PodPhases.succeeded if code == 0 else PodPhases.failed
 
     def delete(self, resource_id: str):
+        chaos_fire("provider.delete", kind=self.kind,
+                   resource_id=resource_id)
         proc = self._procs.pop(resource_id, None)
         if proc is not None:
             if proc.poll() is None:
@@ -206,6 +213,8 @@ class KubernetesProvider(Provider):
     CRD_KINDS = _CRD_KINDS
 
     def create(self, resource: dict, run_uid: str) -> str:
+        chaos_fire("provider.create", kind=self.kind, run_uid=run_uid,
+                   resource=resource)
         kind = resource.get("kind")
         if kind in self.CRD_KINDS:
             group, version, plural = self.CRD_KINDS[kind]
@@ -239,6 +248,8 @@ class KubernetesProvider(Provider):
         return name
 
     def state(self, resource_id: str) -> str:
+        chaos_fire("provider.state", kind=self.kind,
+                   resource_id=resource_id)
         kind, _, name = resource_id.partition("/")
         if kind == "deployment":
             import kubernetes
@@ -290,6 +301,8 @@ class KubernetesProvider(Provider):
         return pod.status.phase
 
     def delete(self, resource_id: str):
+        chaos_fire("provider.delete", kind=self.kind,
+                   resource_id=resource_id)
         kind, _, name = resource_id.partition("/")
         crd = _CRD_BY_LOWER.get(kind)
         if crd:

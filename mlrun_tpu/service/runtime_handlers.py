@@ -15,11 +15,21 @@ the reference's K8sHelperMock tier).
 
 from __future__ import annotations
 
+import copy
 import json
 import threading
 import time
+from datetime import datetime
 
+from ..common.retry import (
+    FailureClass,
+    classify_failure,
+    compute_backoff,
+    resolve_retry_policy,
+)
 from ..common.runtimes_constants import (
+    RESUME_CHECKPOINT_ENV,
+    RESUME_STEP_ENV,
     JobSetConditions,
     PodPhases,
     RunStates,
@@ -37,6 +47,34 @@ from .providers import (  # noqa: F401 - canonical home is
     Provider,
     _extract_pod_spec,
 )
+
+
+def _epoch(iso: str | None) -> float | None:
+    """ISO timestamp (utils.now_iso) → epoch seconds; None when absent or
+    unparseable."""
+    if not iso:
+        return None
+    try:
+        return datetime.fromisoformat(str(iso)).timestamp()
+    except ValueError:
+        return None
+
+
+def _rewrite_exec_config(node, value: str):
+    """Replace every baked exec-config env value (any container, any
+    manifest shape — Pod, JobSet, Deployment) with ``value``."""
+    if isinstance(node, dict):
+        for key, child in node.items():
+            if key == "containers" and isinstance(child, list):
+                for container in child:
+                    for env in container.get("env", []) or []:
+                        if env.get("name") == mlconf.exec_config_env:
+                            env["value"] = value
+            else:
+                _rewrite_exec_config(child, value)
+    elif isinstance(node, list):
+        for item in node:
+            _rewrite_exec_config(item, value)
 
 
 def _wrap_with_bootstrap(runtime, command: list[str]) -> list[str]:
@@ -60,11 +98,34 @@ class BaseRuntimeHandler:
     def __init__(self, db, provider: Provider):
         self.db = db
         self.provider = provider
-        # run uid -> (resource_id, project, started_walltime); mirrored in
+        # run key -> (resource_id, project, started_walltime); mirrored in
         # the DB's runtime_resources table so a service restart can rebuild
-        # it (reference recovers via cluster label listing, base.py:65)
+        # it (reference recovers via cluster label listing, base.py:65).
+        # The key is the run uid for iteration 0 and "uid#iter" for hyper
+        # children — they share the parent's uid, and keying by bare uid
+        # would make child resources overwrite each other AND make the
+        # monitor write child terminal states onto the PARENT run doc
         self._resources: dict[str, tuple[str, str, float]] = {}
+        # run uid -> pristine resource manifest as built by build_resource,
+        # kept so a retryable failure can be resubmitted without the
+        # runtime object; a restarted service falls back to rebuilding the
+        # runtime from the stored function (``_build_retry_manifest``)
+        self._manifests: dict[str, dict] = {}
+        # run uid -> wall-clock before which a scheduled retry must wait
+        self._retry_at: dict[str, float] = {}
+        # run uid -> consecutive state-probe failures; a single apiserver
+        # blip must not be mistaken for a dead resource
+        self._probe_failures: dict[str, int] = {}
         self._lock = threading.RLock()
+
+    @staticmethod
+    def _run_key(uid: str, iteration: int) -> str:
+        return f"{uid}#{iteration}" if iteration else uid
+
+    @staticmethod
+    def _split_key(key: str) -> tuple[str, int]:
+        uid, _, iteration = key.partition("#")
+        return uid, int(iteration or 0)
 
     # -- resource building --------------------------------------------------
     def build_resource(self, runtime, run: RunObject) -> dict:
@@ -73,19 +134,23 @@ class BaseRuntimeHandler:
     def run(self, runtime, run: RunObject, execution=None) -> dict:
         resource = self.build_resource(runtime, run)
         self._apply_secret_projection(resource, run.metadata.project)
+        iteration = run.metadata.iteration or 0
+        key = self._run_key(run.metadata.uid, iteration)
+        with self._lock:
+            self._manifests[key] = copy.deepcopy(resource)
         resource_id = self.provider.create(resource, run.metadata.uid)
         started = time.time()
         with self._lock:
-            self._resources[run.metadata.uid] = (
+            self._resources[key] = (
                 resource_id, run.metadata.project, started)
-        self._persist(run.metadata.uid, run.metadata.project, resource_id,
-                      started)
+        self._persist(key, run.metadata.project, resource_id, started)
         self.db.update_run(
             {"status.state": RunStates.running,
              "status.start_time": now_iso()},
-            run.metadata.uid, run.metadata.project)
+            run.metadata.uid, run.metadata.project, iter=iteration)
         logger.info("runtime resource created", kind=self.kind,
-                    resource=resource_id, uid=run.metadata.uid)
+                    resource=resource_id, uid=run.metadata.uid,
+                    iteration=iteration)
         return {"resource_id": resource_id}
 
     # -- durable state ------------------------------------------------------
@@ -102,6 +167,9 @@ class BaseRuntimeHandler:
     def _forget(self, uid: str, project: str):
         with self._lock:
             self._resources.pop(uid, None)
+            self._manifests.pop(uid, None)
+            self._retry_at.pop(uid, None)
+            self._probe_failures.pop(uid, None)
         drop = getattr(self.db, "del_runtime_resource", None)
         if drop:
             try:
@@ -145,36 +213,69 @@ class BaseRuntimeHandler:
     def monitor_runs(self):
         with self._lock:
             snapshot = list(self._resources.items())
-        for uid, (resource_id, project, started) in snapshot:
+        for key, (resource_id, project, started) in snapshot:
             try:
-                self._monitor_one(uid, resource_id, project, started)
+                self._monitor_one(key, resource_id, project, started)
             except Exception as exc:  # noqa: BLE001 - one bad resource must
                 # not wedge monitoring for every other run of this kind
-                logger.warning("monitoring resource failed", uid=uid,
+                logger.warning("monitoring resource failed", uid=key,
                                resource=resource_id, error=str(exc))
 
-    def _monitor_one(self, uid: str, resource_id: str, project: str,
+    def _monitor_one(self, key: str, resource_id: str, project: str,
                      started: float):
+        uid, iteration = self._split_key(key)
+        probe_error = None
         try:
             phase = self.provider.state(resource_id)
+            with self._lock:
+                self._probe_failures.pop(key, None)
         except Exception as exc:  # noqa: BLE001 - e.g. k8s 404 after the
             # resource was GC'd while the service was down
+            # 404 is definitive (the resource is gone); anything else may
+            # be an apiserver blip — require consecutive failures before
+            # declaring the resource dead, or a transient 5xx would
+            # trigger a resubmission against a still-running resource
+            definitive = getattr(exc, "status", None) == 404 \
+                or "404" in str(exc)
+            if not definitive:
+                with self._lock:
+                    failures = self._probe_failures.get(key, 0) + 1
+                    self._probe_failures[key] = failures
+                if failures < 2:
+                    logger.warning("resource state probe failed — "
+                                   "waiting for the next tick",
+                                   uid=uid, resource=resource_id,
+                                   error=str(exc))
+                    return
             logger.warning("resource state probe failed — treating as gone",
                            uid=uid, resource=resource_id, error=str(exc))
+            probe_error = str(exc)
             phase = PodPhases.failed
         run_state = PodPhases.to_run_state(phase)
-        run = self.db.read_run(uid, project)
+        run = self.db.read_run(uid, project, iter=iteration)
         if run is None:
             self._delete_quietly(resource_id)
-            self._forget(uid, project)
+            self._forget(key, project)
             return
         current = get_in(run, "status.state")
         if current in (RunStates.aborting,):
             self._delete_quietly(resource_id)
             self.db.update_run({"status.state": RunStates.aborted},
-                               uid, project)
-            self._forget(uid, project)
+                               uid, project, iter=iteration)
+            self._forget(key, project)
             return
+        failure_class = None
+        if run_state == RunStates.error:
+            # the fault-tolerance core (reference base.py has no retry at
+            # all — SURVEY §5.3): classify, then resubmit retryable infra
+            # failures within policy instead of failing the run
+            failure_class = classify_failure(
+                probe_error=probe_error,
+                run_error=get_in(run, "status.error"),
+                run_reported_terminal=current in RunStates.terminal_states())
+            if self._maybe_retry(key, resource_id, project, run,
+                                 failure_class):
+                return
         if run_state in RunStates.terminal_states():
             updates = {"status.last_update": now_iso()}
             # the in-run process writes richer state; only force error
@@ -187,10 +288,33 @@ class BaseRuntimeHandler:
                     or "execution resource failed")
             elif current not in RunStates.terminal_states():
                 updates["status.state"] = run_state
-            self.db.update_run(updates, uid, project)
-            self._forget(uid, project)
+            # record the class only on runs that actually FAILED — a
+            # completed run whose finished resource was GC'd before this
+            # tick must not be labeled a user-code failure
+            final_state = updates.get("status.state", current)
+            if failure_class and final_state in RunStates.error_states() \
+                    and not get_in(run, "status.failure_class"):
+                updates["status.failure_class"] = failure_class
+            self.db.update_run(updates, uid, project, iter=iteration)
+            self._forget(key, project)
             self._push_notifications(uid, project, run)
             return
+        if run_state == RunStates.running:
+            # the resource is healthy again: a retry scheduled off a
+            # transient blip must not linger and fire with zero backoff
+            # at the NEXT genuine failure
+            with self._lock:
+                self._retry_at.pop(key, None)
+            if current == RunStates.pending and get_in(
+                    run, "status.failure_class"):
+                # undo the blip's pending-for-retry parking
+                self.db.update_run({"status.state": RunStates.running},
+                                   uid, project, iter=iteration)
+            # heartbeat watchdog: a resource that still reports running
+            # but whose run went silent is stalled (hung collective,
+            # wedged host)
+            if self._check_stalled(key, resource_id, project, run, started):
+                return
         # stuck-state thresholds (reference base.py:518)
         threshold = self._state_threshold(run, run_state)
         if threshold > 0 and time.time() - started > threshold:
@@ -201,8 +325,195 @@ class BaseRuntimeHandler:
                 {"status.state": RunStates.aborted,
                  "status.status_text":
                  f"stuck in state {run_state} over {threshold}s"},
-                uid, project)
-            self._forget(uid, project)
+                uid, project, iter=iteration)
+            self._forget(key, project)
+
+    # -- retry / resubmission (the fault-tolerance subsystem) ----------------
+    def _maybe_retry(self, key: str, resource_id: str, project: str,
+                     run: dict, failure_class: str) -> bool:
+        """Decide whether a failed resource is resubmitted. True → the
+        failure was fully handled here (scheduled or resubmitted); False →
+        fall through to the terminal-state path."""
+        uid, iteration = self._split_key(key)
+        policy = resolve_retry_policy(get_in(run, "spec.retry_policy"))
+        retry_count = int(get_in(run, "status.retry_count", 0) or 0)
+        if failure_class not in policy.retry_on or \
+                not policy.retries_left(retry_count):
+            return False
+        with self._lock:
+            retry_at = self._retry_at.get(key)
+        if retry_at is None:
+            delay = compute_backoff(retry_count, policy, seed=key)
+            if delay > 0:
+                with self._lock:
+                    self._retry_at[key] = time.time() + delay
+                self.db.update_run(
+                    {"status.state": RunStates.pending,
+                     "status.failure_class": failure_class,
+                     "status.status_text":
+                     f"{failure_class}: retry "
+                     f"{retry_count + 1}/{policy.max_retries} "
+                     f"in {delay:.1f}s"},
+                    uid, project, iter=iteration)
+                logger.info("scheduled run retry", uid=uid,
+                            failure_class=failure_class, delay=delay,
+                            attempt=retry_count + 1)
+                return True
+        elif time.time() < retry_at:
+            return True
+        with self._lock:
+            self._retry_at.pop(key, None)
+        return self._resubmit(key, resource_id, project, run,
+                              retry_count + 1, failure_class)
+
+    def _resubmit(self, key: str, old_resource_id: str, project: str,
+                  run: dict, attempt: int, failure_class: str) -> bool:
+        uid, iteration = self._split_key(key)
+        self._delete_quietly(old_resource_id)
+        try:
+            manifest = self._build_retry_manifest(key, project, run, attempt,
+                                                  failure_class)
+        except Exception as exc:  # noqa: BLE001 - unresolvable function etc.
+            logger.warning("cannot rebuild resource for retry", uid=uid,
+                           error=str(exc))
+            manifest = None
+        if manifest is None:
+            self.db.update_run(
+                {"status.state": RunStates.error,
+                 "status.failure_class": failure_class,
+                 "status.error": get_in(run, "status.error")
+                 or f"execution resource failed ({failure_class}); "
+                 "resource could not be rebuilt for retry"},
+                uid, project, iter=iteration)
+            self._forget(key, project)
+            self._push_notifications(uid, project, run)
+            return True
+        try:
+            new_id = self.provider.create(manifest, uid)
+        except Exception as exc:  # noqa: BLE001 - cluster rejected the retry
+            logger.warning("resubmission failed", uid=uid, error=str(exc))
+            self.db.update_run(
+                {"status.state": RunStates.error,
+                 "status.failure_class": failure_class,
+                 "status.error": f"resubmission failed: {exc}"},
+                uid, project, iter=iteration)
+            self._forget(key, project)
+            self._push_notifications(uid, project, run)
+            return True
+        started = time.time()
+        with self._lock:
+            self._resources[key] = (new_id, project, started)
+        self._persist(key, project, new_id, started)
+        self.db.update_run(
+            {"status.state": RunStates.running,
+             "status.retry_count": attempt,
+             "status.failure_class": failure_class,
+             "status.status_text":
+             f"resubmitted after {failure_class} (attempt {attempt})"},
+            uid, project, iter=iteration)
+        logger.info("resubmitted run", uid=uid, resource=new_id,
+                    failure_class=failure_class, attempt=attempt)
+        return True
+
+    def _build_retry_manifest(self, key: str, project: str, run: dict,
+                              attempt: int,
+                              failure_class: str = "") -> dict | None:
+        """Fresh manifest for a retry: the pristine manifest cached at
+        submission (or rebuilt from the stored function after a service
+        restart), renamed per attempt so an async-deleting cluster can't
+        409 the replacement, then handler-customized (resume env)."""
+        with self._lock:
+            manifest = self._manifests.get(key)
+        if manifest is None:
+            manifest = self._rebuild_from_function(
+                self._split_key(key)[0], project, run)
+            if manifest is None:
+                return None
+            with self._lock:
+                self._manifests[key] = copy.deepcopy(manifest)
+        manifest = copy.deepcopy(manifest)
+        name = manifest.get("metadata", {}).get("name")
+        if name:
+            manifest["metadata"]["name"] = f"{name}-r{attempt}"
+        # the baked exec config predates the failure — refresh it so the
+        # retried process knows its retry status (and latest checkpoint)
+        # and its full-doc store_run doesn't erase them
+        run_doc = copy.deepcopy(run)
+        run_doc.setdefault("status", {})["retry_count"] = attempt
+        if failure_class:
+            run_doc["status"]["failure_class"] = failure_class
+        _rewrite_exec_config(manifest, json.dumps(run_doc, default=str))
+        self._customize_retry_manifest(manifest, run, attempt)
+        return manifest
+
+    def _rebuild_from_function(self, uid: str, project: str,
+                               run: dict) -> dict | None:
+        """Post-restart fallback: rebuild the runtime from the function
+        stored in the DB (spec.function 'project/name:tag') and run
+        build_resource again."""
+        getter = getattr(self.db, "get_function", None)
+        uri = get_in(run, "spec.function", "") or ""
+        if not getter or "/" not in uri:
+            return None
+        func_project, _, rest = uri.partition("/")
+        name, _, tag = rest.partition(":")
+        tag, _, _hash = tag.partition("@")
+        struct = getter(name, func_project or project, tag=tag or "latest")
+        if not struct:
+            return None
+        from .launcher import rebuild_function
+
+        runtime = rebuild_function(struct)
+        resource = self.build_resource(runtime, RunObject.from_dict(run))
+        self._apply_secret_projection(resource, project)
+        return resource
+
+    def _customize_retry_manifest(self, manifest: dict, run: dict,
+                                  attempt: int):
+        """Handler hook: adjust the renamed manifest before resubmission
+        (TpuJobHandler wires checkpoint-resume env here)."""
+
+    # -- stall watchdog ------------------------------------------------------
+    def _check_stalled(self, key: str, resource_id: str, project: str,
+                       run: dict, started: float) -> bool:
+        """Escalate runs whose heartbeat went silent past the policy
+        threshold: abort, or resubmit within the retry budget."""
+        uid, iteration = self._split_key(key)
+        policy = resolve_retry_policy(get_in(run, "spec.retry_policy"))
+        if policy.stall_timeout is None or policy.stall_timeout <= 0:
+            return False
+        # floor at the CURRENT resource's start: a just-resubmitted
+        # replacement hasn't heartbeat yet, and judging it by the previous
+        # attempt's stale heartbeat would burn the retry budget one
+        # monitor tick at a time
+        heartbeat = max(_epoch(get_in(run, "status.last_heartbeat")) or 0.0,
+                        started)
+        silent = time.time() - heartbeat
+        if silent <= policy.stall_timeout:
+            return False
+        retry_count = int(get_in(run, "status.retry_count", 0) or 0)
+        logger.warning("run stalled — no heartbeat", uid=uid,
+                       silent_seconds=round(silent, 1),
+                       threshold=policy.stall_timeout,
+                       escalation=policy.on_stall)
+        # on_stall is the explicit directive — it is NOT gated on
+        # retry_on (a run retrying only preemptions but asking for stall
+        # resubmission means exactly that); only the budget limits it
+        if policy.on_stall == "resubmit" and \
+                policy.retries_left(retry_count):
+            return self._resubmit(key, resource_id, project, run,
+                                  retry_count + 1, FailureClass.stalled)
+        self._delete_quietly(resource_id)
+        self.db.update_run(
+            {"status.state": RunStates.aborted,
+             "status.failure_class": FailureClass.stalled,
+             "status.status_text":
+             f"stalled: no heartbeat for {silent:.0f}s "
+             f"(threshold {policy.stall_timeout:.0f}s)"},
+            uid, project, iter=iteration)
+        self._forget(key, project)
+        self._push_notifications(uid, project, run)
+        return True
 
     def _push_notifications(self, uid: str, project: str, run: dict):
         """Server-side push when the monitor retires a terminal resource —
@@ -390,6 +701,35 @@ class TpuJobHandler(BaseRuntimeHandler):
             command += ["--handler", handler]
         command = _wrap_with_bootstrap(runtime, command)
         return runtime.generate_jobset(run, extra_env=env, command=command)
+
+    def _customize_retry_manifest(self, manifest: dict, run: dict,
+                                  attempt: int):
+        """Rescheduled pod-slices resume instead of restarting: fix the
+        JobSet's name-derived wiring (headless-service subdomain, the
+        MEGASCALE coordinator address) for the renamed manifest, and
+        inject the latest checkpoint path + step recorded on
+        ``status.checkpoint`` so training/train.py restores before the
+        first step."""
+        new_name = manifest.get("metadata", {}).get("name", "")
+        checkpoint = get_in(run, "status.checkpoint", {}) or {}
+        resume_env = []
+        if checkpoint.get("path"):
+            resume_env.append({"name": RESUME_CHECKPOINT_ENV,
+                               "value": str(checkpoint["path"])})
+            if checkpoint.get("step") is not None:
+                resume_env.append({"name": RESUME_STEP_ENV,
+                                   "value": str(checkpoint["step"])})
+        for job in get_in(manifest, "spec.replicatedJobs", []) or []:
+            pod_spec = get_in(job, "template.spec.template.spec", {}) or {}
+            if pod_spec.get("subdomain") and new_name:
+                pod_spec["subdomain"] = new_name
+            for container in pod_spec.get("containers", []):
+                env = container.setdefault("env", [])
+                for item in env:
+                    if item.get("name") == "MEGASCALE_COORDINATOR_ADDRESS" \
+                            and new_name:
+                        item["value"] = f"{new_name}-slice-0-0.{new_name}"
+                env.extend(copy.deepcopy(resume_env))
 
 
 class DaskHandler(KubeJobHandler):

@@ -92,6 +92,31 @@ def save_checkpoint_artifact(context, key: str, manager: CheckpointManager,
                              framework: str = "jax", **kwargs):
     """Register the checkpoint dir as a model artifact on the run."""
     manager.wait()
+    record = getattr(context, "log_checkpoint", None)
+    if record is not None:
+        # status.checkpoint is what the service monitor wires into a
+        # resubmitted JobSet's resume env (runtime_handlers.TpuJobHandler)
+        record(manager.directory, step=manager.latest_step(), commit=False)
     return context.log_model(
         key, model_dir=manager.directory, framework=framework,
         upload=False, target_path=manager.directory, **kwargs)
+
+
+def resume_directive() -> tuple[str, Optional[int]] | None:
+    """The checkpoint-resume env contract written by the service when it
+    resubmits a preempted run: (path, step) or None. Step may be None when
+    only the path was recorded."""
+    from ..common.runtimes_constants import (
+        RESUME_CHECKPOINT_ENV,
+        RESUME_STEP_ENV,
+    )
+
+    path = os.environ.get(RESUME_CHECKPOINT_ENV, "")
+    if not path:
+        return None
+    step_raw = os.environ.get(RESUME_STEP_ENV, "")
+    try:
+        step = int(step_raw) if step_raw else None
+    except ValueError:
+        step = None
+    return path, step

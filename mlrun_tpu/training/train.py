@@ -559,6 +559,33 @@ class Trainer:
         self.state, metrics = self.step_fn(self.state, tokens, targets)
         return metrics
 
+    def _maybe_resume(self, checkpoint_manager, context):
+        """Honor the service's checkpoint-resume directive
+        (MLT_RESUME_FROM_CHECKPOINT / MLT_RESUME_STEP, written into a
+        resubmitted JobSet by runtime_handlers.TpuJobHandler): restore the
+        train state before the first step so the rescheduled slice resumes
+        rather than restarting. No directive, no manager, or an
+        already-advanced state (explicit restore) → no-op."""
+        from .checkpoint import resume_directive
+
+        directive = resume_directive()
+        if directive is None or checkpoint_manager is None or \
+                int(self.state.step) != 0:
+            return
+        path, step = directive
+        try:
+            self.state = checkpoint_manager.restore(self.state, step=step)
+        except Exception as exc:  # noqa: BLE001 - a missing/corrupt
+            # checkpoint must not turn a resumable run into a crash loop;
+            # training from step 0 is the correct degraded behavior
+            logger.warning("checkpoint resume failed — starting fresh",
+                           path=path, step=step, error=str(exc))
+            return
+        logger.info("resumed from checkpoint", path=path,
+                    step=int(self.state.step))
+        if context is not None and hasattr(context, "log_result"):
+            context.log_result("resumed_from_step", int(self.state.step))
+
     def fit(self, data_iter, steps: int, context=None,
             log_every: int = 10, callbacks: list | None = None,
             checkpoint_manager=None, preemption_guard=None,
@@ -579,6 +606,7 @@ class Trainer:
         from ..frameworks._common.callbacks import CallbackList
 
         assert self.state is not None, "call init() first"
+        self._maybe_resume(checkpoint_manager, context)
         hooks = CallbackList(callbacks, context=context, trainer=self)
         hooks.on_train_begin()
         t_start = time.perf_counter()
@@ -600,6 +628,14 @@ class Trainer:
                     checkpoint_manager.save(int(self.state.step),
                                             self.state, force=True)
                     checkpoint_manager.wait()
+                    if context is not None and \
+                            hasattr(context, "log_checkpoint"):
+                        # the service reads status.checkpoint when it
+                        # resubmits the evicted slice — this write is what
+                        # makes the restart a *resume*
+                        context.log_checkpoint(
+                            checkpoint_manager.directory,
+                            step=int(self.state.step), commit=False)
                 last = dict(last)
                 last["preempted"] = True
                 last["step"] = int(self.state.step)

@@ -18,19 +18,7 @@ from .helpers import (  # noqa: F401
 )
 
 
-def get_secret_or_env(key: str, secret_provider=None, default: str = "",
-                      prefix: str = "") -> str:
-    """Resolve a secret by key: an explicit provider (callable or
-    mapping) first, then MLT_SECRET_<KEY>, then the plain env var
-    (reference mlrun/secrets get_secret_or_env)."""
-    import os
-
-    if prefix:
-        key = f"{prefix}{key}"
-    if secret_provider is not None:
-        value = secret_provider(key) if callable(secret_provider) \
-            else secret_provider.get(key)
-        if value:
-            return value
-    return (os.environ.get(f"MLT_SECRET_{key.upper()}")
-            or os.environ.get(key, default))
+# one implementation only: the divergent copy that used to live here
+# inverted the precedence (MLT_SECRET_* before the plain env var) and
+# uppercased the key, breaking verbatim-case secrets (ADVICE round-5)
+from ..secrets import get_secret_or_env  # noqa: F401, E402

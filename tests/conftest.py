@@ -25,6 +25,24 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running suites excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (run via `make chaos`)")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_dark():
+    """No armed fault survives a test — a leaked injection would poison
+    every later test through the process-wide registry."""
+    from mlrun_tpu.chaos import chaos
+
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
 @pytest.fixture(autouse=True)
 def isolated_home(monkeypatch, tmp_path):
     """Fresh MLT_HOME + fresh config + fresh run DB per test."""

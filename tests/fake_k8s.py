@@ -16,6 +16,8 @@ from __future__ import annotations
 import base64
 import types
 
+from mlrun_tpu.chaos import fire as chaos_fire
+
 
 class ApiException(Exception):
     def __init__(self, status: int = 500, reason: str = ""):
@@ -67,6 +69,20 @@ class FakeCluster:
     def set_custom_status(self, plural: str, name: str, status: dict):
         self.custom_status[(plural, name)] = status
 
+    def kill_jobset(self, name: str):
+        """Simulate a pod-slice eviction: the JobSet object vanishes
+        out-of-band (node drain / GC), so the next state probe 404s."""
+        self.customs["jobsets"].pop(name, None)
+        self.jobset_conditions.pop(name, None)
+        self.events.append(("kill", "jobset", name))
+
+    def kill_pod(self, name: str):
+        """Simulate an out-of-band pod kill (preemption)."""
+        self.pods.pop(name, None)
+        self.pod_phases.pop(name, None)
+        self.pod_scripts.pop(name, None)
+        self.events.append(("kill", "pod", name))
+
     @property
     def jobsets(self) -> dict:
         return self.customs["jobsets"]
@@ -103,21 +119,25 @@ def make_fake_kubernetes(cluster: FakeCluster):
         def __init__(self, api_client=None):
             self.api_client = api_client or object()
 
-        # pods
+        # pods — each verb fires the matching chaos point so tests can
+        # break the "cluster" itself (apiserver 5xx, vanished objects)
         def create_namespaced_pod(self, ns, manifest):
             name = manifest["metadata"]["name"]
+            chaos_fire("k8s.create", kind="pod", name=name)
             if name in cluster.pods:
                 raise ApiException(409, f"pod {name} exists")
             cluster.pods[name] = manifest
             cluster.events.append(("create", "pod", name))
 
         def read_namespaced_pod(self, name, ns):
+            chaos_fire("k8s.read", kind="pod", name=name)
             if name not in cluster.pods:
                 raise ApiException(404, f"pod {name}")
             return _pod_object(name, cluster.pods[name],
                                cluster._pod_phase(name))
 
         def delete_namespaced_pod(self, name, ns):
+            chaos_fire("k8s.delete", kind="pod", name=name)
             if name not in cluster.pods:
                 raise ApiException(404, f"pod {name}")
             del cluster.pods[name]
@@ -212,6 +232,7 @@ def make_fake_kubernetes(cluster: FakeCluster):
                                             plural, manifest):
             bucket = self._bucket(plural)
             name = manifest["metadata"]["name"]
+            chaos_fire("k8s.create", kind=plural[:-1], name=name)
             if name in bucket:
                 raise ApiException(409, f"{plural}/{name} exists")
             bucket[name] = manifest
@@ -220,6 +241,7 @@ def make_fake_kubernetes(cluster: FakeCluster):
         def get_namespaced_custom_object(self, group, version, ns, plural,
                                          name):
             bucket = self._bucket(plural)
+            chaos_fire("k8s.read", kind=plural[:-1], name=name)
             if name not in bucket:
                 raise ApiException(404, f"{plural}/{name}")
             obj = dict(bucket[name])
@@ -234,6 +256,7 @@ def make_fake_kubernetes(cluster: FakeCluster):
         def delete_namespaced_custom_object(self, group, version, ns,
                                             plural, name):
             bucket = self._bucket(plural)
+            chaos_fire("k8s.delete", kind=plural[:-1], name=name)
             if name not in bucket:
                 raise ApiException(404, f"{plural}/{name}")
             del bucket[name]

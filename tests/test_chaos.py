@@ -1,0 +1,199 @@
+"""Chaos-injection layer: registry semantics + the hooks threaded through
+fake_k8s, the providers, the datastore, httpdb, and the execution ctx.
+
+The registry must be deterministic (seeded schedules), scoped (context
+managers), and dark-by-default (armed-injection flag) — these tests pin
+all three before the fault-tolerance suite builds on them.
+"""
+
+import time
+
+import pytest
+
+from mlrun_tpu.chaos import (
+    ChaosRegistry,
+    FaultPoints,
+    chaos,
+    fail_after,
+    fail_first,
+    fail_nth,
+    fail_with_prob,
+)
+
+from . import fake_k8s
+
+
+# -- registry semantics -----------------------------------------------------
+
+def test_dark_by_default_and_scoping():
+    registry = ChaosRegistry()
+    assert not registry.enabled
+    registry.fire("k8s.create", name="p")  # no-op, nothing armed
+    with registry.inject("k8s.create", error=RuntimeError("boom")):
+        assert registry.enabled
+        with pytest.raises(RuntimeError, match="boom"):
+            registry.fire("k8s.create", name="p")
+    assert not registry.enabled
+    registry.fire("k8s.create", name="p")  # disarmed again
+
+
+def test_fail_nth_first_after_schedules():
+    registry = ChaosRegistry()
+    inj = registry.inject("p", fail_nth(2), error=IOError("n2"))
+    registry.fire("p")
+    with pytest.raises(IOError):
+        registry.fire("p")
+    registry.fire("p")  # only the 2nd call fires
+    assert (inj.calls, inj.fired) == (3, 1)
+    registry.clear()
+
+    registry.inject("p", fail_first(2), error=IOError("f"))
+    for _ in range(2):
+        with pytest.raises(IOError):
+            registry.fire("p")
+    registry.fire("p")  # transient fault over
+    registry.clear()
+
+    registry.inject("p", fail_after(1), error=IOError("a"))
+    registry.fire("p")
+    with pytest.raises(IOError):
+        registry.fire("p")
+    with pytest.raises(IOError):
+        registry.fire("p")
+
+
+def test_fail_with_prob_is_seed_deterministic():
+    def pattern(seed):
+        registry = ChaosRegistry()
+        inj = registry.inject("p", fail_with_prob(0.5, seed=seed),
+                              error=IOError("x"))
+        out = []
+        for _ in range(32):
+            try:
+                registry.fire("p")
+                out.append(0)
+            except IOError:
+                out.append(1)
+        assert inj.fired == sum(out)
+        return out
+
+    a, b = pattern(7), pattern(7)
+    assert a == b  # same seed → identical fault pattern
+    assert pattern(8) != a  # and the seed actually matters
+    assert 0 < sum(a) < 32
+
+
+def test_wildcard_and_match_predicate():
+    registry = ChaosRegistry()
+    registry.inject("k8s.*", error=IOError("any k8s verb"))
+    with pytest.raises(IOError):
+        registry.fire("k8s.delete", name="x")
+    registry.fire("datastore.read")  # different prefix untouched
+    registry.clear()
+
+    registry.inject("k8s.delete", error=IOError("only pod-a"),
+                    match=lambda ctx: ctx.get("name") == "pod-a")
+    registry.fire("k8s.delete", name="pod-b")
+    with pytest.raises(IOError):
+        registry.fire("k8s.delete", name="pod-a")
+
+
+def test_delay_and_action_effects():
+    registry = ChaosRegistry()
+    seen = []
+    registry.inject("p", fail_nth(1), delay=0.05,
+                    action=lambda point, ctx: seen.append(ctx["k"]))
+    t0 = time.monotonic()
+    registry.fire("p", k="v")
+    assert time.monotonic() - t0 >= 0.05
+    assert seen == ["v"]
+
+
+def test_fault_point_names_are_declared():
+    assert "k8s.create" in FaultPoints.all()
+    assert "httpdb.request" in FaultPoints.all()
+    assert "execution.commit" in FaultPoints.all()
+
+
+# -- hooks through the layers ----------------------------------------------
+
+@pytest.mark.chaos
+def test_fake_k8s_hooks_break_the_cluster(monkeypatch):
+    cluster = fake_k8s.install(monkeypatch)
+    from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+    provider = KubernetesProvider(namespace="testns")
+    manifest = {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p1", "labels": {}},
+                "spec": {"containers": [{"name": "c", "image": "x"}]}}
+    # apiserver 5xx on the first create only — the retry lands
+    with chaos.inject("k8s.create", fail_first(1),
+                      error=fake_k8s.ApiException(500, "injected")):
+        with pytest.raises(fake_k8s.ApiException):
+            provider.create(manifest, "u1")
+        assert cluster.pods == {}
+        provider.create(manifest, "u1")
+    assert "p1" in cluster.pods
+
+    # kill the pod out from under the next state probe via an action hook
+    with chaos.inject("k8s.read", fail_nth(1),
+                      action=lambda point, ctx: cluster.kill_pod("p1")):
+        with pytest.raises(fake_k8s.ApiException, match="404"):
+            provider.state("pod/p1")
+
+
+@pytest.mark.chaos
+def test_provider_level_fault_points(monkeypatch):
+    fake_k8s.install(monkeypatch)
+    from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+    provider = KubernetesProvider(namespace="testns")
+    with chaos.inject("provider.delete", error=RuntimeError("drain")):
+        with pytest.raises(RuntimeError, match="drain"):
+            provider.delete("pod/whatever")
+
+
+@pytest.mark.chaos
+def test_datastore_read_write_faults(tmp_path):
+    from mlrun_tpu.datastore import store_manager
+
+    url = f"memory://chaos/{tmp_path.name}"
+    item = store_manager.object(url=url)
+    with chaos.inject("datastore.write", fail_nth(1),
+                      error=IOError("disk on fire")):
+        with pytest.raises(IOError):
+            item.put(b"payload")
+    item.put(b"payload")
+    with chaos.inject("datastore.read", fail_nth(2),
+                      error=IOError("read torn")):
+        assert item.get() == b"payload"
+        with pytest.raises(IOError):
+            item.get()
+    assert item.get() == b"payload"
+
+
+@pytest.mark.chaos
+def test_httpdb_5xx_fault_surfaces_as_rundberror():
+    import requests
+
+    from mlrun_tpu.db.base import RunDBError
+    from mlrun_tpu.db.httpdb import HTTPRunDB
+
+    db = HTTPRunDB("http://127.0.0.1:1")  # never actually dialed
+    with chaos.inject("httpdb.request",
+                      error=requests.RequestException("injected 503")):
+        with pytest.raises(RunDBError, match="injected 503"):
+            db.api_call("GET", "healthz")
+
+
+@pytest.mark.chaos
+def test_execution_commit_stall_delay(rundb_mock):
+    from mlrun_tpu.execution import MLClientCtx
+
+    ctx = MLClientCtx.from_dict(
+        {"metadata": {"name": "t", "uid": "u-chaos", "project": "p"}},
+        rundb=rundb_mock)
+    with chaos.inject("execution.commit", fail_nth(1), delay=0.05):
+        t0 = time.monotonic()
+        ctx.commit()
+        assert time.monotonic() - t0 >= 0.05  # a stalled status write

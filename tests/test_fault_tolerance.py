@@ -1,0 +1,519 @@
+"""Run-level fault tolerance: retry policy engine, service resubmission,
+checkpoint-resume env wiring, and the stall watchdog (ISSUE acceptance
+criteria), plus the PreemptionGuard edge paths.
+
+Reference contrast (SURVEY §5.3): an MPIJob worker failure simply fails
+the run. Here a chaos-killed TpuJob JobSet is resubmitted by the monitor
+with ``status.retry_count`` bumped and the latest checkpoint wired into
+the replacement's env; user-code failures are never retried; silent runs
+are escalated per policy.
+"""
+
+import os
+import signal
+import time
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.chaos import chaos, fail_first, fail_nth
+from mlrun_tpu.common.retry import (
+    FailureClass,
+    classify_failure,
+    compute_backoff,
+    resolve_retry_policy,
+)
+from mlrun_tpu.model import RunObject
+
+from . import fake_k8s
+
+pytestmark = pytest.mark.chaos
+
+
+# -- unit: classifier + policy ----------------------------------------------
+
+def test_classifier_user_code_vs_infra():
+    # in-run process reported a terminal error → permanent
+    assert classify_failure(
+        run_error="ValueError: bad hyperparameter",
+        run_reported_terminal=True) == FailureClass.user_code
+    # resource died before the run could report → infra, refined by text
+    assert classify_failure(probe_error="(404) jobsets/train-x") == \
+        FailureClass.resource_vanished
+    assert classify_failure(reason="Evicted") == FailureClass.preemption
+    assert classify_failure(reason="ImagePullBackOff") == \
+        FailureClass.image_pull_backoff
+    assert classify_failure(run_error="node drain in progress") == \
+        FailureClass.node_drain
+    assert classify_failure(probe_error="HTTP 503 service unavailable") == \
+        FailureClass.http_5xx
+    assert classify_failure() == FailureClass.infra
+
+
+def test_policy_resolution_and_backoff_determinism():
+    policy = resolve_retry_policy({"max_retries": 3, "backoff": 2.0,
+                                   "backoff_factor": 3.0,
+                                   "backoff_max": 10.0})
+    assert policy.retries_left(2) and not policy.retries_left(3)
+    # exponential with ceiling; jitter is keyed on (seed, attempt) so the
+    # schedule is reproducible
+    d0 = compute_backoff(0, policy, seed="u1")
+    d1 = compute_backoff(1, policy, seed="u1")
+    d2 = compute_backoff(2, policy, seed="u1")
+    assert d0 == compute_backoff(0, policy, seed="u1")
+    assert 2.0 * 0.9 <= d0 <= 2.0 * 1.1
+    assert 6.0 * 0.9 <= d1 <= 6.0 * 1.1
+    assert d2 <= 10.0 * 1.1  # ceiling
+    assert compute_backoff(0, resolve_retry_policy({"backoff": 0}),
+                           seed="u1") == 0.0
+    # spec overlays config defaults; unknown classes pass through retry_on
+    policy = resolve_retry_policy({"retry_on": ["preemption"]})
+    assert policy.retry_on == ("preemption",)
+
+
+def test_retry_policy_schema_validates():
+    from mlrun_tpu.common.schemas import RetryPolicy
+
+    policy = RetryPolicy(max_retries=2, stall_timeout=60, on_stall="resubmit")
+    assert policy.model_dump()["max_retries"] == 2
+    with pytest.raises(Exception):
+        RetryPolicy(on_stall="panic")
+
+
+# -- service-side acceptance tests ------------------------------------------
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    return fake_k8s.install(monkeypatch)
+
+
+@pytest.fixture()
+def db(tmp_path):
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+
+    return SQLiteRunDB(dsn=str(tmp_path / "ft.db"),
+                       logs_dir=str(tmp_path / "logs"))
+
+
+@pytest.fixture()
+def handler(cluster, db):
+    from mlrun_tpu.service.runtime_handlers import (
+        KubernetesProvider,
+        TpuJobHandler,
+    )
+
+    return TpuJobHandler(db, KubernetesProvider(namespace="testns"))
+
+
+def _launch(handler, db, uid="abcd1234efgh", retry_policy=None):
+    fn = mlrun_tpu.new_function("train", kind="tpujob", project="p1")
+    fn.with_tpu_topology("tpu-v5-lite-podslice", "2x4")
+    run = RunObject()
+    run.metadata.uid = uid
+    run.metadata.name = "train"
+    run.metadata.project = "p1"
+    if retry_policy:
+        run.spec.retry_policy = retry_policy
+    db.store_run(run.to_dict(), uid, "p1")
+    handler.run(fn, run)
+    return f"train-{uid[:8]}"
+
+
+def _jobset_env(cluster, name):
+    js = cluster.jobsets[name]
+    containers = js["spec"]["replicatedJobs"][0]["template"]["spec"][
+        "template"]["spec"]["containers"]
+    return {e["name"]: e.get("value") for e in containers[0]["env"]}
+
+
+def test_chaos_killed_tpujob_resumes_from_checkpoint(handler, cluster, db):
+    """Acceptance #1: a chaos-killed TpuJob is resubmitted with
+    retry_count == 1 and resume env pointing at the last saved step."""
+    name = _launch(handler, db,
+                   retry_policy={"max_retries": 2, "backoff": 0})
+    assert name in cluster.jobsets
+    # the in-run process checkpointed at step 420 (execution.log_checkpoint)
+    db.update_run({"status.checkpoint": {"path": "/ckpts/train", "step": 420}},
+                  "abcd1234efgh", "p1")
+    # chaos: the JobSet vanishes (node drain) right as the monitor probes
+    with chaos.inject(
+            "k8s.read", fail_nth(1),
+            action=lambda point, ctx: cluster.kill_jobset(name)):
+        handler.monitor_runs()
+    run = db.read_run("abcd1234efgh", "p1")
+    assert run["status"]["retry_count"] == 1
+    assert run["status"]["state"] == "running"
+    assert run["status"]["failure_class"] == FailureClass.resource_vanished
+    replacement = f"{name}-r1"
+    assert replacement in cluster.jobsets
+    env = _jobset_env(cluster, replacement)
+    assert env["MLT_RESUME_FROM_CHECKPOINT"] == "/ckpts/train"
+    assert env["MLT_RESUME_STEP"] == "420"
+    # the renamed JobSet keeps its name-derived wiring consistent
+    pod_spec = cluster.jobsets[replacement]["spec"]["replicatedJobs"][0][
+        "template"]["spec"]["template"]["spec"]
+    assert pod_spec["subdomain"] == replacement
+    # the monitor now tracks the replacement, not the dead resource
+    assert handler._resources["abcd1234efgh"][0] == \
+        f"jobset/{replacement}"
+
+
+def test_user_code_failure_is_not_resubmitted(handler, cluster, db):
+    """Acceptance #2: a permanent user-code error fails the run once."""
+    name = _launch(handler, db, uid="feed5678cafe",
+                   retry_policy={"max_retries": 2, "backoff": 0})
+    # the in-run process reported the handler exception before the pod died
+    db.update_run({"status.state": "error",
+                   "status.error": "Traceback ...\nValueError: user bug"},
+                  "feed5678cafe", "p1")
+    cluster.set_jobset_conditions(
+        name, [{"type": "Failed", "status": "True"}])
+    handler.monitor_runs()
+    run = db.read_run("feed5678cafe", "p1")
+    assert run["status"]["state"] == "error"
+    assert run["status"].get("retry_count", 0) == 0
+    assert run["status"]["failure_class"] == FailureClass.user_code
+    assert f"{name}-r1" not in cluster.jobsets
+    assert "feed5678cafe" not in handler._resources  # retired
+
+
+def test_exhausted_retries_fail_terminally(handler, cluster, db):
+    """The retry budget is a budget: one allowed retry, then the second
+    infra failure is terminal."""
+    name = _launch(handler, db, uid="0123beef4567",
+                   retry_policy={"max_retries": 1, "backoff": 0})
+    cluster.kill_jobset(name)
+    handler.monitor_runs()
+    run = db.read_run("0123beef4567", "p1")
+    assert run["status"]["retry_count"] == 1
+    cluster.kill_jobset(f"{name}-r1")
+    handler.monitor_runs()
+    run = db.read_run("0123beef4567", "p1")
+    assert run["status"]["state"] == "error"
+    assert run["status"]["retry_count"] == 1  # budget spent, no third try
+    assert f"{name}-r1-r2" not in cluster.jobsets
+
+
+def test_backoff_defers_resubmission(handler, cluster, db):
+    """A non-zero backoff parks the run in pending until the deadline."""
+    name = _launch(handler, db, uid="aaaa1111bbbb",
+                   retry_policy={"max_retries": 1, "backoff": 30.0,
+                                 "jitter": 0.0})
+    cluster.kill_jobset(name)
+    handler.monitor_runs()
+    run = db.read_run("aaaa1111bbbb", "p1")
+    assert run["status"]["state"] == "pending"
+    assert "retry 1/1" in run["status"]["status_text"]
+    assert run["status"].get("retry_count", 0) == 0  # not yet resubmitted
+    assert f"{name}-r1" not in cluster.jobsets
+    handler.monitor_runs()  # still waiting — monitor must not double-fire
+    assert f"{name}-r1" not in cluster.jobsets
+    # deadline passes → the next monitor pass resubmits
+    handler._retry_at["aaaa1111bbbb"] = time.time() - 1
+    handler.monitor_runs()
+    assert f"{name}-r1" in cluster.jobsets
+    assert db.read_run("aaaa1111bbbb", "p1")["status"]["retry_count"] == 1
+
+
+def _age_resource(handler, uid, seconds):
+    """Backdate a resource's start time — a genuinely stalled run has been
+    running a while; the watchdog floors the heartbeat at resource start
+    so fresh (re)submissions get a grace window."""
+    rid, project, started = handler._resources[uid]
+    handler._resources[uid] = (rid, project, started - seconds)
+
+
+def test_stalled_run_is_escalated_per_policy(handler, cluster, db):
+    """Acceptance #3: a heartbeat-silent run is flagged stalled and
+    escalated — resubmit when the policy says so, abort otherwise."""
+    stale = (datetime.now(timezone.utc) - timedelta(seconds=60)).isoformat()
+
+    # on_stall=resubmit with retry budget → replacement JobSet
+    name = _launch(handler, db, uid="dddd2222eeee",
+                   retry_policy={"max_retries": 1, "backoff": 0,
+                                 "stall_timeout": 5.0,
+                                 "on_stall": "resubmit"})
+    db.update_run({"status.last_heartbeat": stale}, "dddd2222eeee", "p1")
+    _age_resource(handler, "dddd2222eeee", 60)
+    handler.monitor_runs()
+    run = db.read_run("dddd2222eeee", "p1")
+    assert run["status"]["retry_count"] == 1
+    assert run["status"]["failure_class"] == FailureClass.stalled
+    assert f"{name}-r1" in cluster.jobsets
+    assert name not in cluster.jobsets  # the hung JobSet was torn down
+
+    # on_stall=abort → terminal aborted with an explanation
+    name2 = _launch(handler, db, uid="9999ffff0000",
+                    retry_policy={"stall_timeout": 5.0, "on_stall": "abort"})
+    db.update_run({"status.last_heartbeat": stale}, "9999ffff0000", "p1")
+    _age_resource(handler, "9999ffff0000", 60)
+    handler.monitor_runs()
+    run = db.read_run("9999ffff0000", "p1")
+    assert run["status"]["state"] == "aborted"
+    assert run["status"]["failure_class"] == FailureClass.stalled
+    assert run["status"]["status_text"].startswith("stalled")
+    assert name2 not in cluster.jobsets
+
+
+def test_healthy_heartbeat_is_not_stalled(handler, cluster, db):
+    _launch(handler, db, uid="121234345656",
+            retry_policy={"stall_timeout": 30.0, "on_stall": "abort"})
+    db.update_run(
+        {"status.last_heartbeat": datetime.now(timezone.utc).isoformat()},
+        "121234345656", "p1")
+    handler.monitor_runs()
+    run = db.read_run("121234345656", "p1")
+    assert run["status"]["state"] == "running"
+
+
+# -- execution ctx heartbeat + checkpoint recording --------------------------
+
+def test_ctx_heartbeat_and_checkpoint_status(rundb_mock):
+    from mlrun_tpu.execution import MLClientCtx
+
+    ctx = MLClientCtx.from_dict(
+        {"metadata": {"name": "t", "uid": "hb-uid", "project": "p"}},
+        rundb=rundb_mock)
+    ctx.log_metrics({"loss": 1.0}, step=1)
+    ctx.log_checkpoint("/ckpts/t", step=7)
+    run = rundb_mock.read_run("hb-uid", "p")
+    assert run["status"]["checkpoint"]["path"] == "/ckpts/t"
+    assert run["status"]["checkpoint"]["step"] == 7
+    assert run["status"]["last_heartbeat"]
+
+
+def test_resume_directive_env_contract(monkeypatch):
+    from mlrun_tpu.training.checkpoint import resume_directive
+
+    assert resume_directive() is None
+    monkeypatch.setenv("MLT_RESUME_FROM_CHECKPOINT", "/ckpts/x")
+    monkeypatch.setenv("MLT_RESUME_STEP", "33")
+    assert resume_directive() == ("/ckpts/x", 33)
+    monkeypatch.setenv("MLT_RESUME_STEP", "not-a-step")
+    assert resume_directive() == ("/ckpts/x", None)
+
+
+# -- PreemptionGuard edge paths (ISSUE satellite) ----------------------------
+
+def test_second_sigterm_restores_sig_dfl_and_reraises(monkeypatch):
+    from mlrun_tpu.training import preemption
+
+    killed = []
+    monkeypatch.setattr(preemption.os, "kill",
+                        lambda pid, sig: killed.append((pid, sig)))
+    previous = signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    guard = preemption.PreemptionGuard()
+    try:
+        guard.install()
+        guard._handle(signal.SIGTERM, None)  # first: latch only
+        assert guard.requested and not killed
+        guard._handle(signal.SIGTERM, None)  # second: escalate
+        # SIG_DFL (an int, not callable) was restored and re-raised so the
+        # default terminate semantics actually apply
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+        assert killed == [(os.getpid(), signal.SIGTERM)]
+    finally:
+        guard.restore()
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_second_sigterm_chains_callable_previous_handler():
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    chained = []
+    previous = signal.signal(
+        signal.SIGTERM, lambda signum, frame: chained.append(signum))
+    guard = PreemptionGuard()
+    try:
+        guard.install()
+        guard._handle(signal.SIGTERM, None)
+        assert chained == []  # first signal only latches
+        guard._handle(signal.SIGTERM, None)
+        assert chained == [signal.SIGTERM]  # supervisor semantics kept
+    finally:
+        guard.restore()
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_agreed_single_process_tracks_local_flag():
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    guard = PreemptionGuard()
+    assert guard.agreed() is False  # process_count() == 1, flag unset
+    guard.request()
+    assert guard.agreed() is True
+
+
+def test_resubmission_survives_service_restart(cluster, db):
+    """A restarted service has no in-memory manifest cache; the monitor
+    rebuilds the retry resource from the function stored in the DB
+    (spec.function uri), so recovery and retry compose."""
+    from mlrun_tpu.service.runtime_handlers import (
+        KubernetesProvider,
+        TpuJobHandler,
+    )
+
+    provider = KubernetesProvider(namespace="testns")
+    handler = TpuJobHandler(db, provider)
+    fn = mlrun_tpu.new_function("train", kind="tpujob", project="p1")
+    fn.with_tpu_topology("tpu-v5-lite-podslice", "2x4")
+    db.store_function(fn.to_dict(), "train", "p1", tag="latest")
+    uid = "cafe0000dead"
+    run = RunObject()
+    run.metadata.uid = uid
+    run.metadata.name = "train"
+    run.metadata.project = "p1"
+    run.spec.function = "p1/train:latest"
+    run.spec.retry_policy = {"max_retries": 1, "backoff": 0}
+    db.store_run(run.to_dict(), uid, "p1")
+    handler.run(fn, run)
+    name = f"train-{uid[:8]}"
+
+    # "restart": fresh handler over the same DB + cluster, no caches
+    handler2 = TpuJobHandler(db, provider)
+    handler2.recover_resources()
+    assert uid in handler2._resources
+    assert not handler2._manifests  # the cache did not survive
+
+    cluster.kill_jobset(name)
+    handler2.monitor_runs()
+    doc = db.read_run(uid, "p1")
+    assert doc["status"]["retry_count"] == 1
+    assert f"{name}-r1" in cluster.jobsets
+
+
+def test_stall_clock_resets_after_resubmission(handler, cluster, db):
+    """The watchdog floors the heartbeat at the replacement's start time —
+    a stale pre-failure heartbeat must not burn the whole retry budget one
+    monitor tick at a time (code-review regression)."""
+    stale = (datetime.now(timezone.utc) - timedelta(seconds=60)).isoformat()
+    name = _launch(handler, db, uid="5151aaaa6262",
+                   retry_policy={"max_retries": 3, "backoff": 0,
+                                 "stall_timeout": 5.0,
+                                 "on_stall": "resubmit"})
+    db.update_run({"status.last_heartbeat": stale}, "5151aaaa6262", "p1")
+    _age_resource(handler, "5151aaaa6262", 60)
+    handler.monitor_runs()
+    assert db.read_run("5151aaaa6262", "p1")["status"]["retry_count"] == 1
+    # the replacement has not heartbeat yet; successive ticks must not
+    # re-stall it against the previous attempt's heartbeat
+    handler.monitor_runs()
+    handler.monitor_runs()
+    run = db.read_run("5151aaaa6262", "p1")
+    assert run["status"]["retry_count"] == 1
+    assert run["status"]["state"] == "running"
+    assert f"{name}-r1" in cluster.jobsets
+    assert f"{name}-r1-r2" not in cluster.jobsets
+
+
+def test_retry_on_typo_is_rejected():
+    from mlrun_tpu.common.schemas import RetryPolicy
+
+    with pytest.raises(Exception, match="Preemption"):
+        RetryPolicy(retry_on=["Preemption"])  # capitalized typo
+    assert RetryPolicy(retry_on=["preemption"]).retry_on == ["preemption"]
+
+
+def test_checkpoint_callback_records_status_checkpoint(rundb_mock, tmp_path):
+    """Periodic saves record status.checkpoint so a HARD-killed run (no
+    deliverable SIGTERM) still resumes (code-review regression)."""
+    import types
+
+    from mlrun_tpu.execution import MLClientCtx
+    from mlrun_tpu.frameworks._common.callbacks import CheckpointCallback
+
+    ctx = MLClientCtx.from_dict(
+        {"metadata": {"name": "t", "uid": "cbuid", "project": "p"}},
+        rundb=rundb_mock)
+
+    class Manager:
+        directory = str(tmp_path / "ckpts")
+
+        def save(self, step, state, force=False):
+            return True
+
+    callback = CheckpointCallback(manager=Manager(), every_steps=2)
+    callback.set_state(
+        context=ctx,
+        trainer=types.SimpleNamespace(state=types.SimpleNamespace(step=4)))
+    callback.on_step_end(1, {"loss": 1.0})
+    run = rundb_mock.read_run("cbuid", "p")
+    assert run["status"]["checkpoint"]["path"] == Manager.directory
+    assert run["status"]["checkpoint"]["step"] == 4
+
+
+def test_transient_probe_blip_does_not_resubmit(handler, cluster, db):
+    """One apiserver blip (non-404) must not be mistaken for a dead
+    resource — a resubmission would race a still-running JobSet
+    (code-review regression)."""
+    name = _launch(handler, db, uid="bbbb7777cccc",
+                   retry_policy={"max_retries": 2, "backoff": 0})
+    with chaos.inject("k8s.read", fail_nth(1),
+                      error=RuntimeError("apiserver timeout")):
+        handler.monitor_runs()
+    run = db.read_run("bbbb7777cccc", "p1")
+    assert run["status"]["state"] == "running"
+    assert run["status"].get("retry_count", 0) == 0
+    assert f"{name}-r1" not in cluster.jobsets
+    # the healthy next tick resets the failure streak: two blips separated
+    # by a good probe never add up to "dead"
+    handler.monitor_runs()
+    assert not handler._probe_failures
+    # but two CONSECUTIVE failures are believed, and the retry engine runs
+    with chaos.inject("k8s.read", fail_first(2),
+                      error=RuntimeError("apiserver down")):
+        handler.monitor_runs()
+        handler.monitor_runs()
+    assert db.read_run("bbbb7777cccc", "p1")["status"]["retry_count"] == 1
+    assert f"{name}-r1" in cluster.jobsets
+
+
+def test_on_stall_resubmit_not_gated_by_retry_on(handler, cluster, db):
+    """on_stall='resubmit' is the explicit directive even when retry_on
+    narrows failure retries to other classes (code-review regression)."""
+    stale = (datetime.now(timezone.utc) - timedelta(seconds=60)).isoformat()
+    name = _launch(handler, db, uid="3434dddd5656",
+                   retry_policy={"max_retries": 1, "backoff": 0,
+                                 "retry_on": ["preemption"],
+                                 "stall_timeout": 5.0,
+                                 "on_stall": "resubmit"})
+    db.update_run({"status.last_heartbeat": stale}, "3434dddd5656", "p1")
+    _age_resource(handler, "3434dddd5656", 60)
+    handler.monitor_runs()
+    run = db.read_run("3434dddd5656", "p1")
+    assert run["status"]["retry_count"] == 1
+    assert f"{name}-r1" in cluster.jobsets
+
+
+def test_completed_run_with_gcd_resource_not_mislabeled(handler, cluster, db):
+    """A run that finished successfully whose JobSet was GC'd before the
+    monitor tick keeps state=completed and gets NO failure_class
+    (code-review regression)."""
+    name = _launch(handler, db, uid="7878eeee9090")
+    db.update_run({"status.state": "completed"}, "7878eeee9090", "p1")
+    cluster.kill_jobset(name)  # TTL GC of the finished resource
+    handler.monitor_runs()
+    run = db.read_run("7878eeee9090", "p1")
+    assert run["status"]["state"] == "completed"
+    assert "failure_class" not in run["status"] \
+        or run["status"]["failure_class"] is None
+
+
+def test_retry_policy_rejects_unknown_keys():
+    from mlrun_tpu.common.schemas import RetryPolicy
+
+    with pytest.raises(Exception, match="max_retrys"):
+        RetryPolicy(**{"max_retrys": 3})  # typo'd key, caught at the door
+
+
+def test_resume_env_constants_shared():
+    from mlrun_tpu.common.runtimes_constants import (
+        RESUME_CHECKPOINT_ENV,
+        RESUME_STEP_ENV,
+    )
+    from mlrun_tpu.service import runtime_handlers
+
+    assert runtime_handlers.RESUME_CHECKPOINT_ENV == RESUME_CHECKPOINT_ENV
+    assert RESUME_CHECKPOINT_ENV == "MLT_RESUME_FROM_CHECKPOINT"
+    assert RESUME_STEP_ENV == "MLT_RESUME_STEP"

@@ -83,3 +83,18 @@ def test_moe_trains_sharded_with_expert_axis(cfg):
         first = first if first is not None else loss
         last = loss
     assert last < first, (first, last)
+
+
+def test_loss_metric_surface_chunk_parity(cfg):
+    """`accuracy` is present and equal in BOTH loss paths so callbacks
+    monitoring it behave identically for loss_chunk=0 and >0 (ISSUE
+    satellite)."""
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 16), dtype=np.int32)
+    targets = rng.integers(0, cfg.vocab_size, (2, 16), dtype=np.int32)
+    _, plain = loss_fn(cfg, params, tokens, targets, loss_chunk=0)
+    _, chunked = loss_fn(cfg, params, tokens, targets, loss_chunk=8)
+    assert "accuracy" in plain and "accuracy" in chunked
+    assert abs(float(plain["accuracy"]) - float(chunked["accuracy"])) < 1e-5
+    assert abs(float(plain["ce_loss"]) - float(chunked["ce_loss"])) < 1e-4

@@ -208,3 +208,44 @@ def test_stdlib_families_roundtrip(tmp_path):
                            for key in ("path", "raw", "tup", "labels")},
                    local=True)
     assert run2.status.results["ok"] == 1
+
+
+def test_unpackaging_instruction_module_allowlist():
+    """Instruction-driven resolution is artifact METADATA, not user code:
+    it may only touch builtins, mlrun_tpu, and already-imported modules —
+    a crafted artifact spec cannot trigger an arbitrary import (ISSUE
+    satellite)."""
+    import sys
+
+    from mlrun_tpu.package.packagers_manager import (
+        PackagersManager,
+        _resolve_type,
+    )
+
+    sys.modules.pop("xmlrpc.client", None)
+    sys.modules.pop("xmlrpc", None)
+    # untrusted: a module this process never imported is refused unloaded
+    assert _resolve_type("xmlrpc.client.ServerProxy", trusted=False) is None
+    assert "xmlrpc" not in sys.modules
+    # builtins and already-imported modules still resolve
+    assert _resolve_type("int", trusted=False) is int
+    import pandas
+
+    assert _resolve_type("pandas.DataFrame", trusted=False) \
+        is pandas.DataFrame
+    # handler-written type hints keep full resolution power
+    resolved = _resolve_type("xmlrpc.client.ServerProxy", trusted=True)
+    assert resolved is not None
+    sys.modules.pop("xmlrpc.client", None)
+    sys.modules.pop("xmlrpc", None)
+
+    # end-to-end: the manager hands the item back instead of importing
+    class Item:
+        kind = "file"
+        meta = {"spec": {"unpackaging_instructions": {
+            "object_type": "xmlrpc.client.ServerProxy",
+            "packager": "Anything"}}}
+
+    item = Item()
+    assert PackagersManager().unpack(item, hint=None) is item
+    assert "xmlrpc" not in sys.modules

@@ -144,3 +144,11 @@ def test_secrets_store_env_prefix_fallback(monkeypatch):
     store = SecretsStore()
     assert store.get("FOO") == "bar"
     assert store.get("MISSING", "dflt") == "dflt"
+
+
+def test_utils_reexports_canonical_get_secret_or_env():
+    """One implementation only (ISSUE satellite): the divergent utils copy
+    inverted precedence and uppercased the key."""
+    from mlrun_tpu import secrets, utils
+
+    assert utils.get_secret_or_env is secrets.get_secret_or_env

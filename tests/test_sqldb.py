@@ -172,3 +172,40 @@ def test_get_run_db_dispatches_sql_scheme(tmp_path, monkeypatch):
     db = dbmod.get_run_db("postgresql://u@h/viaurl", force_reconnect=True)
     assert type(db).__name__ == "SQLServerRunDB"
     dbmod.set_run_db(None)
+
+
+def test_mysql_create_index_failure_handling(tmp_path, monkeypatch):
+    """_execute_ddl suppresses ONLY mysql 1061 (ER_DUP_KEYNAME) for
+    CREATE INDEX; other index failures warn-and-continue instead of
+    silently vanishing, and non-index DDL failures still raise."""
+    from mlrun_tpu.db import sqldb
+
+    monkeypatch.setattr(sqldb.SQLServerRunDB, "_init_schema",
+                        lambda self: None)
+    db = sqldb.SQLServerRunDB("mysql://u:p@h/mlt")
+
+    class DriverError(Exception):
+        pass
+
+    class Cur:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def execute(self, sql):
+            raise self.exc
+
+    # duplicate index on re-init: expected, silent
+    db._execute_ddl(Cur(DriverError(1061, "Duplicate key name 'ix'")),
+                    "CREATE INDEX ix_runs ON runs(uid)")
+    # any OTHER index failure: logged, migration continues
+    db._execute_ddl(Cur(DriverError(1071, "Specified key was too long")),
+                    "CREATE INDEX ix_big ON runs(body)")
+    # non-index DDL failures propagate
+    with pytest.raises(DriverError):
+        db._execute_ddl(Cur(DriverError(1064, "syntax error")),
+                        "CREATE TABLE broken (x TEXT)")
+    # postgres keeps strict behavior even for CREATE INDEX
+    pg = sqldb.SQLServerRunDB("postgresql://u@h/mlt")
+    with pytest.raises(DriverError):
+        pg._execute_ddl(Cur(DriverError("boom")),
+                        "CREATE INDEX ix ON runs(uid)")
