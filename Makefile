@@ -16,7 +16,7 @@ test-fast:       ## skip the slow jax-compile-heavy suites
 	  --ignore=tests/test_checkpoint.py --ignore=tests/test_ops.py \
 	  --ignore=tests/test_llm_engine.py
 
-chaos:           ## fault-injection subset (docs/fault_tolerance.md)
+chaos:           ## fault-injection subset: runs + serving resilience (docs/fault_tolerance.md, docs/serving_resilience.md)
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
 
 native:          ## build the C++ log collector (mlt-logd)

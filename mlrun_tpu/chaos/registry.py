@@ -44,6 +44,17 @@ class FaultPoints:
     httpdb_request = "httpdb.request"
     # in-run context commits — a delay() here models a stalled step
     execution_commit = "execution.commit"
+    # serving-graph step execution (states.py TaskStep/RouterStep.run);
+    # a delay() here models a slow model step, an error a failing one
+    serving_step = "serving.step"
+    # remote-step HTTP attempts (serving/remote.py) — an injected
+    # requests.ConnectionError / HTTPError exercises the retry classifier
+    # and circuit breaker without a live endpoint
+    serving_remote = "serving.remote"
+    # async queue admission (states.py QueueStep.run)
+    serving_queue = "serving.queue"
+    # LLM engine request submission (serving/llm_batch.py submit)
+    llm_submit = "llm.submit"
 
     @staticmethod
     def all() -> list[str]:
@@ -53,6 +64,8 @@ class FaultPoints:
             FaultPoints.provider_state, FaultPoints.provider_delete,
             FaultPoints.datastore_read, FaultPoints.datastore_write,
             FaultPoints.httpdb_request, FaultPoints.execution_commit,
+            FaultPoints.serving_step, FaultPoints.serving_remote,
+            FaultPoints.serving_queue, FaultPoints.llm_submit,
         ]
 
 

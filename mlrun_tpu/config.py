@@ -135,6 +135,11 @@ default_config: dict[str, Any] = {
         "default_batching_timeout_ms": 5,
         "max_batch_size": 8,
         "stream_kind": "inmem",  # inmem | file
+        # serving-path resilience defaults (docs/serving_resilience.md);
+        # per-step knobs in the graph spec override these
+        "resilience": {
+            "drain_timeout_s": 30.0,  # GraphServer.drain bound
+        },
     },
     "model_monitoring": {
         "window_seconds": 60,

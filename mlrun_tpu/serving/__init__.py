@@ -1,4 +1,17 @@
-from .remote import BatchHttpRequests, RemoteStep  # noqa: F401
+from .remote import BatchHttpRequests, RemoteCallError, RemoteStep  # noqa: F401
+from .resilience import (  # noqa: F401
+    AdmissionController,
+    AdmissionRejected,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    DegradationLadder,
+    EngineStoppedError,
+    QueueFullError,
+    ResilienceError,
+    ServerDrainingError,
+    StepResilience,
+)
 from .routers import (  # noqa: F401
     EnrichmentModelRouter,
     EnrichmentVotingEnsemble,

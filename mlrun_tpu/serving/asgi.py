@@ -76,6 +76,29 @@ def build_serving_app(server: GraphServer) -> web.Application:
             "p99_ms": round(lat[int(n * 0.99)] * 1000, 2) if n else None,
         })
 
+    # -- resilience endpoints (docs/serving_resilience.md) -------------------
+    async def healthz(request):
+        # liveness: 200 while the process serves, even mid-drain
+        return web.json_response(server.healthz())
+
+    async def readyz(request):
+        # readiness: flips 503 the moment drain starts so the load
+        # balancer stops routing before in-flight events finish
+        payload = server.readyz()
+        return web.json_response(
+            payload, status=200 if payload["ready"] else 503)
+
+    async def drain(request):
+        # operational drain hook (the preemption path uses
+        # GraphServer.drain_on_preemption instead)
+        loop = asyncio.get_event_loop()
+        drained = await loop.run_in_executor(None, server.drain)
+        return web.json_response({"drained": drained,
+                                  "inflight": server.inflight})
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
+    app.router.add_post("/__drain__", drain)
     app.router.add_get("/__stats__", stats)
     app.router.add_route("*", "/{tail:.*}", handle)
     return app
@@ -116,5 +139,17 @@ def serve(function=None, spec: dict | None = None, host: str = "0.0.0.0",
         server.init_states(GraphContext(server=server), namespace or {})
     else:
         server = server_from_env(namespace)
+    # preemptible replica: SIGTERM latches the guard, the watcher drains
+    # in-flight events and flips /readyz before the grace period ends
+    from ..training.preemption import PreemptionGuard
+
+    guard = PreemptionGuard().install()
+    server.drain_on_preemption(guard)
     logger.info("serving graph gateway starting", host=host, port=port)
-    web.run_app(build_serving_app(server), host=host, port=port, print=None)
+    # handle_signals=False: run_app would otherwise re-register SIGTERM
+    # (loop.add_signal_handler -> GracefulExit) over the guard's handler
+    # and tear the server down before drain ever ran. With the guard
+    # owning SIGTERM, the first signal drains (readyz flips, in-flight
+    # finishes) and the second escalates to the default terminate.
+    web.run_app(build_serving_app(server), host=host, port=port, print=None,
+                handle_signals=False)

@@ -444,7 +444,9 @@ class LLMModelServer:
                          kv_dtype: str = "native", top_k: int = 0,
                          top_p: float = 1.0, paged: bool = False,
                          page_size: int = 128,
-                         n_pages: int | None = None, **kw):
+                         n_pages: int | None = None,
+                         max_queue_size: int = 0, max_wait: float = 0.0,
+                         degradation: dict | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -461,6 +463,11 @@ class LLMModelServer:
                 self.paged = paged
                 self.page_size = page_size
                 self.n_pages = n_pages
+                # overload knobs forwarded to the batching engines
+                # (docs/serving_resilience.md)
+                self.max_queue_size = max_queue_size
+                self.max_wait = max_wait
+                self.degradation = degradation
                 self._tokenizer = None
                 self.engine = None
 
@@ -495,13 +502,19 @@ class LLMModelServer:
                             config, params, max_len=self.max_len,
                             slots=self.slots, kv_dtype=self.kv_dtype,
                             page_size=self.page_size,
-                            n_pages=self.n_pages)
+                            n_pages=self.n_pages,
+                            max_queue_size=self.max_queue_size,
+                            max_wait=self.max_wait,
+                            degradation=self.degradation)
                     else:
                         from .llm_batch import ContinuousBatchingEngine
 
                         self.engine = ContinuousBatchingEngine(
                             config, params, max_len=self.max_len,
-                            slots=self.slots, kv_dtype=self.kv_dtype)
+                            slots=self.slots, kv_dtype=self.kv_dtype,
+                            max_queue_size=self.max_queue_size,
+                            max_wait=self.max_wait,
+                            degradation=self.degradation)
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()

@@ -29,11 +29,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import FaultPoints, fire
 from ..models.llama import LlamaConfig, Params
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope, rope_table
 from ..utils import logger
 from .llm import _cached_attention, _forward_with_cache, init_kv_cache
+from .resilience import (  # noqa: F401 - EngineStoppedError re-exported
+    DeadlineExceeded,
+    DegradationLadder,
+    EngineStoppedError,
+    QueueFullError,
+)
 
 
 def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
@@ -152,12 +159,30 @@ class ContinuousBatchingEngine:
     def __init__(self, config: LlamaConfig, params: Params,
                  max_len: int = 2048, slots: int = 4,
                  prefill_buckets: tuple = (128, 512, 1024),
-                 seed: int = 0, kv_dtype: str = "native"):
+                 seed: int = 0, kv_dtype: str = "native",
+                 max_queue_size: int = 0, max_wait: float = 0.0,
+                 degradation: dict | None = None):
         self.config = config
         self.params = params
         self.max_len = max_len
         self.slots = slots
         self.kv_dtype = kv_dtype
+        # -- overload protection (docs/serving_resilience.md) --------------
+        # max_queue_size: bounded admission queue, reject-newest shedding
+        # (0 = unbounded, the pre-resilience behavior)
+        # max_wait: per-request queue-time budget in seconds (0 = off) —
+        # an overloaded engine fails queued requests fast instead of
+        # hanging their futures until result(timeout=300)
+        if max_queue_size < 0:
+            raise ValueError("max_queue_size must be >= 0")
+        if max_wait < 0:
+            raise ValueError("max_wait must be >= 0")
+        self.max_queue_size = int(max_queue_size)
+        self.max_wait = float(max_wait)
+        self.degradation = DegradationLadder.from_spec(degradation)
+        # flipped by the degradation ladder; speculative decoders consult
+        # it via their gate (serving/speculative.py)
+        self.speculative_enabled = True
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
 
@@ -189,11 +214,19 @@ class ContinuousBatchingEngine:
         self._slot_state = [_Slot() for _ in range(slots)]
         self._queue: queue.Queue = queue.Queue()
         self._running = False
+        self._stopped = False
+        self._crash_exc: Optional[Exception] = None
         self._thread: Optional[threading.Thread] = None
         self._next_id = 0
-        self._lock = threading.Lock()
+        # RLock: the expiry sweep holds it across drain/re-put while the
+        # helpers it calls (stats, budget counter) re-acquire it
+        self._lock = threading.RLock()
+        # queued requests carrying a max_wait budget; the per-tick expiry
+        # sweep is skipped entirely while this is zero
+        self._budgeted = 0
         self._stats = {"requests": 0, "completed": 0, "ttft_sum": 0.0,
-                       "tokens_out": 0}
+                       "tokens_out": 0, "shed": 0, "expired": 0,
+                       "degraded": 0}
 
     def _make_cache(self):
         """Slot KV storage (hook: the paged engine swaps in a page pool)."""
@@ -209,10 +242,21 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     def stop(self):
+        """Stop the scheduler and DRAIN the queue: every request still
+        queued (or mid-generation in a slot) fails promptly with
+        :class:`EngineStoppedError` instead of hanging its future until
+        its own result() timeout."""
         self._running = False
+        self._stopped = True
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        self._fail_pending(EngineStoppedError(
+            "engine stopped while the request was pending"))
+
+    def close(self):
+        """Alias for :meth:`stop` (context-manager friendly name)."""
+        self.stop()
 
     def warmup(self):
         """Compile prefill buckets, decode step, and insertion."""
@@ -244,17 +288,79 @@ class ContinuousBatchingEngine:
                     warmup_s=round(time.perf_counter() - started, 2))
 
     # -- API ----------------------------------------------------------------
+    def _free_page_frac(self) -> Optional[float]:
+        """Paged engines report KV-page headroom; dense engines None."""
+        return None
+
+    def _queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def pressure_level(self) -> int:
+        """Degradation-ladder level: 0 normal, 1 degraded (speculative
+        off + max_new_tokens clamp), 2 shedding (queue full)."""
+        depth = self._queue_depth()
+        if self.max_queue_size and depth >= self.max_queue_size:
+            return 2
+        if self.degradation is not None:
+            return self.degradation.level(depth, self.max_queue_size,
+                                          self._free_page_frac())
+        return 0
+
     def submit(self, prompt_tokens, max_new_tokens: int = 64,
                eos_id: int | None = None, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0) -> Future:
+               top_k: int = 0, top_p: float = 1.0,
+               max_wait: float | None = None) -> Future:
+        """Thread-safe request submission. ``max_wait`` overrides the
+        engine-level queue-time budget for this request. The returned
+        future fails FAST — QueueFullError when shedding,
+        EngineStoppedError after stop/crash — never silently hangs."""
         future: Future = Future()
+        if self._stopped and not self._running:
+            cause = f": {self._crash_exc}" if self._crash_exc else ""
+            future.set_exception(EngineStoppedError(
+                f"engine is stopped, not accepting requests{cause}"))
+            return future
+        fire(FaultPoints.llm_submit, prompt_len=len(prompt_tokens),
+             max_new_tokens=max_new_tokens)
+        level = self.pressure_level()
+        if level >= 2:
+            with self._lock:
+                self._stats["shed"] += 1
+            future.set_exception(QueueFullError(
+                f"engine queue is full (max_queue_size="
+                f"{self.max_queue_size}, depth {self._queue.qsize()}) — "
+                f"shedding"))
+            return future
+        if level >= 1:
+            # degraded: clamp the token budget and park speculative
+            # decoding before we have to start shedding
+            if self.degradation is not None:
+                max_new_tokens = self.degradation.clamp_max_new(
+                    max_new_tokens, level)
+            if self.speculative_enabled:
+                logger.warning("engine degraded: speculative decoding off",
+                               queue_depth=self._queue.qsize())
+            self.speculative_enabled = False
+            with self._lock:
+                self._stats["degraded"] += 1
+        else:
+            self.speculative_enabled = True
+        budget = self.max_wait if max_wait is None else float(max_wait)
+        expires = (time.perf_counter() + budget) if budget > 0 else None
+        # enqueue under the lock: the expiry sweep drains and re-puts the
+        # queue atomically, so a racing put must not land mid-sweep and
+        # jump ahead of older requests
         with self._lock:
             request_id = self._next_id
             self._next_id += 1
             self._stats["requests"] += 1
-        self._queue.put((request_id, list(prompt_tokens), max_new_tokens,
-                         eos_id, future, time.perf_counter(),
-                         (float(temperature), int(top_k), float(top_p))))
+            if expires is not None:
+                self._budgeted += 1
+            self._queue.put((request_id, list(prompt_tokens),
+                             max_new_tokens, eos_id, future,
+                             time.perf_counter(),
+                             (float(temperature), int(top_k), float(top_p)),
+                             expires))
         if not self._running:
             self.start()
         return future
@@ -274,6 +380,9 @@ class ContinuousBatchingEngine:
             out = dict(self._stats)
         if out["completed"]:
             out["ttft_avg_s"] = out["ttft_sum"] / out["completed"]
+        out["queue_depth"] = self._queue_depth()
+        out["pressure_level"] = self.pressure_level()
+        out["speculative_enabled"] = self.speculative_enabled
         return out
 
     # -- scheduler ----------------------------------------------------------
@@ -348,9 +457,12 @@ class ContinuousBatchingEngine:
             return False
         try:
             (request_id, prompt, max_new, eos_id, future,
-             submitted, sampling) = self._queue.get_nowait()
+             submitted, sampling, expires) = self._queue.get_nowait()
         except queue.Empty:
             return False
+        self._consume_budget(expires)
+        if self._request_expired(future, submitted, expires):
+            return True
         prompt_len = len(prompt)
         if prompt_len + max_new > self.max_len:
             future.set_exception(ValueError(
@@ -420,9 +532,54 @@ class ContinuousBatchingEngine:
                     slot.remaining <= 0 or capacity:
                 self._finish(i)
 
+    def _consume_budget(self, expires: float | None):
+        """A budgeted item left the admission queue for good."""
+        if expires is not None:
+            with self._lock:
+                self._budgeted = max(0, self._budgeted - 1)
+
+    def _request_expired(self, future: Future, submitted: float,
+                         expires: float | None) -> bool:
+        """Fail a request whose queue-time budget is spent (fast 504-class
+        failure instead of a future hanging for result(timeout=300))."""
+        if expires is None or time.perf_counter() < expires:
+            return False
+        waited = time.perf_counter() - submitted
+        with self._lock:
+            self._stats["expired"] += 1
+        future.set_exception(DeadlineExceeded(
+            f"request spent {waited:.2f}s queued, over its max_wait "
+            f"budget — engine overloaded"))
+        return True
+
+    def _expire_queued(self):
+        """Sweep the admission queue for requests past their queue-time
+        budget. Runs every scheduler iteration, so even when every slot is
+        busy with long generations the queued requests still fail within
+        one decode tick of their budget. Free when no queued request
+        carries a budget (the default), and atomic vs submit() so the
+        drain/re-put can never reorder a racing newcomer ahead of older
+        requests."""
+        if self._budgeted <= 0 or self._queue.empty():
+            return
+        with self._lock:
+            keep = []
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if self._request_expired(item[4], item[5], item[7]):
+                    self._consume_budget(item[7])
+                else:
+                    keep.append(item)
+            for item in keep:  # FIFO order preserved
+                self._queue.put(item)
+
     def _loop(self):
         try:
             while self._running:
+                self._expire_queued()
                 admitted = True
                 while admitted:
                     admitted = self._admit_one()
@@ -435,11 +592,16 @@ class ContinuousBatchingEngine:
             logger.error("continuous batching scheduler died",
                          error=str(exc))
             self._running = False
+            self._stopped = True
+            self._crash_exc = exc
             self._fail_pending(exc)
 
     def _fail_pending(self, exc: Exception):
+        with self._lock:
+            self._budgeted = 0
         for i, slot in enumerate(self._slot_state):
-            if slot.active and slot.future is not None:
+            if slot.active and slot.future is not None \
+                    and not slot.future.done():
                 slot.future.set_exception(exc)
             self._slot_state[i] = _Slot()
         while True:
@@ -447,4 +609,6 @@ class ContinuousBatchingEngine:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
-            item[4].set_exception(exc)
+            future = item[4]
+            if not future.done():
+                future.set_exception(exc)

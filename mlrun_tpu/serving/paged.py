@@ -232,7 +232,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def __init__(self, config: LlamaConfig, params, max_len: int = 2048,
                  slots: int = 4, prefill_buckets: tuple = (128, 512, 1024),
                  seed: int = 0, kv_dtype: str = "native",
-                 page_size: int = 128, n_pages: int | None = None):
+                 page_size: int = 128, n_pages: int | None = None,
+                 max_queue_size: int = 0, max_wait: float = 0.0,
+                 degradation: dict | None = None):
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -241,9 +243,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.page_size = page_size
         self.pages_per_slot = max_len // page_size
         self.n_pages = n_pages or slots * self.pages_per_slot
+        # _pending exists before super().__init__ so _queue_depth /
+        # pressure_level are safe during construction
+        self._pending: deque = deque()
         super().__init__(config, params, max_len=max_len, slots=slots,
                          prefill_buckets=prefill_buckets, seed=seed,
-                         kv_dtype=kv_dtype)
+                         kv_dtype=kv_dtype, max_queue_size=max_queue_size,
+                         max_wait=max_wait, degradation=degradation)
         # +1 physical page: the scratch page for masked writes
         self._pool = init_paged_pool(config, self.n_pages + 1, page_size,
                                      kv_dtype)
@@ -252,7 +258,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._pos = np.zeros((slots,), np.int32)
         self._free_pages: deque = deque(range(self.n_pages))
         self._slot_pages: dict[int, list] = {}
-        self._pending: deque = deque()
         self._decode_paged = jax.jit(
             functools.partial(_decode_rowwise_paged, config, page_size),
             donate_argnums=(2,))
@@ -291,6 +296,27 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     pages=self.n_pages, page_size=self.page_size,
                     warmup_s=round(time.perf_counter() - started, 2))
 
+    # -- resilience: page-pool pressure + pending-deque expiry ---------------
+    def _free_page_frac(self) -> float:
+        """KV-page headroom — the degradation ladder degrades (speculative
+        off, max_new clamp) before admission would start blocking on an
+        exhausted pool."""
+        if not self.n_pages:
+            return 1.0
+        return len(self._free_pages) / self.n_pages
+
+    def _queue_depth(self) -> int:
+        return self._queue.qsize() + len(self._pending)
+
+    def _expire_queued(self):
+        super()._expire_queued()
+        # head-of-line requests parked waiting for pages also carry a
+        # queue-time budget
+        while self._pending and self._request_expired(
+                self._pending[0][4], self._pending[0][5],
+                self._pending[0][7]):
+            self._pending.popleft()
+
     # -- admission with page reservation ------------------------------------
     def _admit_one(self) -> bool:
         free = next((i for i, s in enumerate(self._slot_state)
@@ -299,11 +325,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             return False
         if not self._pending:
             try:
-                self._pending.append(self._queue.get_nowait())
+                item = self._queue.get_nowait()
             except queue.Empty:
                 return False
+            # the item left the admission queue; the head-of-line sweep in
+            # _expire_queued tracks it from here
+            self._consume_budget(item[7])
+            self._pending.append(item)
         (request_id, prompt, max_new, eos_id, future, submitted,
-         sampling) = self._pending[0]
+         sampling, expires) = self._pending[0]
+        if self._request_expired(future, submitted, expires):
+            self._pending.popleft()
+            return True
         prompt_len = len(prompt)
         if prompt_len + max_new > self.max_len:
             self._pending.popleft()
@@ -336,6 +369,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._activate_slot(free, request_id, first_token, max_new, eos_id,
                             future, submitted, prompt_len, sampling)
         return True
+
+    def _fail_pending(self, exc: Exception):
+        # head-of-line requests parked in the pending deque must fail
+        # with everything else on stop/crash
+        while self._pending:
+            future = self._pending.popleft()[4]
+            if not future.done():
+                future.set_exception(exc)
+        super()._fail_pending(exc)
 
     def _release_slot_storage(self, index: int):
         for pid in self._slot_pages.pop(index, []):

@@ -1,14 +1,66 @@
 """Remote http steps (reference analog: mlrun/serving/remote.py:39 RemoteStep,
-:241 BatchHttpRequests)."""
+:241 BatchHttpRequests).
+
+Resilience semantics (docs/serving_resilience.md):
+
+- retries apply ONLY to retryable failures — connection errors, timeouts,
+  429 and 5xx responses. Other 4xx responses are the caller's bug and
+  fail immediately instead of hammering the endpoint in a tight loop.
+- backoff between attempts is exponential with deterministic jitter
+  (``common/retry.py compute_backoff`` keyed on step+event), so a chaos
+  test's retry timeline is reproducible.
+- the raised :class:`RemoteCallError` preserves the original exception as
+  ``__cause__`` and carries ``status_code``, instead of flattening
+  everything to ``RuntimeError(str)``.
+- the per-attempt HTTP timeout is clamped to the event's remaining
+  deadline budget (``X-MLT-Timeout`` propagation — serving/resilience.py).
+- ``chaos`` hook: every attempt fires ``serving.remote`` first, so tests
+  inject connection errors / HTTP statuses without a live endpoint.
+"""
 
 from __future__ import annotations
 
 import concurrent.futures
-import json
+import time
 from typing import Optional
 
+from ..chaos import FaultPoints, fire
+from ..common.retry import RetryPolicy, compute_backoff
 from ..utils import logger
 from ..utils.safe_eval import safe_eval
+from .resilience import DeadlineExceeded, deadline_remaining
+
+# patch point for tests (deterministic backoff assertions without sleeping)
+_sleep = time.sleep
+
+
+class RemoteCallError(RuntimeError):
+    """A remote step exhausted its retries (or hit a permanent failure).
+    ``status_code`` is the last HTTP status (None for transport errors);
+    the original exception is chained as ``__cause__``."""
+
+    def __init__(self, message: str, status_code: int | None = None):
+        super().__init__(message)
+        self.status_code = status_code
+
+
+def _failure_status(exc: Exception) -> Optional[int]:
+    response = getattr(exc, "response", None)
+    return getattr(response, "status_code", None)
+
+
+def _is_retryable(exc: Exception) -> bool:
+    """Connection errors, timeouts, 429 and 5xx are transient; any other
+    HTTP error (401, 404, 422, ...) is permanent."""
+    import requests
+
+    if isinstance(exc, requests.exceptions.HTTPError):
+        status = _failure_status(exc)
+        return status is not None and (status == 429 or status >= 500)
+    if isinstance(exc, (requests.exceptions.ConnectionError,
+                        requests.exceptions.Timeout)):
+        return True
+    return False
 
 
 class RemoteStep:
@@ -18,7 +70,9 @@ class RemoteStep:
                  subpath: str = "", method: str = "POST",
                  headers: dict | None = None, return_json: bool = True,
                  timeout: int = 30, retries: int = 2, url_expression: str = "",
-                 body_expression: str = "", **kwargs):
+                 body_expression: str = "", backoff: float = 0.2,
+                 backoff_factor: float = 2.0, backoff_max: float = 10.0,
+                 **kwargs):
         self.context = context
         self.name = name
         self.url = url
@@ -30,6 +84,9 @@ class RemoteStep:
         self.retries = retries
         self.url_expression = url_expression
         self.body_expression = body_expression
+        self._retry_policy = RetryPolicy(
+            max_retries=retries, backoff=backoff,
+            backoff_factor=backoff_factor, backoff_max=backoff_max)
 
     def post_init(self, mode: str = "sync"):
         pass
@@ -41,6 +98,49 @@ class RemoteStep:
         if self.subpath:
             url += "/" + self.subpath.lstrip("/")
         return url
+
+    def _clamped_timeout(self, event) -> float:
+        """HTTP timeout clamped to the event's remaining deadline budget —
+        a remote call must never outlive the request it serves."""
+        remaining = deadline_remaining(event)
+        if remaining is None:
+            return self.timeout
+        if remaining <= 0:
+            raise DeadlineExceeded(
+                f"remote step '{self.name}' has no deadline budget left")
+        return min(self.timeout, remaining)
+
+    def _call_with_retries(self, call, event, item_id: str = ""):
+        """Shared attempt loop: classify, back off (deterministic jitter
+        keyed on step+event+item), preserve the original failure."""
+        last_exc: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                fire(FaultPoints.serving_remote, step=self.name,
+                     attempt=attempt, event=event)
+                return call(self._clamped_timeout(event))
+            except DeadlineExceeded:
+                raise
+            except Exception as exc:  # noqa: BLE001 - classified below
+                last_exc = exc
+                if not _is_retryable(exc) or attempt >= self.retries:
+                    break
+                delay = compute_backoff(
+                    attempt, self._retry_policy,
+                    seed=f"{self.name}:{getattr(event, 'id', '')}:{item_id}")
+                remaining = deadline_remaining(event)
+                if remaining is not None and delay >= remaining:
+                    break  # no budget for another attempt
+                logger.warning("remote step retrying", step=self.name,
+                               attempt=attempt + 1, delay=round(delay, 3),
+                               error=str(exc))
+                if delay > 0:
+                    _sleep(delay)
+        status = _failure_status(last_exc)
+        raise RemoteCallError(
+            f"remote step {self.name} failed: "
+            f"{type(last_exc).__name__}: {last_exc}",
+            status_code=status) from last_exc
 
     def do_event(self, event):
         import requests
@@ -55,22 +155,27 @@ class RemoteStep:
                 kwargs["json"] = body
             else:
                 kwargs["data"] = body
-        last_exc = None
-        for _ in range(self.retries + 1):
-            try:
-                resp = requests.request(
-                    self.method.upper(), url, headers=self.headers,
-                    timeout=self.timeout, **kwargs)
-                resp.raise_for_status()
-                event.body = resp.json() if self.return_json else resp.content
-                return event
-            except Exception as exc:  # noqa: BLE001 - retried
-                last_exc = exc
-        raise RuntimeError(f"remote step {self.name} failed: {last_exc}")
+
+        def call(timeout):
+            resp = requests.request(self.method.upper(), url,
+                                    headers=self.headers, timeout=timeout,
+                                    **kwargs)
+            resp.raise_for_status()
+            return resp.json() if self.return_json else resp.content
+
+        event.body = self._call_with_retries(call, event)
+        return event
 
 
 class BatchHttpRequests(RemoteStep):
-    """Issue one request per list item concurrently (reference remote.py:241)."""
+    """Issue one request per list item concurrently (reference remote.py:241).
+
+    Per-item isolation: one failing item no longer aborts the whole batch
+    and loses every other result — each item resolves independently to a
+    ``{"result": ...}`` or ``{"error": ..., "status_code": ...}`` envelope
+    (order preserved), and each item gets the parent class's full
+    retry/backoff treatment.
+    """
 
     def __init__(self, *args, max_in_flight: int = 8, **kwargs):
         super().__init__(*args, **kwargs)
@@ -82,15 +187,32 @@ class BatchHttpRequests(RemoteStep):
         items = event.body if isinstance(event.body, list) else [event.body]
         url = self._resolve_url(event)
 
-        def call(item):
-            resp = requests.request(
-                self.method.upper(), url, headers=self.headers,
-                timeout=self.timeout,
-                json=item if isinstance(item, (dict, list)) else None)
-            resp.raise_for_status()
-            return resp.json() if self.return_json else resp.content
+        def call_item(index_item):
+            index, item = index_item
+
+            def call(timeout):
+                resp = requests.request(
+                    self.method.upper(), url, headers=self.headers,
+                    timeout=timeout,
+                    json=item if isinstance(item, (dict, list)) else None)
+                resp.raise_for_status()
+                return resp.json() if self.return_json else resp.content
+
+            try:
+                return {"result": self._call_with_retries(
+                    call, event, item_id=str(index))}
+            except DeadlineExceeded:
+                # not a per-item failure: the whole request's budget is
+                # spent — propagate so the server answers with a fast 504
+                raise
+            except Exception as exc:  # noqa: BLE001 - per-item envelope
+                envelope = {"error": str(exc)}
+                status = getattr(exc, "status_code", None)
+                if status is not None:
+                    envelope["status_code"] = status
+                return envelope
 
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.max_in_flight) as pool:
-            event.body = list(pool.map(call, items))
+            event.body = list(pool.map(call_item, enumerate(items)))
         return event

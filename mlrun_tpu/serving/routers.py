@@ -10,6 +10,7 @@ from typing import Optional, Union
 import numpy as np
 
 from ..utils import logger
+from .resilience import check_deadline
 
 
 class BaseModelRouter:
@@ -78,6 +79,8 @@ class BaseModelRouter:
         if model not in self.routes:
             raise ValueError(
                 f"model '{model}' not found in routes {list(self.routes)}")
+        # an expired request must not reach the model at all
+        check_deadline(event, f"{self.name}/{model}")
         return self.routes[model].run(event)
 
 
@@ -103,6 +106,9 @@ class ParallelRun(BaseModelRouter):
 
     def do_event(self, event, *args, **kwargs):
         event = self.parse_event(event)
+        # fan-out multiplies the cost of serving an expired request by
+        # len(routes) — check the budget once before dispatching anywhere
+        check_deadline(event, self.name)
         results = {}
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=max(1, len(self.routes))) as pool:
@@ -170,6 +176,7 @@ class VotingEnsemble(BaseModelRouter):
             event.body = {"models": list(self.routes.keys()),
                           "router": self.name}
             return event
+        check_deadline(event, self.name)
         predictions = {}
         for name, step in self.routes.items():
             sub = copy.copy(event)

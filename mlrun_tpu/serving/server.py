@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
+import time
 import traceback
 import uuid
 from typing import Any, Optional, Union
@@ -20,6 +22,12 @@ from ..config import mlconf
 from ..model import ModelObj
 from ..secrets import SecretsStore
 from ..utils import logger, now_iso
+from .resilience import (
+    ResilienceError,
+    ServerDrainingError,
+    deadline_from_headers,
+    deadline_remaining,
+)
 from .states import FlowStep, RootFlowStep, RouterStep, graph_root_setter
 
 
@@ -27,7 +35,8 @@ class MockEvent:
     """Event object used offline and by the ASGI adapter (server.py:437)."""
 
     def __init__(self, body=None, content_type=None, headers=None, method=None,
-                 path=None, event_id=None, trigger=None, error=None):
+                 path=None, event_id=None, trigger=None, error=None,
+                 deadline: float | None = None):
         self.id = event_id or uuid.uuid4().hex
         self.key = ""
         self.body = body
@@ -38,6 +47,9 @@ class MockEvent:
         self.path = path or "/"
         self.trigger = trigger
         self.error = error
+        # absolute deadline on the time.monotonic() timebase; steps check
+        # the remaining budget before executing (serving/resilience.py)
+        self.deadline = deadline
 
     def __str__(self):
         return f"Event(id={self.id}, path={self.path}, body={self.body})"
@@ -76,6 +88,13 @@ class GraphContext:
         self._secrets = SecretsStore()
         self.is_mock = False
         self.monitoring_stream = None
+        # resilience observability: breaker trips, sheds, rejections
+        self.metrics: dict[str, int] = {}
+        self._metrics_lock = threading.Lock()
+
+    def incr(self, name: str, value: int = 1):
+        with self._metrics_lock:
+            self.metrics[name] = self.metrics.get(name, 0) + value
 
     def get_param(self, key: str, default=None):
         if self.server and self.server.parameters:
@@ -133,6 +152,11 @@ class GraphServer(ModelObj):
         self.default_content_type = default_content_type
         self._namespace = {}
         self._current_function = None
+        # serving-path resilience state (not serialized)
+        self._inflight = 0
+        self._state_lock = threading.Lock()
+        self._draining = False
+        self.step_errors: dict[str, int] = {}
 
     @property
     def graph(self) -> Union[RootFlowStep, RouterStep]:
@@ -181,10 +205,37 @@ class GraphServer(ModelObj):
                                self.load_mode)
 
     def run(self, event: MockEvent, context=None, get_body: bool = False):
-        """Process one event through the graph (reference server.py:252)."""
+        """Process one event through the graph (reference server.py:252).
+
+        Resilience semantics: a draining replica rejects with 503 before
+        touching the graph; a deadline/timeout header becomes an absolute
+        event deadline every step checks; resilience rejections
+        (429/503/504 — see serving/resilience.py) come back as fast
+        typed responses, not 500s with tracebacks.
+        """
         server_context = self.context
+        if self._draining:
+            self._incr_metric("server.draining_rejected")
+            exc = ServerDrainingError("server is draining, not admitting "
+                                      "new events")
+            return Response(body={"error": str(exc)},
+                            status_code=exc.status_code)
+        if getattr(event, "deadline", None) is None:
+            event.deadline = deadline_from_headers(
+                getattr(event, "headers", None))
+        with self._state_lock:
+            self._inflight += 1
         try:
             response = self.graph.run(event)
+        except ResilienceError as exc:
+            # fast failure: typed status, compact log, no traceback spam
+            self._incr_metric(
+                f"server.{type(exc).__name__}")
+            logger.warning("serving resilience rejection",
+                           error=str(exc), kind=type(exc).__name__,
+                           event_id=getattr(event, "id", None))
+            return Response(body={"error": str(exc)},
+                            status_code=exc.status_code)
         except Exception as exc:  # noqa: BLE001
             message = f"{exc}\n{traceback.format_exc()}"
             if server_context:
@@ -194,7 +245,13 @@ class GraphServer(ModelObj):
 
                 get_stream_pusher(self.error_stream).push(
                     {"error": str(exc), "event": str(event.body)})
-            return Response(body={"error": str(exc)}, status_code=500)
+            status = getattr(exc, "status_code", None)
+            if not isinstance(status, int) or status < 400:
+                status = 500
+            return Response(body={"error": str(exc)}, status_code=status)
+        finally:
+            with self._state_lock:
+                self._inflight -= 1
         if isinstance(response, MockEvent):
             body = response.body
             if get_body:
@@ -222,6 +279,77 @@ class GraphServer(ModelObj):
         """Drain async branches (flow engine)."""
         if self.graph and hasattr(self.graph, "_flush"):
             self.graph._flush()
+
+    # -- resilience: health / readiness / drain ------------------------------
+    def _incr_metric(self, name: str, value: int = 1):
+        if isinstance(self.context, GraphContext):
+            self.context.incr(name, value)
+
+    def record_step_error(self, step: str):
+        """Async-branch error counter (QueueStep workers report here so
+        tier-1 tests can assert on swallowed-exception counts)."""
+        with self._state_lock:
+            self.step_errors[step] = self.step_errors.get(step, 0) + 1
+
+    @property
+    def inflight(self) -> int:
+        with self._state_lock:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def healthz(self) -> dict:
+        """Liveness: the process serves, even while draining."""
+        return {"status": "ok", "inflight": self.inflight,
+                "draining": self._draining}
+
+    def readyz(self) -> dict:
+        """Readiness: flips false the moment drain starts so the load
+        balancer stops routing before in-flight events finish."""
+        ready = (self.graph is not None and self.context is not None
+                 and not self._draining)
+        return {"ready": ready, "draining": self._draining,
+                "inflight": self.inflight}
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: stop admission (readyz → not ready), wait for
+        in-flight events, then flush async queue branches — all bounded by
+        ``timeout``. Returns True when everything completed in time.
+
+        Wired to the preemption signal via ``drain_on_preemption``: a
+        preempted serving replica finishes its in-flight requests inside
+        the eviction grace period instead of dropping them.
+        """
+        if timeout is None:
+            resilience_conf = getattr(mlconf.serving, "resilience", None)
+            timeout = float(getattr(resilience_conf, "drain_timeout_s",
+                                    30.0))
+        self._draining = True
+        logger.info("serving drain started", inflight=self.inflight,
+                    timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.inflight == 0:
+                break
+            time.sleep(0.005)
+        drained = self.inflight == 0
+        if self.graph is not None and hasattr(self.graph, "_flush"):
+            remaining = max(0.0, deadline - time.monotonic())
+            drained = self.graph._flush(remaining) and drained
+        logger.info("serving drain finished", drained=drained,
+                    inflight=self.inflight)
+        return drained
+
+    def drain_on_preemption(self, guard, timeout: float | None = None):
+        """Arm a watcher that drains this server when the
+        ``PreemptionGuard`` latches (SIGTERM on a preemptible slice). The
+        watcher blocks on the guard's event — no polling — so readyz
+        flips not-ready well before the guard's second-signal escalation
+        fires. Returns the watcher thread."""
+        return guard.on_preempted(lambda: self.drain(timeout),
+                                  name="serving-drain-on-preemption")
 
 
 def create_graph_server(parameters=None, load_mode=None, graph=None,

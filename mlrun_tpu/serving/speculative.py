@@ -49,6 +49,7 @@ class SpecStats:
     accepted: int = 0
     tokens: int = 0
     elapsed_s: float = 0.0
+    fallback_rounds: int = 0  # rounds decoded target-only (gate closed)
 
     @property
     def acceptance_rate(self) -> float:
@@ -63,6 +64,7 @@ class SpecStats:
                 "accepted": self.accepted, "tokens": self.tokens,
                 "acceptance_rate": round(self.acceptance_rate, 4),
                 "tokens_per_round": round(self.tokens_per_round, 3),
+                "fallback_rounds": self.fallback_rounds,
                 "elapsed_s": round(self.elapsed_s, 4)}
 
 
@@ -77,9 +79,15 @@ class SpeculativeDecoder:
     def __init__(self, target_config: LlamaConfig, target_params: Params,
                  draft_config: LlamaConfig, draft_params: Params,
                  k: int = 4, max_len: int = 2048,
-                 kv_dtype: str = "native"):
+                 kv_dtype: str = "native", gate=None):
         if target_config.vocab_size != draft_config.vocab_size:
             raise ValueError("draft and target must share a vocabulary")
+        # degradation-ladder hook: a callable consulted every round; when it
+        # returns False the round decodes ONE token target-only (exact
+        # greedy, same stream) instead of running draft+verify. Wire an
+        # engine's flag: gate=lambda: engine.speculative_enabled
+        self.enabled = True
+        self.gate = gate
         self.target_config = target_config
         self.target_params = target_params
         self.draft_config = draft_config
@@ -113,6 +121,18 @@ class SpeculativeDecoder:
         self._draft_propose = jax.jit(draft_propose)
         self._target_verify = jax.jit(target_verify)
 
+    def _speculation_allowed(self) -> bool:
+        if not self.enabled:
+            return False
+        if self.gate is not None:
+            try:
+                return bool(self.gate())
+            except Exception as exc:  # noqa: BLE001 - a broken gate must
+                # not take decoding down; fall back to full speculation
+                logger.warning("speculative gate failed, assuming enabled",
+                               error=str(exc))
+        return True
+
     def _prefill(self, params, config, tokens):
         cache = init_kv_cache(config, 1, self.max_len,
                               kv_dtype=self.kv_dtype)
@@ -139,6 +159,24 @@ class SpeculativeDecoder:
 
         while len(out) < max_new_tokens and (
                 eos_id is None or out[-1] != eos_id):
+            if not self._speculation_allowed():
+                # degraded mode (engine under pressure): decode ONE token
+                # target-only. Exact same greedy stream, no draft compute;
+                # both caches stay in sync so speculation can resume the
+                # moment the gate reopens.
+                t_logits, t_cache = _forward_with_cache(
+                    self.target_config, self.target_params,
+                    last[:, None], t_cache)
+                _, d_cache = _forward_with_cache(
+                    self.draft_config, self.draft_params,
+                    last[:, None], d_cache)
+                nxt = int(jax.device_get(
+                    jnp.argmax(t_logits, axis=-1))[0])
+                out.append(nxt)
+                stats.rounds += 1
+                stats.fallback_rounds += 1
+                last = jnp.asarray([out[-1]], jnp.int32)
+                continue
             proposals, d_cache = self._draft_propose(
                 self.draft_params, last, d_cache)
             verified, t_cache = self._target_verify(

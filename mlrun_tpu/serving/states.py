@@ -16,8 +16,16 @@ import inspect
 import traceback
 from typing import Any, Callable, Optional, Union
 
+from ..chaos import FaultPoints, fire
 from ..model import ModelObj
 from ..utils import get_in, logger, update_in
+from .resilience import (
+    DeadlineExceeded,
+    QueueFullError,
+    StepResilience,
+    check_deadline,
+    validate_resilience_spec,
+)
 
 callable_prefix = "_"
 path_splitter = "/"
@@ -64,7 +72,8 @@ class BaseStep(ModelObj):
     kind = "BaseStep"
     _dict_fields = ["kind", "name", "class_name", "class_args", "handler",
                     "after", "function", "comment", "shape", "full_event",
-                    "input_path", "result_path", "on_error", "responder"]
+                    "input_path", "result_path", "on_error", "responder",
+                    "resilience"]
 
     def __init__(self, name: str | None = None, after: list | None = None,
                  shape: str | None = None):
@@ -81,6 +90,8 @@ class BaseStep(ModelObj):
         self.result_path = None
         self.on_error = None
         self.responder = False
+        self.resilience = None
+        self._resilience: Optional[StepResilience] = None
         self._parent: Optional["FlowStep"] = None
         self._next: list[str] = []
 
@@ -98,6 +109,26 @@ class BaseStep(ModelObj):
     def error_handler(self, name: str):
         self.on_error = name
         return self
+
+    def with_resilience(self, circuit_breaker: dict | None = None,
+                        admission: dict | None = None):
+        """Attach an admission controller and/or circuit breaker to this
+        step (validated at graph init — see serving/resilience.py)."""
+        spec = {}
+        if circuit_breaker is not None:
+            spec["circuit_breaker"] = circuit_breaker
+        if admission is not None:
+            spec["admission"] = admission
+        validate_resilience_spec(spec, self.name or "")
+        self.resilience = spec or None
+        return self
+
+    def _init_resilience(self, clock=None):
+        try:
+            self._resilience = StepResilience.from_spec(
+                self.resilience, name=self.name or "", clock=clock)
+        except ValueError as exc:
+            raise GraphError(str(exc)) from exc
 
     def respond(self):
         self.responder = True
@@ -173,6 +204,7 @@ class TaskStep(BaseStep):
 
     def init_object(self, context, namespace: dict, mode: str = "sync"):
         self.context = context
+        self._init_resilience()
         if self.class_name or self._class_object:
             cls = self._class_object or get_class(self.class_name, namespace)
             # NOTE: no deepcopy — routers receive live route step objects
@@ -203,6 +235,14 @@ class TaskStep(BaseStep):
     def run(self, event, *args, **kwargs):
         if self._handler_fn is None:
             raise GraphError(f"step '{self.name}' was not initialized")
+        check_deadline(event, self.name)
+        fire(FaultPoints.serving_step, step=self.name, event=event)
+        if self._resilience is not None:
+            return self._resilience.call(lambda: self._execute(event),
+                                         context=self.context)
+        return self._execute(event)
+
+    def _execute(self, event):
         needs_event = self.full_event or getattr(
             self._object, "_needs_event", False) or (
             self._object is not None
@@ -266,8 +306,16 @@ class RouterStep(TaskStep):
             route.init_object(context, namespace, mode)
 
     def run(self, event, *args, **kwargs):
-        result = self._handler_fn(event)
-        return result if result is not None else event
+        check_deadline(event, self.name)
+        fire(FaultPoints.serving_step, step=self.name, event=event)
+
+        def _dispatch():
+            result = self._handler_fn(event)
+            return result if result is not None else event
+
+        if self._resilience is not None:
+            return self._resilience.call(_dispatch, context=self.context)
+        return _dispatch()
 
 
 class QueueStep(BaseStep):
@@ -276,22 +324,44 @@ class QueueStep(BaseStep):
     process consume asynchronously via the flow engine."""
 
     kind = "queue"
-    _dict_fields = BaseStep._dict_fields + ["path", "shards", "retention_in_hours"]
+    _dict_fields = BaseStep._dict_fields + [
+        "path", "shards", "retention_in_hours", "max_queue_size", "max_wait"]
 
     def __init__(self, name=None, path: str = "", after=None, shards=None,
-                 retention_in_hours=None, **options):
+                 retention_in_hours=None, max_queue_size: int | None = None,
+                 max_wait: float | None = None, **options):
         super().__init__(name, after)
         self.path = path
         self.shards = shards
         self.retention_in_hours = retention_in_hours
+        self.max_queue_size = max_queue_size
+        self.max_wait = max_wait
         self.options = options
         self._stream = None
         self._queue = None
         self._workers = None
         self._pending = 0
         self._lock = None
+        self._shed = 0
+        self._errors = 0
+
+    def _validate_bounds(self):
+        if self.max_queue_size is not None:
+            if not isinstance(self.max_queue_size, int) \
+                    or self.max_queue_size <= 0:
+                raise GraphError(
+                    f"queue '{self.name}': max_queue_size must be a "
+                    f"positive int, got {self.max_queue_size!r}")
+        if self.max_wait is not None:
+            if not isinstance(self.max_wait, (int, float)) \
+                    or self.max_wait <= 0:
+                raise GraphError(
+                    f"queue '{self.name}': max_wait must be a positive "
+                    f"number of seconds, got {self.max_wait!r}")
 
     def init_object(self, context, namespace, mode="sync"):
+        self._validate_bounds()
+        self.context = context
         if self.path:
             from .streams import get_stream_pusher
 
@@ -312,30 +382,98 @@ class QueueStep(BaseStep):
     def _consume(self):
         """Worker loop: pop events, run the downstream subgraph
         (the storey async-flow replacement, reference states.py:1622-1710)."""
-        while True:
-            event = self._queue.get()
-            try:
-                self._parent._run_downstream(self, event)
-            except Exception as exc:  # noqa: BLE001 - async branch errors log
-                from ..utils import logger
+        import time as time_mod
 
-                logger.error("async queue branch failed", step=self.name,
-                             error=str(exc))
+        while True:
+            event, enqueued = self._queue.get()
+            try:
+                waited = time_mod.monotonic() - enqueued
+                if self.max_wait is not None and waited > self.max_wait:
+                    # queue-time budget spent: shed instead of burning
+                    # TPU time on a request the caller has given up on
+                    self._record_shed("max_wait", waited=round(waited, 3))
+                    continue
+                try:
+                    check_deadline(event, self.name)
+                except DeadlineExceeded:
+                    self._record_shed("deadline", waited=round(waited, 3))
+                    continue
+                self._parent._run_downstream(self, event)
+            except Exception as exc:  # noqa: BLE001 - async branch errors
+                self._handle_async_error(event, exc)
             finally:
                 with self._lock:
                     self._pending -= 1
                 self._queue.task_done()
+
+    def _record_shed(self, reason: str, **fields):
+        self._shed += 1
+        logger.warning("queue shed event", step=self.name, reason=reason,
+                       shed_total=self._shed, **fields)
+        incr = getattr(self.context, "incr", None)
+        if callable(incr):
+            incr(f"queue.{self.name}.shed")
+
+    def _handle_async_error(self, event, exc: Exception):
+        """Async-branch failure: count it on the server, surface it in
+        metrics, and route through ``on_error`` when one is set (the old
+        behavior logged and swallowed, hiding every async failure)."""
+        self._errors += 1
+        server = getattr(self.context, "server", None)
+        if server is not None and hasattr(server, "record_step_error"):
+            server.record_step_error(self.name)
+        incr = getattr(self.context, "incr", None)
+        if callable(incr):
+            incr(f"queue.{self.name}.errors")
+        handler = None
+        if self.on_error and self._parent is not None:
+            handler = self._parent._steps.get(self.on_error)
+        if handler is not None:
+            error_event = copy.copy(event)
+            error_event.error = str(exc)
+            try:
+                handler.run(error_event)
+                return
+            except Exception as handler_exc:  # noqa: BLE001
+                logger.error("queue on_error handler failed",
+                             step=self.name, handler=self.on_error,
+                             error=str(handler_exc))
+        logger.error("async queue branch failed", step=self.name,
+                     error=str(exc))
 
     def run(self, event, *args, **kwargs):
         if self._stream is not None:
             body = event.body if not self.full_event else event.__dict__
             self._stream.push(body)
         if self._queue is not None:
+            import time as time_mod
+
+            check_deadline(event, self.name)
+            fire(FaultPoints.serving_queue, step=self.name, event=event)
+            if self.max_queue_size is not None \
+                    and self._queue.qsize() >= self.max_queue_size:
+                # reject-newest load shedding: answer in microseconds
+                # instead of growing an unbounded backlog
+                self._record_shed("queue_full",
+                                  max_queue_size=self.max_queue_size)
+                raise QueueFullError(
+                    f"queue '{self.name}' is full "
+                    f"(max_queue_size={self.max_queue_size})")
             with self._lock:
                 self._pending += 1
-            self._queue.put(copy.deepcopy(event))
+            self._queue.put((copy.deepcopy(event), time_mod.monotonic()))
             return None  # downstream continues on a worker thread
         return event
+
+    @property
+    def shed_count(self) -> int:
+        """Events shed by this queue (full / max_wait / deadline)."""
+        return self._shed
+
+    @property
+    def error_count(self) -> int:
+        """Async branch errors observed below this queue."""
+        return self._errors
 
     def wait_empty(self, timeout: float = 30.0) -> bool:
         """Drain; True when empty, False on timeout (callers must not treat
@@ -430,7 +568,8 @@ class FlowStep(BaseStep):
     def add_step(self, class_name=None, name=None, handler=None,
                  model_path: str | None = None, after=None, function=None,
                  full_event=None, input_path=None, result_path=None,
-                 graph_shape=None, **class_args) -> BaseStep:
+                 graph_shape=None, resilience: dict | None = None,
+                 **class_args) -> BaseStep:
         if class_name == "$queue" or (isinstance(class_name, str)
                                       and class_name == "queue"):
             step = QueueStep(name=name, path=class_args.pop("path", ""),
@@ -452,6 +591,9 @@ class FlowStep(BaseStep):
         step.name = step.name or f"step{len(self._steps)}"
         if after:
             step.after = [a if isinstance(a, str) else a.name for a in after]
+        if resilience:
+            validate_resilience_spec(resilience, step.name)
+            step.resilience = resilience
         step.set_parent(self)
         self._steps[step.name] = step
         return step
@@ -519,6 +661,10 @@ class FlowStep(BaseStep):
             step, current = queue.pop(0)
             try:
                 result = step.run(current)
+            except DeadlineExceeded:
+                # no budget left — a fallback handler would still miss the
+                # deadline, so always propagate as a fast 504
+                raise
             except Exception as exc:  # noqa: BLE001 - route to error handler
                 if step.on_error and step.on_error in self._steps:
                     error_event = copy.copy(current)
@@ -550,6 +696,8 @@ class FlowStep(BaseStep):
             step, current = queue.pop(0)
             try:
                 result = step.run(current)
+            except DeadlineExceeded:
+                raise
             except Exception as exc:  # noqa: BLE001
                 if step.on_error and step.on_error in self._steps:
                     error_event = copy.copy(current)

@@ -86,6 +86,30 @@ class PreemptionGuard:
         """Programmatic preemption (tests / external watchers)."""
         self._event.set()
 
+    def on_preempted(self, callback, name: str = "preemption-watcher",
+                     timeout: float | None = None) -> threading.Thread:
+        """Run ``callback`` once when the preemption latch sets.
+
+        The watcher thread blocks on the latch event (no polling), so the
+        callback fires on the FIRST signal — before the second-signal
+        escalation in ``_handle`` can ever run. Serving replicas use this
+        to drain in-flight requests inside the eviction grace period
+        (``GraphServer.drain_on_preemption``). Returns the (daemon)
+        watcher thread."""
+
+        def _wait():
+            if not self._event.wait(timeout):
+                return
+            try:
+                callback()
+            except Exception as exc:  # noqa: BLE001 - a crashing handler
+                # must not take the watcher (and the process teardown) down
+                logger.error("preemption callback failed", error=str(exc))
+
+        thread = threading.Thread(target=_wait, daemon=True, name=name)
+        thread.start()
+        return thread
+
     @property
     def requested(self) -> bool:
         return self._event.is_set()

@@ -1,0 +1,791 @@
+"""Serving-path resilience (serving/resilience.py + the wiring through
+states/server/remote/llm_batch/paged/speculative).
+
+Everything here is deterministic: breakers and admission run against fake
+clocks, remote calls are chaos-injected or stubbed (no sockets), queue
+tests synchronize on threading.Events, and engine overload tests never
+touch the device (the scheduler is pinned "busy" by patching admission).
+No sleep exceeds 1s.
+"""
+
+import threading
+import time
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.chaos import FaultPoints, chaos, fail_first
+from mlrun_tpu.serving import GraphServer, MockEvent, Response
+from mlrun_tpu.serving.remote import BatchHttpRequests, RemoteCallError, RemoteStep
+from mlrun_tpu.serving.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    DegradationLadder,
+    EngineStoppedError,
+    QueueFullError,
+    check_deadline,
+    deadline_from_headers,
+)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float):
+        self.now += seconds
+
+
+# -- circuit breaker state machine -------------------------------------------
+
+def test_breaker_opens_on_consecutive_failures_and_recovers():
+    clock = FakeClock()
+    breaker = CircuitBreaker(name="dep", failure_threshold=3,
+                             recovery_timeout=10.0, clock=clock)
+    for _ in range(3):
+        breaker.allow()
+        breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()
+    # recovery window elapses -> half-open admits ONE probe
+    clock.advance(10.0)
+    breaker.allow()
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()  # second concurrent probe rejected
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    breaker.allow()  # fully recovered
+
+
+def test_breaker_halfopen_failure_reopens():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_timeout=5.0,
+                             clock=clock)
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    clock.advance(5.0)
+    breaker.allow()
+    breaker.record_failure()  # probe failed
+    assert breaker.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()  # a fresh recovery window started
+    assert breaker.opened_total == 2
+
+
+def test_breaker_failure_rate_trip_needs_full_window():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=100,
+                             failure_rate_threshold=0.5, window=4,
+                             clock=clock)
+    breaker.record_failure()  # 1/1 failures but window not full yet
+    assert breaker.state == CircuitBreaker.CLOSED
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_success()  # window full at 2/4 = 0.5 >= 0.5 BUT last
+    # outcome was a success; rate is evaluated on failures only
+    assert breaker.state == CircuitBreaker.CLOSED
+    breaker.record_failure()  # 3/4 failing
+    assert breaker.state == CircuitBreaker.OPEN
+
+
+def test_breaker_spec_validation():
+    with pytest.raises(ValueError, match="failure_rate_threshold"):
+        CircuitBreaker(failure_rate_threshold=1.5)
+    with pytest.raises(ValueError, match="failure_threshold"):
+        CircuitBreaker(failure_threshold=0)
+
+
+# -- admission control -------------------------------------------------------
+
+def test_admission_token_bucket_refills_on_fake_clock():
+    clock = FakeClock()
+    adm = AdmissionController(rate=2.0, burst=2, clock=clock)
+    assert adm.try_acquire() and adm.try_acquire()
+    assert not adm.try_acquire()  # bucket empty
+    clock.advance(0.5)  # refills one token at 2/s
+    assert adm.try_acquire()
+    assert adm.rejected == 1
+
+
+def test_admission_sub_unit_rate_still_admits():
+    """rate < 1 rps must not starve: the bucket floor is one whole token
+    (a rate=0.5 limiter admits a request every 2s, not never)."""
+    clock = FakeClock()
+    adm = AdmissionController(rate=0.5, clock=clock)
+    assert adm.try_acquire()       # first token available immediately
+    assert not adm.try_acquire()
+    clock.advance(2.0)             # refills one token at 0.5/s
+    assert adm.try_acquire()
+
+
+def test_admission_concurrency_ceiling():
+    adm = AdmissionController(max_concurrent=2)
+    assert adm.try_acquire() and adm.try_acquire()
+    assert not adm.try_acquire()
+    adm.release()
+    assert adm.try_acquire()
+
+
+# -- deadline propagation ----------------------------------------------------
+
+def test_deadline_from_headers_and_check():
+    clock = FakeClock()
+    deadline = deadline_from_headers({"X-MLT-Timeout": "1.5"}, clock=clock)
+    assert deadline == pytest.approx(1001.5)
+    # malformed values are ignored, not 500s
+    assert deadline_from_headers({"x-mlt-timeout": "soon"},
+                                 clock=clock) is None
+    event = MockEvent(body={}, deadline=clock() + 1.0)
+    check_deadline(event, "s", clock=clock)  # within budget
+    clock.advance(2.0)
+    with pytest.raises(DeadlineExceeded):
+        check_deadline(event, "s", clock=clock)
+
+
+@pytest.mark.chaos
+def test_deadline_expires_mid_graph_returns_504():
+    """A slow first step (chaos delay) burns the budget; the SECOND step's
+    pre-execution check rejects with a 504 instead of running."""
+    ran = []
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="slow", handler=lambda x: x) \
+         .to(name="after", handler=lambda x: ran.append(x) or x).respond()
+    server = fn.to_mock_server()
+    with chaos.inject(FaultPoints.serving_step, delay=0.05,
+                      match=lambda ctx: ctx.get("step") == "slow"):
+        out = server.test(body=1, headers={"X-MLT-Timeout": "0.01"},
+                          silent=True, get_body=False)
+    assert isinstance(out, Response) and out.status_code == 504
+    assert ran == []  # the downstream step never burned compute
+    assert server.context.metrics.get("server.DeadlineExceeded") == 1
+
+
+def test_router_rejects_expired_event_before_model():
+    from mlrun_tpu.serving import V2ModelServer
+
+    ran = []
+
+    class Model(V2ModelServer):
+        def load(self):
+            self.model = True
+
+        def predict(self, request):
+            ran.append(request)
+            return request["inputs"]
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    fn.set_topology("router")
+    fn.add_model("m1", class_name=Model, model_path="")
+    server = fn.to_mock_server()
+    out = server.test("/v2/models/m1/infer", body={"inputs": [1]},
+                      headers={"X-MLT-Timeout": "-1"}, silent=True,
+                      get_body=False)
+    assert isinstance(out, Response) and out.status_code == 504
+    assert ran == []  # the model never ran
+
+
+def test_deadline_expired_on_arrival_rejected_before_any_step():
+    ran = []
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="a", handler=lambda x: ran.append(x) or x).respond()
+    server = fn.to_mock_server()
+    out = server.test(body=1, headers={"X-MLT-Timeout": "-1"},
+                      silent=True, get_body=False)
+    assert isinstance(out, Response) and out.status_code == 504
+    assert ran == []
+
+
+# -- breaker-wrapped RemoteStep against chaos-injected failures --------------
+
+def _fake_response(payload=None, status=200):
+    class _Resp:
+        status_code = status
+
+        def raise_for_status(self):
+            if status >= 400:
+                import requests
+
+                raise requests.exceptions.HTTPError(
+                    f"{status} error", response=self)
+
+        def json(self):
+            return payload if payload is not None else {"ok": True}
+
+        @property
+        def content(self):
+            return b"ok"
+
+    return _Resp()
+
+
+@pytest.mark.chaos
+def test_breaker_stops_calling_failed_endpoint_and_recovers(monkeypatch):
+    """Acceptance scenario: with chaos-injected dependency failures a
+    breaker-wrapped RemoteStep stops calling the endpoint after the
+    threshold, then recovers through a half-open probe."""
+    import requests
+
+    monkeypatch.setattr(requests, "request",
+                        lambda *a, **k: _fake_response({"ok": True}))
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    step = graph.to(
+        class_name=RemoteStep, name="dep", url="http://dep.local",
+        retries=0).respond()
+    step.with_resilience(circuit_breaker={"failure_threshold": 2,
+                                          "recovery_timeout": 30.0})
+    server = fn.to_mock_server()
+
+    injection = chaos.inject(
+        FaultPoints.serving_remote,
+        error=requests.exceptions.ConnectionError("injected refusal"),
+        match=lambda ctx: ctx.get("step") == "dep")
+    try:
+        for _ in range(2):
+            out = server.test(body={"q": 1}, silent=True, get_body=False)
+            assert out.status_code == 500  # real failures pass through
+        assert injection.calls == 2
+        # breaker now open: NO further calls reach the endpoint
+        for _ in range(3):
+            out = server.test(body={"q": 1}, silent=True, get_body=False)
+            assert out.status_code == 503
+        assert injection.calls == 2
+    finally:
+        injection.remove()
+
+    breaker = server.graph.steps["dep"]._resilience.breaker
+    assert breaker.state == CircuitBreaker.OPEN
+    assert breaker.rejected == 3
+    assert server.context.metrics["step.dep.breaker_rejected"] == 3
+    # recovery window elapses (fault fixed, chaos disarmed): the half-open
+    # probe succeeds and the breaker closes again
+    breaker._opened_at = breaker._clock() - breaker.recovery_timeout - 1
+    out = server.test(body={"q": 1})
+    assert out == {"ok": True}
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_step_admission_rejects_with_429():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="a", handler=lambda x: x,
+             resilience={"admission": {"rate": 0.001, "burst": 2}}).respond()
+    server = fn.to_mock_server()
+    assert server.test(body=1) == 1
+    assert server.test(body=1) == 1
+    out = server.test(body=1, silent=True, get_body=False)
+    assert isinstance(out, Response) and out.status_code == 429
+    assert server.context.metrics["step.a.admission_rejected"] == 1
+
+
+def test_resilience_spec_validation_rejects_unknown_keys():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    with pytest.raises(ValueError, match="unknown resilience keys"):
+        graph.add_step(name="bad", handler=lambda x: x,
+                       resilience={"bogus": {}})
+    with pytest.raises(ValueError, match="unknown circuit_breaker keys"):
+        graph.add_step(name="bad2", handler=lambda x: x,
+                       resilience={"circuit_breaker": {"treshold": 3}})
+    with pytest.raises(ValueError, match="unknown admission keys"):
+        graph.to(name="a", handler=lambda x: x).with_resilience(
+            admission={"rps": 5})
+
+
+def test_resilience_spec_survives_serialization_roundtrip():
+    """Deploy path: the graph spec serializes to a dict (SERVING_SPEC_ENV)
+    and the rebuilt server re-creates the breaker from it."""
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="a", handler="tests.test_serving_resilience.echo_handler",
+             resilience={"circuit_breaker": {"failure_threshold": 7}}) \
+        .respond()
+    spec = fn._get_serving_spec()
+    server = GraphServer.from_dict(spec)
+    from mlrun_tpu.serving.server import GraphContext
+
+    server.init_states(GraphContext(server=server), namespace={})
+    step = server.graph.steps["a"]
+    assert step._resilience.breaker.failure_threshold == 7
+    assert server.test(body=5) == 5
+
+
+def echo_handler(x):
+    return x
+
+
+# -- RemoteStep retry classification + backoff -------------------------------
+
+@pytest.mark.chaos
+def test_remote_retries_connection_errors_then_succeeds(monkeypatch):
+    import requests
+
+    monkeypatch.setattr(requests, "request",
+                        lambda *a, **k: _fake_response({"v": 2}))
+    monkeypatch.setattr("mlrun_tpu.serving.remote._sleep", lambda s: None)
+    step = RemoteStep(name="r", url="http://x", retries=3, backoff=0.01)
+    with chaos.inject(FaultPoints.serving_remote, fail_first(2),
+                      error=requests.exceptions.ConnectionError("refused")) \
+            as injection:
+        event = step.do_event(MockEvent(body={"a": 1}))
+    assert event.body == {"v": 2}
+    assert injection.calls == 3  # 2 failures + 1 success
+
+
+def test_remote_does_not_retry_4xx_and_preserves_cause(monkeypatch):
+    import requests
+
+    calls = []
+
+    def fake_request(*a, **k):
+        calls.append(k)
+        return _fake_response(status=404)
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    step = RemoteStep(name="r", url="http://x", retries=5)
+    with pytest.raises(RemoteCallError) as excinfo:
+        step.do_event(MockEvent(body={"a": 1}))
+    assert len(calls) == 1  # permanent failure: no retry storm
+    assert excinfo.value.status_code == 404
+    assert isinstance(excinfo.value.__cause__,
+                      requests.exceptions.HTTPError)
+
+
+def test_remote_retries_5xx_with_deterministic_backoff(monkeypatch):
+    import requests
+
+    monkeypatch.setattr(requests, "request",
+                        lambda *a, **k: _fake_response(status=503))
+    delays = []
+    monkeypatch.setattr("mlrun_tpu.serving.remote._sleep", delays.append)
+    step = RemoteStep(name="r", url="http://x", retries=2, backoff=0.2)
+    event = MockEvent(body={"a": 1}, event_id="fixed")
+    with pytest.raises(RemoteCallError) as excinfo:
+        step.do_event(event)
+    assert excinfo.value.status_code == 503
+    assert len(delays) == 2
+    # deterministic jitter: same step+event => identical schedule
+    delays2 = []
+    monkeypatch.setattr("mlrun_tpu.serving.remote._sleep", delays2.append)
+    with pytest.raises(RemoteCallError):
+        step.do_event(MockEvent(body={"a": 1}, event_id="fixed"))
+    assert delays == delays2
+    assert delays[1] > delays[0]  # exponential growth
+
+
+def test_remote_clamps_http_timeout_to_deadline(monkeypatch):
+    import requests
+
+    seen = {}
+
+    def fake_request(*a, **k):
+        seen["timeout"] = k["timeout"]
+        return _fake_response()
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    step = RemoteStep(name="r", url="http://x", timeout=30)
+    event = MockEvent(body={"a": 1}, deadline=time.monotonic() + 0.5)
+    step.do_event(event)
+    assert seen["timeout"] <= 0.5  # clamped far below the configured 30s
+    # a spent budget fails before any socket work
+    event = MockEvent(body={"a": 1}, deadline=time.monotonic() - 1)
+    with pytest.raises(DeadlineExceeded):
+        step.do_event(event)
+
+
+def test_batch_http_per_item_envelopes_and_retries(monkeypatch):
+    import requests
+
+    attempts = {}
+
+    def fake_request(method, url, json=None, **k):
+        key = str(json)
+        attempts[key] = attempts.get(key, 0) + 1
+        if isinstance(json, dict) and json.get("boom"):
+            return _fake_response(status=500)
+        return _fake_response({"ok": json["i"]})
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    monkeypatch.setattr("mlrun_tpu.serving.remote._sleep", lambda s: None)
+    step = BatchHttpRequests(name="b", url="http://x", retries=1,
+                             backoff=0.01)
+    event = step.do_event(MockEvent(
+        body=[{"i": 0}, {"boom": True, "i": 1}, {"i": 2}]))
+    # one failing item no longer nukes the whole batch
+    assert event.body[0] == {"result": {"ok": 0}}
+    assert event.body[2] == {"result": {"ok": 2}}
+    assert "error" in event.body[1] and event.body[1]["status_code"] == 500
+    # the failing item got the retry budget (1 retry => 2 attempts)
+    assert attempts[str({"boom": True, "i": 1})] == 2
+
+
+def test_batch_http_expired_deadline_is_fast_504_not_envelopes(monkeypatch):
+    """A spent request budget is not a per-item failure: it propagates as
+    DeadlineExceeded (504) instead of a 200 full of error envelopes."""
+    import requests
+
+    called = []
+    monkeypatch.setattr(requests, "request",
+                        lambda *a, **k: called.append(1) or _fake_response())
+    step = BatchHttpRequests(name="b", url="http://x")
+    event = MockEvent(body=[{"i": 0}, {"i": 1}],
+                      deadline=time.monotonic() - 1)
+    with pytest.raises(DeadlineExceeded):
+        step.do_event(event)
+    assert called == []  # no fan-out for an abandoned request
+
+
+# -- bounded queues + load shedding ------------------------------------------
+
+@pytest.mark.chaos
+def test_queue_sheds_newest_when_full():
+    """With the worker wedged on a slow step, a bounded queue rejects the
+    overflow event with a 429-class error instead of growing forever."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow(x):
+        entered.set()
+        assert release.wait(5)
+        return x
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow", engine="async")
+    graph.to("$queue", name="q", max_queue_size=2, shards=1) \
+         .to(name="work", handler=slow)
+    server = fn.to_mock_server()
+    try:
+        server.test(body=1)          # worker picks this up and blocks
+        assert entered.wait(5)
+        server.test(body=2)          # queued (1/2)
+        server.test(body=3)          # queued (2/2)
+        out = server.test(body=4, silent=True, get_body=False)  # shed
+        assert isinstance(out, Response) and out.status_code == 429
+        queue_step = server.graph.steps["q"]
+        assert queue_step.shed_count == 1
+        assert server.context.metrics["queue.q.shed"] == 1
+    finally:
+        release.set()
+    server.wait_for_completion()
+
+
+def test_queue_max_wait_sheds_stale_events():
+    """Events that out-waited their queue-time budget are dropped at the
+    consumer instead of burning compute on an abandoned request."""
+    release = threading.Event()
+    entered = threading.Event()
+    processed = []
+
+    def slow(x):
+        if not entered.is_set():
+            entered.set()
+            assert release.wait(5)
+        processed.append(x)
+        return x
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow", engine="async")
+    graph.to("$queue", name="q", max_wait=0.02, shards=1) \
+         .to(name="work", handler=slow)
+    server = fn.to_mock_server()
+    server.test(body=1)              # blocks the single worker
+    assert entered.wait(5)
+    server.test(body=2)              # will out-wait its budget
+    time.sleep(0.05)
+    release.set()
+    server.wait_for_completion()
+    assert processed == [1]          # event 2 shed, never executed
+    assert server.graph.steps["q"].shed_count == 1
+
+
+def test_queue_async_error_routes_on_error_and_counts():
+    """Satellite: the async branch used to log-and-swallow; now it routes
+    through the queue's on_error handler and counts on the server."""
+    caught = []
+
+    def boom(x):
+        raise ValueError("async boom")
+
+    def catcher(event):
+        caught.append(event.error)
+        return event
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow", engine="async")
+    queue_step = graph.to("$queue", name="q", shards=1)
+    queue_step.to(name="boom", handler=boom)
+    # catcher sits behind the always-raising step, so the ONLY way it
+    # runs is through the queue's on_error routing
+    graph.add_step(name="catcher", handler=catcher, full_event=True,
+                   after=["boom"])
+    queue_step.error_handler("catcher")
+    server = fn.to_mock_server()
+    server.test(body=7, silent=True)
+    server.wait_for_completion()
+    assert server.step_errors.get("q") == 1
+    assert server.graph.steps["q"].error_count == 1
+    assert len(caught) == 1 and "async boom" in caught[0]
+
+
+def test_sync_error_handler_path_still_routes():
+    """Coverage for the error_handler -> on_error contract on the sync
+    engine (pinning the API the async branch now shares)."""
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    step = graph.to(name="boom",
+                    handler=lambda x: (_ for _ in ()).throw(
+                        ValueError("sync boom")))
+    graph.add_step(name="catcher", handler=lambda e: {"caught": e.error},
+                   full_event=True, after=[])
+    assert step.error_handler("catcher") is step
+    assert step.on_error == "catcher"
+    server = fn.to_mock_server()
+    out = server.test(body=1)
+    assert out == {"caught": "sync boom"}
+
+
+def test_queue_spec_validation():
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow", engine="async")
+    graph.to("$queue", name="q", max_queue_size=-1)
+    with pytest.raises(Exception, match="max_queue_size"):
+        fn.to_mock_server()
+
+
+# -- llm engine: shedding, queue-time budget, stop/crash, degradation --------
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    import jax
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    cfg = tiny_llama(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _busy_engine(tiny_setup, **kwargs):
+    """Engine whose scheduler runs but never admits (every slot 'busy') —
+    overload semantics without touching the device."""
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+
+    cfg, params = tiny_setup
+    engine = ContinuousBatchingEngine(cfg, params, max_len=64, slots=1,
+                                      prefill_buckets=(16,), **kwargs)
+    engine._admit_one = lambda: False
+    return engine
+
+
+@pytest.mark.chaos
+def test_engine_rejects_excess_within_max_wait(tiny_setup):
+    """Acceptance scenario: an overloaded engine fails queued futures
+    within their max_wait budget — nobody waits out result(timeout=300)."""
+    engine = _busy_engine(tiny_setup, max_queue_size=2, max_wait=0.05)
+    try:
+        f1 = engine.submit([1, 2], max_new_tokens=4)
+        f2 = engine.submit([3, 4], max_new_tokens=4)
+        f3 = engine.submit([5, 6], max_new_tokens=4)  # over max_queue_size
+        with pytest.raises(QueueFullError):
+            f3.result(timeout=1)  # shed immediately, not queued
+        started = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            f1.result(timeout=5)  # expired by the scheduler sweep
+        with pytest.raises(DeadlineExceeded):
+            f2.result(timeout=5)
+        assert time.perf_counter() - started < 2.0
+        stats = engine.stats
+        assert stats["shed"] == 1 and stats["expired"] == 2
+    finally:
+        engine.stop()
+
+
+def test_engine_stop_drains_queue_with_engine_stopped_error(tiny_setup):
+    engine = _busy_engine(tiny_setup)
+    f1 = engine.submit([1, 2], max_new_tokens=4)
+    f2 = engine.submit([3, 4], max_new_tokens=4)
+    engine.close()
+    with pytest.raises(EngineStoppedError):
+        f1.result(timeout=1)
+    with pytest.raises(EngineStoppedError):
+        f2.result(timeout=1)
+    # post-stop submissions fail fast too
+    with pytest.raises(EngineStoppedError):
+        engine.submit([5], max_new_tokens=2).result(timeout=1)
+
+
+def test_engine_crash_marks_stopped_for_later_submits(tiny_setup):
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+
+    cfg, params = tiny_setup
+    engine = ContinuousBatchingEngine(cfg, params, max_len=64, slots=1,
+                                      prefill_buckets=(16,))
+
+    def boom():
+        raise RuntimeError("injected scheduler crash")
+
+    engine._expire_queued = boom
+    future = engine.submit([1, 2], max_new_tokens=4)  # auto-starts loop
+    with pytest.raises(RuntimeError, match="injected scheduler crash"):
+        future.result(timeout=5)
+    # the crash cause is carried into later fast-failures
+    with pytest.raises(EngineStoppedError, match="injected scheduler"):
+        engine.submit([3], max_new_tokens=2).result(timeout=1)
+
+
+def test_degradation_ladder_clamps_and_disables_speculative(tiny_setup):
+    engine = _busy_engine(
+        tiny_setup, max_queue_size=8,
+        degradation={"queue_depth": 2, "max_new_tokens": 4})
+    engine.start = lambda: None  # keep the queue inspectable
+    assert engine.speculative_enabled
+    engine.submit([1], max_new_tokens=16)
+    engine.submit([2], max_new_tokens=16)
+    # depth 2 hits the degraded rung: clamp + speculative off
+    engine.submit([3], max_new_tokens=16)
+    assert not engine.speculative_enabled
+    assert engine.pressure_level() == 1
+    items = []
+    while not engine._queue.empty():
+        items.append(engine._queue.get_nowait())
+    assert [item[2] for item in items] == [16, 16, 4]  # last one clamped
+    assert engine.stats["degraded"] == 1
+    # pressure released -> speculation re-enabled
+    engine.submit([4], max_new_tokens=16)
+    assert engine.speculative_enabled
+
+
+def test_degradation_spec_validation():
+    with pytest.raises(ValueError, match="unknown degradation keys"):
+        DegradationLadder.from_spec({"queue_dpth": 3})
+    with pytest.raises(ValueError, match="min_free_page_frac"):
+        DegradationLadder.from_spec({"min_free_page_frac": 2.0})
+
+
+def test_paged_page_exhaustion_degrades(tiny_setup):
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    cfg, params = tiny_setup
+    engine = PagedContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
+        page_size=16, degradation={"min_free_page_frac": 0.5,
+                                   "max_new_tokens": 4})
+    assert engine.pressure_level() == 0
+    # burn pages below the floor: KV-page exhaustion degrades BEFORE
+    # admission starts blocking on the pool
+    while len(engine._free_pages) / engine.n_pages >= 0.5:
+        engine._free_pages.popleft()
+    assert engine._free_page_frac() < 0.5
+    assert engine.pressure_level() == 1
+
+
+# -- degraded speculative decoding -------------------------------------------
+
+def test_speculative_gate_falls_back_to_exact_target_decode():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from mlrun_tpu.models import tiny_llama
+    from mlrun_tpu.models.llama import init_params
+    from mlrun_tpu.serving.llm import _forward_with_cache, init_kv_cache
+    from mlrun_tpu.serving.speculative import SpeculativeDecoder
+
+    cfg = dataclasses.replace(tiny_llama(attention_impl="reference"),
+                              vocab_size=64, tie_embeddings=False)
+    target = init_params(cfg, jax.random.PRNGKey(0))
+    draft = init_params(cfg, jax.random.PRNGKey(1))
+    prompt = [1, 5, 9]
+
+    # plain greedy reference via the same token-by-token forward the
+    # fallback path uses (identical program shape => exact comparison)
+    cache = init_kv_cache(cfg, 1, 128)
+    logits, cache = _forward_with_cache(
+        cfg, target, jnp.asarray([prompt], jnp.int32), cache)
+    reference = [int(jnp.argmax(logits, -1)[0])]
+    while len(reference) < 6:
+        logits, cache = _forward_with_cache(
+            cfg, target, jnp.asarray([[reference[-1]]], jnp.int32), cache)
+        reference.append(int(jnp.argmax(logits, -1)[0]))
+
+    decoder = SpeculativeDecoder(cfg, target, cfg, draft, k=2, max_len=128,
+                                 gate=lambda: False)  # engine degraded
+    tokens_fallback, stats = decoder.generate(prompt, max_new_tokens=6)
+    assert stats.fallback_rounds == stats.rounds > 0
+    assert stats.proposed == 0  # the draft model never proposed
+    # greedy-exactness contract survives degradation
+    assert tokens_fallback == reference
+
+
+# -- health / readiness / graceful drain -------------------------------------
+
+@pytest.mark.chaos
+def test_drain_completes_inflight_and_flips_readyz_before_escalation():
+    """Acceptance scenario: drain() finishes in-flight events and flips
+    /readyz to not-ready on the FIRST preemption signal — i.e. before the
+    PreemptionGuard's second-signal escalation could ever fire."""
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow(x):
+        entered.set()
+        assert release.wait(5)
+        return {"done": x}
+
+    fn = mlrun_tpu.new_function("s", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="work", handler=slow).respond()
+    server = fn.to_mock_server()
+    assert server.readyz()["ready"] and server.healthz()["status"] == "ok"
+
+    guard = PreemptionGuard()  # not installed: signal-free test drive
+    watcher = server.drain_on_preemption(guard, timeout=5)
+
+    result = {}
+    worker = threading.Thread(
+        target=lambda: result.update(out=server.test(body=1)))
+    worker.start()
+    assert entered.wait(5)
+
+    guard.request()  # the preemption SIGTERM latches
+    deadline = time.monotonic() + 2
+    while server.readyz()["ready"] and time.monotonic() < deadline:
+        time.sleep(0.005)
+    ready = server.readyz()
+    assert not ready["ready"] and ready["draining"]
+    assert server.inflight == 1  # in-flight request still being served
+    # load balancer stopped routing: new events get a fast 503
+    rejected = server.run(MockEvent(body=2))
+    assert isinstance(rejected, Response) and rejected.status_code == 503
+
+    release.set()
+    worker.join(timeout=5)
+    watcher.join(timeout=5)
+    assert result["out"] == {"done": 1}  # in-flight event completed
+    assert server.inflight == 0
+    assert not watcher.is_alive()  # drain returned before escalation
+    assert server.healthz()["status"] == "ok"  # alive while draining
+
+
+def test_preemption_callback_runs_once_on_latch():
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    fired = []
+    guard = PreemptionGuard()
+    thread = guard.on_preempted(lambda: fired.append(1))
+    assert not fired
+    guard.request()
+    thread.join(timeout=2)
+    assert fired == [1]
