@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -27,6 +27,9 @@ bench:           ## training benchmark (one JSON line)
 
 bench-serving:   ## serving TTFT benchmark (one JSON line)
 	$(PYTHON) scripts/bench_serving.py
+
+bench-serve:     ## prefix-cache / chunked-prefill microbench, CPU-runnable (one JSON line)
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py
 
 dryrun:          ## multi-chip sharding dryrun on 8 virtual CPU devices
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,155 @@
+"""Serving prefix-cache / chunked-prefill microbench (one JSON line).
+
+CPU-runnable on ``tiny_llama`` — a perf-trajectory datapoint that does
+not depend on the TPU relay. Two workloads against the paged
+continuous-batching engine:
+
+- **repeated**: every prompt shares a long system prefix and differs only
+  in a short suffix (the production-dominant shape). Measures cold vs
+  warm p50 TTFT on the prefix-cache engine, the same workload on a
+  cache-disabled engine, and the hit rate.
+- **unique**: every prompt is random (worst case for the cache). Measures
+  end-to-end throughput with the cache on vs off — reuse must not tax
+  traffic that can't reuse.
+
+Run: python bench_serve.py [--requests N] [--prefix-tokens N] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _percentile(samples, q):
+    # same nearest-rank definition as the engine's stats keys (import is
+    # deferred so --help stays jax-free)
+    from mlrun_tpu.serving.llm_batch import _percentile as engine_pct
+
+    return engine_pct(sorted(samples), q)
+
+
+def _make_engine(config, params, *, prefix_cache, max_len, page_size,
+                 prefill_buckets, warmup=True):
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    engine = PagedContinuousBatchingEngine(
+        config, params, max_len=max_len, slots=4, page_size=page_size,
+        prefill_buckets=prefill_buckets, prefix_cache=prefix_cache)
+    if warmup:
+        engine.warmup()
+    engine.start()
+    return engine
+
+
+def _ttft_series(engine, prompts, max_new):
+    """Serial generation (one request in flight) so each TTFT isolates
+    the prefill path, not queueing behind other requests."""
+    ttfts = []
+    for prompt in prompts:
+        _, stats = engine.generate(prompt, max_new_tokens=max_new)
+        ttfts.append(stats["ttft_s"])
+    return ttfts
+
+
+def _throughput(engine, prompts, max_new):
+    """Concurrent submission; tokens/sec over the whole batch wall time."""
+    started = time.perf_counter()
+    futures = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+    results = [f.result(timeout=600) for f in futures]
+    wall = time.perf_counter() - started
+    generated = sum(len(tokens) for tokens, _ in results)
+    return generated / wall if wall > 0 else 0.0
+
+
+def run(requests: int = 12, prefix_tokens: int = 960,
+        suffix_tokens: int = 8, max_new: int = 16, page_size: int = 32,
+        max_len: int = 1024, seed: int = 0, warmup: bool = True) -> dict:
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(64, max_len), max_len}))
+
+    def prompt_of(length):
+        return rng.integers(0, config.vocab_size, length).tolist()
+
+    prefix = prompt_of(prefix_tokens)
+    repeated = [prefix + prompt_of(suffix_tokens) for _ in range(requests)]
+    unique = [prompt_of(prefix_tokens + suffix_tokens)
+              for _ in range(requests)]
+
+    out = {"requests": requests, "prefix_tokens": prefix_tokens,
+           "suffix_tokens": suffix_tokens, "page_size": page_size,
+           "model": "tiny"}
+
+    # repeated-prefix workload: cache on (cold first, then warm hits)
+    engine = _make_engine(config, params, prefix_cache=True,
+                          max_len=max_len, page_size=page_size,
+                          prefill_buckets=buckets, warmup=warmup)
+    try:
+        ttfts = _ttft_series(engine, repeated, max_new)
+        stats = engine.stats
+    finally:
+        engine.stop()
+    warm_ttfts = ttfts[1:] or ttfts  # --requests 1: no warm samples
+    out["repeated"] = {
+        "cold_ttft_ms": round(ttfts[0] * 1000, 2),
+        "warm_p50_ttft_ms": round(
+            _percentile(warm_ttfts, 0.50) * 1000, 2),
+        "prefix_hit_rate": round(stats["prefix_hit_rate"], 3),
+        "prefix_cached_tokens": stats["prefix_cached_tokens"],
+    }
+
+    # same workload, cache disabled — the baseline p50 the speedup is vs
+    engine = _make_engine(config, params, prefix_cache=False,
+                          max_len=max_len, page_size=page_size,
+                          prefill_buckets=buckets, warmup=warmup)
+    try:
+        base_ttfts = _ttft_series(engine, repeated, max_new)
+    finally:
+        engine.stop()
+    out["repeated"]["nocache_p50_ttft_ms"] = round(
+        _percentile(base_ttfts, 0.50) * 1000, 2)
+    warm = _percentile(warm_ttfts, 0.50)
+    out["repeated"]["p50_ttft_speedup"] = round(
+        _percentile(base_ttfts, 0.50) / warm, 2) if warm > 0 else 0.0
+
+    # unique-prompt workload: throughput must not regress with the cache
+    tps = {}
+    for label, cache_on in (("cache_on", True), ("cache_off", False)):
+        engine = _make_engine(config, params, prefix_cache=cache_on,
+                              max_len=max_len, page_size=page_size,
+                              prefill_buckets=buckets, warmup=warmup)
+        try:
+            tps[label] = round(_throughput(engine, unique, max_new), 1)
+        finally:
+            engine.stop()
+    out["unique"] = {"tokens_per_sec_cache_on": tps["cache_on"],
+                     "tokens_per_sec_cache_off": tps["cache_off"]}
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--prefix-tokens", type=int, default=960)
+    parser.add_argument("--suffix-tokens", type=int, default=8)
+    parser.add_argument("--max-new", type=int, default=16)
+    parser.add_argument("--page-size", type=int, default=32)
+    parser.add_argument("--max-len", type=int, default=1024)
+    args = parser.parse_args(argv)
+    result = run(requests=args.requests, prefix_tokens=args.prefix_tokens,
+                 suffix_tokens=args.suffix_tokens, max_new=args.max_new,
+                 page_size=args.page_size, max_len=args.max_len)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,10 @@ class FaultPoints:
     serving_queue = "serving.queue"
     # LLM engine request submission (serving/llm_batch.py submit)
     llm_submit = "llm.submit"
+    # prefix-cache page eviction (serving/paged.py _reclaim_pages) — fires
+    # per evicted page with page_id/refcount context; an action() here
+    # observes eviction order, an error models a poisoned reclaim
+    llm_prefix_evict = "llm.prefix_evict"
 
     @staticmethod
     def all() -> list[str]:
@@ -66,6 +70,7 @@ class FaultPoints:
             FaultPoints.httpdb_request, FaultPoints.execution_commit,
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
+            FaultPoints.llm_prefix_evict,
         ]
 
 

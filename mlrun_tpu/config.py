@@ -140,6 +140,18 @@ default_config: dict[str, Any] = {
         "resilience": {
             "drain_timeout_s": 30.0,  # GraphServer.drain bound
         },
+        # LLM engine hot-path knobs (docs/serving.md "Prefill & prefix
+        # cache"); engine / LLMModelServer class args override these
+        "llm": {
+            # tokens prefilled per scheduler tick (0 = whole prompt in one
+            # dispatch — a long prompt then stalls running decodes)
+            "prefill_chunk": 0,
+            # paged engine: block-granular prompt KV reuse across requests
+            "prefix_cache": True,
+            # ring-buffer samples behind the p50/p95 TTFT / inter-token
+            # latency percentiles in engine stats
+            "latency_window": 512,
+        },
     },
     "model_monitoring": {
         "window_seconds": 60,

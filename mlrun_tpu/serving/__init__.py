@@ -7,6 +7,7 @@ from .resilience import (  # noqa: F401
     DeadlineExceeded,
     DegradationLadder,
     EngineStoppedError,
+    PromptTooLongError,
     QueueFullError,
     ResilienceError,
     ServerDrainingError,

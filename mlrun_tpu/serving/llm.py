@@ -253,6 +253,12 @@ class LLMEngine:
 
         prompt = np.asarray(prompt_tokens, dtype=np.int32).reshape(1, -1)
         prompt_len = prompt.shape[1]
+        if prompt_len + max_new_tokens > self.max_len:
+            from .resilience import PromptTooLongError
+
+            raise PromptTooLongError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_len {self.max_len}")
         bucket = self._bucket_for(prompt_len)
         padded = np.zeros((self.batch, bucket), np.int32)
         padded[:, :prompt_len] = prompt
@@ -446,7 +452,9 @@ class LLMModelServer:
                          page_size: int = 128,
                          n_pages: int | None = None,
                          max_queue_size: int = 0, max_wait: float = 0.0,
-                         degradation: dict | None = None, **kw):
+                         degradation: dict | None = None,
+                         prefill_chunk: int | None = None,
+                         prefix_cache: bool | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -468,6 +476,10 @@ class LLMModelServer:
                 self.max_queue_size = max_queue_size
                 self.max_wait = max_wait
                 self.degradation = degradation
+                # prefill/prefix-cache knobs (docs/serving.md "Prefill &
+                # prefix cache"); None = mlconf.serving.llm defaults
+                self.prefill_chunk = prefill_chunk
+                self.prefix_cache = prefix_cache
                 self._tokenizer = None
                 self.engine = None
 
@@ -505,7 +517,9 @@ class LLMModelServer:
                             n_pages=self.n_pages,
                             max_queue_size=self.max_queue_size,
                             max_wait=self.max_wait,
-                            degradation=self.degradation)
+                            degradation=self.degradation,
+                            prefill_chunk=self.prefill_chunk,
+                            prefix_cache=self.prefix_cache)
                     else:
                         from .llm_batch import ContinuousBatchingEngine
 
@@ -514,7 +528,8 @@ class LLMModelServer:
                             slots=self.slots, kv_dtype=self.kv_dtype,
                             max_queue_size=self.max_queue_size,
                             max_wait=self.max_wait,
-                            degradation=self.degradation)
+                            degradation=self.degradation,
+                            prefill_chunk=self.prefill_chunk)
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()
@@ -563,6 +578,13 @@ class LLMModelServer:
                         wall = max(s["total_s"] for _, s in results)
                         if wall > 0:
                             self.set_metric("decode_tps", generated / wall)
+                    engine_stats = self.engine.stats
+                    for key in ("ttft_p50_s", "ttft_p95_s", "itl_p50_s",
+                                "itl_p95_s", "prefix_hit_rate",
+                                "prefix_cached_tokens", "prefix_evictions",
+                                "prefill_chunks"):
+                        if key in engine_stats:
+                            self.set_metric(key, engine_stats[key])
                     out_tokens = [tokens for tokens, _ in results]
                 else:
                     out_tokens = []

@@ -18,9 +18,11 @@ behind the <200ms p50 TTFT target under concurrency (BASELINE.md).
 from __future__ import annotations
 
 import functools
+import math
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
@@ -30,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..chaos import FaultPoints, fire
+from ..config import mlconf
 from ..models.llama import LlamaConfig, Params
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope, rope_table
@@ -39,6 +42,7 @@ from .resilience import (  # noqa: F401 - EngineStoppedError re-exported
     DeadlineExceeded,
     DegradationLadder,
     EngineStoppedError,
+    PromptTooLongError,
     QueueFullError,
 )
 
@@ -129,6 +133,42 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
     return next_token, new_cache
 
 
+def _percentile(sorted_samples: list, q: float) -> float:
+    """Nearest-rank percentile (ceil(q*n)-th order statistic) over an
+    already-sorted sample list."""
+    idx = max(0, math.ceil(q * len(sorted_samples)) - 1)
+    return sorted_samples[min(idx, len(sorted_samples) - 1)]
+
+
+@dataclass
+class _Admission:
+    """A request claimed off the queue and being prefilled into a slot.
+
+    With chunked prefill the same admission resumes across scheduler
+    ticks: ``offset`` is the absolute prefill cursor (it starts at
+    ``base`` > 0 on a paged prefix-cache hit, where the cached prefix KV
+    was gathered into ``small`` instead of recomputed)."""
+
+    slot: int
+    request_id: int
+    prompt: list
+    max_new: int
+    eos_id: Optional[int]
+    future: Future
+    submitted: float
+    sampling: tuple
+    expires: Optional[float]
+    small: dict = None
+    base: int = 0
+    offset: int = 0
+    chunks: int = 0
+    first_token: int = -1
+    # paged-engine bookkeeping (unused by the dense engine)
+    page_ids: object = None
+    pages: list = field(default_factory=list)
+    prefix_nodes: list = field(default_factory=list)
+
+
 @dataclass
 class _Slot:
     request_id: int = -1
@@ -161,7 +201,9 @@ class ContinuousBatchingEngine:
                  prefill_buckets: tuple = (128, 512, 1024),
                  seed: int = 0, kv_dtype: str = "native",
                  max_queue_size: int = 0, max_wait: float = 0.0,
-                 degradation: dict | None = None):
+                 degradation: dict | None = None,
+                 prefill_chunk: int | None = None,
+                 latency_window: int | None = None):
         self.config = config
         self.params = params
         self.max_len = max_len
@@ -180,6 +222,27 @@ class ContinuousBatchingEngine:
         self.max_queue_size = int(max_queue_size)
         self.max_wait = float(max_wait)
         self.degradation = DegradationLadder.from_spec(degradation)
+        # -- chunked prefill (docs/serving.md "Prefill & prefix cache") ----
+        # at most prefill_chunk prompt tokens run per scheduler tick, so
+        # admitting a long prompt never freezes inter-token latency for
+        # the slots already decoding; 0 = whole-prompt prefill inline
+        llm_defaults = mlconf.serving.llm
+        if prefill_chunk is None:
+            prefill_chunk = int(llm_defaults.prefill_chunk)
+        if prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
+        self.prefill_chunk = min(int(prefill_chunk), max_len)
+        if latency_window is None:
+            latency_window = int(llm_defaults.latency_window)
+        if latency_window <= 0:
+            raise ValueError("latency_window must be > 0")
+        # bounded rings behind the p50/p95 TTFT / inter-token-latency
+        # percentiles in stats (per-slot ttft alone was discarded)
+        self._ttft_ring: deque = deque(maxlen=latency_window)
+        self._itl_ring: deque = deque(maxlen=latency_window)
+        # the admission being prefilled right now (chunked mode resumes it
+        # across ticks; only ever touched by the scheduler thread)
+        self._admission: Optional[_Admission] = None
         # flipped by the degradation ladder; speculative decoders consult
         # it via their gate (serving/speculative.py)
         self.speculative_enabled = True
@@ -226,7 +289,8 @@ class ContinuousBatchingEngine:
         self._budgeted = 0
         self._stats = {"requests": 0, "completed": 0, "ttft_sum": 0.0,
                        "tokens_out": 0, "shed": 0, "expired": 0,
-                       "degraded": 0}
+                       "degraded": 0, "rejected_too_long": 0,
+                       "prefill_chunks": 0, "prefill_tokens_tick_max": 0}
 
     def _make_cache(self):
         """Slot KV storage (hook: the paged engine swaps in a page pool)."""
@@ -270,6 +334,14 @@ class ContinuousBatchingEngine:
             _, small = self._prefill(self.params,
                                      jnp.zeros((1, 1), jnp.int32), small)
             self._cache = self._insert(self._cache, small, 0, bucket)
+        if self.prefill_chunk and self.prefill_chunk not in \
+                self.prefill_buckets:
+            # chunked prefill dispatches a fixed (1, chunk) shape
+            small = init_kv_cache(self.config, 1, self.max_len,
+                                  kv_dtype=self.kv_dtype)
+            self._prefill(self.params,
+                          jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                          small)
         step = jnp.zeros((self.slots, 1), jnp.int32)
         tok, self._cache = self._decode(self.params, step, self._cache)
         float(jnp.sum(tok))  # host fetch = real sync on the relay
@@ -320,7 +392,17 @@ class ContinuousBatchingEngine:
             future.set_exception(EngineStoppedError(
                 f"engine is stopped, not accepting requests{cause}"))
             return future
-        fire(FaultPoints.llm_submit, prompt_len=len(prompt_tokens),
+        prompt_len = len(prompt_tokens)
+        if prompt_len + max_new_tokens > self.max_len:
+            # 400-class rejection up front — past the largest bucket the
+            # prefill path would otherwise pad/truncate undefined
+            with self._lock:
+                self._stats["rejected_too_long"] += 1
+            future.set_exception(PromptTooLongError(
+                f"prompt_len {prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_len {self.max_len}"))
+            return future
+        fire(FaultPoints.llm_submit, prompt_len=prompt_len,
              max_new_tokens=max_new_tokens)
         level = self.pressure_level()
         if level >= 2:
@@ -378,8 +460,16 @@ class ContinuousBatchingEngine:
     def stats(self) -> dict:
         with self._lock:
             out = dict(self._stats)
+            ttfts = sorted(self._ttft_ring)
+            itls = sorted(self._itl_ring)
         if out["completed"]:
             out["ttft_avg_s"] = out["ttft_sum"] / out["completed"]
+        if ttfts:
+            out["ttft_p50_s"] = _percentile(ttfts, 0.50)
+            out["ttft_p95_s"] = _percentile(ttfts, 0.95)
+        if itls:
+            out["itl_p50_s"] = _percentile(itls, 0.50)
+            out["itl_p95_s"] = _percentile(itls, 0.95)
         out["queue_depth"] = self._queue_depth()
         out["pressure_level"] = self.pressure_level()
         out["speculative_enabled"] = self.speculative_enabled
@@ -392,39 +482,67 @@ class ContinuousBatchingEngine:
                 return bucket
         return self.max_len
 
-    def _prefill_first_token(self, prompt: list, temperature: float,
-                             top_k: int, top_p: float):
-        """Bucketed prefill + (for non-bucket lengths) a last-token replay
-        for the real last-position logits; samples/argmaxes the first
-        generated token. Shared by the dense and paged admission paths.
-        Returns (first_token, small_cache)."""
-        prompt_arr = np.asarray(prompt, np.int32).reshape(1, -1)
-        prompt_len = prompt_arr.shape[1]
-        bucket = self._bucket_for(prompt_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :prompt_len] = prompt_arr
-
-        small = init_kv_cache(self.config, 1, self.max_len,
-                              kv_dtype=self.kv_dtype)
-        logits, small = self._prefill(self.params, jnp.asarray(padded),
-                                      small)
-        if prompt_len != bucket:
-            # bucket padding advanced pos past the prompt; replay the last
-            # real token for its logits (same trick as LLMEngine.generate)
-            small["pos"] = jnp.full((1,), prompt_len - 1, jnp.int32)
-            logits, small = self._prefill(
-                self.params, jnp.asarray(prompt_arr[:, -1:]), small)
+    def _first_token(self, logits, sampling: tuple) -> int:
+        """Sample/argmax the first generated token from last-position
+        logits (shared by the inline and chunked prefill paths)."""
+        temperature, top_k, top_p = sampling
         if temperature > 0:
             from .sampling import sample_logits
 
             self._rng, sub = jax.random.split(self._rng)
-            first_token = int(np.asarray(sample_logits(
+            return int(np.asarray(sample_logits(
                 logits, sub, jnp.full((1,), temperature, jnp.float32),
                 jnp.full((1,), top_k, jnp.int32),
                 jnp.full((1,), top_p, jnp.float32)))[0])
+        return int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+
+    def _run_prefill(self, adm: _Admission,
+                     limit: int | None = None) -> bool:
+        """Advance the admission's prefill by ONE dispatch: up to ``limit``
+        prompt tokens (the whole remaining suffix, bucket-padded, when
+        limit is None). The cursor starts at ``adm.base`` — on a paged
+        prefix-cache hit the cached prefix KV is already in ``adm.small``
+        and only the suffix runs. Returns True once the prompt is fully
+        prefilled and the first token is sampled."""
+        prompt = adm.prompt
+        total = len(prompt)
+        start = adm.offset
+        remaining = total - start
+        cap = self.max_len - start
+        if limit is None:
+            # prefer a warmed bucket shape that still fits the cache tail
+            # (start > 0 after a prefix hit can rule the usual bucket
+            # out); the cap fallback compiles once per distinct tail
+            pad_len = next(
+                (b for b in self.prefill_buckets if remaining <= b <= cap),
+                min(self._bucket_for(remaining), cap))
         else:
-            first_token = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-        return first_token, small
+            pad_len = min(limit, cap)
+        take = min(remaining, pad_len)
+        padded = np.zeros((1, pad_len), np.int32)
+        padded[0, :take] = prompt[start:start + take]
+        adm.small["pos"] = jnp.full((1,), start, jnp.int32)
+        logits, adm.small = self._prefill(self.params, jnp.asarray(padded),
+                                          adm.small)
+        adm.offset += take
+        adm.chunks += 1
+        with self._lock:
+            self._stats["prefill_chunks"] += 1
+            # tick instrumentation: the most prefill compute any single
+            # scheduler iteration absorbed (tests assert <= prefill_chunk)
+            if take > self._stats["prefill_tokens_tick_max"]:
+                self._stats["prefill_tokens_tick_max"] = take
+        if adm.offset < total:
+            return False
+        if take != pad_len:
+            # padding advanced pos past the prompt; replay the last real
+            # token for its logits (same trick as LLMEngine.generate)
+            adm.small["pos"] = jnp.full((1,), total - 1, jnp.int32)
+            logits, adm.small = self._prefill(
+                self.params, jnp.asarray([[prompt[-1]]], dtype=jnp.int32),
+                adm.small)
+        adm.first_token = self._first_token(logits, adm.sampling)
+        return True
 
     def _activate_slot(self, free: int, request_id: int, first_token: int,
                        max_new: int, eos_id, future, submitted: float,
@@ -444,36 +562,118 @@ class ContinuousBatchingEngine:
         slot.temperature = temperature
         slot.top_k = top_k
         slot.top_p = top_p
+        with self._lock:
+            self._ttft_ring.append(slot.ttft)
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
 
-    def _admit_one(self) -> bool:
-        """Prefill one queued request into a free slot (returns True if a
-        request was admitted)."""
+    # -- admission -----------------------------------------------------------
+    def _validate_item(self, item) -> bool:
+        """Expiry + capacity checks on a dequeued request. Returns False
+        (consuming the item) when its future was already failed."""
+        (_, prompt, max_new, _, future, submitted, _, expires) = item
+        if self._request_expired(future, submitted, expires):
+            return False
+        if len(prompt) + max_new > self.max_len:
+            # backstop for requests enqueued before a config change —
+            # submit() already rejects these up front
+            future.set_exception(PromptTooLongError(
+                f"prompt_len {len(prompt)} + max_new_tokens {max_new} "
+                f"exceeds max_len {self.max_len}"))
+            return False
+        return True
+
+    def _prepare_admission(self) -> Optional[_Admission]:
+        """Claim a free slot + the next valid queued request; build the
+        admission (batch=1 prefill cache, cursor at 0). The paged engine
+        overrides this with page reservation + prefix matching."""
         free = next((i for i, s in enumerate(self._slot_state)
                      if not s.active), None)
         if free is None:
+            return None
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return None
+            self._consume_budget(item[7])
+            if not self._validate_item(item):
+                continue
+            (request_id, prompt, max_new, eos_id, future, submitted,
+             sampling, expires) = item
+            try:
+                return _Admission(
+                    slot=free, request_id=request_id, prompt=prompt,
+                    max_new=max_new, eos_id=eos_id, future=future,
+                    submitted=submitted, sampling=sampling,
+                    expires=expires,
+                    small=init_kv_cache(self.config, 1, self.max_len,
+                                        kv_dtype=self.kv_dtype))
+            except Exception as exc:
+                # dequeued but not yet tracked in self._admission — fail
+                # the future before the scheduler dies or it would hang
+                # outside every container _fail_pending drains
+                if not future.done():
+                    future.set_exception(exc)
+                raise
+
+    def _complete_storage(self, adm: _Admission):
+        """Move the prefilled batch=1 cache into slot storage (the paged
+        engine scatters into its page pool instead)."""
+        self._cache = self._insert(self._cache, adm.small, adm.slot,
+                                   len(adm.prompt))
+
+    def _finish_admission(self, adm: _Admission):
+        self._complete_storage(adm)
+        self._activate_slot(adm.slot, adm.request_id, adm.first_token,
+                            adm.max_new, adm.eos_id, adm.future,
+                            adm.submitted, len(adm.prompt), adm.sampling)
+
+    def _abort_admission(self, adm: _Admission):
+        """Release admission-held storage (expiry mid-prefill, stop). The
+        dense engine's batch=1 cache just drops; the paged engine returns
+        pages and prefix refs."""
+
+    def _admit_one(self) -> bool:
+        """Prefill one queued request into a free slot (returns True if a
+        request was admitted). The admission is tracked in
+        ``self._admission`` while prefill runs so a scheduler crash
+        mid-prefill still fails its future (and frees its storage) via
+        ``_fail_pending``."""
+        adm = self._prepare_admission()
+        if adm is None:
             return False
-        try:
-            (request_id, prompt, max_new, eos_id, future,
-             submitted, sampling, expires) = self._queue.get_nowait()
-        except queue.Empty:
-            return False
-        self._consume_budget(expires)
-        if self._request_expired(future, submitted, expires):
-            return True
-        prompt_len = len(prompt)
-        if prompt_len + max_new > self.max_len:
-            future.set_exception(ValueError(
-                f"prompt_len {prompt_len} + max_new_tokens {max_new} "
-                f"exceeds max_len {self.max_len}"))
-            return True
-        first_token, small = self._prefill_first_token(prompt, *sampling)
-        self._cache = self._insert(self._cache, small, free, prompt_len)
-        self._activate_slot(free, request_id, first_token, max_new, eos_id,
-                            future, submitted, prompt_len, sampling)
+        self._admission = adm
+        self._run_prefill(adm, limit=None)
+        self._finish_admission(adm)
+        self._admission = None
         return True
+
+    def _admission_tick(self):
+        """Admission work for one scheduler iteration. With chunked
+        prefill at most ONE <= prefill_chunk dispatch runs per tick, so
+        slots already decoding keep making progress while a long prompt
+        prefills; otherwise admit whole prompts until slots or queue run
+        out (the pre-chunking behavior)."""
+        if not self.prefill_chunk:
+            admitted = True
+            while admitted:
+                admitted = self._admit_one()
+            return
+        adm = self._admission
+        if adm is None:
+            adm = self._prepare_admission()
+            if adm is None:
+                return
+            self._admission = adm
+        # no expiry check here: max_wait is a QUEUE-time budget, spent the
+        # moment the request was dequeued in _prepare_admission — a
+        # mid-prefill admission is being served, not waiting (the
+        # unchunked path behaves the same)
+        if self._run_prefill(adm, limit=self.prefill_chunk):
+            self._finish_admission(adm)
+            self._admission = None
 
     def _finish(self, index: int):
         slot = self._slot_state[index]
@@ -498,10 +698,10 @@ class ContinuousBatchingEngine:
         # (now unused) region
         self._cache["pos"] = self._cache["pos"].at[index].set(0)
 
-    def _decode_tick(self):
+    def _decode_tick(self) -> int:
         active = [i for i, s in enumerate(self._slot_state) if s.active]
         if not active:
-            return
+            return 0
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]
@@ -531,6 +731,7 @@ class ContinuousBatchingEngine:
             if (slot.eos_id is not None and token == slot.eos_id) or \
                     slot.remaining <= 0 or capacity:
                 self._finish(i)
+        return len(active)
 
     def _consume_budget(self, expires: float | None):
         """A budgeted item left the admission queue for good."""
@@ -579,14 +780,21 @@ class ContinuousBatchingEngine:
     def _loop(self):
         try:
             while self._running:
+                # the ITL sample spans the WHOLE iteration (admission
+                # prefill included): an unchunked long-prompt prefill
+                # between two decode ticks IS the inter-token gap clients
+                # see, and the percentiles must show it
+                started = time.perf_counter()
                 self._expire_queued()
-                admitted = True
-                while admitted:
-                    admitted = self._admit_one()
+                self._admission_tick()
                 if not any(s.active for s in self._slot_state):
-                    time.sleep(0.002)  # idle: poll admissions at 2ms
+                    if self._admission is None:
+                        time.sleep(0.002)  # idle: poll admissions at 2ms
                     continue
-                self._decode_tick()
+                if self._decode_tick():
+                    with self._lock:
+                        self._itl_ring.append(
+                            time.perf_counter() - started)
         except Exception as exc:  # noqa: BLE001 - a dead scheduler must
             # fail pending work loudly, not leave futures hanging forever
             logger.error("continuous batching scheduler died",
@@ -597,6 +805,13 @@ class ContinuousBatchingEngine:
             self._fail_pending(exc)
 
     def _fail_pending(self, exc: Exception):
+        adm, self._admission = self._admission, None
+        if adm is not None:
+            # a request parked mid-chunked-prefill fails with everything
+            # else on stop/crash (and returns its storage)
+            if not adm.future.done():
+                adm.future.set_exception(exc)
+            self._abort_admission(adm)
         with self._lock:
             self._budgeted = 0
         for i, slot in enumerate(self._slot_state):

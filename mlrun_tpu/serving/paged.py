@@ -22,6 +22,17 @@ TPU shaping (everything static under jit):
 Pages for prompt + max_new_tokens are reserved at admission, so decode can
 never run out mid-generation (no preemption path needed).
 
+Prefix-aware KV reuse (docs/serving.md "Prefill & prefix cache"): full
+page-size blocks of each prompt are indexed in a radix trie
+(serving/prefix.py) mapping block-chains to page ids with refcounts. On
+admission the longest cached chain is shared read-only into the new
+slot's page table (refcount++) and ONLY the uncached suffix is prefilled
+— the dominant TTFT win on repeated-system-prompt traffic. Refcount-0
+pages stay cached and are evicted LRU (leaf-first) when an allocation
+needs them; eviction fires the ``llm.prefix_evict`` chaos point and the
+evictable pool counts toward ``_free_page_frac`` so the PR 2 degradation
+ladder sees reclaimable headroom, not just the raw free list.
+
 No reference analog: the reference has no inference engine
 (mlrun/serving/v2_serving.py calls user predict()).
 """
@@ -37,10 +48,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import FaultPoints, fire
+from ..config import mlconf
 from ..models.llama import LlamaConfig
 from ..utils import logger
 from .llm import init_kv_cache
-from .llm_batch import ContinuousBatchingEngine
+from .llm_batch import ContinuousBatchingEngine, _Admission
+from .prefix import PrefixCache
 
 
 def init_paged_pool(config: LlamaConfig, n_pages: int, page_size: int,
@@ -89,6 +103,32 @@ def insert_prompt_pages(pool: dict, small: dict, page_ids: jax.Array,
         return out
 
     return jax.lax.fori_loop(0, pages, body, pool)
+
+
+def gather_prefix_pages(pool: dict, small: dict, page_ids: jax.Array,
+                        page_size: int) -> dict:
+    """Inverse of :func:`insert_prompt_pages`: copy cached prefix pages
+    from the pool into a batch=1 slot-cache (``small`` from
+    init_kv_cache), so a suffix-only prefill can attend over the reused
+    prefix KV without recomputing it. Ids < 0 leave the corresponding
+    rows untouched (one compile covers every prefix length)."""
+    pages = page_ids.shape[0]
+
+    def body(p, small_):
+        pid = page_ids[p]
+        out = dict(small_)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            if name not in pool or name not in small_:
+                continue
+            row = pool[name][:, jnp.maximum(pid, 0)]
+            cur = jax.lax.dynamic_slice_in_dim(
+                small_[name][:, 0], p * page_size, page_size, axis=1)
+            row = jnp.where(pid >= 0, row.astype(small_[name].dtype), cur)
+            out[name] = jax.lax.dynamic_update_slice_in_dim(
+                small_[name][:, 0], row, p * page_size, axis=1)[:, None]
+        return out
+
+    return jax.lax.fori_loop(0, pages, body, small)
 
 
 def _write_token_all_layers(pool: dict, k_tok, v_tok, page_table, pos,
@@ -234,7 +274,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  seed: int = 0, kv_dtype: str = "native",
                  page_size: int = 128, n_pages: int | None = None,
                  max_queue_size: int = 0, max_wait: float = 0.0,
-                 degradation: dict | None = None):
+                 degradation: dict | None = None,
+                 prefill_chunk: int | None = None,
+                 latency_window: int | None = None,
+                 prefix_cache: bool | None = None):
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -246,10 +289,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # _pending exists before super().__init__ so _queue_depth /
         # pressure_level are safe during construction
         self._pending: deque = deque()
+        if prefix_cache is None:
+            prefix_cache = bool(mlconf.serving.llm.prefix_cache)
+        self._prefix = PrefixCache(page_size) if prefix_cache else None
+        # trie nodes each slot holds a refcount on (matched + registered)
+        self._slot_prefix_nodes: dict[int, list] = {}
         super().__init__(config, params, max_len=max_len, slots=slots,
                          prefill_buckets=prefill_buckets, seed=seed,
                          kv_dtype=kv_dtype, max_queue_size=max_queue_size,
-                         max_wait=max_wait, degradation=degradation)
+                         max_wait=max_wait, degradation=degradation,
+                         prefill_chunk=prefill_chunk,
+                         latency_window=latency_window)
         # +1 physical page: the scratch page for masked writes
         self._pool = init_paged_pool(config, self.n_pages + 1, page_size,
                                      kv_dtype)
@@ -264,6 +314,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._insert_paged = jax.jit(
             functools.partial(insert_prompt_pages, page_size=page_size),
             donate_argnums=(0,))
+        self._gather_paged = jax.jit(
+            functools.partial(gather_prefix_pages, page_size=page_size),
+            donate_argnums=(1,))
 
     def _make_cache(self):
         return None  # slot KV lives in the page pool
@@ -279,6 +332,21 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             _, small = self._prefill(
                 self.params, jnp.zeros((1, 1), jnp.int32), small)
             self._pool = self._insert_paged(self._pool, small, ids)
+        if self.prefill_chunk and self.prefill_chunk not in \
+                self.prefill_buckets:
+            small = init_kv_cache(self.config, 1, self.max_len,
+                                  kv_dtype=self.kv_dtype)
+            self._prefill(self.params,
+                          jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                          small)
+        if self._prefix is not None:
+            # compile the prefix-page gather (first cache hit must not
+            # pay the compile); all-(-1) ids touch no live page
+            small = init_kv_cache(self.config, 1, self.max_len,
+                                  kv_dtype=self.kv_dtype)
+            self._gather_paged(
+                self._pool, small,
+                jnp.full((self.pages_per_slot,), -1, jnp.int32))
         step = jnp.zeros((self.slots, 1), jnp.int32)
         table = jnp.asarray(self._page_table)
         pos = jnp.asarray(self._pos)
@@ -300,10 +368,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _free_page_frac(self) -> float:
         """KV-page headroom — the degradation ladder degrades (speculative
         off, max_new clamp) before admission would start blocking on an
-        exhausted pool."""
+        exhausted pool. Refcount-0 cached prefix pages are reclaimable on
+        demand, so they count as headroom."""
         if not self.n_pages:
             return 1.0
-        return len(self._free_pages) / self.n_pages
+        free = len(self._free_pages)
+        if self._prefix is not None:
+            free += self._prefix.evictable_pages()
+        return free / self.n_pages
 
     def _queue_depth(self) -> int:
         return self._queue.qsize() + len(self._pending)
@@ -317,58 +389,143 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._pending[0][7]):
             self._pending.popleft()
 
-    # -- admission with page reservation ------------------------------------
-    def _admit_one(self) -> bool:
+    # -- admission: page reservation + prefix reuse -------------------------
+    def _reclaim_pages(self, needed: int):
+        """Evict LRU refcount-0 cached prefix pages until the free list
+        covers ``needed`` pages. Fires the ``llm.prefix_evict`` chaos
+        point per evicted page."""
+        if self._prefix is None or len(self._free_pages) >= needed:
+            return
+
+        def on_evict(node):
+            fire(FaultPoints.llm_prefix_evict, page_id=node.page_id,
+                 refcount=node.refcount, last_used=node.last_used)
+
+        freed = self._prefix.evict(needed - len(self._free_pages),
+                                   on_evict)
+        self._free_pages.extend(freed)
+
+    def _prepare_admission(self) -> _Admission | None:
         free = next((i for i, s in enumerate(self._slot_state)
                      if not s.active), None)
         if free is None:
-            return False
-        if not self._pending:
+            return None
+        while True:
+            if not self._pending:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    return None
+                # the item left the admission queue; the head-of-line
+                # sweep in _expire_queued tracks it from here
+                self._consume_budget(item[7])
+                self._pending.append(item)
+            item = self._pending[0]
+            if not self._validate_item(item):
+                self._pending.popleft()
+                continue
+            (request_id, prompt, max_new, eos_id, future, submitted,
+             sampling, expires) = item
+            prompt_len = len(prompt)
+            needed = -(-(prompt_len + max_new) // self.page_size)
+            if needed > self.n_pages:
+                # would never fit — fail fast instead of blocking the
+                # queue head forever
+                self._pending.popleft()
+                future.set_exception(ValueError(
+                    f"request needs {needed} pages but the pool has only "
+                    f"{self.n_pages}; raise n_pages or lower "
+                    f"max_new_tokens"))
+                continue
+            matched_pages: list = []
+            matched_nodes: list = []
+            if self._prefix is not None:
+                matched_pages, matched_nodes = self._prefix.match(prompt)
+            k = len(matched_pages)
+            fresh_needed = needed - k
+            available = len(self._free_pages)
+            if self._prefix is not None:
+                available += self._prefix.evictable_pages()
+            if available < fresh_needed:
+                # head-of-line waits for pages (in order); drop the match
+                # holds so the cached prefix stays evictable meanwhile
+                if self._prefix is not None:
+                    self._prefix.release(matched_nodes)
+                return None
+            self._pending.popleft()
+            fresh: list = []
             try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                return False
-            # the item left the admission queue; the head-of-line sweep in
-            # _expire_queued tracks it from here
-            self._consume_budget(item[7])
-            self._pending.append(item)
-        (request_id, prompt, max_new, eos_id, future, submitted,
-         sampling, expires) = self._pending[0]
-        if self._request_expired(future, submitted, expires):
-            self._pending.popleft()
-            return True
-        prompt_len = len(prompt)
-        if prompt_len + max_new > self.max_len:
-            self._pending.popleft()
-            future.set_exception(ValueError(
-                f"prompt_len {prompt_len} + max_new_tokens {max_new} "
-                f"exceeds max_len {self.max_len}"))
-            return True
-        needed = -(-(prompt_len + max_new) // self.page_size)
-        if needed > self.n_pages:
-            # would never fit — fail fast instead of blocking the queue
-            # head forever
-            self._pending.popleft()
-            future.set_exception(ValueError(
-                f"request needs {needed} pages but the pool has only "
-                f"{self.n_pages}; raise n_pages or lower max_new_tokens"))
-            return True
-        if len(self._free_pages) < needed:
-            return False  # head-of-line waits for pages (in order)
-        self._pending.popleft()
-        page_ids = [self._free_pages.popleft() for _ in range(needed)]
-        self._slot_pages[free] = page_ids
+                if self._prefix is not None:
+                    self._prefix.queries += 1
+                    if k:
+                        self._prefix.hits += 1
+                        self._prefix.cached_tokens += k * self.page_size
+                self._reclaim_pages(fresh_needed)
+                fresh = [self._free_pages.popleft()
+                         for _ in range(fresh_needed)]
+                ids = np.full((self.pages_per_slot,), -1, np.int32)
+                ids[:k] = matched_pages
+                ids[k:needed] = fresh
+                adm = _Admission(
+                    slot=free, request_id=request_id, prompt=prompt,
+                    max_new=max_new, eos_id=eos_id, future=future,
+                    submitted=submitted, sampling=sampling,
+                    expires=expires,
+                    small=init_kv_cache(self.config, 1, self.max_len,
+                                        kv_dtype=self.kv_dtype),
+                    base=k * self.page_size, offset=k * self.page_size)
+                adm.page_ids = ids
+                adm.pages = fresh
+                adm.prefix_nodes = matched_nodes
+                if k:
+                    # seed the batch=1 cache with the shared prefix KV;
+                    # the suffix-only prefill attends over it from
+                    # pos=base
+                    gather_ids = ids.copy()
+                    gather_ids[k:] = -1
+                    adm.small = self._gather_paged(self._pool, adm.small,
+                                                   jnp.asarray(gather_ids))
+                return adm
+            except Exception as exc:
+                # popped but not yet tracked in self._admission: fail the
+                # future and give back the storage before the scheduler
+                # dies (e.g. an armed llm.prefix_evict error), or the
+                # request would hang outside every drained container
+                self._free_pages.extend(fresh)
+                if self._prefix is not None:
+                    self._prefix.release(matched_nodes)
+                if not future.done():
+                    future.set_exception(exc)
+                raise
 
-        first_token, small = self._prefill_first_token(prompt, *sampling)
-        ids = np.full((self.pages_per_slot,), -1, np.int32)
-        ids[:needed] = page_ids
-        self._pool = self._insert_paged(self._pool, small,
-                                        jnp.asarray(ids))
-        self._page_table[free] = ids
-        self._pos[free] = prompt_len
-        self._activate_slot(free, request_id, first_token, max_new, eos_id,
-                            future, submitted, prompt_len, sampling)
-        return True
+    def _complete_storage(self, adm: _Admission):
+        k = adm.base // self.page_size
+        insert_ids = np.asarray(adm.page_ids, np.int32).copy()
+        # shared prefix pages are read-only — route their rows to scratch
+        insert_ids[:k] = -1
+        self._pool = self._insert_paged(self._pool, adm.small,
+                                        jnp.asarray(insert_ids))
+        held = list(adm.prefix_nodes)
+        pages = list(adm.pages)
+        if self._prefix is not None:
+            # index this prompt's freshly written full blocks for future
+            # reuse; claimed pages become cache-owned (not freed on
+            # release — they stay cached until evicted)
+            new_nodes, claimed = self._prefix.register(
+                adm.prompt, adm.page_ids, adm.prefix_nodes)
+            held.extend(new_nodes)
+            if claimed:
+                claimed_set = set(claimed)
+                pages = [p for p in pages if p not in claimed_set]
+        self._slot_pages[adm.slot] = pages
+        self._slot_prefix_nodes[adm.slot] = held
+        self._page_table[adm.slot] = adm.page_ids
+        self._pos[adm.slot] = len(adm.prompt)
+
+    def _abort_admission(self, adm: _Admission):
+        self._free_pages.extend(adm.pages)
+        if self._prefix is not None:
+            self._prefix.release(adm.prefix_nodes)
 
     def _fail_pending(self, exc: Exception):
         # head-of-line requests parked in the pending deque must fail
@@ -382,13 +539,33 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _release_slot_storage(self, index: int):
         for pid in self._slot_pages.pop(index, []):
             self._free_pages.append(pid)
+        if self._prefix is not None:
+            # cache-owned pages: drop this slot's holds; refcount-0 pages
+            # STAY cached (hot prefixes survive across requests) until
+            # the LRU eviction reclaims them under pool pressure
+            self._prefix.release(self._slot_prefix_nodes.pop(index, []))
         self._page_table[index] = -1
         self._pos[index] = 0
 
-    def _decode_tick(self):
+    @property
+    def stats(self) -> dict:
+        out = ContinuousBatchingEngine.stats.fget(self)
+        out["free_pages"] = len(self._free_pages)
+        if self._prefix is not None:
+            queries = self._prefix.queries
+            out["prefix_queries"] = queries
+            out["prefix_hits"] = self._prefix.hits
+            out["prefix_hit_rate"] = (
+                self._prefix.hits / queries if queries else 0.0)
+            out["prefix_cached_tokens"] = self._prefix.cached_tokens
+            out["prefix_evictions"] = self._prefix.evictions
+            out["prefix_cached_pages"] = self._prefix.cached_pages()
+        return out
+
+    def _decode_tick(self) -> int:
         active = [i for i, s in enumerate(self._slot_state) if s.active]
         if not active:
-            return
+            return 0
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]
@@ -422,3 +599,4 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if (slot.eos_id is not None and token == slot.eos_id) or \
                     slot.remaining <= 0 or capacity:
                 self._finish(i)
+        return len(active)

@@ -1,0 +1,193 @@
+"""Prefix-aware KV reuse index for the paged engine (serving/paged.py).
+
+Production LLM traffic is dominated by shared prompt prefixes (system
+prompts, few-shot templates, multi-turn history). The paged KV pool makes
+those prefixes shareable at page granularity: this module is the host-side
+radix index mapping chains of page-size token blocks to physical page ids
+with refcounts. On admission the engine matches the longest cached chain,
+points the new slot's page table at the shared pages read-only
+(refcount++), and prefills only the uncached suffix — the TTFT win the
+paper's <200ms serving claim needs on repeated-prefix workloads.
+
+Invariants the engine relies on:
+
+- Only FULL blocks are indexed, and a match never covers the whole prompt
+  (at least one token is left to prefill, because the engine needs the
+  last real position's logits to sample the first generated token).
+- Shared pages are read-only by construction: decode writes land at
+  positions >= prompt_len, which always sit in the slot's private pages.
+- Every indexed node carries a page; the page-bearing set is closed under
+  ancestors (chains register root-down, eviction is leaf-first), so a
+  match can always walk a contiguous chain.
+- Eviction only reclaims refcount-0 pages, leaf-first in LRU order
+  (evicting a parent before its child would orphan the child's chain).
+
+Pure host-side bookkeeping owned by the engine's scheduler thread — no
+jax imports, no locking (single-writer by construction, like the page
+free-list it feeds).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+
+class _Node:
+    """One full block in a cached chain: the physical page holding its KV,
+    how many active slots reference that page, and an LRU stamp."""
+
+    __slots__ = ("parent", "block", "children", "page_id", "refcount",
+                 "last_used")
+
+    def __init__(self, parent: "_Node | None" = None, block: tuple = ()):
+        self.parent = parent
+        self.block = block
+        self.children: dict[tuple, _Node] = {}
+        self.page_id = -1
+        self.refcount = 0
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Radix index over page-size token blocks -> (page id, refcount)."""
+
+    def __init__(self, page_size: int):
+        if page_size <= 0:
+            raise ValueError(f"page_size must be > 0, got {page_size}")
+        self.page_size = int(page_size)
+        self._root = _Node()
+        self._tick = 0          # monotonic LRU clock (deterministic)
+        self._cached = 0        # page-bearing node count
+        self._held = 0          # nodes with refcount > 0
+        # observability counters (surfaced through engine stats)
+        self.queries = 0
+        self.hits = 0
+        self.cached_tokens = 0  # prompt tokens served from cache
+        self.evictions = 0
+
+    def _block(self, prompt, i: int) -> tuple:
+        ps = self.page_size
+        return tuple(prompt[i * ps:(i + 1) * ps])
+
+    def _hold(self, node: _Node) -> None:
+        if node.refcount == 0:
+            self._held += 1
+        node.refcount += 1
+        node.last_used = self._tick
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, prompt) -> tuple[list[int], list[_Node]]:
+        """Longest cached chain of full blocks, capped at
+        ``(len(prompt) - 1) // page_size`` so at least one suffix token
+        remains to prefill. Increments refcounts on the matched nodes
+        (caller must :meth:`release` them when the slot frees). Returns
+        (page_ids, nodes), both possibly empty. The hit/query counters
+        are the ENGINE's to update — it may match-and-release repeatedly
+        while the head-of-line request waits for pages."""
+        self._tick += 1
+        limit = max(0, (len(prompt) - 1) // self.page_size)
+        node = self._root
+        pages: list[int] = []
+        nodes: list[_Node] = []
+        for i in range(limit):
+            child = node.children.get(self._block(prompt, i))
+            if child is None or child.page_id < 0:
+                break
+            self._hold(child)
+            pages.append(child.page_id)
+            nodes.append(child)
+            node = child
+        return pages, nodes
+
+    def release(self, nodes) -> None:
+        """Drop one slot-hold per node (admission abort or slot free)."""
+        for node in nodes:
+            if node.refcount > 0:
+                node.refcount -= 1
+                if node.refcount == 0:
+                    self._held -= 1
+
+    # -- registration --------------------------------------------------------
+    def register(self, prompt, page_ids,
+                 matched_nodes) -> tuple[list[_Node], list[int]]:
+        """Index the prompt's full blocks past the matched chain, claiming
+        the freshly written pages ``page_ids[i]`` for blocks that are not
+        already cached. Returns (held_nodes, claimed_page_ids): claimed
+        pages are now cache-owned — the slot must NOT return them to the
+        free list on release (they stay cached until evicted). Blocks that
+        raced an identical registration keep the caller's page private
+        (skipped, not claimed) but are still HELD, so every slot holds a
+        contiguous root-down chain — the invariant behind the O(1)
+        :meth:`evictable_pages` count."""
+        self._tick += 1
+        k = len(matched_nodes)
+        node = matched_nodes[-1] if matched_nodes else self._root
+        full = len(prompt) // self.page_size
+        held: list[_Node] = []
+        claimed: list[int] = []
+        for i in range(k, full):
+            block = self._block(prompt, i)
+            child = node.children.get(block)
+            if child is None:
+                child = _Node(parent=node, block=block)
+                child.page_id = int(page_ids[i])
+                node.children[block] = child
+                self._cached += 1
+                claimed.append(child.page_id)
+            # else: identical chain raced us — the request's physical page
+            # for this block stays private to the slot (freed on release)
+            self._hold(child)
+            held.append(child)
+            node = child
+        return held, claimed
+
+    # -- eviction ------------------------------------------------------------
+    def cached_pages(self) -> int:
+        return self._cached
+
+    def evictable_pages(self) -> int:
+        """Pages reclaimable right now. Because every slot holds a
+        contiguous root-down chain (match holds the prefix, register holds
+        everything it descends through), a held node's ancestors are all
+        held too — so a refcount-0 node can never sit above a held one and
+        the count is simply cached minus held. O(1): this runs on every
+        submit() via the pressure ladder."""
+        return self._cached - self._held
+
+    def evict(self, n: int, on_evict=None) -> list[int]:
+        """Reclaim up to ``n`` refcount-0 pages, leaf-first in LRU order.
+        ``on_evict(node)`` observes each victim before detach (the engine
+        fires the ``llm.prefix_evict`` chaos point there). Returns the
+        freed page ids.
+
+        ONE trie walk collects the candidate leaves into a heap; parents
+        are promoted as their last child is evicted — O(trie + n log n)
+        per reclaim, not a re-walk per page."""
+        freed: list[int] = []
+        if n <= 0:
+            return freed
+        heap: list[tuple[int, int, _Node]] = []
+
+        def walk(node: _Node):
+            for child in node.children.values():
+                walk(child)
+            if node is not self._root and not node.children \
+                    and node.refcount == 0:
+                heap.append((node.last_used, id(node), node))
+
+        walk(self._root)
+        heapq.heapify(heap)
+        while heap and len(freed) < n:
+            _, _, victim = heapq.heappop(heap)
+            if on_evict is not None:
+                on_evict(victim)
+            parent = victim.parent
+            parent.children.pop(victim.block, None)
+            self._cached -= 1
+            self.evictions += 1
+            freed.append(victim.page_id)
+            if parent is not self._root and not parent.children \
+                    and parent.refcount == 0:
+                heapq.heappush(heap,
+                               (parent.last_used, id(parent), parent))
+        return freed

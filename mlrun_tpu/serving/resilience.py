@@ -63,6 +63,15 @@ class QueueFullError(AdmissionRejected):
     status_code = 429
 
 
+class PromptTooLongError(ResilienceError, ValueError):
+    """prompt + max_new_tokens exceed the engine's max_len — a client
+    error rejected at submit() before any queueing or prefill, instead of
+    undefined padding/truncation past the largest bucket. Subclasses
+    ValueError so pre-typed callers keep working."""
+
+    status_code = 400
+
+
 class DeadlineExceeded(ResilienceError):
     """The event's deadline expired before/while executing a step."""
 
@@ -103,9 +112,10 @@ def deadline_from_headers(headers: dict | None,
         try:
             return clock() + float(timeout)
         except (TypeError, ValueError):
+            # fall through: a valid absolute-deadline header must still
+            # be honored when the relative one is garbage
             logger.warning("ignoring malformed timeout header",
                            value=timeout)
-            return None
     epoch = lowered.get(DEADLINE_HEADER)
     if epoch is not None:
         try:

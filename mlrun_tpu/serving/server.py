@@ -214,17 +214,26 @@ class GraphServer(ModelObj):
         typed responses, not 500s with tracebacks.
         """
         server_context = self.context
-        if self._draining:
+        # header parsing happens BEFORE the inflight increment: a parse
+        # exception here must not leak the gauge (the decrement lives in
+        # the finally of the graph.run block below)
+        if getattr(event, "deadline", None) is None:
+            event.deadline = deadline_from_headers(
+                getattr(event, "headers", None))
+        # admission vs drain must be ATOMIC: checked and incremented under
+        # one lock hold, or a request could slip between the drain-flag
+        # read and the inflight increment and still be executing after
+        # drain() observed inflight == 0 and reported drained
+        with self._state_lock:
+            admitted = not self._draining
+            if admitted:
+                self._inflight += 1
+        if not admitted:
             self._incr_metric("server.draining_rejected")
             exc = ServerDrainingError("server is draining, not admitting "
                                       "new events")
             return Response(body={"error": str(exc)},
                             status_code=exc.status_code)
-        if getattr(event, "deadline", None) is None:
-            event.deadline = deadline_from_headers(
-                getattr(event, "headers", None))
-        with self._state_lock:
-            self._inflight += 1
         try:
             response = self.graph.run(event)
         except ResilienceError as exc:

@@ -146,7 +146,10 @@ class SpeculativeDecoder:
         greedy decode; returns (tokens, stats)."""
         prompt = [int(t) for t in prompt_tokens]
         if len(prompt) + max_new_tokens + self.k + 1 > self.max_len:
-            raise ValueError("prompt + max_new_tokens exceeds max_len")
+            from .resilience import PromptTooLongError
+
+            raise PromptTooLongError(
+                "prompt + max_new_tokens exceeds max_len")
         stats = SpecStats()
         start = time.perf_counter()
 
