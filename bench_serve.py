@@ -43,6 +43,21 @@ def _make_engine(config, params, *, prefix_cache, max_len, page_size,
     return engine
 
 
+_SNAPSHOT_KEYS = (
+    "requests", "completed", "queue_depth", "pressure_level",
+    "prefill_chunks", "prefill_tokens_tick_max", "free_pages",
+    "prefix_hit_rate", "prefix_cached_tokens", "prefix_cached_pages",
+    "prefix_evictions", "ttft_p50_s", "ttft_p95_s", "itl_p50_s",
+    "itl_p95_s")
+
+
+def _metrics_snapshot(stats: dict) -> dict:
+    """Engine-telemetry context frozen next to the latency numbers, so a
+    future BENCH_*.json diff can tell a regression from a workload shift
+    (different hit rate / queue depth / prefill chunking)."""
+    return {key: stats[key] for key in _SNAPSHOT_KEYS if key in stats}
+
+
 def _ttft_series(engine, prompts, max_new):
     """Serial generation (one request in flight) so each TTFT isolates
     the prefill path, not queueing behind other requests."""
@@ -104,6 +119,7 @@ def run(requests: int = 12, prefix_tokens: int = 960,
             _percentile(warm_ttfts, 0.50) * 1000, 2),
         "prefix_hit_rate": round(stats["prefix_hit_rate"], 3),
         "prefix_cached_tokens": stats["prefix_cached_tokens"],
+        "metrics": _metrics_snapshot(stats),
     }
 
     # same workload, cache disabled — the baseline p50 the speedup is vs
@@ -122,16 +138,20 @@ def run(requests: int = 12, prefix_tokens: int = 960,
 
     # unique-prompt workload: throughput must not regress with the cache
     tps = {}
+    unique_metrics = {}
     for label, cache_on in (("cache_on", True), ("cache_off", False)):
         engine = _make_engine(config, params, prefix_cache=cache_on,
                               max_len=max_len, page_size=page_size,
                               prefill_buckets=buckets, warmup=warmup)
         try:
             tps[label] = round(_throughput(engine, unique, max_new), 1)
+            if cache_on:
+                unique_metrics = _metrics_snapshot(engine.stats)
         finally:
             engine.stop()
     out["unique"] = {"tokens_per_sec_cache_on": tps["cache_on"],
-                     "tokens_per_sec_cache_off": tps["cache_off"]}
+                     "tokens_per_sec_cache_off": tps["cache_off"],
+                     "metrics": unique_metrics}
     return out
 
 

@@ -23,6 +23,17 @@ from __future__ import annotations
 import threading
 import time
 
+# observer(point) called whenever an armed injection's effect actually
+# fires — pushed in from above (mlrun_tpu/obs wires it to a counter) so
+# this module keeps its no-mlrun_tpu-imports rule. Kept off the dark
+# path: a process with no armed faults never calls it.
+_fire_observer = None
+
+
+def set_fire_observer(observer):
+    global _fire_observer
+    _fire_observer = observer
+
 
 class FaultPoints:
     """Named fault points threaded through the codebase. A point name is
@@ -55,6 +66,10 @@ class FaultPoints:
     serving_queue = "serving.queue"
     # LLM engine request submission (serving/llm_batch.py submit)
     llm_submit = "llm.submit"
+    # one prefill dispatch on the scheduler thread (llm_batch._run_prefill)
+    # — a delay()/action() here wedges the scheduler mid-dispatch, the
+    # shape of hang the stop() epoch guard exists for
+    llm_prefill = "llm.prefill"
     # prefix-cache page eviction (serving/paged.py _reclaim_pages) — fires
     # per evicted page with page_id/refcount context; an action() here
     # observes eviction order, an error models a poisoned reclaim
@@ -70,7 +85,7 @@ class FaultPoints:
             FaultPoints.httpdb_request, FaultPoints.execution_commit,
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
-            FaultPoints.llm_prefix_evict,
+            FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
         ]
 
 
@@ -188,6 +203,11 @@ class Injection:
         if not self.schedule.should_fire(self.calls):
             return
         self.fired += 1
+        if _fire_observer is not None:
+            try:
+                _fire_observer(point)
+            except Exception:  # noqa: BLE001 - telemetry must not alter
+                pass           # the injected failure semantics
         if self.delay > 0:
             time.sleep(self.delay)
         if self.action is not None:

@@ -153,6 +153,24 @@ default_config: dict[str, Any] = {
             "latency_window": 512,
         },
     },
+    "observability": {
+        # unified telemetry (docs/observability.md): the metrics registry
+        # behind GET /metrics and the X-MLT-Trace span tracer.
+        # metrics_enabled=false turns the /metrics endpoints into 404s
+        # (collection itself is nanoseconds and stays on)
+        "metrics_enabled": True,
+        # per-metric label-set bound default lives in obs/metrics.py
+        # (DEFAULT_MAX_LABEL_SETS); families override per metric
+        # span ring size (in-memory export, always on)
+        "trace_ring": 2048,
+        # JSONL span export path ("" = ring only); each finished span is
+        # appended as one JSON object per line
+        "trace_path": "",
+        # stamp active trace ids into jax.profiler.TraceAnnotation region
+        # names (utils/profiler.annotate) so XLA device traces join
+        # request spans in TensorBoard
+        "xla_annotations": True,
+    },
     "model_monitoring": {
         "window_seconds": 60,
         "store": "sqlite",

@@ -1,0 +1,116 @@
+"""Unified telemetry: the metrics registry + tracer behind ``/metrics``
+and ``X-MLT-Trace`` (docs/observability.md).
+
+This package owns the canonical metric families so every ``/metrics``
+render — serving gateway or service API — exposes the same schema even
+before a sample lands. Producers import the family objects from here;
+consumers render ``REGISTRY``.
+
+Naming: ``mlt_<area>_<what>[_total|_seconds]``, labels snake_case.
+"""
+
+from .metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    CardinalityError,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    MetricsRegistry,
+    REGISTRY,
+)
+from .tracing import (  # noqa: F401
+    TRACE_HEADER,
+    Span,
+    Tracer,
+    configure_from_mlconf,
+    format_trace_header,
+    get_tracer,
+    new_trace_id,
+    parse_trace_header,
+    trace_id_for,
+    tracer,
+)
+
+# -- serving path ------------------------------------------------------------
+REQUEST_LATENCY = REGISTRY.histogram(
+    "mlt_request_latency_seconds",
+    "End-to-end GraphServer.run latency per event")
+STEP_LATENCY = REGISTRY.histogram(
+    "mlt_step_latency_seconds",
+    "Per-step execution latency in the serving graph",
+    labels=("step",), overflow="drop")
+SERVING_EVENTS = REGISTRY.counter(
+    "mlt_serving_events_total",
+    "Serving-path events mirrored from context.metrics (breaker trips, "
+    "admission rejects, sheds, deadline expiries, drain rejections)",
+    labels=("event",), overflow="drop")
+PROBE_REQUESTS = REGISTRY.counter(
+    "mlt_probe_requests_total",
+    "Probe/scrape endpoint hits (healthz/readyz/stats/metrics) — counted "
+    "here, excluded from request telemetry and never traced",
+    labels=("path",), overflow="drop")
+BREAKER_STATE = REGISTRY.gauge(
+    "mlt_breaker_state",
+    "Circuit breaker state per step (0 closed, 1 half-open, 2 open)",
+    labels=("step",), overflow="drop")
+SERVER_INFLIGHT = REGISTRY.gauge(
+    "mlt_server_inflight", "In-flight events on the graph server")
+
+# -- LLM engines -------------------------------------------------------------
+LLM_TTFT = REGISTRY.histogram(
+    "mlt_llm_ttft_seconds", "Time to first token (continuous batching)")
+LLM_ITL = REGISTRY.histogram(
+    "mlt_llm_itl_seconds",
+    "Inter-token latency: whole scheduler iterations that produced a "
+    "decode step",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))
+LLM_QUEUE_DEPTH = REGISTRY.gauge(
+    "mlt_llm_queue_depth", "Queued + pending admissions per engine",
+    labels=("engine",), overflow="drop")
+LLM_FREE_PAGE_FRAC = REGISTRY.gauge(
+    "mlt_llm_free_page_frac",
+    "Free (incl. reclaimable prefix) KV-page fraction, paged engines",
+    labels=("engine",), overflow="drop")
+LLM_EVENTS = REGISTRY.counter(
+    "mlt_llm_events_total",
+    "Cumulative engine events mirrored from stats() (requests, completed, "
+    "shed, expired, prefix_hits, prefix_evictions, ...)",
+    labels=("engine", "event"), max_label_sets=1024, overflow="drop")
+
+# -- run lifecycle -----------------------------------------------------------
+RUN_SUBMITS = REGISTRY.counter(
+    "mlt_run_submits_total", "Runs launched via the server-side launcher",
+    labels=("kind",), overflow="drop")
+RUN_RETRIES = REGISTRY.counter(
+    "mlt_run_retries_total",
+    "Failed resources resubmitted by the monitor, by failure class",
+    labels=("failure_class",), overflow="drop")
+RUN_STALL_ABORTS = REGISTRY.counter(
+    "mlt_run_stall_aborts_total",
+    "Runs aborted by the heartbeat-stall watchdog")
+
+# -- chaos / training --------------------------------------------------------
+CHAOS_FIRED = REGISTRY.counter(
+    "mlt_chaos_fired_total",
+    "Armed fault injections whose effect actually fired, by point",
+    labels=("point",), overflow="drop")
+TRAIN_MFU = REGISTRY.gauge(
+    "mlt_training_mfu", "Last computed model FLOPs utilization")
+TRAIN_STEP_TIME = REGISTRY.gauge(
+    "mlt_train_step_seconds", "Last step wall time per StepTimer",
+    labels=("timer",), overflow="drop")
+
+
+def _install_chaos_observer():
+    """Count fired injections without giving chaos/registry (a bottom
+    layer that must not import mlrun_tpu) a metrics dependency: the hook
+    is pushed in from above."""
+    from ..chaos.registry import set_fire_observer
+
+    set_fire_observer(lambda point: CHAOS_FIRED.inc(point=point))
+
+
+_install_chaos_observer()

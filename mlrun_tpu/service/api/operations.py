@@ -19,6 +19,23 @@ def register(r: web.RouteTableDef, state):
     async def healthz(request):
         return json_response({"status": "ok", "version": __version__})
 
+    @r.get("/metrics")
+    async def metrics(request):
+        """Prometheus text exposition of the process-wide registry
+        (docs/observability.md): run-lifecycle counters (submits, retries
+        by failure class, stall aborts), chaos fire counts, and — when
+        this process also serves — the serving/engine series. Root path
+        (not under the API base) per scraper convention; left open by the
+        auth middleware like healthz."""
+        from ...obs import CONTENT_TYPE, PROBE_REQUESTS, REGISTRY
+
+        PROBE_REQUESTS.inc(path="/metrics")
+        if not bool(mlconf.observability.metrics_enabled):
+            return web.Response(status=404, text="metrics exposition is "
+                                "disabled (mlconf.observability)")
+        return web.Response(body=REGISTRY.render().encode(),
+                            headers={"Content-Type": CONTENT_TYPE})
+
     @r.get(f"{API}/client-spec")
     async def client_spec(request):
         return json_response({

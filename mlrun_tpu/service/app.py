@@ -77,8 +77,11 @@ def auth_middleware():
     async def middleware(request, handler):
         required = mlconf.httpdb.auth_token or os.environ.get(
             "MLT_SERVICE_TOKEN", "")
-        healthz = mlconf.api_base_path.rstrip("/") + "/healthz"
-        if required and request.path.rstrip("/") != healthz:
+        # probes and scrapers stay open: healthz for the orchestrator,
+        # /metrics for Prometheus (exposition carries no secrets)
+        open_paths = {mlconf.api_base_path.rstrip("/") + "/healthz",
+                      "/metrics"}
+        if required and request.path.rstrip("/") not in open_paths:
             header = request.headers.get("Authorization", "")
             if header != f"Bearer {required}":
                 return error_response("unauthorized", 401)
@@ -196,6 +199,9 @@ def run_app(host: str = "", port: int = 0):
     port = port or mlconf.httpdb.port
     # make the advertised port consistent for spawned run resources
     mlconf.httpdb.port = port
+    from ..obs import configure_from_mlconf
+
+    configure_from_mlconf()  # span JSONL path / ring for this service
     logger.info("starting mlrun-tpu service", host=host, port=port,
                 version=__version__)
     web.run_app(build_app(), host=host, port=port, print=None)

@@ -11,6 +11,7 @@ from ..common.runtimes_constants import RunStates, RuntimeKinds
 from ..config import mlconf
 from ..launcher.base import BaseLauncher
 from ..model import RunObject
+from ..obs import RUN_SUBMITS, get_tracer, trace_id_for
 from ..runtimes import get_runtime_class
 from ..utils import generate_uid, logger, now_iso
 from .runtime_handlers import Provider, get_runtime_handler
@@ -77,14 +78,22 @@ class ServerSideLauncher(BaseLauncher):
             return run
 
         handler = self.handler_for(runtime.kind)
-        try:
-            handler.run(runtime, run)
-        except Exception as exc:  # noqa: BLE001 - record the failure
-            self.db.update_run(
-                {"status.state": RunStates.error,
-                 "status.error": str(exc)},
-                run.metadata.uid, run.metadata.project)
-            raise
+        RUN_SUBMITS.inc(kind=runtime.kind)
+        # run-lifecycle trace: every span of this run (submit here,
+        # retry/resume/stall in the monitor) shares the uid-derived trace
+        # id, so one timeline covers submit → schedule → running → retry
+        with get_tracer().span(
+                "run.submit", trace_id=trace_id_for(run.metadata.uid),
+                attrs={"uid": run.metadata.uid, "kind": runtime.kind,
+                       "project": run.metadata.project}):
+            try:
+                handler.run(runtime, run)
+            except Exception as exc:  # noqa: BLE001 - record the failure
+                self.db.update_run(
+                    {"status.state": RunStates.error,
+                     "status.error": str(exc)},
+                    run.metadata.uid, run.metadata.project)
+                raise
         return run
 
     def _run_hyper(self, runtime, run: RunObject):

@@ -37,6 +37,12 @@ from ..common.runtimes_constants import (
 )
 from ..config import mlconf
 from ..model import RunObject
+from ..obs import (
+    RUN_RETRIES,
+    RUN_STALL_ABORTS,
+    get_tracer,
+    trace_id_for,
+)
 from ..utils import get_in, logger, now_iso
 
 
@@ -138,7 +144,16 @@ class BaseRuntimeHandler:
         key = self._run_key(run.metadata.uid, iteration)
         with self._lock:
             self._manifests[key] = copy.deepcopy(resource)
-        resource_id = self.provider.create(resource, run.metadata.uid)
+        try:
+            resource_id = self.provider.create(resource, run.metadata.uid)
+        except Exception:
+            # a failed create never registers the key in _resources, so
+            # _forget would never fire for it — drop the cached manifest
+            # here or repeatedly failing submissions accumulate deep
+            # copies forever (ROADMAP open item)
+            with self._lock:
+                self._manifests.pop(key, None)
+            raise
         started = time.time()
         with self._lock:
             self._resources[key] = (
@@ -411,8 +426,15 @@ class BaseRuntimeHandler:
              "status.status_text":
              f"resubmitted after {failure_class} (attempt {attempt})"},
             uid, project, iter=iteration)
+        RUN_RETRIES.inc(failure_class=failure_class)
+        # joins the run.submit span on the uid-derived lifecycle trace
+        get_tracer().emit(
+            "run.retry", trace_id_for(uid),
+            attrs={"uid": uid, "failure_class": failure_class,
+                   "attempt": attempt, "resource": new_id})
         logger.info("resubmitted run", uid=uid, resource=new_id,
-                    failure_class=failure_class, attempt=attempt)
+                    failure_class=failure_class, attempt=attempt,
+                    trace_id=trace_id_for(uid))
         return True
 
     def _build_retry_manifest(self, key: str, project: str, run: dict,
@@ -511,6 +533,11 @@ class BaseRuntimeHandler:
              f"stalled: no heartbeat for {silent:.0f}s "
              f"(threshold {policy.stall_timeout:.0f}s)"},
             uid, project, iter=iteration)
+        RUN_STALL_ABORTS.inc()
+        get_tracer().emit(
+            "run.stall_abort", trace_id_for(uid),
+            attrs={"uid": uid, "silent_s": round(silent, 1),
+                   "threshold_s": policy.stall_timeout})
         self._forget(key, project)
         self._push_notifications(uid, project, run)
         return True

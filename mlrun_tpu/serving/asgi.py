@@ -19,6 +19,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..config import mlconf
+from ..obs import CONTENT_TYPE, PROBE_REQUESTS, REGISTRY, configure_from_mlconf
 from ..utils import logger
 from .server import GraphContext, GraphServer, MockEvent, Response
 
@@ -67,7 +68,15 @@ def build_serving_app(server: GraphServer) -> web.Application:
         return web.json_response(payload, status=status,
                                  dumps=lambda d: json.dumps(d, default=str))
 
+    # probe/scrape endpoints count themselves on one dedicated low-cost
+    # counter and NEVER allocate spans (they answer before GraphServer.run,
+    # the only span producer) — load-balancer probes and Prometheus
+    # scrapers must not pollute request telemetry
+    def _probe(path: str):
+        PROBE_REQUESTS.inc(path=path)
+
     async def stats(request):
+        _probe("/__stats__")
         lat = sorted(app["latencies"])
         n = len(lat)
         return web.json_response({
@@ -79,11 +88,13 @@ def build_serving_app(server: GraphServer) -> web.Application:
     # -- resilience endpoints (docs/serving_resilience.md) -------------------
     async def healthz(request):
         # liveness: 200 while the process serves, even mid-drain
+        _probe("/healthz")
         return web.json_response(server.healthz())
 
     async def readyz(request):
         # readiness: flips 503 the moment drain starts so the load
         # balancer stops routing before in-flight events finish
+        _probe("/readyz")
         payload = server.readyz()
         return web.json_response(
             payload, status=200 if payload["ready"] else 503)
@@ -96,8 +107,20 @@ def build_serving_app(server: GraphServer) -> web.Application:
         return web.json_response({"drained": drained,
                                   "inflight": server.inflight})
 
+    async def metrics(request):
+        # Prometheus text exposition of the process-wide registry
+        # (docs/observability.md) — engine, resilience, step-latency and
+        # request series for this replica
+        _probe("/metrics")
+        if not bool(mlconf.observability.metrics_enabled):
+            return web.Response(status=404, text="metrics exposition is "
+                                "disabled (mlconf.observability)")
+        return web.Response(body=REGISTRY.render().encode(),
+                            headers={"Content-Type": CONTENT_TYPE})
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
+    app.router.add_get("/metrics", metrics)
     app.router.add_post("/__drain__", drain)
     app.router.add_get("/__stats__", stats)
     app.router.add_route("*", "/{tail:.*}", handle)
@@ -131,6 +154,7 @@ def serve(function=None, spec: dict | None = None, host: str = "0.0.0.0",
           port: int = 8080, namespace: dict | None = None):
     """Start the gateway for a ServingRuntime object, a serialized spec, or
     the SERVING_SPEC_ENV contract."""
+    configure_from_mlconf()  # span JSONL path / ring size for this replica
     if function is not None:
         server = function.to_mock_server(namespace=namespace)
         server.context.is_mock = False

@@ -34,6 +34,15 @@ import numpy as np
 from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..models.llama import LlamaConfig, Params
+from ..obs import (
+    LLM_EVENTS,
+    LLM_FREE_PAGE_FRAC,
+    LLM_ITL,
+    LLM_QUEUE_DEPTH,
+    LLM_TTFT,
+    REGISTRY,
+    get_tracer,
+)
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope, rope_table
 from ..utils import logger
@@ -133,6 +142,10 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
     return next_token, new_cache
 
 
+# distinct `engine` label per instance on the shared gauges/counters
+_ENGINE_SEQUENCE = iter(range(1, 1 << 30))
+
+
 def _percentile(sorted_samples: list, q: float) -> float:
     """Nearest-rank percentile (ceil(q*n)-th order statistic) over an
     already-sorted sample list."""
@@ -163,6 +176,11 @@ class _Admission:
     offset: int = 0
     chunks: int = 0
     first_token: int = -1
+    # trace context captured at submit ((trace_id, parent_span_id)) and
+    # the wall clock when the request was claimed off the queue — the
+    # scheduler emits the llm.prefill span from these
+    trace: Optional[tuple] = None
+    claimed: float = 0.0
     # paged-engine bookkeeping (unused by the dense engine)
     page_ids: object = None
     pages: list = field(default_factory=list)
@@ -182,6 +200,10 @@ class _Slot:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # trace context + decode-phase start (wall clock) for the llm.decode
+    # span emitted at finish
+    trace: Optional[tuple] = None
+    decode_started: float = 0.0
 
     @property
     def active(self) -> bool:
@@ -280,6 +302,17 @@ class ContinuousBatchingEngine:
         self._stopped = False
         self._crash_exc: Optional[Exception] = None
         self._thread: Optional[threading.Thread] = None
+        # scheduler-epoch guard (docs/observability.md is unrelated; see
+        # stop()): each scheduler thread runs one epoch; stop() and the
+        # thread race for teardown ownership through these sets under
+        # self._lock, so exactly one side fails the in-flight admission
+        self._epoch = 0
+        self._dead_epochs: set = set()
+        self._stale_epochs: set = set()
+        # /metrics identity + scrape-time collector handle
+        self._obs_name = (f"{type(self).__name__}-"
+                          f"{next(_ENGINE_SEQUENCE)}")
+        self._metrics_collector = None
         self._next_id = 0
         # RLock: the expiry sweep holds it across drain/re-put while the
         # helpers it calls (stats, budget counter) re-acquire it
@@ -302,25 +335,111 @@ class ContinuousBatchingEngine:
         if self._running:
             return
         self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._epoch += 1
+        self._register_metrics()
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(self._epoch,), daemon=True)
         self._thread.start()
 
-    def stop(self):
+    def stop(self, timeout: float = 10.0):
         """Stop the scheduler and DRAIN the queue: every request still
         queued (or mid-generation in a slot) fails promptly with
         :class:`EngineStoppedError` instead of hanging its future until
-        its own result() timeout."""
+        its own result() timeout.
+
+        Epoch guard: ``join`` returning does NOT prove the scheduler is
+        gone — it can still be wedged in a device dispatch past the
+        timeout, and tearing down the in-flight admission here would race
+        the live thread (page-table vs free-list divergence, both sides
+        resolving one future → InvalidStateError). Teardown ownership is
+        decided under the lock: if the scheduler's epoch already
+        registered dead, stop() tears down; otherwise the epoch is marked
+        stale ("disowned") and the scheduler runs the teardown itself on
+        its way out — exactly one side ever does it.
+        """
         self._running = False
         self._stopped = True
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
-        self._fail_pending(EngineStoppedError(
-            "engine stopped while the request was pending"))
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout)
+        exc = EngineStoppedError(
+            "engine stopped while the request was pending")
+        epoch = self._epoch
+        with self._lock:
+            scheduler_live = thread is not None \
+                and epoch not in self._dead_epochs
+            if scheduler_live:
+                self._stale_epochs.add(epoch)
+            else:
+                self._dead_epochs.discard(epoch)
+        if scheduler_live:
+            logger.warning(
+                "engine stop: scheduler still in a dispatch after join "
+                "timeout — queued requests failed now, in-flight "
+                "admission/slot teardown deferred to the scheduler",
+                timeout=timeout, epoch=epoch)
+            self._drain_queue(exc)
+        else:
+            self._fail_pending(exc)
+        self._unregister_metrics()
 
     def close(self):
         """Alias for :meth:`stop` (context-manager friendly name)."""
         self.stop()
+
+    # -- /metrics collector --------------------------------------------------
+    # cumulative stats() keys mirrored as counter series at scrape time
+    _COUNTER_STATS = ("requests", "completed", "tokens_out", "shed",
+                      "expired", "degraded", "rejected_too_long",
+                      "prefill_chunks", "prefix_queries", "prefix_hits",
+                      "prefix_evictions", "prefix_cached_tokens")
+
+    def _register_metrics(self):
+        """Expose this engine on the process registry: queue-depth /
+        free-page-fraction gauges and the cumulative stats counters,
+        read at scrape time (weakly bound; retired on stop())."""
+        if self._metrics_collector is not None:
+            return
+        import weakref
+
+        ref = weakref.ref(self)
+        name = self._obs_name
+
+        counter_stats = self._COUNTER_STATS
+
+        def remove_series():
+            LLM_QUEUE_DEPTH.remove(engine=name)
+            LLM_FREE_PAGE_FRAC.remove(engine=name)
+            for key in counter_stats:
+                LLM_EVENTS.remove(engine=name, event=key)
+
+        def collect():
+            engine = ref()
+            if engine is None:
+                remove_series()
+                return False
+            stats = engine.stats
+            LLM_QUEUE_DEPTH.set(stats.get("queue_depth", 0), engine=name)
+            frac = engine._free_page_frac()
+            if frac is not None:
+                LLM_FREE_PAGE_FRAC.set(frac, engine=name)
+            for key in engine._COUNTER_STATS:
+                if key in stats:
+                    LLM_EVENTS.set_total(stats[key], engine=name, event=key)
+            return None
+
+        self._metrics_collector = collect
+        self._remove_metric_series = remove_series
+        REGISTRY.add_collector(collect)
+
+    def _unregister_metrics(self):
+        """Drop the collector AND every labeled series this engine owns —
+        a process churning engines (redeploys) must not pin dead series
+        until the family's cardinality bound starts dropping live ones."""
+        collector, self._metrics_collector = self._metrics_collector, None
+        if collector is not None:
+            REGISTRY.remove_collector(collector)
+            self._remove_metric_series()
 
     def warmup(self):
         """Compile prefill buckets, decode step, and insertion."""
@@ -429,6 +548,12 @@ class ContinuousBatchingEngine:
             self.speculative_enabled = True
         budget = self.max_wait if max_wait is None else float(max_wait)
         expires = (time.perf_counter() + budget) if budget > 0 else None
+        # trace context crosses the thread boundary inside the queue item:
+        # the scheduler emits llm.prefill/llm.decode spans parented on the
+        # submitting step's span (docs/observability.md)
+        current_span = get_tracer().current()
+        trace = ((current_span.trace_id, current_span.span_id)
+                 if current_span is not None else None)
         # enqueue under the lock: the expiry sweep drains and re-puts the
         # queue atomically, so a racing put must not land mid-sweep and
         # jump ahead of older requests
@@ -442,7 +567,7 @@ class ContinuousBatchingEngine:
                              max_new_tokens, eos_id, future,
                              time.perf_counter(),
                              (float(temperature), int(top_k), float(top_p)),
-                             expires))
+                             expires, trace))
         if not self._running:
             self.start()
         return future
@@ -504,6 +629,8 @@ class ContinuousBatchingEngine:
         prefix-cache hit the cached prefix KV is already in ``adm.small``
         and only the suffix runs. Returns True once the prompt is fully
         prefilled and the first token is sampled."""
+        fire(FaultPoints.llm_prefill, request_id=adm.request_id,
+             slot=adm.slot, offset=adm.offset, chunks=adm.chunks)
         prompt = adm.prompt
         total = len(prompt)
         start = adm.offset
@@ -546,7 +673,8 @@ class ContinuousBatchingEngine:
 
     def _activate_slot(self, free: int, request_id: int, first_token: int,
                        max_new: int, eos_id, future, submitted: float,
-                       prompt_len: int, sampling: tuple):
+                       prompt_len: int, sampling: tuple,
+                       trace: tuple | None = None):
         """Fill slot bookkeeping after a successful prefill (shared by the
         dense and paged admission paths)."""
         temperature, top_k, top_p = sampling
@@ -562,8 +690,11 @@ class ContinuousBatchingEngine:
         slot.temperature = temperature
         slot.top_k = top_k
         slot.top_p = top_p
+        slot.trace = trace
+        slot.decode_started = time.time()
         with self._lock:
             self._ttft_ring.append(slot.ttft)
+        LLM_TTFT.observe(slot.ttft)
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
@@ -572,7 +703,7 @@ class ContinuousBatchingEngine:
     def _validate_item(self, item) -> bool:
         """Expiry + capacity checks on a dequeued request. Returns False
         (consuming the item) when its future was already failed."""
-        (_, prompt, max_new, _, future, submitted, _, expires) = item
+        (_, prompt, max_new, _, future, submitted, _, expires) = item[:8]
         if self._request_expired(future, submitted, expires):
             return False
         if len(prompt) + max_new > self.max_len:
@@ -601,13 +732,13 @@ class ContinuousBatchingEngine:
             if not self._validate_item(item):
                 continue
             (request_id, prompt, max_new, eos_id, future, submitted,
-             sampling, expires) = item
+             sampling, expires) = item[:8]
             try:
                 return _Admission(
                     slot=free, request_id=request_id, prompt=prompt,
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
-                    expires=expires,
+                    expires=expires, trace=item[8], claimed=time.time(),
                     small=init_kv_cache(self.config, 1, self.max_len,
                                         kv_dtype=self.kv_dtype))
             except Exception as exc:
@@ -626,9 +757,18 @@ class ContinuousBatchingEngine:
 
     def _finish_admission(self, adm: _Admission):
         self._complete_storage(adm)
+        if adm.trace is not None:
+            # the prefill scheduler phase as a span under the submitting
+            # step — chunk count and cached-prefix length ride as attrs
+            get_tracer().emit(
+                "llm.prefill", adm.trace[0], adm.trace[1],
+                start=adm.claimed, attrs={
+                    "slot": adm.slot, "prompt_len": len(adm.prompt),
+                    "chunks": adm.chunks, "cached_prefix": adm.base})
         self._activate_slot(adm.slot, adm.request_id, adm.first_token,
                             adm.max_new, adm.eos_id, adm.future,
-                            adm.submitted, len(adm.prompt), adm.sampling)
+                            adm.submitted, len(adm.prompt), adm.sampling,
+                            trace=adm.trace)
 
     def _abort_admission(self, adm: _Admission):
         """Release admission-held storage (expiry mid-prefill, stop). The
@@ -687,10 +827,15 @@ class ContinuousBatchingEngine:
             self._stats["completed"] += 1
             self._stats["ttft_sum"] += slot.ttft
             self._stats["tokens_out"] += len(slot.tokens)
+        if slot.trace is not None:
+            get_tracer().emit(
+                "llm.decode", slot.trace[0], slot.trace[1],
+                start=slot.decode_started,
+                attrs={"slot": index, "generated": len(slot.tokens)})
         future, tokens = slot.future, slot.tokens
         self._slot_state[index] = _Slot()
         self._release_slot_storage(index)
-        if future is not None and not future.cancelled():
+        if future is not None and not future.done():
             future.set_result((tokens, stats))
 
     def _release_slot_storage(self, index: int):
@@ -777,7 +922,7 @@ class ContinuousBatchingEngine:
             for item in keep:  # FIFO order preserved
                 self._queue.put(item)
 
-    def _loop(self):
+    def _loop(self, epoch: int = 0):
         try:
             while self._running:
                 # the ITL sample spans the WHOLE iteration (admission
@@ -792,9 +937,10 @@ class ContinuousBatchingEngine:
                         time.sleep(0.002)  # idle: poll admissions at 2ms
                     continue
                 if self._decode_tick():
+                    elapsed = time.perf_counter() - started
                     with self._lock:
-                        self._itl_ring.append(
-                            time.perf_counter() - started)
+                        self._itl_ring.append(elapsed)
+                    LLM_ITL.observe(elapsed)
         except Exception as exc:  # noqa: BLE001 - a dead scheduler must
             # fail pending work loudly, not leave futures hanging forever
             logger.error("continuous batching scheduler died",
@@ -803,6 +949,32 @@ class ContinuousBatchingEngine:
             self._stopped = True
             self._crash_exc = exc
             self._fail_pending(exc)
+        finally:
+            # epoch-guard handshake with stop(): register this epoch dead
+            # and, if stop() already disowned teardown to us (its join
+            # timed out while this thread was wedged in a dispatch), run
+            # the teardown here — we are the only thread that may touch
+            # the in-flight admission/slot state (_fail_pending is
+            # idempotent, so the crash path above is safe to follow)
+            with self._lock:
+                self._dead_epochs.add(epoch)
+                disowned = epoch in self._stale_epochs
+                self._stale_epochs.discard(epoch)
+            if disowned:
+                self._fail_pending(EngineStoppedError(
+                    "engine stopped while the request was pending"))
+
+    def _drain_queue(self, exc: Exception):
+        """Fail every request still in the (thread-safe) admission queue.
+        Safe from any thread — each item is popped exactly once."""
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            future = item[4]
+            if not future.done():
+                future.set_exception(exc)
 
     def _fail_pending(self, exc: Exception):
         adm, self._admission = self._admission, None
@@ -815,15 +987,18 @@ class ContinuousBatchingEngine:
         with self._lock:
             self._budgeted = 0
         for i, slot in enumerate(self._slot_state):
-            if slot.active and slot.future is not None \
-                    and not slot.future.done():
+            if not slot.active:
+                continue
+            if slot.future is not None and not slot.future.done():
                 slot.future.set_exception(exc)
             self._slot_state[i] = _Slot()
-        while True:
+            # return slot storage (paged: pages back to the free list,
+            # prefix holds released) so teardown leaves the free list and
+            # page table consistent; guarded — a crash mid-decode can
+            # leave the dense cache donated, and storage cleanup must
+            # never stop the remaining futures from failing
             try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            future = item[4]
-            if not future.done():
-                future.set_exception(exc)
+                self._release_slot_storage(i)
+            except Exception:  # noqa: BLE001
+                pass
+        self._drain_queue(exc)

@@ -425,7 +425,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._pending.popleft()
                 continue
             (request_id, prompt, max_new, eos_id, future, submitted,
-             sampling, expires) = item
+             sampling, expires) = item[:8]
             prompt_len = len(prompt)
             needed = -(-(prompt_len + max_new) // self.page_size)
             if needed > self.n_pages:
@@ -470,7 +470,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     slot=free, request_id=request_id, prompt=prompt,
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
-                    expires=expires,
+                    expires=expires, trace=item[8], claimed=time.time(),
                     small=init_kv_cache(self.config, 1, self.max_len,
                                         kv_dtype=self.kv_dtype),
                     base=k * self.page_size, offset=k * self.page_size)

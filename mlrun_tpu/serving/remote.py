@@ -26,6 +26,7 @@ from typing import Optional
 
 from ..chaos import FaultPoints, fire
 from ..common.retry import RetryPolicy, compute_backoff
+from ..obs import format_trace_header
 from ..utils import logger
 from ..utils.safe_eval import safe_eval
 from .resilience import DeadlineExceeded, deadline_remaining
@@ -99,6 +100,31 @@ class RemoteStep:
             url += "/" + self.subpath.lstrip("/")
         return url
 
+    def _outbound_span(self, event, url: str):
+        """(span, headers) for one outbound call: a child span of the
+        current step span, with ``X-MLT-Trace`` injected so the callee's
+        server joins this trace (docs/observability.md header contract).
+        Without an active trace the step's configured headers pass
+        through untouched."""
+        tracer = getattr(self.context, "tracer", None)
+        trace_id = getattr(event, "trace_id", None)
+        if tracer is None or not trace_id:
+            return None, self.headers
+        current = tracer.current()
+        parent_id = (current.span_id
+                     if current is not None and current.trace_id == trace_id
+                     else getattr(event, "span_id", None))
+        span = tracer.start_span(
+            f"remote.{self.name}", trace_id=trace_id, parent_id=parent_id,
+            attrs={"url": url}, activate=True)
+        headers = dict(self.headers)
+        headers["X-MLT-Trace"] = format_trace_header(trace_id, span.span_id)
+        return span, headers
+
+    def _finish_span(self, span, status: str = "ok"):
+        if span is not None:
+            self.context.tracer.end_span(span, status=status)
+
     def _clamped_timeout(self, event) -> float:
         """HTTP timeout clamped to the event's remaining deadline budget —
         a remote call must never outlive the request it serves."""
@@ -155,15 +181,21 @@ class RemoteStep:
                 kwargs["json"] = body
             else:
                 kwargs["data"] = body
+        span, headers = self._outbound_span(event, url)
 
         def call(timeout):
             resp = requests.request(self.method.upper(), url,
-                                    headers=self.headers, timeout=timeout,
+                                    headers=headers, timeout=timeout,
                                     **kwargs)
             resp.raise_for_status()
             return resp.json() if self.return_json else resp.content
 
-        event.body = self._call_with_retries(call, event)
+        try:
+            event.body = self._call_with_retries(call, event)
+        except Exception:
+            self._finish_span(span, "error")
+            raise
+        self._finish_span(span)
         return event
 
 
@@ -186,13 +218,16 @@ class BatchHttpRequests(RemoteStep):
 
         items = event.body if isinstance(event.body, list) else [event.body]
         url = self._resolve_url(event)
+        # one span covers the whole batch; every item's request carries
+        # the same injected trace header so callee spans parent onto it
+        span, headers = self._outbound_span(event, url)
 
         def call_item(index_item):
             index, item = index_item
 
             def call(timeout):
                 resp = requests.request(
-                    self.method.upper(), url, headers=self.headers,
+                    self.method.upper(), url, headers=headers,
                     timeout=timeout,
                     json=item if isinstance(item, (dict, list)) else None)
                 resp.raise_for_status()
@@ -212,7 +247,12 @@ class BatchHttpRequests(RemoteStep):
                     envelope["status_code"] = status
                 return envelope
 
-        with concurrent.futures.ThreadPoolExecutor(
-                max_workers=self.max_in_flight) as pool:
-            event.body = list(pool.map(call_item, enumerate(items)))
+        try:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.max_in_flight) as pool:
+                event.body = list(pool.map(call_item, enumerate(items)))
+        except Exception:
+            self._finish_span(span, "error")
+            raise
+        self._finish_span(span)
         return event

@@ -20,6 +20,15 @@ from typing import Any, Optional, Union
 
 from ..config import mlconf
 from ..model import ModelObj
+from ..obs import (
+    BREAKER_STATE,
+    REGISTRY,
+    REQUEST_LATENCY,
+    SERVER_INFLIGHT,
+    SERVING_EVENTS,
+    get_tracer,
+    parse_trace_header,
+)
 from ..secrets import SecretsStore
 from ..utils import logger, now_iso
 from .resilience import (
@@ -38,6 +47,10 @@ class MockEvent:
                  path=None, event_id=None, trigger=None, error=None,
                  deadline: float | None = None):
         self.id = event_id or uuid.uuid4().hex
+        # trace context stamped by GraphServer.run (docs/observability.md):
+        # steps/remote calls/engines hang their child spans off these
+        self.trace_id = None
+        self.span_id = None
         self.key = ""
         self.body = body
         self.time = now_iso()
@@ -88,13 +101,18 @@ class GraphContext:
         self._secrets = SecretsStore()
         self.is_mock = False
         self.monitoring_stream = None
-        # resilience observability: breaker trips, sheds, rejections
+        # resilience observability: breaker trips, sheds, rejections.
+        # The dict stays the compat view; every increment is mirrored
+        # into the process-wide registry (mlt_serving_events_total) so
+        # /metrics carries the same series with labels
         self.metrics: dict[str, int] = {}
         self._metrics_lock = threading.Lock()
+        self.tracer = None  # set by GraphServer.init_states
 
     def incr(self, name: str, value: int = 1):
         with self._metrics_lock:
             self.metrics[name] = self.metrics.get(name, 0) + value
+        SERVING_EVENTS.inc(value, event=name)
 
     def get_param(self, key: str, default=None):
         if self.server and self.server.parameters:
@@ -157,6 +175,10 @@ class GraphServer(ModelObj):
         self._state_lock = threading.Lock()
         self._draining = False
         self.step_errors: dict[str, int] = {}
+        # span factory (not serialized); assign a dedicated Tracer before
+        # init_states to isolate this server's spans (tests do), else the
+        # process-wide tracer is used
+        self.tracer = None
 
     @property
     def graph(self) -> Union[RootFlowStep, RouterStep]:
@@ -197,7 +219,12 @@ class GraphServer(ModelObj):
             self.context.monitoring_stream = get_monitoring_stream(
                 self.context.project or mlconf.default_project)
         self._namespace = namespace or {}
+        if self.tracer is None:
+            self.tracer = get_tracer()
+        if isinstance(self.context, GraphContext):
+            self.context.tracer = self.tracer
         self.graph.init_object(self.context, self._namespace, self.load_mode)
+        self._register_breaker_collector()
         return self
 
     def init_object(self, namespace: dict | None = None):
@@ -234,33 +261,61 @@ class GraphServer(ModelObj):
                                       "new events")
             return Response(body={"error": str(exc)},
                             status_code=exc.status_code)
+        SERVER_INFLIGHT.inc()
+        # root span: an incoming X-MLT-Trace header joins the caller's
+        # trace; otherwise a fresh trace starts here. Steps, remote calls,
+        # and engine phases hang their child spans off event.trace_id
+        span = None
+        tracer = self.tracer
+        if tracer is not None:
+            trace_id, parent_id = parse_trace_header(
+                getattr(event, "headers", None))
+            span = tracer.start_span(
+                "server.run", trace_id=trace_id, parent_id=parent_id,
+                attrs={"path": getattr(event, "path", ""),
+                       "event_id": getattr(event, "id", None)},
+                activate=True)
+            event.trace_id = span.trace_id
+            event.span_id = span.span_id
+        started = time.perf_counter()
+        span_status = "ok"
         try:
-            response = self.graph.run(event)
-        except ResilienceError as exc:
-            # fast failure: typed status, compact log, no traceback spam
-            self._incr_metric(
-                f"server.{type(exc).__name__}")
-            logger.warning("serving resilience rejection",
-                           error=str(exc), kind=type(exc).__name__,
-                           event_id=getattr(event, "id", None))
-            return Response(body={"error": str(exc)},
-                            status_code=exc.status_code)
-        except Exception as exc:  # noqa: BLE001
-            message = f"{exc}\n{traceback.format_exc()}"
-            if server_context:
-                server_context.push_error(event, message, source="graph")
-            if self.error_stream:
-                from .streams import get_stream_pusher
+            try:
+                response = self.graph.run(event)
+            except ResilienceError as exc:
+                # fast failure: typed status, compact log, no traceback spam
+                span_status = "error"
+                self._incr_metric(
+                    f"server.{type(exc).__name__}")
+                logger.warning("serving resilience rejection",
+                               error=str(exc), kind=type(exc).__name__,
+                               event_id=getattr(event, "id", None),
+                               trace_id=getattr(event, "trace_id", None))
+                return Response(
+                    body=self._error_envelope(exc, event),
+                    status_code=exc.status_code)
+            except Exception as exc:  # noqa: BLE001
+                span_status = "error"
+                message = f"{exc}\n{traceback.format_exc()}"
+                if server_context:
+                    server_context.push_error(event, message, source="graph")
+                if self.error_stream:
+                    from .streams import get_stream_pusher
 
-                get_stream_pusher(self.error_stream).push(
-                    {"error": str(exc), "event": str(event.body)})
-            status = getattr(exc, "status_code", None)
-            if not isinstance(status, int) or status < 400:
-                status = 500
-            return Response(body={"error": str(exc)}, status_code=status)
+                    get_stream_pusher(self.error_stream).push(
+                        {"error": str(exc), "event": str(event.body)})
+                status = getattr(exc, "status_code", None)
+                if not isinstance(status, int) or status < 400:
+                    status = 500
+                return Response(body=self._error_envelope(exc, event),
+                                status_code=status)
         finally:
             with self._state_lock:
                 self._inflight -= 1
+            SERVER_INFLIGHT.dec()
+            REQUEST_LATENCY.observe(time.perf_counter() - started)
+            if span is not None:
+                tracer.end_span(span, status=span_status)
         if isinstance(response, MockEvent):
             body = response.body
             if get_body:
@@ -288,6 +343,51 @@ class GraphServer(ModelObj):
         """Drain async branches (flow engine)."""
         if self.graph and hasattr(self.graph, "_flush"):
             self.graph._flush()
+
+    # -- observability -------------------------------------------------------
+    @staticmethod
+    def _error_envelope(exc: Exception, event) -> dict:
+        """Error body with the trace id stamped in so a client can hand
+        support the exact span timeline of its failed request."""
+        envelope = {"error": str(exc)}
+        trace_id = getattr(event, "trace_id", None)
+        if trace_id:
+            envelope["trace_id"] = trace_id
+        return envelope
+
+    def _register_breaker_collector(self):
+        """Scrape-time gauge of every configured breaker's state
+        (0 closed, 1 half-open, 2 open). Weakly bound: the collector
+        retires itself once this server is gone."""
+        if getattr(self, "_breaker_collector", None) is not None:
+            return
+        import weakref
+
+        ref = weakref.ref(self)
+        state_levels = {"closed": 0, "half_open": 1, "open": 2}
+
+        def collect():
+            server = ref()
+            if server is None:
+                return False
+            graph = server.graph
+            steps = []
+            if graph is not None:
+                # a bare RouterStep root keeps children in .routes only
+                steps.extend(getattr(graph, "routes", {}).values())
+                for step in (getattr(graph, "steps", {}) or {}).values():
+                    steps.append(step)
+                    steps.extend(getattr(step, "routes", {}).values())
+            for step in steps:
+                resilience = getattr(step, "_resilience", None)
+                breaker = getattr(resilience, "breaker", None)
+                if breaker is not None:
+                    BREAKER_STATE.set(state_levels.get(breaker.state, 0),
+                                      step=step.name or "")
+            return None
+
+        self._breaker_collector = collect
+        REGISTRY.add_collector(collect)
 
     # -- resilience: health / readiness / drain ------------------------------
     def _incr_metric(self, name: str, value: int = 1):

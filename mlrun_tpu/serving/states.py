@@ -13,11 +13,13 @@ from __future__ import annotations
 import copy
 import importlib
 import inspect
+import time
 import traceback
 from typing import Any, Callable, Optional, Union
 
 from ..chaos import FaultPoints, fire
 from ..model import ModelObj
+from ..obs import STEP_LATENCY
 from ..utils import get_in, logger, update_in
 from .resilience import (
     DeadlineExceeded,
@@ -432,7 +434,10 @@ class QueueStep(BaseStep):
             error_event = copy.copy(event)
             error_event.error = str(exc)
             try:
-                handler.run(error_event)
+                # observed like any step: the error handler is often
+                # the slowest hop of a failing request and must show
+                # in the latency histogram and the span tree
+                self._parent._observed_run(handler, error_event)
                 return
             except Exception as handler_exc:  # noqa: BLE001
                 logger.error("queue on_error handler failed",
@@ -651,6 +656,33 @@ class FlowStep(BaseStep):
     def _children(self, name: str) -> list[BaseStep]:
         return [s for s in self._steps.values() if name in (s.after or [])]
 
+    def _observed_run(self, step: BaseStep, event):
+        """One step execution wrapped in telemetry: the per-step latency
+        histogram always, plus a child span (parented on the server's
+        root span) when the event carries a trace id."""
+        tracer = getattr(self.context, "tracer", None)
+        trace_id = getattr(event, "trace_id", None)
+        span = None
+        if tracer is not None and trace_id:
+            span = tracer.start_span(
+                f"step.{step.name}", trace_id=trace_id,
+                parent_id=getattr(event, "span_id", None),
+                attrs={"kind": step.kind}, activate=True)
+        started = time.perf_counter()
+        try:
+            result = step.run(event)
+        except Exception:
+            STEP_LATENCY.observe(time.perf_counter() - started,
+                                 step=step.name or "")
+            if span is not None:
+                tracer.end_span(span, status="error")
+            raise
+        STEP_LATENCY.observe(time.perf_counter() - started,
+                             step=step.name or "")
+        if span is not None:
+            tracer.end_span(span)
+        return result
+
     def run(self, event, *args, **kwargs):
         """Execute the DAG synchronously: follow after-links from the start
         steps; the responder step's (or last) result becomes the response."""
@@ -660,7 +692,7 @@ class FlowStep(BaseStep):
         while queue:
             step, current = queue.pop(0)
             try:
-                result = step.run(current)
+                result = self._observed_run(step, current)
             except DeadlineExceeded:
                 # no budget left — a fallback handler would still miss the
                 # deadline, so always propagate as a fast 504
@@ -669,7 +701,8 @@ class FlowStep(BaseStep):
                 if step.on_error and step.on_error in self._steps:
                     error_event = copy.copy(current)
                     error_event.error = str(exc)
-                    result = self._steps[step.on_error].run(error_event)
+                    result = self._observed_run(
+                        self._steps[step.on_error], error_event)
                 else:
                     raise
             if result is None and isinstance(step, (QueueStep, JoinStep)):
@@ -695,14 +728,15 @@ class FlowStep(BaseStep):
         while queue:
             step, current = queue.pop(0)
             try:
-                result = step.run(current)
+                result = self._observed_run(step, current)
             except DeadlineExceeded:
                 raise
             except Exception as exc:  # noqa: BLE001
                 if step.on_error and step.on_error in self._steps:
                     error_event = copy.copy(current)
                     error_event.error = str(exc)
-                    result = self._steps[step.on_error].run(error_event)
+                    result = self._observed_run(
+                        self._steps[step.on_error], error_event)
                 else:
                     raise
             if result is None and isinstance(step, (QueueStep, JoinStep)):

@@ -32,4 +32,10 @@ def mfu(tokens_per_sec: float, flops_per_token: float,
         n_chips: int | None = None, device=None) -> float:
     n_chips = n_chips or jax.device_count()
     peak = chip_peak_flops(device) * n_chips
-    return (tokens_per_sec * flops_per_token) / peak
+    value = (tokens_per_sec * flops_per_token) / peak
+    # surface the last computed utilization on /metrics so a scrape
+    # answers "is this slice earning its keep" without a log dive
+    from ..obs import TRAIN_MFU
+
+    TRAIN_MFU.set(value)
+    return value

@@ -48,18 +48,34 @@ def profile_run(context=None, key: str = "xla-trace",
 
 @contextlib.contextmanager
 def annotate(name: str):
-    """Named region in the device trace (TraceAnnotation)."""
+    """Named region in the device trace (TraceAnnotation). When a request
+    span is active on this thread the trace id is stamped into the region
+    name (``<name>|trace=<id16>``), so an XLA device trace in TensorBoard
+    joins the span timeline of the request that dispatched the compute
+    (docs/observability.md)."""
     import jax
 
+    try:
+        from ..config import mlconf
+        from ..obs import get_tracer
+
+        if bool(mlconf.observability.xla_annotations):
+            current = get_tracer().current()
+            if current is not None:
+                name = f"{name}|trace={current.trace_id[:16]}"
+    except Exception:  # noqa: BLE001 - annotation is best-effort telemetry
+        pass
     with jax.profiler.TraceAnnotation(name):
         yield
 
 
 class StepTimer:
-    """Rolling per-step wall-time stats for trainer/serving loops."""
+    """Rolling per-step wall-time stats for trainer/serving loops.
+    ``name`` keys the ``mlt_train_step_seconds`` gauge on /metrics."""
 
-    def __init__(self, window: int = 100):
+    def __init__(self, window: int = 100, name: str = "step"):
         self.window = window
+        self.name = name
         self._times: list[float] = []
         self._last: Optional[float] = None
 
@@ -74,6 +90,12 @@ class StepTimer:
         if len(self._times) > self.window:
             del self._times[: len(self._times) - self.window]
         self._last = None
+        try:
+            from ..obs import TRAIN_STEP_TIME
+
+            TRAIN_STEP_TIME.set(elapsed, timer=self.name)
+        except Exception:  # noqa: BLE001 - telemetry must not break a step
+            pass
         return elapsed
 
     @contextlib.contextmanager

@@ -1,0 +1,595 @@
+"""Unified telemetry (mlrun_tpu/obs): metrics registry + Prometheus
+exposition, cross-service trace propagation, and the two lifecycle fixes
+that rode along (runtime-handler manifest leak, LLM engine stop() epoch
+guard).
+
+Everything is deterministic and host-side except the engine stop-race
+tests, which run a real tiny engine wedged via the ``llm.prefill`` chaos
+point (events, no sleeps beyond the join timeout under test).
+"""
+
+import json
+import math
+import re
+import threading
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.obs import (
+    CHAOS_FIRED,
+    PROBE_REQUESTS,
+    REGISTRY,
+    CardinalityError,
+    MetricError,
+    MetricsRegistry,
+    Tracer,
+    parse_trace_header,
+    trace_id_for,
+)
+
+
+# -- Prometheus text-format parser (the format contract under test) ----------
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r' (?P<value>-?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|Inf|NaN))$',
+    re.IGNORECASE)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str):
+    """Parse exposition text; assert-fail on any malformed line. Returns
+    (samples {(name, labels-frozenset): float}, types {family: type})."""
+    samples = {}
+    types = {}
+    helped = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, family, type_name = line.split(maxsplit=3)
+            assert type_name in ("counter", "gauge", "histogram"), line
+            types[family] = type_name
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"malformed sample line: {line!r}"
+        labels = frozenset(_LABEL_RE.findall(match.group("labels") or ""))
+        value = match.group("value")
+        samples[(match.group("name"), labels)] = (
+            math.inf if value == "+Inf" else float(value))
+    # every family carries HELP + TYPE
+    assert set(types) <= helped
+    return samples, types
+
+
+def check_histogram_consistency(samples, family: str):
+    """Bucket counts cumulative & non-decreasing; +Inf == _count; _sum
+    present — per label group."""
+    groups = {}
+    for (name, labels), value in samples.items():
+        if not name.startswith(family):
+            continue
+        suffix = name[len(family):]
+        base = frozenset(kv for kv in labels if kv[0] != "le")
+        groups.setdefault(base, {})[
+            (suffix, dict(labels).get("le"))] = value
+    assert groups, f"no samples for histogram {family}"
+    for base, series in groups.items():
+        buckets = sorted(
+            ((math.inf if le == "+Inf" else float(le)), value)
+            for (suffix, le), value in series.items()
+            if suffix == "_bucket")
+        counts = [value for _, value in buckets]
+        assert counts == sorted(counts), f"non-cumulative buckets: {base}"
+        assert buckets[-1][0] == math.inf
+        assert buckets[-1][1] == series[("_count", None)]
+        assert series[("_sum", None)] >= 0
+
+
+# -- registry unit behavior --------------------------------------------------
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "a counter", labels=("kind",))
+    c.inc(kind="x")
+    c.inc(2, kind="x")
+    c.inc(kind="y")
+    assert c.value(kind="x") == 3
+    with pytest.raises(MetricError):
+        c.inc(-1, kind="x")
+    g = reg.gauge("t_gauge", "a gauge")
+    g.set(1.5)
+    g.inc()
+    assert g.value() == 2.5
+    h = reg.histogram("t_seconds", "a histogram", buckets=(0.1, 1, 10))
+    for v in (0.05, 0.5, 5, 50):
+        h.observe(v)
+    samples, types = parse_prometheus(reg.render())
+    assert types == {"t_total": "counter", "t_gauge": "gauge",
+                     "t_seconds": "histogram"}
+    assert samples[("t_total", frozenset({("kind", "x")}))] == 3
+    check_histogram_consistency(samples, "t_seconds")
+    assert samples[("t_seconds_count", frozenset())] == 4
+    # counters are monotone across renders
+    c.inc(kind="x")
+    samples2, _ = parse_prometheus(reg.render())
+    assert samples2[("t_total", frozenset({("kind", "x")}))] == 4
+
+
+def test_counter_set_total_is_monotone():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", labels=("e",))
+    c.set_total(5, e="a")
+    c.set_total(3, e="a")  # engine restarted / stats reset: never regress
+    assert c.value(e="a") == 5
+    c.set_total(9, e="a")
+    assert c.value(e="a") == 9
+
+
+def test_cardinality_overflow_typed_error_and_drop_mode():
+    reg = MetricsRegistry()
+    strict = reg.counter("t_strict_total", labels=("k",), max_label_sets=2)
+    strict.inc(k="a")
+    strict.inc(k="b")
+    with pytest.raises(CardinalityError):
+        strict.inc(k="c")
+    assert strict.value(k="a") == 1  # existing series unharmed
+    dropped = reg.counter("t_drop_total", labels=("k",), max_label_sets=2,
+                          overflow="drop")
+    dropped.inc(k="a")
+    dropped.inc(k="b")
+    dropped.inc(k="c")  # silently dropped, counted
+    dropped.inc(k="a")  # existing series still works
+    assert dropped.dropped == 1
+    assert dropped.value(k="a") == 2
+    assert dropped.value(k="c") == 0
+
+
+def test_label_escaping_roundtrip():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", labels=("path",))
+    nasty = 'a"b\\c\nd'
+    c.inc(path=nasty)
+    text = reg.render()
+    samples, _ = parse_prometheus(text)
+    (labels,) = [labels for (name, labels) in samples if name == "t_total"]
+    # unescape what the parser captured and compare to the original
+    (value,) = [v for k, v in labels if k == "path"]
+    unescaped = value.replace("\\n", "\n").replace('\\"', '"').replace(
+        "\\\\", "\\")
+    assert unescaped == nasty
+
+
+def test_registry_type_clash_and_collector_retirement():
+    reg = MetricsRegistry()
+    reg.counter("t_total")
+    with pytest.raises(MetricError):
+        reg.gauge("t_total")
+    calls = []
+    reg.add_collector(lambda: calls.append(1))
+    reg.add_collector(lambda: False)  # retires itself on first scrape
+    reg.render()
+    reg.render()
+    assert len(calls) == 2
+    assert len(reg._collectors) == 1
+
+
+def test_chaos_fire_counter():
+    from mlrun_tpu.chaos import chaos, fail_first, fire
+
+    before = CHAOS_FIRED.value(point="datastore.read")
+    with chaos.inject("datastore.read", fail_first(1),
+                      error=RuntimeError("boom")):
+        with pytest.raises(RuntimeError):
+            fire("datastore.read")
+        fire("datastore.read")  # schedule exhausted: no fire, no count
+    assert CHAOS_FIRED.value(point="datastore.read") == before + 1
+
+
+# -- tracer unit behavior ----------------------------------------------------
+
+def test_trace_header_parse_and_malformed():
+    assert parse_trace_header(None) == (None, None)
+    assert parse_trace_header({"X-MLT-Trace": "abc123-def4"}) == \
+        ("abc123", "def4")
+    assert parse_trace_header({"x-mlt-trace": "abc123"}) == ("abc123", None)
+    # malformed values never break a request
+    assert parse_trace_header({"X-MLT-Trace": "not hex!"}) == (None, None)
+    assert parse_trace_header({"X-MLT-Trace": "abc-XYZ"}) == ("abc", None)
+
+
+def test_tracer_nesting_ring_and_jsonl(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    t = Tracer(ring=8, path=path)
+    with t.span("outer") as outer:
+        assert t.current() is outer
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert t.current() is None
+    names = [s.name for s in t.spans(trace_id=outer.trace_id)]
+    assert names == ["inner", "outer"]  # ended innermost-first
+    lines = [json.loads(line) for line in open(path)]
+    assert {line["name"] for line in lines} == {"inner", "outer"}
+    assert all(line["duration_s"] >= 0 for line in lines)
+
+
+def test_trace_id_for_is_deterministic():
+    assert trace_id_for("uid1") == trace_id_for("uid1")
+    assert trace_id_for("uid1") != trace_id_for("uid2")
+
+
+# -- serving graph integration ----------------------------------------------
+
+def echo(data):
+    return data
+
+
+def _flow_server(tracer=None, name="echo-fn"):
+    fn = mlrun_tpu.new_function(name, kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to(name="echo", handler="echo").respond()
+    server = fn.to_mock_server(namespace={"echo": echo})
+    if tracer is not None:
+        server.tracer = tracer
+        server.context.tracer = tracer
+    return server
+
+
+def test_server_run_creates_spans_and_metrics():
+    tracer = Tracer()
+    server = _flow_server(tracer)
+    hist_before = REGISTRY.get("mlt_request_latency_seconds").value()
+    result = server.test(body={"a": 1}, headers={
+        "X-MLT-Trace": "feed" * 8 + "-" + "ab" * 8})
+    assert result == {"a": 1}
+    spans = tracer.spans(trace_id="feed" * 8)
+    names = {s.name for s in spans}
+    assert names == {"server.run", "step.echo"}
+    root = next(s for s in spans if s.name == "server.run")
+    assert root.parent_id == "ab" * 8
+    step = next(s for s in spans if s.name == "step.echo")
+    assert step.parent_id == root.span_id
+    hist_after = REGISTRY.get("mlt_request_latency_seconds").value()
+    assert hist_after["count"] == hist_before["count"] + 1
+
+
+def test_context_incr_mirrors_to_registry():
+    server = _flow_server(Tracer())
+    events = REGISTRY.get("mlt_serving_events_total")
+    before = events.value(event="custom.metric")
+    server.context.incr("custom.metric", 3)
+    assert server.context.metrics["custom.metric"] == 3  # compat view
+    assert events.value(event="custom.metric") == before + 3
+
+
+def test_trace_propagates_through_remote_step_to_nested_server(
+        tmp_path, monkeypatch):
+    """Acceptance: a client trace id crosses RemoteStep into a nested
+    GraphServer and shows up in both sides' span JSONL with matching
+    ids and a correct parent chain."""
+    tracer_a = Tracer(path=str(tmp_path / "a.jsonl"))
+    tracer_b = Tracer(path=str(tmp_path / "b.jsonl"))
+    server_b = _flow_server(tracer_b, name="inner-fn")
+
+    captured = {}
+
+    class FakeResponse:
+        status_code = 200
+
+        def __init__(self, body):
+            self._body = body
+
+        def raise_for_status(self):
+            pass
+
+        def json(self):
+            return self._body
+
+    def fake_request(method, url, headers=None, timeout=None, json=None,
+                     data=None, **kwargs):
+        captured["headers"] = dict(headers or {})
+        from mlrun_tpu.serving.server import MockEvent
+
+        event = MockEvent(body=json, path="/", method=method,
+                          headers=dict(headers or {}))
+        return FakeResponse(server_b.run(event, get_body=True))
+
+    import requests
+
+    monkeypatch.setattr(requests, "request", fake_request)
+
+    fn = mlrun_tpu.new_function("outer-fn", kind="serving")
+    graph = fn.set_topology("flow")
+    graph.to("mlrun_tpu.serving.remote.RemoteStep", name="hop",
+             url="http://nested.local").respond()
+    server_a = fn.to_mock_server()
+    server_a.tracer = tracer_a
+    server_a.context.tracer = tracer_a
+
+    trace_id = "cafe" * 8
+    result = server_a.test(body={"inputs": [1]}, headers={
+        "X-MLT-Trace": f"{trace_id}-1234567890abcdef"})
+    assert result == {"inputs": [1]}
+
+    # side A: root -> step -> remote, one trace
+    spans_a = tracer_a.spans(trace_id=trace_id)
+    by_name = {s.name: s for s in spans_a}
+    assert set(by_name) == {"server.run", "step.hop", "remote.hop"}
+    assert by_name["step.hop"].parent_id == by_name["server.run"].span_id
+    assert by_name["remote.hop"].parent_id == by_name["step.hop"].span_id
+
+    # the outbound hop injected the trace header with the remote span id
+    sent = captured["headers"].get("X-MLT-Trace", "")
+    assert sent == f"{trace_id}-{by_name['remote.hop'].span_id}"
+
+    # side B: same trace id, rooted under A's remote span
+    spans_b = tracer_b.spans(trace_id=trace_id)
+    names_b = {s.name: s for s in spans_b}
+    assert set(names_b) == {"server.run", "step.echo"}
+    assert names_b["server.run"].parent_id == by_name["remote.hop"].span_id
+
+    # both JSONL artifacts carry the trace id
+    for path in (tmp_path / "a.jsonl", tmp_path / "b.jsonl"):
+        lines = [json.loads(line) for line in open(path)]
+        assert any(line["trace_id"] == trace_id for line in lines)
+
+
+# -- /metrics over HTTP: serving gateway + service API -----------------------
+
+@pytest.fixture()
+def gateway_url(isolated_home):
+    import asyncio
+    import socket
+
+    from aiohttp import web
+
+    from mlrun_tpu.serving.asgi import build_serving_app
+
+    server = _flow_server()
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    box = {}
+
+    async def serve():
+        runner = web.AppRunner(build_serving_app(server))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        started.set()
+        while not box.get("stop"):
+            await asyncio.sleep(0.05)
+        await runner.cleanup()
+
+    thread = threading.Thread(
+        target=lambda: (asyncio.set_event_loop(loop),
+                        loop.run_until_complete(serve())), daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield f"http://127.0.0.1:{port}"
+    box["stop"] = True
+    thread.join(timeout=5)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_gateway_metrics_endpoint_and_probe_isolation(gateway_url):
+    import requests
+
+    from mlrun_tpu.obs import get_tracer
+
+    requests.post(gateway_url + "/", json={"inputs": [1]}, timeout=10)
+    spans_before = len(get_tracer().spans())
+    probes_before = PROBE_REQUESTS.value(path="/healthz")
+    assert requests.get(gateway_url + "/healthz", timeout=10).ok
+    assert requests.get(gateway_url + "/readyz", timeout=10).ok
+    resp = requests.get(gateway_url + "/metrics", timeout=10)
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    samples, types = parse_prometheus(resp.text)
+    # core families across engine / resilience / step-latency areas
+    for family in ("mlt_request_latency_seconds", "mlt_step_latency_seconds",
+                   "mlt_serving_events_total", "mlt_probe_requests_total",
+                   "mlt_llm_ttft_seconds", "mlt_llm_itl_seconds",
+                   "mlt_breaker_state", "mlt_run_retries_total",
+                   "mlt_run_stall_aborts_total", "mlt_chaos_fired_total"):
+        assert family in types, f"missing family {family}"
+    check_histogram_consistency(samples, "mlt_request_latency_seconds")
+    check_histogram_consistency(samples, "mlt_step_latency_seconds")
+    # probes counted on the dedicated counter...
+    assert PROBE_REQUESTS.value(path="/healthz") == probes_before + 1
+    # ...but allocate NO spans (scrapers must not pollute request traces)
+    assert len(get_tracer().spans()) == spans_before
+    # monotone across scrapes
+    resp2 = requests.get(gateway_url + "/metrics", timeout=10)
+    samples2, _ = parse_prometheus(resp2.text)
+    for key, value in samples.items():
+        name = key[0]
+        if name.endswith("_total") or name.endswith("_count") \
+                or name.endswith("_bucket"):
+            assert samples2.get(key, 0) >= value, f"{key} went backwards"
+
+
+def test_service_api_metrics_endpoint(service):
+    import requests
+
+    url, _ = service
+    resp = requests.get(url + "/metrics", timeout=10)
+    assert resp.status_code == 200
+    samples, types = parse_prometheus(resp.text)
+    for family in ("mlt_run_submits_total", "mlt_run_retries_total",
+                   "mlt_run_stall_aborts_total", "mlt_probe_requests_total",
+                   "mlt_serving_events_total"):
+        assert family in types
+    # open without auth even when a service token is required
+    from mlrun_tpu.config import mlconf
+
+    mlconf.httpdb.auth_token = "sekret"
+    try:
+        assert requests.get(url + "/metrics", timeout=10).status_code == 200
+        runs = requests.get(url + "/api/v1/runs", timeout=10)
+        assert runs.status_code == 401
+    finally:
+        mlconf.httpdb.auth_token = ""
+    # the kill switch turns exposition off (collection stays on)
+    mlconf.observability.metrics_enabled = False
+    try:
+        assert requests.get(url + "/metrics", timeout=10).status_code == 404
+    finally:
+        mlconf.observability.metrics_enabled = True
+
+
+# -- satellite: runtime-handler manifest leak --------------------------------
+
+class _BoomProvider:
+    def create(self, resource, uid):
+        raise RuntimeError("cluster rejected the manifest")
+
+
+class _NullDB:
+    def update_run(self, *args, **kwargs):
+        pass
+
+
+def test_failed_create_drops_cached_manifest():
+    from mlrun_tpu.model import RunObject
+    from mlrun_tpu.service.runtime_handlers import KubeJobHandler
+
+    handler = KubeJobHandler(_NullDB(), _BoomProvider())
+    runtime = mlrun_tpu.new_function("leaky", kind="job")
+    run = RunObject.from_dict({
+        "metadata": {"name": "leaky", "uid": "u" * 32, "project": "p"}})
+    for _ in range(3):  # repeatedly failing submissions must not pile up
+        with pytest.raises(RuntimeError, match="cluster rejected"):
+            handler.run(runtime, run)
+    assert handler._manifests == {}
+    assert handler._resources == {}
+
+
+def test_successful_create_keeps_manifest_for_retry():
+    from mlrun_tpu.model import RunObject
+    from mlrun_tpu.service.runtime_handlers import KubeJobHandler
+
+    class OkProvider:
+        def create(self, resource, uid):
+            return f"pod-{uid[:6]}"
+
+    handler = KubeJobHandler(_NullDB(), OkProvider())
+    runtime = mlrun_tpu.new_function("ok", kind="job")
+    run = RunObject.from_dict({
+        "metadata": {"name": "ok", "uid": "v" * 32, "project": "p"}})
+    handler.run(runtime, run)
+    assert "v" * 32 in handler._manifests  # retry path still has it
+    assert "v" * 32 in handler._resources
+
+
+# -- satellite: LLM engine stop() epoch guard --------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+@pytest.mark.chaos
+def test_stop_race_epoch_guard_dense(tiny_model):
+    """join(timeout) returning with the scheduler wedged in a dispatch
+    must NOT tear down the in-flight admission from stop(): the live
+    thread owns it (epoch guard). Old behavior double-resolved the
+    future (InvalidStateError inside the scheduler)."""
+    from mlrun_tpu.chaos import chaos
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+    from mlrun_tpu.serving.resilience import EngineStoppedError
+
+    config, params = tiny_model
+    engine = ContinuousBatchingEngine(config, params, max_len=128, slots=2,
+                                      prefill_buckets=(32,))
+    wedged = threading.Event()
+    release = threading.Event()
+
+    def wedge(point, context):
+        wedged.set()
+        release.wait(20)
+
+    injection = chaos.inject("llm.prefill", action=wedge)
+    try:
+        first = engine.submit(list(range(1, 9)), max_new_tokens=8)
+        assert wedged.wait(30), "scheduler never reached prefill"
+        thread = engine._thread
+        queued = engine.submit(list(range(1, 5)), max_new_tokens=4)
+        engine.stop(timeout=0.2)  # join times out: scheduler still live
+        # queued work failed promptly by stop(); the wedged admission
+        # is NOT touched — its future is still pending
+        with pytest.raises(EngineStoppedError):
+            queued.result(timeout=5)
+        assert not first.done()
+    finally:
+        injection.remove()
+        release.set()
+    # the disowned scheduler finishes its dispatch, then runs the
+    # teardown itself: exactly one resolution, no InvalidStateError
+    thread.join(timeout=30)
+    assert not thread.is_alive()
+    with pytest.raises(EngineStoppedError):
+        first.result(timeout=5)
+    assert all(not s.active for s in engine._slot_state)
+
+
+@pytest.mark.chaos
+def test_stop_race_page_accounting_paged(tiny_model):
+    """After a wedged stop, the scheduler-owned teardown must leave the
+    page free-list consistent (no page-table vs free-list divergence)."""
+    from mlrun_tpu.chaos import chaos
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+    from mlrun_tpu.serving.resilience import EngineStoppedError
+
+    config, params = tiny_model
+    engine = PagedContinuousBatchingEngine(
+        config, params, max_len=128, slots=2, page_size=32,
+        prefill_buckets=(32,), prefix_cache=False)
+    wedged = threading.Event()
+    release = threading.Event()
+    injection = chaos.inject(
+        "llm.prefill",
+        action=lambda point, ctx: (wedged.set(), release.wait(20)))
+    try:
+        future = engine.submit(list(range(1, 9)), max_new_tokens=8)
+        assert wedged.wait(30)
+        thread = engine._thread
+        engine.stop(timeout=0.2)
+    finally:
+        injection.remove()
+        release.set()
+    thread.join(timeout=30)
+    with pytest.raises(EngineStoppedError):
+        future.result(timeout=5)
+    # every page back on the free list, page table fully unmapped
+    assert len(engine._free_pages) == engine.n_pages
+    assert (engine._page_table == -1).all()
+
+
+def test_stop_without_wedge_still_drains(tiny_model):
+    """The common path is unchanged: stop() after a clean join fails
+    queued futures immediately."""
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+    from mlrun_tpu.serving.resilience import EngineStoppedError
+
+    config, params = tiny_model
+    engine = ContinuousBatchingEngine(config, params, max_len=128, slots=2,
+                                      prefill_buckets=(32,))
+    tokens, _ = engine.generate(list(range(1, 9)), max_new_tokens=4,
+                                timeout=120)
+    assert len(tokens) == 4
+    engine.stop()
+    with pytest.raises(EngineStoppedError):
+        engine.submit([1, 2, 3]).result(timeout=5)
