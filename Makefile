@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-train obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -30,6 +30,9 @@ bench-serving:   ## serving TTFT benchmark (one JSON line)
 
 bench-serve:     ## prefix-cache / chunked-prefill microbench, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py
+
+bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train
 
 obs-smoke:       ## boot a graph, scrape /metrics, assert a span artifact (docs/observability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py

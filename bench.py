@@ -10,6 +10,13 @@ Each config attempt runs in its OWN subprocess: a failed attempt (OOM,
 compile error) otherwise leaves HBM allocations behind on the chip and
 poisons every later attempt in the same process (observed 2026-07-29: after
 one compile-OOM at batch 32, even the tiny model hit RESOURCE_EXHAUSTED).
+
+``bench.py --train`` runs the hot-loop pipelining A-B microbench instead
+(``make bench-train``, CPU-runnable): prefetch-off vs prefetch-on steps/s
++ input-wait seconds on the same tiny model and a simulated host input
+cost, plus a cold-vs-warm ``Trainer.warmup()`` through the persistent
+compile cache (docs/training_performance.md). One JSON line, same
+envelope as bench_serve.py.
 """
 
 from __future__ import annotations
@@ -133,6 +140,114 @@ def _probe_platform() -> str:
     return out.stdout.strip().splitlines()[-1]
 
 
+# -- hot-loop pipelining A-B (make bench-train) ------------------------------
+
+def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
+              depth: int = 2, input_delay_s: float = 0.025,
+              cache_dir: str | None = None, log_every: int = 0) -> dict:
+    """Prefetch-off vs prefetch-on A-B on the tiny model (CPU-runnable).
+
+    ``input_delay_s`` simulates per-batch host input cost (tokenization/
+    IO); the prefetch arm should hide it under step compute, so steps/s
+    rises and ``input_wait_seconds`` drops. The default (25ms against a
+    ~100-250ms CPU step) keeps the expected gap well above CPU-load
+    timing noise, so the A-B stays monotone run to run. Both arms init from the same
+    seed and consume the same synthetic stream, so the final losses must
+    match bit-exactly (asserted in the tier-1 smoke test). The OFF arm's
+    ``warmup()`` is the cold compile and the ON arm's the warm one —
+    with a persistent cache dir the second skips XLA.
+    """
+    import tempfile
+    import time
+
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.models import tiny_llama
+    from mlrun_tpu.training import TrainConfig, Trainer, \
+        synthetic_token_stream
+
+    from mlrun_tpu.utils import compile_cache
+
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="mlt-compile-cache-")
+    previous_cache = str(mlconf.training.get("compile_cache_dir", "") or "")
+    mlconf.training.compile_cache_dir = cache_dir
+    config = tiny_llama(attention_impl="reference", remat=False)
+    log_every = log_every or steps
+
+    def _delayed(stream):
+        for item in stream:
+            if input_delay_s:
+                time.sleep(input_delay_s)
+            yield item
+
+    def _arm(prefetch: int) -> dict:
+        trainer = Trainer(config, TrainConfig(total_steps=steps + 4))
+        trainer.init(0)
+        warm = trainer.warmup(batch, seq)
+        stream = _delayed(synthetic_token_stream(batch, seq,
+                                                 config.vocab_size))
+        out = trainer.fit(stream, steps=steps, log_every=log_every,
+                          prefetch=prefetch)
+        tps = out["tokens_per_sec"]
+        return {
+            "steps_per_sec": tps / (batch * seq),
+            "tokens_per_sec": tps,
+            "input_wait_seconds": out["input_wait_seconds"],
+            "compile_seconds": warm.get("compile_seconds", 0.0),
+            "loss": out["loss"],
+            "mfu": out["mfu"],
+        }
+
+    try:
+        off = _arm(0)
+        on = _arm(depth)
+    finally:
+        # restore the caller's cache config (the smoke test runs this
+        # in-process — a leaked global would re-point every later
+        # Trainer at the bench's tmp dir)
+        mlconf.training.compile_cache_dir = previous_cache
+        if previous_cache:
+            compile_cache.configure(previous_cache)
+        else:
+            compile_cache.disable()
+    ratio = (on["steps_per_sec"] / off["steps_per_sec"]
+             if off["steps_per_sec"] else 0.0)
+    return {
+        "metric": "train_prefetch_steps_per_sec_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        # parity (1.0) is the floor: prefetch must never cost throughput
+        "vs_baseline": round(ratio, 4),
+        "detail": {
+            "prefetch_off": {k: round(v, 6) for k, v in off.items()},
+            "prefetch_on": {k: round(v, 6) for k, v in on.items()},
+            "prefetch_depth": depth,
+            "steps": steps, "batch": batch, "seq": seq,
+            "input_delay_s": input_delay_s,
+            "compile_cold_s": round(off["compile_seconds"], 3),
+            "compile_warm_s": round(on["compile_seconds"], 3),
+            "loss_parity": off["loss"] == on["loss"],
+            "cache_dir": cache_dir,
+        },
+    }
+
+
+def _train_main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--train", action="store_true")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--depth", type=int, default=2)
+    parser.add_argument("--input-delay-ms", type=float, default=25.0)
+    args = parser.parse_args()
+    out = run_train(steps=args.steps, batch=args.batch, seq=args.seq,
+                    depth=args.depth,
+                    input_delay_s=args.input_delay_ms / 1000.0)
+    print(json.dumps(out))
+
+
 def main():
     platform = _probe_platform()
     on_tpu = platform in ("tpu", "axon")
@@ -188,5 +303,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--one":
         _subprocess_main()
+    elif "--train" in sys.argv:
+        _train_main()
     else:
         main()

@@ -74,6 +74,12 @@ class FaultPoints:
     # per evicted page with page_id/refcount context; an action() here
     # observes eviction order, an error models a poisoned reclaim
     llm_prefix_evict = "llm.prefix_evict"
+    # training device-prefetch stage (training/data.py
+    # DevicePrefetchIterator): fires on the background thread once per
+    # host batch BEFORE the H2D transfer — a delay() stalls the input
+    # pipeline (input-boundness on demand), an error models a poisoned
+    # batch reaching the consumer at its exact position
+    train_prefetch = "train.prefetch"
 
     @staticmethod
     def all() -> list[str]:
@@ -86,6 +92,7 @@ class FaultPoints:
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
+            FaultPoints.train_prefetch,
         ]
 
 

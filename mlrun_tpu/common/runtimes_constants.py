@@ -13,6 +13,13 @@ from __future__ import annotations
 RESUME_CHECKPOINT_ENV = "MLT_RESUME_FROM_CHECKPOINT"
 RESUME_STEP_ENV = "MLT_RESUME_STEP"
 
+# persistent XLA compilation-cache dir, threaded into (re)submitted TPU
+# JobSets by TpuJobHandler. The name is the mlconf env mapping for
+# ``mlconf.training.compile_cache_dir`` on purpose: the in-pod trainer
+# picks it up through the ordinary config layer
+# (utils/compile_cache.configure_from_mlconf) with no extra plumbing.
+COMPILE_CACHE_ENV = "MLT_TRAINING__COMPILE_CACHE_DIR"
+
 
 class RunStates:
     created = "created"

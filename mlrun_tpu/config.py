@@ -130,6 +130,25 @@ default_config: dict[str, Any] = {
             "dcn_axes": ["data"],
         },
     },
+    "training": {
+        # hot-loop pipelining defaults (docs/training_performance.md);
+        # Trainer.fit arguments override these per run.
+        # device-prefetch depth: host batches pulled + transferred ahead
+        # of the consuming step so H2D overlaps compute (0 = off)
+        "prefetch": 2,
+        # defer log-point metric reads via async device->host copies,
+        # drained one log interval later (callbacks force the synchronous
+        # path — they are handed same-step host values)
+        "defer_metrics": True,
+        # steps excluded from the steady-state tokens_per_sec/MFU window
+        # (first-step compile + ramp); compile time is reported separately
+        # as compile_seconds
+        "warmup_steps_excluded": 1,
+        # persistent XLA compilation-cache dir ("" = disabled); the
+        # service threads this into resubmitted JobSets
+        # (COMPILE_CACHE_ENV) so a preemption-resume restarts warm
+        "compile_cache_dir": "",
+    },
     "scheduler": {"min_allowed_interval_seconds": 60, "tick_seconds": 5.0},
     "serving": {
         "default_batching_timeout_ms": 5,

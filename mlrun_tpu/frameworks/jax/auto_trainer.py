@@ -139,7 +139,9 @@ def train(context: MLClientCtx | None = None,
           callbacks: list | None = None,
           model_name: str = "model",
           log_every: int = 10,
-          seed: int = 0) -> dict:
+          seed: int = 0,
+          prefetch: int | None = None,
+          warmup: bool = True) -> dict:
     """Run a (LoRA) fine-tune end-to-end inside a run context.
 
     This is the handler the ``tpujob`` runtime executes on every host of the
@@ -230,13 +232,31 @@ def train(context: MLClientCtx | None = None,
     # exit instead of a killed run (training/preemption.py)
     from ...training.preemption import PreemptionGuard
 
+    if warmup:
+        # AOT-compile the step before the loop: compile time lands in
+        # compile_seconds (kept out of steady-state MFU), and with
+        # mlconf.training.compile_cache_dir set — threaded into
+        # resubmitted JobSets by the service — a preemption-resume
+        # restart skips XLA entirely (docs/training_performance.md)
+        try:
+            warm = trainer.warmup(batch_size, seq_len)
+        except Exception as exc:  # noqa: BLE001 - a warmup failure must
+            # degrade to a first-step compile, not kill the run
+            logger.warning("warmup failed — compiling on first step",
+                           error=str(exc))
+        else:
+            if context is not None and warm.get("compile_seconds"):
+                context.log_result("compile_seconds",
+                                   warm["compile_seconds"])
+
     guard = PreemptionGuard().install()
     start = time.perf_counter()
     try:
         final_metrics = trainer.fit(
             stream, steps=steps, context=context, log_every=log_every,
             callbacks=callbacks, checkpoint_manager=manager,
-            preemption_guard=guard, epoch_steps=epoch_steps)
+            preemption_guard=guard, epoch_steps=epoch_steps,
+            prefetch=prefetch)
     finally:
         guard.restore()
     elapsed = time.perf_counter() - start

@@ -102,6 +102,29 @@ TRAIN_MFU = REGISTRY.gauge(
 TRAIN_STEP_TIME = REGISTRY.gauge(
     "mlt_train_step_seconds", "Last step wall time per StepTimer",
     labels=("timer",), overflow="drop")
+TRAIN_INPUT_WAIT = REGISTRY.counter(
+    "mlt_train_input_wait_seconds",
+    "Cumulative seconds the training loop spent blocked waiting on the "
+    "input pipeline (next(data_iter)) — a growing rate proves the run is "
+    "input-bound, not FLOPs-bound")
+TRAIN_H2D_BYTES = REGISTRY.counter(
+    "mlt_train_h2d_bytes_total",
+    "Host->device batch bytes issued by the training input path "
+    "(device prefetch stage or inline shard_batch)")
+TRAIN_COMPILE_SECONDS = REGISTRY.gauge(
+    "mlt_train_compile_seconds",
+    "Wall seconds of the last train-step XLA compile (Trainer.warmup or "
+    "the first fit step) — near-zero after a persistent-cache hit")
+TRAIN_LOADER_OCCUPANCY = REGISTRY.gauge(
+    "mlt_train_loader_ring_occupancy",
+    "Staged batches currently in the native TokenShardLoader ring buffer "
+    "(0 with consumer waits climbing = input-bound)",
+    labels=("loader",), overflow="drop")
+TRAIN_LOADER_EVENTS = REGISTRY.counter(
+    "mlt_train_loader_events_total",
+    "Cumulative TokenShardLoader counters mirrored from stats() "
+    "(batches, consumer_waits, producer_waits, epochs)",
+    labels=("loader", "event"), max_label_sets=512, overflow="drop")
 
 
 def _install_chaos_observer():

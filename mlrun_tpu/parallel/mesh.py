@@ -94,10 +94,14 @@ def make_mesh(shape: dict | None = None, devices=None,
     num_slices = num_slices or _detect_num_slices(devices)
     # Auto axis types: we annotate params/data in/out shardings and let
     # GSPMD propagate + insert collectives (jax 0.9 defaults to Explicit,
-    # which demands per-op sharding types instead).
-    from jax.sharding import AxisType
+    # which demands per-op sharding types instead). jax builds that
+    # predate AxisType are Auto-only — the kwarg is simply omitted.
+    try:
+        from jax.sharding import AxisType
 
-    axis_types = (AxisType.Auto,) * len(names)
+        mesh_kwargs = {"axis_types": (AxisType.Auto,) * len(names)}
+    except ImportError:
+        mesh_kwargs = {}
     if num_slices > 1:
         from jax.experimental.mesh_utils import create_hybrid_device_mesh
 
@@ -109,14 +113,13 @@ def make_mesh(shape: dict | None = None, devices=None,
         ici = (sizes[0] // num_slices,) + sizes[1:]
         device_array = create_hybrid_device_mesh(
             ici, dcn, devices=devices, allow_split_physical_axes=True)
-        return Mesh(device_array, names, axis_types=axis_types)
+        return Mesh(device_array, names, **mesh_kwargs)
     try:
-        return jax.make_mesh(sizes, names, devices=devices,
-                             axis_types=axis_types)
+        return jax.make_mesh(sizes, names, devices=devices, **mesh_kwargs)
     except TypeError:
-        # older signature without devices kwarg
+        # older signature without devices/axis_types kwargs
         device_array = np.asarray(devices).reshape(sizes)
-        return Mesh(device_array, names, axis_types=axis_types)
+        return Mesh(device_array, names, **mesh_kwargs)
 
 
 def _detect_num_slices(devices) -> int:

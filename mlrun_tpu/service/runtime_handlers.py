@@ -28,6 +28,7 @@ from ..common.retry import (
     resolve_retry_policy,
 )
 from ..common.runtimes_constants import (
+    COMPILE_CACHE_ENV,
     RESUME_CHECKPOINT_ENV,
     RESUME_STEP_ENV,
     JobSetConditions,
@@ -718,6 +719,11 @@ class TpuJobHandler(BaseRuntimeHandler):
             "MLT_DBPATH": mlconf.get("dbpath", "")
             or f"http://127.0.0.1:{mlconf.httpdb.port}",
         }
+        cache_dir = self._compile_cache_dir()
+        if cache_dir:
+            # persistent XLA compile cache (utils/compile_cache.py): the
+            # first attempt populates it, a preemption-resume restarts warm
+            env[COMPILE_CACHE_ENV] = cache_dir
         env.update(self._secret_env(run.metadata.project))
         build = runtime.spec.build
         if build and build.functionSourceCode:
@@ -729,14 +735,22 @@ class TpuJobHandler(BaseRuntimeHandler):
         command = _wrap_with_bootstrap(runtime, command)
         return runtime.generate_jobset(run, extra_env=env, command=command)
 
+    @staticmethod
+    def _compile_cache_dir() -> str:
+        training = mlconf.get("training")
+        if training is None:
+            return ""
+        return str(training.get("compile_cache_dir", "") or "")
+
     def _customize_retry_manifest(self, manifest: dict, run: dict,
                                   attempt: int):
         """Rescheduled pod-slices resume instead of restarting: fix the
         JobSet's name-derived wiring (headless-service subdomain, the
-        MEGASCALE coordinator address) for the renamed manifest, and
-        inject the latest checkpoint path + step recorded on
+        MEGASCALE coordinator address) for the renamed manifest, inject
+        the latest checkpoint path + step recorded on
         ``status.checkpoint`` so training/train.py restores before the
-        first step."""
+        first step, and thread the persistent compile-cache dir so the
+        replacement skips XLA recompilation (warm restart)."""
         new_name = manifest.get("metadata", {}).get("name", "")
         checkpoint = get_in(run, "status.checkpoint", {}) or {}
         resume_env = []
@@ -746,6 +760,10 @@ class TpuJobHandler(BaseRuntimeHandler):
             if checkpoint.get("step") is not None:
                 resume_env.append({"name": RESUME_STEP_ENV,
                                    "value": str(checkpoint["step"])})
+        cache_dir = self._compile_cache_dir()
+        if cache_dir:
+            resume_env.append({"name": COMPILE_CACHE_ENV,
+                               "value": cache_dir})
         for job in get_in(manifest, "spec.replicatedJobs", []) or []:
             pod_spec = get_in(job, "template.spec.template.spec", {}) or {}
             if pod_spec.get("subdomain") and new_name:
@@ -756,7 +774,16 @@ class TpuJobHandler(BaseRuntimeHandler):
                     if item.get("name") == "MEGASCALE_COORDINATOR_ADDRESS" \
                             and new_name:
                         item["value"] = f"{new_name}-slice-0-0.{new_name}"
-                env.extend(copy.deepcopy(resume_env))
+                # upsert: the pristine manifest may already carry the
+                # cache env (build_resource) — overwrite in place rather
+                # than appending a duplicate name
+                for item in resume_env:
+                    for existing in env:
+                        if existing.get("name") == item["name"]:
+                            existing["value"] = item["value"]
+                            break
+                    else:
+                        env.append(copy.deepcopy(item))
 
 
 class DaskHandler(KubeJobHandler):

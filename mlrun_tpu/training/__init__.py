@@ -1,5 +1,6 @@
 from .checkpoint import CheckpointManager, save_checkpoint_artifact  # noqa: F401
 from .data import (  # noqa: F401
+    DevicePrefetchIterator,
     TokenShardLoader,
     array_token_stream,
     device_prefetch,
@@ -7,7 +8,7 @@ from .data import (  # noqa: F401
     synthetic_token_stream,
     text_file_stream,
 )
-from .mfu import chip_peak_flops, mfu  # noqa: F401
+from .mfu import ThroughputTracker, chip_peak_flops, mfu  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .train import (  # noqa: F401
     TrainConfig,

@@ -9,9 +9,14 @@ jax.make_array_from_process_local_data when running SPMD).
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
+
+from ..chaos import FaultPoints, fire
 
 
 def synthetic_token_stream(batch_size: int, seq_len: int, vocab_size: int,
@@ -119,6 +124,14 @@ class TokenShardLoader:
         self._lib.mlt_loader_total_tokens.restype = ctypes.c_uint64
         self._lib.mlt_loader_epoch.restype = ctypes.c_uint64
         self._lib.mlt_loader_close.argtypes = [ctypes.c_uint64]
+        try:
+            self._lib.mlt_loader_stats.restype = ctypes.c_int
+            self._lib.mlt_loader_stats.argtypes = [
+                ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]
+            self._has_stats = True
+        except AttributeError:
+            # an older libmlt_data.so without the stats export still loads
+            self._has_stats = False
 
         arr = (ctypes.c_char_p * len(self.paths))(
             *[p.encode() for p in self.paths])
@@ -130,6 +143,10 @@ class TokenShardLoader:
                 f"mlt_loader_open failed for {self.paths} (empty shards, "
                 f"bad dtype, or shards shorter than seq_len+1)")
         self._buf = np.empty((batch_size, seq_len + 1), np.int32)
+        self._obs_name = (f"{os.path.basename(self.paths[0])}"
+                          f"@{self._handle}")
+        self._metrics_registered = False
+        self._register_metrics()
 
     @property
     def total_tokens(self) -> int:
@@ -138,6 +155,70 @@ class TokenShardLoader:
     @property
     def epoch(self) -> int:
         return int(self._lib.mlt_loader_epoch(self._handle))
+
+    def stats(self) -> dict:
+        """Engine-style telemetry snapshot: ring occupancy + wait
+        counters from the native side. ``consumer_waits`` climbing while
+        ``ring_occupancy`` sits at 0 is the input-bound signature; the
+        same keys surface on ``/metrics`` via the registry collector."""
+        import ctypes
+
+        out = {"queue_depth": 0, "ring_occupancy": 0, "batches": 0,
+               "consumer_waits": 0, "producer_waits": 0}
+        if self._handle and self._has_stats:
+            raw = (ctypes.c_uint64 * 5)()
+            if self._lib.mlt_loader_stats(self._handle, raw):
+                out.update(ring_occupancy=int(raw[0]),
+                           queue_depth=int(raw[1]), batches=int(raw[2]),
+                           consumer_waits=int(raw[3]),
+                           producer_waits=int(raw[4]))
+        out["epochs"] = int(self.epoch) if self._handle else 0
+        return out
+
+    # cumulative stats() keys mirrored as counter series at scrape time
+    _COUNTER_STATS = ("batches", "consumer_waits", "producer_waits",
+                      "epochs")
+
+    def _register_metrics(self):
+        """Expose the ring on the process registry the way the LLM
+        engines do: a weakly-bound scrape-time collector that retires
+        itself (and removes its series) once the loader is closed or
+        collected."""
+        if self._metrics_registered:
+            return
+        import weakref
+
+        try:
+            from ..obs import (
+                REGISTRY,
+                TRAIN_LOADER_EVENTS,
+                TRAIN_LOADER_OCCUPANCY,
+            )
+        except Exception:  # noqa: BLE001 - telemetry must never block IO
+            return
+        ref = weakref.ref(self)
+        name = self._obs_name
+        counter_stats = self._COUNTER_STATS
+
+        def remove_series():
+            TRAIN_LOADER_OCCUPANCY.remove(loader=name)
+            for key in counter_stats:
+                TRAIN_LOADER_EVENTS.remove(loader=name, event=key)
+
+        def collect():
+            loader = ref()
+            if loader is None or not loader._handle:
+                remove_series()
+                return False
+            stats = loader.stats()
+            TRAIN_LOADER_OCCUPANCY.set(stats["ring_occupancy"], loader=name)
+            for key in counter_stats:
+                TRAIN_LOADER_EVENTS.set_total(stats[key], loader=name,
+                                              event=key)
+            return None
+
+        REGISTRY.add_collector(collect)
+        self._metrics_registered = True
 
     def __iter__(self):
         return self
@@ -175,7 +256,12 @@ class TokenShardLoader:
 def device_prefetch(stream, sharding=None, depth: int = 2):
     """Wrap a (tokens, targets) host iterator with device-side prefetch:
     keeps ``depth`` batches already transferred (optionally with a
-    NamedSharding) so the train step never waits on host->HBM copies."""
+    NamedSharding) so the train step never waits on host->HBM copies.
+
+    Synchronous variant: transfers are *issued* ahead but the host batch
+    for slot k+depth is still pulled on the consumer thread between
+    steps. ``DevicePrefetchIterator`` moves that pull (and the transfer
+    issue) onto a background thread — ``Trainer.fit`` uses it."""
     import collections
 
     import jax
@@ -202,3 +288,182 @@ def device_prefetch(stream, sharding=None, depth: int = 2):
         except StopIteration:
             pass
         yield out
+
+
+class _PrefetchError:
+    """Queue envelope carrying a producer-side exception to the consumer
+    at the exact batch position it occurred."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_PREFETCH_END = object()  # sentinel: upstream iterator exhausted
+
+
+class DevicePrefetchIterator:
+    """Bounded background device-prefetch stage for the training loop.
+
+    A producer thread pulls host batches from ``stream`` (a generator or
+    :class:`TokenShardLoader`), issues the host->device transfer — via
+    ``per_process_batch`` when a sharding is given, which routes through
+    ``jax.make_array_from_process_local_data`` under multi-host SPMD —
+    and stages the device arrays in a queue of ``depth`` entries. The
+    consuming step therefore overlaps its compute with both the NEXT
+    batch's host production (tokenization/IO) and its H2D copy, instead
+    of paying them serially between dispatches (arXiv:2011.03641 §4:
+    input staging, not FLOPs, sets the pod-scale throughput ceiling).
+
+    Contracts:
+
+    - **Order-preserving and deterministic** — one producer thread pulls
+      sequentially; consumers see exactly the upstream batch sequence.
+    - **Error-transparent** — a producer-side exception (bad shard, chaos
+      injection at ``train.prefetch``) surfaces on the consumer at the
+      position of the failing batch, not as a hang.
+    - **Deadlock-free shutdown** — ``close()`` drains the queue while the
+      producer may be blocked on a full one, so a preemption exit
+      (``PreemptionGuard.agreed()`` before ``next()``) never waits on a
+      prefetched batch nobody will consume. Prefetched-but-unconsumed
+      batches are simply dropped.
+
+    Telemetry: ``stats()`` reports wait seconds / staged bytes, and the
+    process registry gets ``mlt_train_input_wait_seconds`` +
+    ``mlt_train_h2d_bytes_total`` increments as they accrue.
+    """
+
+    def __init__(self, stream, sharding=None, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._iterator = iter(stream)
+        self._sharding = sharding
+        self.depth = depth
+        self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+        self._closed = threading.Event()
+        self._exhausted = False
+        # telemetry (producer-written fields only touched by the thread)
+        self._wait_seconds = 0.0
+        self._bytes_staged = 0
+        self._batches_staged = 0
+        self._batches_consumed = 0
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True, name="mlt-device-prefetch")
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------------
+    def _place(self, item):
+        import jax
+
+        tokens, targets = item
+        self._bytes_staged += (getattr(tokens, "nbytes", 0)
+                               + getattr(targets, "nbytes", 0))
+        if self._sharding is not None:
+            return (per_process_batch(tokens, self._sharding),
+                    per_process_batch(targets, self._sharding))
+        return jax.device_put(tokens), jax.device_put(targets)
+
+    def _put(self, item) -> bool:
+        """Enqueue with close-awareness: never blocks indefinitely on a
+        full queue (the consumer may have exited at a preemption point)."""
+        while not self._closed.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _produce(self):
+        index = 0
+        while not self._closed.is_set():
+            try:
+                fire(FaultPoints.train_prefetch, batch_index=index)
+                batch = next(self._iterator)
+            except StopIteration:
+                self._put(_PREFETCH_END)
+                return
+            except BaseException as exc:  # noqa: BLE001 - delivered to
+                # the consumer at this batch's position
+                self._put(_PrefetchError(exc))
+                return
+            try:
+                placed = self._place(batch)
+            except BaseException as exc:  # noqa: BLE001
+                self._put(_PrefetchError(exc))
+                return
+            if not self._put(placed):
+                return
+            self._batches_staged += 1
+            index += 1
+
+    # -- consumer ------------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._closed.is_set():
+            raise StopIteration
+        started = time.perf_counter()
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+                break
+            except queue_mod.Empty:
+                if self._closed.is_set() or not self._thread.is_alive():
+                    # a dead producer always leaves a sentinel/error
+                    # behind — an empty queue here means close() raced us
+                    if self._queue.empty():
+                        raise StopIteration from None
+        waited = time.perf_counter() - started
+        self._wait_seconds += waited
+        if item is _PREFETCH_END:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, _PrefetchError):
+            self._exhausted = True
+            raise item.exc
+        self._batches_consumed += 1
+        return item
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, timeout: float = 5.0):
+        """Stop the producer and drop staged batches. Safe to call from
+        the preemption/early-stop path with the queue full — the drain
+        below is what unblocks a producer mid-``put``."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+
+        def _drain_queue():
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue_mod.Empty:
+                    return
+
+        _drain_queue()
+        self._thread.join(timeout)
+        # a producer that was blocked in put() may have slipped one item
+        # into the just-drained queue before observing the closed flag —
+        # drain again after the join so no staged batch stays referenced
+        _drain_queue()
+        # the upstream stream is NOT closed: the caller owns its
+        # lifecycle (a TokenShardLoader may feed a later fit/resume)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def stats(self) -> dict:
+        return {
+            "depth": self.depth,
+            "queued": self._queue.qsize(),
+            "batches_staged": self._batches_staged,
+            "batches_consumed": self._batches_consumed,
+            "input_wait_seconds": self._wait_seconds,
+            "h2d_bytes": self._bytes_staged,
+        }

@@ -30,7 +30,7 @@ from ..parallel.sharding import (
     tree_shardings,
 )
 from ..utils import logger
-from .mfu import chip_peak_flops, mfu
+from .mfu import ThroughputTracker, chip_peak_flops, mfu
 
 
 @dataclasses.dataclass
@@ -219,9 +219,14 @@ def make_train_step(model_config: LlamaConfig, train_config: TrainConfig,
     # under Auto axis types GSPMD resolves the embedding gather itself;
     # act_spec stays available for Explicit-mode meshes
     act_spec = None
-    from jax.sharding import AxisType
+    try:
+        from jax.sharding import AxisType
+    except ImportError:  # pre-AxisType jax: every mesh is Auto-typed
+        AxisType = None
 
-    if any(t == AxisType.Explicit for t in mesh.axis_types):
+    if AxisType is not None and any(
+            t == AxisType.Explicit
+            for t in getattr(mesh, "axis_types", ())):
         batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
                            and mesh.shape[a] > 1) or None
         tensor_axis = "tensor" if ("tensor" in mesh.axis_names
@@ -531,6 +536,14 @@ class Trainer:
     def __init__(self, model_config: LlamaConfig,
                  train_config: TrainConfig | None = None,
                  mesh: Mesh | None = None, rules=None):
+        # wire the persistent XLA compilation cache BEFORE anything can
+        # trigger a jit compile: a resubmitted JobSet carrying
+        # COMPILE_CACHE_ENV then loads step-fn executables from disk
+        # instead of recompiling (utils/compile_cache.py); no-op when
+        # mlconf.training.compile_cache_dir is unset
+        from ..utils import compile_cache
+
+        compile_cache.configure_from_mlconf()
         self.train_config = train_config or TrainConfig()
         self.model_config = resolve_model_config(model_config,
                                                  self.train_config)
@@ -542,12 +555,53 @@ class Trainer:
             self.mesh, rules)
         self.state: Optional[TrainState] = None
         self._metrics_history: list[dict] = []
+        # warmup() products: wall seconds of the last step-fn compile and
+        # the AOT executable train_step dispatches through when shapes
+        # match (no in-process recompile even without a persistent cache)
+        self.compile_seconds: Optional[float] = None
+        self._compiled = None
+        self._warmed_shape: Optional[tuple] = None
 
     def init(self, seed: int = 0) -> TrainState:
         self.state = init_train_state(
             self.model_config, self.train_config, self.optimizer, self.mesh,
             jax.random.PRNGKey(seed), self.rules)
         return self.state
+
+    def warmup(self, batch_size: int, seq_len: int) -> dict:
+        """AOT-lower/compile the step function for ``(batch_size,
+        seq_len)`` int32 batches before the loop starts.
+
+        Records the compile wall time (``compile_seconds``, also the
+        ``mlt_train_compile_seconds`` gauge) and keeps the compiled
+        executable so matching-shape ``train_step`` calls dispatch
+        through it directly. With ``mlconf.training.compile_cache_dir``
+        set, the compile also lands in the persistent cache, so the NEXT
+        process — a preemption-resume resubmit, a second A-B bench run —
+        warms up in loader-time instead of compile-time. Step functions
+        without an AOT path (context-parallel wrapper) skip gracefully.
+        """
+        assert self.state is not None, "call init() first"
+        from ..obs import TRAIN_COMPILE_SECONDS
+        from ..utils import compile_cache
+
+        cache_dir = compile_cache.configure_from_mlconf()
+        if not hasattr(self.step_fn, "lower"):
+            logger.warning("warmup skipped: step function has no AOT "
+                           "lowering path", step_fn=type(self.step_fn))
+            return {"skipped": True}
+        spec = jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32)
+        started = time.perf_counter()
+        self._compiled = self.step_fn.lower(self.state, spec, spec).compile()
+        elapsed = time.perf_counter() - started
+        self._warmed_shape = (batch_size, seq_len)
+        self.compile_seconds = elapsed
+        TRAIN_COMPILE_SECONDS.set(elapsed)
+        logger.info("train step compiled", batch=batch_size, seq=seq_len,
+                    compile_s=round(elapsed, 3),
+                    cache_dir=cache_dir or "(off)")
+        return {"compile_seconds": elapsed, "cache_dir": cache_dir,
+                "batch_size": batch_size, "seq_len": seq_len}
 
     def shard_batch(self, tokens, targets):
         sharding = self.step_fn._data_sharding
@@ -556,7 +610,12 @@ class Trainer:
 
     def train_step(self, tokens, targets) -> dict:
         tokens, targets = self.shard_batch(tokens, targets)
-        self.state, metrics = self.step_fn(self.state, tokens, targets)
+        fn = self.step_fn
+        if (self._compiled is not None
+                and tokens.shape == self._warmed_shape
+                and tokens.dtype == jnp.int32):
+            fn = self._compiled
+        self.state, metrics = fn(self.state, tokens, targets)
         return metrics
 
     def _maybe_resume(self, checkpoint_manager, context):
@@ -569,8 +628,14 @@ class Trainer:
         from .checkpoint import resume_directive
 
         directive = resume_directive()
-        if directive is None or checkpoint_manager is None or \
-                int(self.state.step) != 0:
+        if directive is None or checkpoint_manager is None:
+            # the common no-directive entry must not force a device sync:
+            # int(state.step) blocks the host on everything in flight,
+            # and fit() may be entered with steps still dispatching
+            return
+        if int(self.state.step) != 0:
+            # a directive exists — only now is the sync warranted, to let
+            # an explicit prior restore win over the env contract
             return
         path, step = directive
         try:
@@ -589,12 +654,26 @@ class Trainer:
     def fit(self, data_iter, steps: int, context=None,
             log_every: int = 10, callbacks: list | None = None,
             checkpoint_manager=None, preemption_guard=None,
-            epoch_steps: int = 0) -> dict:
+            epoch_steps: int = 0, prefetch: int | None = None,
+            defer_metrics: bool | None = None) -> dict:
         """Run the training loop; logs metrics to the run context
         rank-0-only. With ``preemption_guard`` + ``checkpoint_manager``, a
         SIGTERM (TPU slice eviction) triggers one final synchronous
         checkpoint and a clean early return with ``preempted: True`` — the
         JobSet restart then resumes from that step (training/preemption.py).
+
+        The hot loop is pipelined (docs/training_performance.md):
+        ``prefetch`` (default ``mlconf.training.prefetch``) wraps
+        ``data_iter`` in a :class:`~.data.DevicePrefetchIterator` so host
+        batch production and the H2D transfer overlap the previous step's
+        compute; ``defer_metrics`` (default
+        ``mlconf.training.defer_metrics``) stages log-point metric reads
+        as async device->host copies drained one log interval later —
+        the host never stalls dispatch on ``float(loss)``. Callbacks are
+        handed same-step host values at log points, so their presence
+        forces the synchronous read path. ``tokens_per_sec``/``mfu`` are
+        steady-state (post compile/ramp window); the first-step compile
+        is reported separately as ``compile_seconds``.
 
         ``callbacks`` take structured ``frameworks._common.Callback``
         objects (on_train_begin / on_step_end / on_epoch_end /
@@ -603,14 +682,97 @@ class Trainer:
         legacy bare ``callback(step, metrics, trainer)`` callables.
         ``epoch_steps`` groups steps into epochs for the epoch hooks
         (0 = no epoch structure)."""
+        from ..config import mlconf
         from ..frameworks._common.callbacks import CallbackList
+        from ..obs import (
+            TRAIN_COMPILE_SECONDS,
+            TRAIN_H2D_BYTES,
+            TRAIN_INPUT_WAIT,
+            TRAIN_STEP_TIME,
+        )
+        from .data import DevicePrefetchIterator
 
         assert self.state is not None, "call init() first"
         self._maybe_resume(checkpoint_manager, context)
         hooks = CallbackList(callbacks, context=context, trainer=self)
+
+        train_cfg = mlconf.training
+        depth = (int(train_cfg.get("prefetch", 0) or 0)
+                 if prefetch is None else int(prefetch))
+        prefetcher = (data_iter
+                      if isinstance(data_iter, DevicePrefetchIterator)
+                      else None)
+        owned = None
+        if depth > 0 and prefetcher is None:
+            data_iter = owned = prefetcher = DevicePrefetchIterator(
+                data_iter,
+                sharding=getattr(self.step_fn, "_data_sharding", None),
+                depth=depth)
+        defer = (bool(train_cfg.get("defer_metrics", True))
+                 if defer_metrics is None else bool(defer_metrics))
+        defer = defer and not hooks.callbacks
+
+        tracker = ThroughputTracker(
+            int(train_cfg.get("warmup_steps_excluded", 1) or 0))
+        input_wait = 0.0     # host seconds blocked in next(data_iter)
+        wait_flushed = 0.0   # portion already on the registry counter
+        h2d_inline = 0       # bytes counted on the no-prefetch path
+        # a caller-owned prefetcher may carry bytes from a PREVIOUS fit —
+        # baseline the flush so the counter only gets this fit's delta.
+        # (an owned one starts at 0: its pre-baseline staging is ours)
+        h2d_flushed = (prefetcher.stats()["h2d_bytes"]
+                       if prefetcher is not None and owned is None else 0)
+        pending = None       # staged log point awaiting its drain
+
+        def _flush_obs():
+            nonlocal wait_flushed, h2d_flushed
+            if input_wait > wait_flushed:
+                TRAIN_INPUT_WAIT.inc(input_wait - wait_flushed)
+                wait_flushed = input_wait
+            total = (prefetcher.stats()["h2d_bytes"]
+                     if prefetcher is not None else h2d_inline)
+            if total > h2d_flushed:
+                TRAIN_H2D_BYTES.inc(total - h2d_flushed)
+                h2d_flushed = total
+
+        def _log_view(view: dict) -> dict:
+            self._metrics_history.append(view)
+            if context is not None:
+                context.log_metrics(view, step=view["step"])
+            else:
+                logger.info("train step", **{
+                    k: round(v, 4) if isinstance(v, float) else v
+                    for k, v in view.items()})
+            return view
+
+        def _stage(metrics: dict, extras: dict):
+            """Issue async device->host copies for the log point; the
+            values are read (cheaply, already resident) at the NEXT log
+            point or the loop-exit flush — dispatch never stalls here."""
+            staged = {}
+            for key, value in metrics.items():
+                try:
+                    value.copy_to_host_async()
+                except AttributeError:
+                    pass
+                staged[key] = value
+            # state.step itself is donated into the NEXT dispatch
+            # (donate_argnums=0) — stage a fresh derived array instead
+            step_arr = self.state.step + 0
+            try:
+                step_arr.copy_to_host_async()
+            except AttributeError:
+                pass
+            return (step_arr, staged, extras)
+
+        def _drain(entry) -> dict:
+            step_arr, staged, extras = entry
+            view = {k: float(v) for k, v in staged.items()}
+            view.update(extras)
+            view["step"] = int(step_arr)
+            return _log_view(view)
+
         hooks.on_train_begin()
-        t_start = time.perf_counter()
-        tokens_seen = 0
         seq_len = None
         last = {}
         epoch = 0
@@ -618,110 +780,150 @@ class Trainer:
         local_stop = False  # pending stop vote, acted on at uniform points
         if epoch_steps:
             hooks.on_epoch_begin(0)
-        for step in range(steps):
-            # agreed() (not .requested): all hosts must latch in the SAME
-            # step or the ones still stepping deadlock the slice collectives
-            if preemption_guard is not None and preemption_guard.agreed():
-                logger.warning("preempted — checkpointing before exit",
-                               step=int(self.state.step))
-                if checkpoint_manager is not None:
-                    checkpoint_manager.save(int(self.state.step),
-                                            self.state, force=True)
-                    checkpoint_manager.wait()
-                    if context is not None and \
-                            hasattr(context, "log_checkpoint"):
-                        # the service reads status.checkpoint when it
-                        # resubmits the evicted slice — this write is what
-                        # makes the restart a *resume*
-                        context.log_checkpoint(
-                            checkpoint_manager.directory,
-                            step=int(self.state.step), commit=False)
-                last = dict(last)
-                last["preempted"] = True
-                last["step"] = int(self.state.step)
-                if context is not None:
-                    context.log_result("preempted", True)
-                # preempted runs still finalize callbacks (close writers,
-                # log the tensorboard dir) — they matter MOST here, since
-                # the artifacts are what survives the eviction
-                hooks.on_train_end(last)
-                return last
-            tokens, targets = next(data_iter)
-            seq_len = tokens.shape[1]
-            metrics = self.train_step(tokens, targets)
-            tokens_seen += tokens.shape[0] * tokens.shape[1]
-            log_point = (step + 1) % log_every == 0 or step == steps - 1
-            # non-log steps hand callbacks the RAW device metrics — no
-            # float() there, so the host keeps dispatching ahead of the
-            # device; a callback that reads a value pays its own sync
-            step_metrics: dict = dict(metrics)
-            if log_point:
-                step_metrics = {k: float(v) for k, v in metrics.items()}
-                elapsed = time.perf_counter() - t_start
-                tps = tokens_seen / elapsed
-                step_metrics["tokens_per_sec"] = tps
-                step_metrics["tokens_per_sec_per_chip"] = \
-                    tps / jax.device_count()
-                step_metrics["mfu"] = mfu(
-                    tps, self.model_config.flops_per_token(seq_len))
-                step_metrics["step"] = int(self.state.step)
-                self._metrics_history.append(step_metrics)
-                last = step_metrics
-                if context is not None:
-                    context.log_metrics(step_metrics,
-                                        step=int(self.state.step))
-                else:
-                    logger.info("train step", **{
-                        k: round(v, 4) if isinstance(v, float) else v
-                        for k, v in step_metrics.items()})
-            if hooks.callbacks:
-                multihost = jax.process_count() > 1
-                if not hooks.on_step_end(step, step_metrics,
-                                         log_point=log_point):
-                    local_stop = True
-                if not multihost:
-                    stopped = stopped or local_stop
-                elif log_point:
-                    # multi-host: a stop vote driven by host-local state
-                    # must flip every host in the SAME step or the
-                    # still-stepping hosts deadlock in the slice
-                    # collectives (PreemptionGuard.agreed construction).
-                    # Agreement runs only at log points — deterministic
-                    # step indices every host reaches — so pure-observer
-                    # callbacks don't cost an allgather per step; a vote
-                    # takes effect within log_every steps.
-                    stopped = _all_hosts_agree(local_stop)
-                epoch_boundary = epoch_steps and \
-                    ((step + 1) % epoch_steps == 0 or step == steps - 1
-                     or stopped)
-                if epoch_boundary:
-                    # epoch hooks always see host-readable floats — a
-                    # boundary off the log cadence would otherwise hand
-                    # TensorBoard/metrics logging raw device arrays
-                    epoch_view = step_metrics if log_point else \
-                        {k: float(v) for k, v in metrics.items()}
-                    epoch_vote = not hooks.on_epoch_end(epoch, epoch_view)
-                    local_stop = local_stop or epoch_vote
-                    if not multihost:
-                        stopped = stopped or epoch_vote
-                    elif not stopped:
-                        # uniform: every host reaches this iff `stopped`
-                        # (agreed) is False everywhere, and the boundary
-                        # condition itself is step-index-deterministic
-                        stopped = _all_hosts_agree(local_stop)
-                    epoch += 1
-                    if not stopped and step < steps - 1:
-                        hooks.on_epoch_begin(epoch)
-                if stopped:
-                    if isinstance(last, dict) and last:
-                        last = dict(last)
+        try:
+            for step in range(steps):
+                # agreed() (not .requested): all hosts must latch in the SAME
+                # step or the ones still stepping deadlock the slice collectives
+                if preemption_guard is not None and preemption_guard.agreed():
+                    logger.warning("preempted — checkpointing before exit",
+                                   step=int(self.state.step))
+                    # a staged log point must land before the early return —
+                    # its metrics are what the post-mortem sees
+                    if pending is not None:
+                        last = _drain(pending)
+                        pending = None
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.save(int(self.state.step),
+                                                self.state, force=True)
+                        checkpoint_manager.wait()
+                        if context is not None and \
+                                hasattr(context, "log_checkpoint"):
+                            # the service reads status.checkpoint when it
+                            # resubmits the evicted slice — this write is what
+                            # makes the restart a *resume*
+                            context.log_checkpoint(
+                                checkpoint_manager.directory,
+                                step=int(self.state.step), commit=False)
+                    last = dict(last)
+                    last["preempted"] = True
+                    last["step"] = int(self.state.step)
+                    if context is not None:
+                        context.log_result("preempted", True)
+                    # preempted runs still finalize callbacks (close writers,
+                    # log the tensorboard dir) — they matter MOST here, since
+                    # the artifacts are what survives the eviction
+                    hooks.on_train_end(last)
+                    return last
+                t_input = time.perf_counter()
+                tokens, targets = next(data_iter)
+                input_wait += time.perf_counter() - t_input
+                seq_len = tokens.shape[1]
+                if prefetcher is None:
+                    h2d_inline += (getattr(tokens, "nbytes", 0)
+                                   + getattr(targets, "nbytes", 0))
+                t_dispatch = time.perf_counter()
+                metrics = self.train_step(tokens, targets)
+                if step == 0 and self.compile_seconds is None:
+                    # tracing + XLA compile block the host inside the first
+                    # dispatch (execution does not) — compile-class time,
+                    # kept OUT of the steady-state throughput window
+                    self.compile_seconds = time.perf_counter() - t_dispatch
+                    TRAIN_COMPILE_SECONDS.set(self.compile_seconds)
+                tracker.note_step(tokens.shape[0] * tokens.shape[1])
+                log_point = (step + 1) % log_every == 0 or step == steps - 1
+                # non-log steps hand callbacks the RAW device metrics — no
+                # float() there, so the host keeps dispatching ahead of the
+                # device; a callback that reads a value pays its own sync
+                step_metrics: dict = dict(metrics)
+                if log_point:
+                    tps = tracker.tokens_per_sec()
+                    extras = {
+                        "tokens_per_sec": tps,
+                        "tokens_per_sec_per_chip": tps / jax.device_count(),
+                        "mfu": mfu(tps,
+                                   self.model_config.flops_per_token(seq_len)),
+                        "input_wait_seconds": input_wait,
+                    }
+                    if self.compile_seconds is not None:
+                        extras["compile_seconds"] = self.compile_seconds
+                    if tps > 0:
+                        TRAIN_STEP_TIME.set(
+                            tokens.shape[0] * seq_len / tps, timer="fit")
+                    _flush_obs()
+                    if defer:
+                        if pending is not None:
+                            last = _drain(pending)
+                        pending = _stage(metrics, extras)
                     else:
-                        last = {k: float(v) for k, v in metrics.items()}
-                    last["stopped_early"] = True
-                    last.setdefault("step", int(self.state.step))
-                    break
-        hooks.on_train_end(last)
-        return last
+                        step_metrics = {k: float(v) for k, v in metrics.items()}
+                        step_metrics.update(extras)
+                        step_metrics["step"] = int(self.state.step)
+                        last = _log_view(step_metrics)
+                if hooks.callbacks:
+                    multihost = jax.process_count() > 1
+                    if not hooks.on_step_end(step, step_metrics,
+                                             log_point=log_point):
+                        local_stop = True
+                    if not multihost:
+                        stopped = stopped or local_stop
+                    elif log_point:
+                        # multi-host: a stop vote driven by host-local state
+                        # must flip every host in the SAME step or the
+                        # still-stepping hosts deadlock in the slice
+                        # collectives (PreemptionGuard.agreed construction).
+                        # Agreement runs only at log points — deterministic
+                        # step indices every host reaches — so pure-observer
+                        # callbacks don't cost an allgather per step; a vote
+                        # takes effect within log_every steps.
+                        stopped = _all_hosts_agree(local_stop)
+                    epoch_boundary = epoch_steps and \
+                        ((step + 1) % epoch_steps == 0 or step == steps - 1
+                         or stopped)
+                    if epoch_boundary:
+                        # epoch hooks always see host-readable floats — a
+                        # boundary off the log cadence would otherwise hand
+                        # TensorBoard/metrics logging raw device arrays
+                        epoch_view = step_metrics if log_point else \
+                            {k: float(v) for k, v in metrics.items()}
+                        epoch_vote = not hooks.on_epoch_end(epoch, epoch_view)
+                        local_stop = local_stop or epoch_vote
+                        if not multihost:
+                            stopped = stopped or epoch_vote
+                        elif not stopped:
+                            # uniform: every host reaches this iff `stopped`
+                            # (agreed) is False everywhere, and the boundary
+                            # condition itself is step-index-deterministic
+                            stopped = _all_hosts_agree(local_stop)
+                        epoch += 1
+                        if not stopped and step < steps - 1:
+                            hooks.on_epoch_begin(epoch)
+                    if stopped:
+                        if isinstance(last, dict) and last:
+                            last = dict(last)
+                        else:
+                            last = {k: float(v) for k, v in metrics.items()}
+                        last["stopped_early"] = True
+                        last.setdefault("step", int(self.state.step))
+                        break
+            if pending is not None:
+                last = _drain(pending)
+                pending = None
+            hooks.on_train_end(last)
+            return last
+        finally:
+            if pending is not None:
+                # exception exit with a staged log point: land it in the
+                # history/context before unwinding (the preemption branch
+                # does the same — these are the post-mortem metrics)
+                try:
+                    _drain(pending)
+                except Exception:  # noqa: BLE001 - the original
+                    pass           # exception must win the unwind
+            _flush_obs()
+            if owned is not None:
+                # created here -> closed here; drains staged batches so a
+                # producer blocked on a full queue can never outlive fit
+                owned.close()
 
     @property
     def metrics_history(self) -> list[dict]:

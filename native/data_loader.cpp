@@ -14,6 +14,9 @@
 //       -> 1 ok, 0 closed/error   (blocks until a batch is staged)
 //   mlt_loader_total_tokens(handle) -> u64
 //   mlt_loader_epoch(handle) -> u64 (completed shuffle epochs)
+//   mlt_loader_stats(handle, out_u64 /* [5]: ring occupancy, queue depth,
+//                    batches served, consumer waits, producer waits */)
+//       -> 1 ok, 0 bad handle
 //   mlt_loader_close(handle)
 //
 // Shuffling: each epoch draws a new permutation of window starts
@@ -66,6 +69,12 @@ struct Loader {
     std::atomic<bool> closing{false};
     std::atomic<uint64_t> epoch{0};
     std::atomic<int> inflight{0};  // mlt_loader_next calls in progress
+    // occupancy/wait telemetry (mlt_loader_stats): consumer_waits counts
+    // next() calls that found the ring empty (the step loop stalled on
+    // IO), producer_waits counts workers that found it full (IO is ahead)
+    std::atomic<uint64_t> batches_served{0};
+    std::atomic<uint64_t> consumer_waits{0};
+    std::atomic<uint64_t> producer_waits{0};
     std::vector<std::thread> threads;
 
     // work list for the current epoch (indices into `windows`)
@@ -134,6 +143,8 @@ void worker(Loader* ld) {
             }
         }
         std::unique_lock<std::mutex> lock(ld->mu);
+        if (!ld->closing.load() && ld->ready.size() >= ld->queue_depth)
+            ld->producer_waits.fetch_add(1);
         ld->cv_space.wait(lock, [&] {
             return ld->closing.load() || ld->ready.size() < ld->queue_depth;
         });
@@ -215,12 +226,15 @@ int mlt_loader_next(uint64_t handle, int32_t* out_tokens) {
     int result = 0;
     {
         std::unique_lock<std::mutex> lock(ld->mu);
+        if (ld->ready.empty() && !ld->closing.load())
+            ld->consumer_waits.fetch_add(1);
         ld->cv_ready.wait(lock, [&] {
             return ld->closing.load() || !ld->ready.empty();
         });
         if (!ld->ready.empty()) {
             std::vector<int32_t> batch = std::move(ld->ready.front());
             ld->ready.pop_front();
+            ld->batches_served.fetch_add(1);
             ld->cv_space.notify_one();
             lock.unlock();
             std::memcpy(out_tokens, batch.data(),
@@ -246,6 +260,22 @@ uint64_t mlt_loader_epoch(uint64_t handle) {
     auto it = g_loaders.find(handle);
     if (it == g_loaders.end()) return 0;
     return it->second->epoch.load();
+}
+
+int mlt_loader_stats(uint64_t handle, uint64_t* out) {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_loaders.find(handle);
+    if (it == g_loaders.end() || !out) return 0;
+    Loader* ld = it->second;
+    {
+        std::lock_guard<std::mutex> ring(ld->mu);
+        out[0] = ld->ready.size();
+    }
+    out[1] = ld->queue_depth;
+    out[2] = ld->batches_served.load();
+    out[3] = ld->consumer_waits.load();
+    out[4] = ld->producer_waits.load();
+    return 1;
 }
 
 void mlt_loader_close(uint64_t handle) {

@@ -60,9 +60,14 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
                 guard.request()  # programmatic SIGTERM stand-in
             yield next(base)
 
+    # prefetch=0 keeps the signal's arrival step deterministic: the
+    # device-prefetch producer would otherwise pull (and fire) the
+    # side-effecting stream a few batches AHEAD of the consuming step
+    # (docs/training_performance.md); the discard-on-preemption contract
+    # itself is covered in tests/test_train_pipeline.py
     result = trainer.fit(stream(), steps=50, log_every=100,
                          checkpoint_manager=manager,
-                         preemption_guard=guard)
+                         preemption_guard=guard, prefetch=0)
     # the batch that raced the signal still completes: saved step is the
     # one AFTER the request landed, far short of the 50 requested
     saved_step = preempt_after + 1

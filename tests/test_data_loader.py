@@ -101,6 +101,63 @@ def test_loader_rejects_bad_input(tmp_path):
         TokenShardLoader(str(tmp_path / "missing.bin"), 1, 2)
 
 
+def test_loader_stats_ring_occupancy_and_waits(tmp_path):
+    """Engine-style stats(): the native ring's occupancy + wait counters
+    make an input-bound run diagnosable (docs/training_performance.md)."""
+    import time
+
+    from mlrun_tpu.training.data import TokenShardLoader
+
+    seq = 4
+    tokens = []
+    for w in range(64):
+        tokens.extend([w] * (seq + 1))
+    p = tmp_path / "shard.bin"
+    _write_shard(p, tokens)
+
+    with TokenShardLoader(str(p), batch_size=2, seq_len=seq, seed=1,
+                          workers=1, queue_depth=2) as loader:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            stats = loader.stats()
+            if stats["ring_occupancy"] >= 2 and \
+                    stats["producer_waits"] >= 1:
+                break           # ring full AND the worker blocked on it
+            time.sleep(0.01)
+        assert stats["queue_depth"] == 2
+        assert stats["ring_occupancy"] == 2      # full: producer ahead
+        assert stats["producer_waits"] >= 1      # ...and it blocked on us
+        for _ in range(4):
+            next(loader)
+        stats = loader.stats()
+        assert stats["batches"] == 4
+        assert stats["epochs"] == loader.epoch
+
+
+def test_loader_stats_surface_on_metrics_registry(tmp_path):
+    from mlrun_tpu.obs import REGISTRY
+    from mlrun_tpu.training.data import TokenShardLoader
+
+    seq = 2
+    p = tmp_path / "shard.bin"
+    _write_shard(p, list(range(12 * (seq + 1))))
+    loader = TokenShardLoader(str(p), batch_size=1, seq_len=seq,
+                              workers=1)
+    try:
+        next(loader)
+        text = REGISTRY.render()
+        label = f'loader="{loader._obs_name}"'
+        assert "mlt_train_loader_ring_occupancy{" in text
+        assert label in text
+        assert "mlt_train_loader_events_total{" in text
+        assert f'{label},event="batches"' in text
+    finally:
+        loader.close()
+    # closed loader: the collector retires itself and removes its series
+    text = REGISTRY.render()
+    assert f'loader="{loader._obs_name}"' not in text
+
+
 def test_device_prefetch_preserves_order(tmp_path):
     from mlrun_tpu.training.data import device_prefetch
 
