@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-train obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-train bench-attn obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -33,6 +33,9 @@ bench-serve:     ## prefix-cache / chunked-prefill microbench, CPU-runnable (one
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train
+
+bench-attn:      ## attention kernels vs reference (flash v1/v2 + paged decode), CPU interpret mode; rewrites BENCH_ATTN_CPU.json
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_attention_cpu.py
 
 obs-smoke:       ## boot a graph, scrape /metrics, assert a span artifact (docs/observability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py

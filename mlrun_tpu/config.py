@@ -170,6 +170,13 @@ default_config: dict[str, Any] = {
             # ring-buffer samples behind the p50/p95 TTFT / inter-token
             # latency percentiles in engine stats
             "latency_window": 512,
+            # attention kernel dispatch (docs/serving.md "Attention
+            # kernels"): auto picks the pallas kernels on TPU (paged
+            # decode straight off the page table + offset-aware flash
+            # prefill) and the dense reference paths on CPU, unless
+            # MLT_ATTN_INTERPRET=1 forces the kernels in interpret mode.
+            # flash | kernel | reference override per engine.
+            "attention_impl": "auto",
         },
     },
     "observability": {

@@ -24,6 +24,7 @@ from ..ops.norms import rms_norm
 from ..ops.ring_attention import ring_attention
 from ..ops.rotary import rope_table
 from ..ops.ulysses import ulysses_attention
+from ..parallel.compat import shard_map
 from .llama import LlamaConfig, Params, _layer_body
 
 
@@ -97,11 +98,19 @@ def make_context_parallel_loss(config: LlamaConfig, mesh: Mesh,
     """
     data_axes = tuple(data_axes or ())
     manual = frozenset({seq_axis, *data_axes})
+    if not hasattr(jax, "shard_map"):
+        # legacy (jax.experimental) shard_map cannot lower axis_index /
+        # ring collectives while another mesh axis stays auto (the SPMD
+        # partitioner rejects the PartitionId it emits) — go full-manual
+        # over every mesh axis instead; axes the specs leave unmentioned
+        # ride replicated, which is exactly the partial-manual semantics
+        # for the batch dim here
+        manual = frozenset(mesh.axis_names)
     batch_spec = tuple(data_axes) or None
     data_spec = P(batch_spec, seq_axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), data_spec, data_spec, P()),
         out_specs=P(batch_spec, seq_axis),
         check_vma=False,
@@ -122,8 +131,10 @@ def make_context_parallel_loss(config: LlamaConfig, mesh: Mesh,
         if not data_axes:
             # pin the auto (batch) axes replicated: GSPMD may otherwise
             # pick a sharding the out_specs (manual axes only) cannot
-            # express
-            nll = jax.lax.with_sharding_constraint(nll, P(None, None))
+            # express. NamedSharding (not a bare spec): legacy jax builds
+            # require a mesh context for PartitionSpec constraints.
+            nll = jax.lax.with_sharding_constraint(
+                nll, NamedSharding(mesh, P(None, None)))
         return nll
 
     def loss(params, tokens, targets, lora=None):

@@ -67,6 +67,12 @@ LLM_ITL = REGISTRY.histogram(
     "decode step",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
+LLM_DECODE_TICK = REGISTRY.histogram(
+    "mlt_llm_decode_tick_seconds",
+    "One decode dispatch (host-observed, admission prefill excluded) — "
+    "the attention-dominated device step the paged/flash kernels target",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))
 LLM_QUEUE_DEPTH = REGISTRY.gauge(
     "mlt_llm_queue_depth", "Queued + pending admissions per engine",
     labels=("engine",), overflow="drop")

@@ -17,12 +17,21 @@ No reference-repo analog: the reference has no attention code at all
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -2.0**30
+
+
+def interpret_forced() -> bool:
+    """``MLT_ATTN_INTERPRET=1`` makes every ``auto`` dispatcher pick the
+    Pallas kernels even off-TPU (interpret mode) — how tier-1 exercises
+    the real kernel code paths on the CPU mesh."""
+    return os.environ.get("MLT_ATTN_INTERPRET", "").strip().lower() in (
+        "1", "true", "yes", "on")
 
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -60,6 +69,20 @@ def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 # our pallas flash kernel (forward), causal, MHA/GQA via pre-repeated kv
 # ---------------------------------------------------------------------------
+
+def _fit_block(n: int, preferred: int) -> int:
+    """Block size for a sequence of length ``n``: ``preferred`` for long
+    sequences (a sub-block tail just pads — big MXU blocks beat the
+    <1-block padding, measured 12x at head_dim 64; see
+    ``_tuned_block_sizes``); below ``preferred``, the largest of
+    (256, 128) that divides n, else the length itself — a short-prompt
+    prefill no longer rounds up to the 512 block minimum."""
+    if n >= preferred:
+        return preferred
+    for c in (256, 128):
+        if c < preferred and n >= c and n % c == 0:
+            return c
+    return n
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       block_k: int, seq_k: int, kv_len: int, scale: float,
@@ -121,17 +144,21 @@ except Exception:  # noqa: BLE001
     _PALLAS_OK = False
 
 
-def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                         m_scr, l_scr, acc_scr, *,
-                         num_kb: int, kv_len: int, scale: float,
-                         causal: bool):
-    """Grid-pipelined flash forward: grid (bh, q_blocks, k_blocks).
+def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, *,
+                   num_kb: int, kv_len: int, scale: float, causal: bool):
+    """Grid-pipelined flash forward body: grid (bh, q_blocks, k_blocks).
 
     Unlike the v1 kernel (full KV resident in VMEM), each program sees one
     (q_block, k_block) tile — pallas double-buffers the HBM→VMEM streams
     across the innermost grid dim, so sequence length is bounded by HBM,
     not VMEM. Running max/denominator/accumulator live in scratch that
     persists across the k grid steps of a fixed (bh, qi).
+
+    ``q_off`` shifts every q position by an absolute offset: 0 (a static
+    python int — the training/self-attention form) or a traced scalar
+    (the cached-prefill form, where q rows sit at ``start + i`` against a
+    KV cache whose rows start at position 0).
     """
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -147,7 +174,9 @@ def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
     q_start = qi * block_q
     k_start = kb * block_k
     # causal: whole tile masked out when every k is beyond every q
-    live = (not causal) or (k_start <= q_start + block_q - 1)
+    # (python bool when q_off is the static 0, a traced predicate when it
+    # is the dynamic cached-prefill offset — pl.when takes both)
+    live = (not causal) or (k_start <= q_off + q_start + block_q - 1)
 
     @pl.when(live)
     def _compute():
@@ -158,7 +187,7 @@ def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k_pos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
+            q_pos = q_off + q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         s = jnp.where(k_pos < kv_len, s, NEG_INF)
@@ -179,17 +208,35 @@ def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l), (block_q, 8))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
-def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
-                  interpret=None):
-    """Grid-pipelined flash forward; q,k,v [B, S, H, D] (kv pre-repeated)."""
+def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         m_scr, l_scr, acc_scr, **kw):
+    """Self-attention form: q positions aligned with kv position 0."""
+    _flash_v2_body(0, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, **kw)
+
+
+def _flash_fwd_kernel_v2_cached(q_off_ref, q_ref, k_ref, v_ref, o_ref,
+                                lse_ref, m_scr, l_scr, acc_scr, **kw):
+    """Cached-prefill form: q rows live at absolute positions
+    ``q_off + i`` against a KV cache indexed from 0 (serving engines'
+    chunked/suffix prefill — ops/attention.flash_attention_cached)."""
+    _flash_v2_body(q_off_ref[0], q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, **kw)
+
+
+def _flash_v2_call(q, k, v, causal, block_q, block_k, interpret, q_offset):
+    """Shared v2 plumbing (block fit, padding, fold batch*heads, grid,
+    scratch) for the self-attention and cached-prefill forms — one body,
+    so the two can never diverge (the cold-vs-hit bit-equality guarantee
+    rides on identical block/padding choices). ``q_offset=None`` selects
+    the static-zero kernel; otherwise the offset rides a (1,) SMEM
+    operand."""
     if interpret is None:
         interpret = not _on_tpu()
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
     orig_sq, orig_sk = sq, sk
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k
@@ -206,13 +253,19 @@ def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     num_kb = sk // block_k
     grid = (b * h, sq // block_q, num_kb)
-    kernel = functools.partial(
-        _flash_fwd_kernel_v2, num_kb=num_kb, kv_len=orig_sk, scale=scale,
-        causal=causal)
+    static = dict(num_kb=num_kb, kv_len=orig_sk, scale=scale, causal=causal)
+    if q_offset is None:
+        kernel = functools.partial(_flash_fwd_kernel_v2, **static)
+        off_specs, off_args = [], ()
+    else:
+        kernel = functools.partial(_flash_fwd_kernel_v2_cached, **static)
+        off_specs = [pl.BlockSpec((1,), lambda bh, i, j: (0,),
+                                  memory_space=pltpu.SMEM)]
+        off_args = (jnp.asarray(q_offset, jnp.int32).reshape(1),)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=off_specs + [
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
@@ -236,13 +289,48 @@ def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
             pltpu.VMEM((block_q, d), jnp.float32),   # accumulator
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*off_args, qt, kt, vt)
     o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse[:, :, 0].reshape(b, h, sq)
     if pad_q:
         o = o[:, :orig_sq]
         lse = lse[:, :, :orig_sq]
     return o, lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd_v2(q, k, v, causal=True, block_q=512, block_k=512,
+                  interpret=None):
+    """Grid-pipelined flash forward; q,k,v [B, S, H, D] (kv pre-repeated)."""
+    return _flash_v2_call(q, k, v, causal, block_q, block_k, interpret,
+                          None)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd_v2_cached(q, k, v, q_offset, block_q=512, block_k=512,
+                         interpret=None):
+    """Causal grid-pipelined flash where q rows sit at absolute positions
+    ``q_offset + i`` against kv rows indexed from 0 — the serving prefill
+    form (q is a prompt chunk, k/v the full KV cache with the chunk
+    already written at ``q_offset``..). kv pre-repeated to q heads.
+    Returns (o, lse). The k-block accumulation order for a given q row is
+    identical whatever ``q_offset``/``block_q`` split the prompt arrived
+    under, which is what keeps engine-cold and prefix-hit greedy decoding
+    bit-identical (docs/serving.md "Attention kernels")."""
+    return _flash_v2_call(q, k, v, True, block_q, block_k, interpret,
+                          q_offset)
+
+
+def flash_attention_cached(q, k, v, q_start) -> jax.Array:
+    """Forward-only flash over a KV cache: q [B, S, H, D] rows at
+    positions ``q_start + i``; k/v [B, M, H, D] the cache (kv already
+    repeated to q heads, current rows written at q_start..q_start+S).
+    Rows past ``q_start + S - 1`` are excluded by the causal mask, so the
+    cache tail needs no explicit length."""
+    o, _ = _flash_fwd_v2_cached(q, k, v, q_start)
+    return o
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -390,7 +478,10 @@ def _tuned_block_sizes(sq: int, sk: int):
     MXU ~12x under-utilized at bench shapes (measured on v5e: 49ms/layer at
     128-blocks vs 4.1ms at 512-blocks for b16 s2048 h32 d64). Pick the
     largest of 512/256/128 that divides each sequence length, for both the
-    forward and the dq/dkv backward passes.
+    forward and the dq/dkv backward passes. ``pick`` only ever returns a
+    divisor of the length (the library kernel requires block | seq), so
+    blocks are inherently clamped to the sequence; the short-prompt
+    block clamping for OUR v2 kernel path lives in ``_fit_block``.
     """
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
@@ -429,6 +520,25 @@ def _on_tpu() -> bool:
         return False
 
 
+def resolve_prefill_impl(impl: str = "auto") -> str:
+    """Resolve a serving ``attention_impl`` knob to the engines' prefill
+    attention path: ``flash`` (flash_attention_cached — interpret mode
+    off-TPU) or ``dense`` (the masked-softmax `_cached_attention`).
+    ``kernel`` opts the paged DECODE kernel in while keeping prefill
+    dense (decode-path isolation for parity tests)."""
+    if impl == "flash":
+        return "flash"
+    if impl in ("reference", "dense", "kernel"):
+        return "dense"
+    if impl != "auto":
+        raise ValueError(
+            f"unknown prefill attention impl '{impl}' "
+            "(auto | flash | kernel | reference | dense)")
+    if _PALLAS_OK and (_on_tpu() or interpret_forced()):
+        return "flash"
+    return "dense"
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
               impl: str = "auto") -> jax.Array:
     """Dispatching attention: [B, S, H|Hkv, D] in, [B, S, H, D] out."""
@@ -442,7 +552,14 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
             and q.shape[1] >= min_dim and k.shape[1] >= min_dim
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
         )
-        impl = "flash" if use_kernel else "reference"
+        if use_kernel:
+            impl = "flash"
+        elif _PALLAS_OK and not _on_tpu() and interpret_forced():
+            # forced interpret mode: run our pallas kernel (fwd + blockwise
+            # custom-vjp bwd) so CPU test runs cover the real kernel path
+            impl = "mlt_flash"
+        else:
+            impl = "reference"
     if impl == "reference":
         return attention_reference(q, k, v, causal=causal)
     k = _repeat_kv(k, n_rep)

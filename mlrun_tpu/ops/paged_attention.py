@@ -1,0 +1,221 @@
+"""Paged-decode attention: one token per slot straight off the KV page pool.
+
+The paged engine (serving/paged.py) historically materialized a dense
+``[slots, max_len]`` KV view per layer per decode tick (``jnp.take`` over
+the page table) and ran plain masked attention on it — HBM traffic on the
+order of the whole cache for every generated token. This module computes
+the same attention by indexing the page pool THROUGH the page table inside
+a Pallas kernel: each grid step DMAs exactly one (page, kv-head) tile from
+HBM into VMEM, so the bytes read per tick are the slot's *live* pages once
+— never a gathered copy of the full view.
+
+Layout (one layer of the pool, see serving/paged.py):
+
+- q:          [slots, n_heads, head_dim]   — the current decode token,
+  post-RoPE (its KV must already be written into the pool; the kernel
+  masks ``k_pos <= pos`` so the current position participates).
+- k/v pages:  [n_pages + 1, page_size, n_kv_heads, head_dim] — the LAST
+  physical page is the scratch page; page-table entries < 0 are routed to
+  it (they are masked out by ``pos`` anyway, the routing just keeps the
+  DMA addresses in-bounds).
+- page_table: [slots, pages_per_slot] int32, -1 = unmapped.
+- pos:        [slots] int32 absolute position of the current token
+  (valid cache length is ``pos + 1``).
+
+Grid ``(slots, kv_heads, pages_per_slot)``: for a fixed (slot, kv head)
+the kernel streams that slot's pages in order, carrying the online-softmax
+running max/denominator/accumulator for the head's GQA query group in VMEM
+scratch — the same accumulation scheme as the verified flash_v2 kernel
+(ops/attention.py), so numerics match the dense reference to float32
+round-off. The page table and positions ride scalar prefetch
+(``PrefetchScalarGridSpec``) because the k/v BlockSpec index maps need
+them to translate (slot, page-slot) -> physical page id before the DMA.
+
+Dispatch mirrors ``ops.attention.attention``: ``resolve_paged_impl``
+picks the kernel on TPU, the gather+dense reference on CPU — unless
+interpret mode is forced (``MLT_ATTN_INTERPRET=1``), which runs the real
+kernel code path under the Pallas interpreter so tier-1 exercises it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .attention import (
+    NEG_INF,
+    _on_tpu,
+    _PALLAS_OK,
+    _repeat_kv,
+    interpret_forced,
+)
+
+if _PALLAS_OK:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+
+def resolve_paged_impl(impl: str = "auto") -> str:
+    """Resolve a serving ``attention_impl`` knob to the paged-decode path:
+    ``kernel`` (Pallas, page-table indexed) or ``reference``
+    (gather+dense). ``flash`` counts as an explicit kernel opt-in;
+    ``dense`` as an explicit reference opt-in."""
+    if impl in ("kernel", "flash"):
+        return "kernel"
+    if impl in ("reference", "dense"):
+        return "reference"
+    if impl != "auto":
+        raise ValueError(
+            f"unknown paged attention impl '{impl}' "
+            "(auto | flash | kernel | reference | dense)")
+    if _PALLAS_OK and (_on_tpu() or interpret_forced()):
+        return "kernel"
+    return "reference"
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, page_size: int,
+                         pages_per_slot: int, scale: float):
+    """Grid (slot, kv_head, page-slot); refs:
+    q [1, n_rep, d] (this kv head's GQA query group), k/v [1, page_size,
+    1, d] (the physical page the index map resolved via the page table).
+    Scratch carries the online softmax across the page-slot grid dim."""
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[s]
+    n_rep = q_ref.shape[1]
+    # pages wholly past the current position contribute nothing — skip the
+    # flops (the DMA already happened; it fetched the scratch page or a
+    # masked page, both harmless)
+    live = p * page_size <= pos
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [n_rep, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)          # [page_size, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rep, page_size), 1)
+        logits = jnp.where(k_pos <= pos, logits, NEG_INF)
+        m_prev = m_scr[:]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        weight = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(weight, axis=-1,
+                                              keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            weight, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(p == pages_per_slot - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
+                       page_size: int, interpret=None):
+    """q [slots, H, D] x pool pages [P+1, page_size, Hkv, D] -> [slots,
+    H, D]. ``page_table`` may contain -1 (routed to the scratch page)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    slots, h, d = q.shape
+    hkv = k_pages.shape[2]
+    n_rep = h // hkv
+    pages_per_slot = page_table.shape[1]
+    scale = d ** -0.5
+    scratch_page = k_pages.shape[0] - 1
+    safe_table = jnp.where(page_table >= 0, page_table,
+                           scratch_page).astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, page_size=page_size,
+        pages_per_slot=pages_per_slot, scale=scale)
+
+    def q_map(s, h_, p, pt, ps):
+        return (s, h_, 0)
+
+    def kv_map(s, h_, p, pt, ps):
+        return (pt[s, p], 0, h_, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, hkv, pages_per_slot),
+        in_specs=[
+            pl.BlockSpec((1, n_rep, d), q_map),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+            pl.BlockSpec((1, page_size, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, n_rep, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((n_rep, 1), jnp.float32),   # running max
+            pltpu.VMEM((n_rep, 1), jnp.float32),   # running denom
+            pltpu.VMEM((n_rep, d), jnp.float32),   # accumulator
+        ],
+    )
+    # q reshaped so the head dim blocks by kv-head group: heads h*n_rep..
+    # (h+1)*n_rep are kv head h's GQA group (matches _repeat_kv order)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, h, d), q.dtype),
+        interpret=interpret,
+    )(safe_table, pos, q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# gather+dense reference (the pre-kernel engine math)
+# ---------------------------------------------------------------------------
+
+def paged_decode_reference(q, k_pages, v_pages, page_table, pos,
+                           page_size: int):
+    """Dense-view reference: gather every slot's pages into
+    [slots, max_len] (the materialization the kernel exists to avoid) and
+    run masked attention. Used for parity tests and as the CPU path."""
+    slots, h, d = q.shape
+    hkv = k_pages.shape[2]
+    n_rep = h // hkv
+    safe = jnp.maximum(page_table, 0)
+    kd = jnp.take(k_pages, safe, axis=0)     # [slots, pps, ps, hkv, d]
+    vd = jnp.take(v_pages, safe, axis=0)
+    s_, p_, ps_, hh, dd = kd.shape
+    kd = _repeat_kv(kd.reshape(s_, p_ * ps_, hh, dd), n_rep)
+    vd = _repeat_kv(vd.reshape(s_, p_ * ps_, hh, dd), n_rep)
+    scale = d ** -0.5
+    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                        kd.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(p_ * ps_)[None, None, :]
+    logits = jnp.where(k_pos <= pos[:, None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", weights,
+                      vd.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, pos, *,
+                    page_size: int, impl: str = "auto",
+                    interpret=None):
+    """Dispatching paged-decode attention (see module docstring)."""
+    impl = resolve_paged_impl(impl)
+    if impl == "reference":
+        return paged_decode_reference(q, k_pages, v_pages, page_table,
+                                      pos, page_size)
+    return _paged_decode_call(q, k_pages, v_pages, page_table, pos,
+                              page_size, interpret=interpret)

@@ -105,12 +105,14 @@ def make_ring_attention(mesh, seq_axis: str = "seq", causal: bool = True):
     """
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.compat import shard_map
+
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
                        and mesh.shape[a] > 1) or None
     spec = P(batch_axes, seq_axis, None, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def _ring(q, k, v):
         return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)

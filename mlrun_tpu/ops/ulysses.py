@@ -71,12 +71,14 @@ def make_ulysses_attention(mesh, seq_axis: str = "seq", causal: bool = True):
     P(batch_axes, seq_axis, None, None)."""
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.compat import shard_map
+
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names
                        and mesh.shape[a] > 1) or None
     spec = P(batch_axes, seq_axis, None, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def _ulysses(q, k, v):
         return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal)

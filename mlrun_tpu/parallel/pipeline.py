@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.llama import LlamaConfig, _layer_body
 from ..ops.norms import rms_norm
 from ..ops.rotary import rope_table
+from .compat import shard_map
 
 
 def split_layers_for_stages(layers: dict, n_stages: int) -> dict:
@@ -68,7 +69,7 @@ def make_pipeline_forward(config: LlamaConfig, mesh: Mesh,
         return out
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(pipe_axis), P(None, batch_axis), P(), P()),
         out_specs=P(None, batch_axis), check_vma=False)
     def pipelined_decoder(stage_layers, x_micro, cos, sin):

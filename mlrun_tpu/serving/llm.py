@@ -85,11 +85,17 @@ def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
 def _forward_with_cache(config: LlamaConfig, params: Params,
                         tokens: jax.Array, cache: dict,
                         lora: Optional[Params] = None,
-                        all_logits: bool = False):
+                        all_logits: bool = False,
+                        attn_impl: str = "dense"):
     """Run tokens starting at cache['pos']; returns (logits_last, new_cache).
     ``all_logits=True`` returns [B, S, vocab] logits for every input
     position instead of just the last (speculative verification needs the
-    target's distribution after each proposed token — serving/speculative.py)."""
+    target's distribution after each proposed token — serving/speculative.py).
+
+    ``attn_impl="flash"`` runs the attention over the cache through the
+    offset-aware flash kernel (ops.attention.flash_attention_cached,
+    interpret mode off-TPU) instead of the dense masked softmax — the
+    engines' prefill hot path (docs/serving.md "Attention kernels")."""
     b, s = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["pos"]  # [B]
@@ -140,8 +146,21 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
                 (0, start[0], 0, 0))
             k_attn, v_attn = k_cache, v_cache
             scales = None
-        attn = _cached_attention(config, q, k_attn, v_attn, positions,
-                                 max_len)
+        if attn_impl == "flash" and s > 1:
+            from ..ops.attention import _repeat_kv, flash_attention_cached
+
+            n_rep = config.n_heads // config.n_kv_heads
+            # positions are uniform per batch row on the prefill path
+            # (mixed-start batches never reach here — see rope note above).
+            # 1-token dispatches (last-prompt-token replay, warmup) stay
+            # dense: a block_q=1 kernel instance gains nothing and is a
+            # shape class TPU lowering never otherwise sees
+            attn = flash_attention_cached(
+                q, _repeat_kv(k_attn, n_rep), _repeat_kv(v_attn, n_rep),
+                start[0])
+        else:
+            attn = _cached_attention(config, q, k_attn, v_attn, positions,
+                                     max_len)
         attn = attn.reshape(b, s, config.qkv_dim)
         x_mid = x_in + proj(attn, lp["wo"])
         h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
@@ -186,7 +205,11 @@ class LLMEngine:
                  max_len: int = 2048, batch: int = 1,
                  prefill_buckets: tuple = (128, 512, 1024),
                  temperature: float = 0.0, kv_dtype: str = "native",
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 attention_impl: str | None = None):
+        from ..config import mlconf
+        from ..ops.attention import resolve_prefill_impl
+
         self.config = config
         self.params = params
         self.max_len = max_len
@@ -198,9 +221,17 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(seed)
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
+        if attention_impl is None:
+            attention_impl = str(
+                mlconf.serving.llm.get("attention_impl", "auto"))
+        self.attention_impl = attention_impl
+        # flash prefill; decode stays dense — a 1-token q gains nothing
+        # from blockwise streaming and the masked softmax is one fused op
+        self.prefill_impl = resolve_prefill_impl(attention_impl)
 
         self._prefill = jax.jit(
-            functools.partial(_forward_with_cache, config))
+            functools.partial(_forward_with_cache, config,
+                              attn_impl=self.prefill_impl))
         self._decode = jax.jit(
             functools.partial(_forward_with_cache, config),
             donate_argnums=(2,))
@@ -454,7 +485,8 @@ class LLMModelServer:
                          max_queue_size: int = 0, max_wait: float = 0.0,
                          degradation: dict | None = None,
                          prefill_chunk: int | None = None,
-                         prefix_cache: bool | None = None, **kw):
+                         prefix_cache: bool | None = None,
+                         attention_impl: str | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -480,6 +512,9 @@ class LLMModelServer:
                 # prefix cache"); None = mlconf.serving.llm defaults
                 self.prefill_chunk = prefill_chunk
                 self.prefix_cache = prefix_cache
+                # attention kernel dispatch (docs/serving.md "Attention
+                # kernels"): auto | flash | kernel | reference
+                self.attention_impl = attention_impl
                 self._tokenizer = None
                 self.engine = None
 
@@ -519,7 +554,8 @@ class LLMModelServer:
                             max_wait=self.max_wait,
                             degradation=self.degradation,
                             prefill_chunk=self.prefill_chunk,
-                            prefix_cache=self.prefix_cache)
+                            prefix_cache=self.prefix_cache,
+                            attention_impl=self.attention_impl)
                     else:
                         from .llm_batch import ContinuousBatchingEngine
 
@@ -529,7 +565,8 @@ class LLMModelServer:
                             max_queue_size=self.max_queue_size,
                             max_wait=self.max_wait,
                             degradation=self.degradation,
-                            prefill_chunk=self.prefill_chunk)
+                            prefill_chunk=self.prefill_chunk,
+                            attention_impl=self.attention_impl)
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()
@@ -542,7 +579,8 @@ class LLMModelServer:
                         config, params, max_len=self.max_len,
                         temperature=self.temperature,
                         top_k=self.top_k, top_p=self.top_p,
-                        kv_dtype=self.kv_dtype)
+                        kv_dtype=self.kv_dtype,
+                        attention_impl=self.attention_impl)
                     if self._warmup:
                         self.engine.warmup()
                 self.model = self.engine

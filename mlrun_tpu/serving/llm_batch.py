@@ -35,6 +35,7 @@ from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..models.llama import LlamaConfig, Params
 from ..obs import (
+    LLM_DECODE_TICK,
     LLM_EVENTS,
     LLM_FREE_PAGE_FRAC,
     LLM_ITL,
@@ -225,7 +226,10 @@ class ContinuousBatchingEngine:
                  max_queue_size: int = 0, max_wait: float = 0.0,
                  degradation: dict | None = None,
                  prefill_chunk: int | None = None,
-                 latency_window: int | None = None):
+                 latency_window: int | None = None,
+                 attention_impl: str | None = None):
+        from ..ops.attention import resolve_prefill_impl
+
         self.config = config
         self.params = params
         self.max_len = max_len
@@ -262,6 +266,19 @@ class ContinuousBatchingEngine:
         # percentiles in stats (per-slot ttft alone was discarded)
         self._ttft_ring: deque = deque(maxlen=latency_window)
         self._itl_ring: deque = deque(maxlen=latency_window)
+        # decode-dispatch wall time (the attention-dominated device step,
+        # admission prefill excluded) behind decode_tick_p50/p95_s
+        self._tick_ring: deque = deque(maxlen=latency_window)
+        # -- attention kernel dispatch (docs/serving.md "Attention kernels")
+        # auto | flash | kernel | reference; prefill resolves to the
+        # offset-aware flash kernel or the dense masked softmax. The
+        # rowwise decode of THIS engine stays dense (per-row positions);
+        # the paged subclass routes decode through the page-table kernel.
+        if attention_impl is None:
+            attention_impl = str(
+                llm_defaults.get("attention_impl", "auto"))
+        self.attention_impl = attention_impl
+        self.prefill_impl = resolve_prefill_impl(attention_impl)
         # the admission being prefilled right now (chunked mode resumes it
         # across ticks; only ever touched by the scheduler thread)
         self._admission: Optional[_Admission] = None
@@ -271,8 +288,8 @@ class ContinuousBatchingEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len) or (max_len,)
 
-        self._prefill = jax.jit(functools.partial(_forward_with_cache,
-                                                  config))
+        self._prefill = jax.jit(functools.partial(
+            _forward_with_cache, config, attn_impl=self.prefill_impl))
         self._decode = jax.jit(functools.partial(_decode_rowwise, config),
                                donate_argnums=(2,))
         # the sampled variant is the same jit object called with the extra
@@ -587,6 +604,7 @@ class ContinuousBatchingEngine:
             out = dict(self._stats)
             ttfts = sorted(self._ttft_ring)
             itls = sorted(self._itl_ring)
+            ticks = sorted(self._tick_ring)
         if out["completed"]:
             out["ttft_avg_s"] = out["ttft_sum"] / out["completed"]
         if ttfts:
@@ -595,6 +613,11 @@ class ContinuousBatchingEngine:
         if itls:
             out["itl_p50_s"] = _percentile(itls, 0.50)
             out["itl_p95_s"] = _percentile(itls, 0.95)
+        if ticks:
+            out["decode_tick_p50_s"] = _percentile(ticks, 0.50)
+            out["decode_tick_p95_s"] = _percentile(ticks, 0.95)
+        out["attention_impl"] = self.attention_impl
+        out["prefill_impl"] = self.prefill_impl
         out["queue_depth"] = self._queue_depth()
         out["pressure_level"] = self.pressure_level()
         out["speculative_enabled"] = self.speculative_enabled
@@ -936,11 +959,19 @@ class ContinuousBatchingEngine:
                     if self._admission is None:
                         time.sleep(0.002)  # idle: poll admissions at 2ms
                     continue
+                t_tick = time.perf_counter()
                 if self._decode_tick():
-                    elapsed = time.perf_counter() - started
+                    now = time.perf_counter()
+                    elapsed = now - started
+                    tick_s = now - t_tick
                     with self._lock:
                         self._itl_ring.append(elapsed)
+                        # decode dispatch alone (admission prefill
+                        # excluded): the per-tick attention cost the
+                        # kernel work targets
+                        self._tick_ring.append(tick_s)
                     LLM_ITL.observe(elapsed)
+                    LLM_DECODE_TICK.observe(tick_s)
         except Exception as exc:  # noqa: BLE001 - a dead scheduler must
             # fail pending work loudly, not leave futures hanging forever
             logger.error("continuous batching scheduler died",

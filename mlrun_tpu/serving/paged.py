@@ -156,7 +156,8 @@ def _write_token_all_layers(pool: dict, k_tok, v_tok, page_table, pos,
     return out
 
 
-def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
+def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
+                          attn_impl: str, params,
                           tokens: jax.Array, pool: dict,
                           page_table: jax.Array, pos: jax.Array,
                           rng: jax.Array = None,
@@ -164,13 +165,23 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
                           top_k: jax.Array = None, top_p: jax.Array = None):
     """One decode token per slot against the page pool.
 
-    Per layer: gather the slot's pages into a dense view, splice the
-    just-computed token into the view for attention (it is only written to
-    the pool once, for all layers, at the end), run the dense masked
-    attention. tokens [slots, 1]; pos [slots] absolute positions.
+    ``attn_impl="reference"``: per layer, gather the slot's pages into a
+    dense [slots, max_len] view, splice the just-computed token into the
+    view for attention (it is only written to the pool once, for all
+    layers, at the end), run the dense masked attention.
+
+    ``attn_impl="kernel"``: per layer, scatter the token's KV into the
+    pool FIRST (one [slots] page-table-routed write), then run the pallas
+    paged-decode kernel which reads the pool THROUGH the page table — the
+    dense view is never materialized (ops/paged_attention.py). Both paths
+    store and read identical bits at identical positions, so greedy
+    decoding is token-identical between them.
+
+    tokens [slots, 1]; pos [slots] absolute positions.
     Returns (next_token, new_pool, new_pos).
     """
     from ..ops.norms import rms_norm
+    from ..ops.paged_attention import paged_attention
     from ..ops.rotary import apply_rope, rope_table
     from .llm import _cached_attention, _quantize_kv
     from .sampling import sample_logits
@@ -182,6 +193,18 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
     x = params["embedding"][tokens].astype(config.dtype)
     cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
     quantized = "k_scale" in pool
+    use_kernel = attn_impl == "kernel"
+    if use_kernel:
+        # int8 pools resolve to "reference" at engine construction — the
+        # kernel reads raw pool pages and carries no dequant scales
+        assert not quantized, "paged kernel does not cover int8 KV"
+        scratch = pool["k"].shape[1] - 1
+        page_idx = pos // page_size
+        offset = pos % page_size
+        pid = jnp.take_along_axis(page_table, page_idx[:, None],
+                                  axis=1)[:, 0]
+        pid_safe = jnp.where(pid >= 0, pid, scratch)
+        pool = dict(pool)
 
     k_new, v_new = [], []
     for layer in range(config.n_layers):
@@ -201,37 +224,50 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        # dense per-layer view of this slot's pages (dequantized)
-        kp = jnp.take(pool["k"][layer], safe_table, axis=0)
-        vp = jnp.take(pool["v"][layer], safe_table, axis=0)
-        s_, p_, ps_, hh, dd = kp.shape
-        kd = kp.reshape(s_, p_ * ps_, hh, dd)
-        vd = vp.reshape(s_, p_ * ps_, hh, dd)
-        if quantized:
-            ksc = jnp.take(pool["k_scale"][layer], safe_table,
-                           axis=0).reshape(s_, p_ * ps_, hh)
-            vsc = jnp.take(pool["v_scale"][layer], safe_table,
-                           axis=0).reshape(s_, p_ * ps_, hh)
-            kd = (kd.astype(jnp.float32) * ksc[..., None]).astype(
-                config.dtype)
-            vd = (vd.astype(jnp.float32) * vsc[..., None]).astype(
-                config.dtype)
+        if use_kernel:
+            # token KV lands in the pool first (unmapped slots route to
+            # the never-read scratch page), then the kernel attends
+            # pool-side via the page table — no dense view, no gather
+            pool["k"] = pool["k"].at[layer, pid_safe, offset].set(
+                k[:, 0].astype(pool["k"].dtype))
+            pool["v"] = pool["v"].at[layer, pid_safe, offset].set(
+                v[:, 0].astype(pool["v"].dtype))
+            attn = paged_attention(
+                q[:, 0], pool["k"][layer], pool["v"][layer], page_table,
+                pos, page_size=page_size, impl="kernel")[:, None]
         else:
-            kd = kd.astype(config.dtype)
-            vd = vd.astype(config.dtype)
-        # splice the new token into the dense view at each slot's position
-        kd = kd.at[rows, pos].set(k[:, 0])
-        vd = vd.at[rows, pos].set(v[:, 0])
-        attn = _cached_attention(config, q, kd, vd, positions,
-                                 kd.shape[1])
+            # dense per-layer view of this slot's pages (dequantized)
+            kp = jnp.take(pool["k"][layer], safe_table, axis=0)
+            vp = jnp.take(pool["v"][layer], safe_table, axis=0)
+            s_, p_, ps_, hh, dd = kp.shape
+            kd = kp.reshape(s_, p_ * ps_, hh, dd)
+            vd = vp.reshape(s_, p_ * ps_, hh, dd)
+            if quantized:
+                ksc = jnp.take(pool["k_scale"][layer], safe_table,
+                               axis=0).reshape(s_, p_ * ps_, hh)
+                vsc = jnp.take(pool["v_scale"][layer], safe_table,
+                               axis=0).reshape(s_, p_ * ps_, hh)
+                kd = (kd.astype(jnp.float32) * ksc[..., None]).astype(
+                    config.dtype)
+                vd = (vd.astype(jnp.float32) * vsc[..., None]).astype(
+                    config.dtype)
+            else:
+                kd = kd.astype(config.dtype)
+                vd = vd.astype(config.dtype)
+            # splice the new token into the dense view at each slot's
+            # position
+            kd = kd.at[rows, pos].set(k[:, 0])
+            vd = vd.at[rows, pos].set(v[:, 0])
+            attn = _cached_attention(config, q, kd, vd, positions,
+                                     kd.shape[1])
+            k_new.append(k[:, 0])
+            v_new.append(v[:, 0])
         attn = attn.reshape(b, 1, config.qkv_dim)
         x_mid = x + proj(attn, lp["wo"])
         h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
         gate = proj(h2, lp["w_gate"])
         up = proj(h2, lp["w_up"])
         x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
-        k_new.append(k[:, 0])
-        v_new.append(v[:, 0])
 
     x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
     head = params.get("lm_head")
@@ -243,6 +279,10 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int, params,
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     else:
         next_token = sample_logits(logits, rng, temperature, top_k, top_p)
+
+    if use_kernel:
+        # KV was written layer-by-layer before each attention call
+        return next_token, pool, pos + 1
 
     # one pooled write for all layers: [L, slots, H, D]
     k_tok = jnp.stack(k_new)
@@ -277,7 +317,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  degradation: dict | None = None,
                  prefill_chunk: int | None = None,
                  latency_window: int | None = None,
-                 prefix_cache: bool | None = None):
+                 prefix_cache: bool | None = None,
+                 attention_impl: str | None = None):
+        from ..ops.paged_attention import resolve_paged_impl
+
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -299,7 +342,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          kv_dtype=kv_dtype, max_queue_size=max_queue_size,
                          max_wait=max_wait, degradation=degradation,
                          prefill_chunk=prefill_chunk,
-                         latency_window=latency_window)
+                         latency_window=latency_window,
+                         attention_impl=attention_impl)
+        # decode path: pallas paged kernel (page-table indexed) or the
+        # gather+dense reference — resolved once, from the same knob the
+        # base class resolved the prefill path from
+        self.attn_impl = resolve_paged_impl(self.attention_impl)
+        if self.attn_impl == "kernel" and kv_dtype == "int8":
+            logger.info("paged attention kernel does not cover int8 KV — "
+                        "decode uses the gather+dense reference path")
+            self.attn_impl = "reference"
         # +1 physical page: the scratch page for masked writes
         self._pool = init_paged_pool(config, self.n_pages + 1, page_size,
                                      kv_dtype)
@@ -308,8 +360,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._pos = np.zeros((slots,), np.int32)
         self._free_pages: deque = deque(range(self.n_pages))
         self._slot_pages: dict[int, list] = {}
+        # HBM bytes the gather path would copy per decode tick (the dense
+        # k+v view of every slot, per layer) — what the kernel path avoids
+        self._gather_bytes_per_tick = sum(
+            arr.dtype.itemsize * config.n_layers * slots * max_len
+            * int(np.prod(arr.shape[3:]))
+            for name, arr in self._pool.items() if name in ("k", "v"))
+        self._stats.update({"attn_kernel_ticks": 0, "attn_gather_ticks": 0,
+                            "attn_hbm_bytes_avoided": 0})
         self._decode_paged = jax.jit(
-            functools.partial(_decode_rowwise_paged, config, page_size),
+            functools.partial(_decode_rowwise_paged, config, page_size,
+                              self.attn_impl),
             donate_argnums=(2,))
         self._insert_paged = jax.jit(
             functools.partial(insert_prompt_pages, page_size=page_size),
@@ -547,9 +608,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._page_table[index] = -1
         self._pos[index] = 0
 
+    # paged-only cumulative stats mirrored to mlt_llm_events_total
+    _COUNTER_STATS = ContinuousBatchingEngine._COUNTER_STATS + (
+        "attn_kernel_ticks", "attn_gather_ticks", "attn_hbm_bytes_avoided")
+
     @property
     def stats(self) -> dict:
         out = ContinuousBatchingEngine.stats.fget(self)
+        out["decode_attn_impl"] = self.attn_impl
         out["free_pages"] = len(self._free_pages)
         if self._prefix is not None:
             queries = self._prefix.queries
@@ -589,6 +655,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             next_token, self._pool, _ = self._decode_paged(
                 self.params, jnp.asarray(last), self._pool, table, pos)
         tokens_host = np.asarray(next_token)
+        with self._lock:
+            # the microbench/acceptance stat: on the kernel path the tick
+            # never gathers a dense view (attn_gather_ticks stays 0) and
+            # the avoided HBM copy is accounted per tick
+            if self.attn_impl == "kernel":
+                self._stats["attn_kernel_ticks"] += 1
+                self._stats["attn_hbm_bytes_avoided"] += \
+                    self._gather_bytes_per_tick
+            else:
+                self._stats["attn_gather_ticks"] += 1
         for i in active:
             slot = self._slot_state[i]
             token = int(tokens_host[i])

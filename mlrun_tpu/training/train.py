@@ -68,6 +68,13 @@ class TrainConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # attention kernel for the train step (None = keep the model config's
+    # own setting): "mlt_flash" runs our pallas flash kernel (custom-vjp
+    # blockwise backward; interpret mode off-TPU so CPU runs exercise the
+    # real kernel path), "flash" the tuned library kernel, "reference"
+    # plain XLA — see ops/attention.attention and
+    # docs/training_performance.md "Flash attention in the step"
+    attention_impl: str | None = None
 
 
 class TrainState:
@@ -124,7 +131,9 @@ def resolve_model_config(model_config, train_config: TrainConfig):
     """Apply TrainConfig model-shaping options: ``moe_experts`` converts a
     dense LlamaConfig into an MoEConfig with the same backbone dims, so a
     user reaches expert parallelism through TrainConfig exactly like
-    ``context_parallel``/``pipeline_stages`` (SURVEY §2.4)."""
+    ``context_parallel``/``pipeline_stages`` (SURVEY §2.4);
+    ``attention_impl`` overrides the model's attention dispatch for the
+    whole step (flash kernels in the training hot path)."""
     from ..models.moe import MoEConfig
 
     if train_config.moe_experts and not isinstance(model_config, MoEConfig):
@@ -133,6 +142,10 @@ def resolve_model_config(model_config, train_config: TrainConfig):
             n_experts=train_config.moe_experts,
             top_k=train_config.moe_top_k,
             capacity_factor=train_config.moe_capacity_factor)
+    if train_config.attention_impl is not None and \
+            hasattr(model_config, "attention_impl"):
+        model_config = dataclasses.replace(
+            model_config, attention_impl=train_config.attention_impl)
     return model_config
 
 
