@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-train bench-attn obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -30,6 +30,9 @@ bench-serving:   ## serving TTFT benchmark (one JSON line)
 
 bench-serve:     ## prefix-cache / chunked-prefill microbench, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py
+
+bench-fleet:     ## engine-fleet routing A/B at replicas=4: affinity vs random, CPU-runnable (one JSON line)
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --fleet
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

@@ -12,7 +12,16 @@ continuous-batching engine:
   end-to-end throughput with the cache on vs off — reuse must not tax
   traffic that can't reuse.
 
-Run: python bench_serve.py [--requests N] [--prefix-tokens N] ...
+``--fleet`` runs the engine-fleet section instead (docs/serving.md
+"Engine fleet"): a hot-prefix workload against an ``EngineFleet`` at
+replicas=4 with page pools sized so one replica CANNOT hold every hot
+prefix — prefix-affinity routing keeps each prefix cache-resident on its
+ring owner, while random routing spreads them across replicas and churns
+every pool's LRU. Records aggregate hit rate + p50/p95 TTFT per policy,
+and the unique-prompt p50 per policy (affinity must not tax traffic that
+can't reuse).
+
+Run: python bench_serve.py [--fleet] [--requests N] [--prefix-tokens N] ...
 """
 
 from __future__ import annotations
@@ -155,18 +164,129 @@ def run(requests: int = 12, prefix_tokens: int = 960,
     return out
 
 
+def run_fleet(replicas: int = 4, prefixes: int = 12,
+              requests_per_prefix: int = 5, prefix_tokens: int = 96,
+              suffix_tokens: int = 8, max_new: int = 8,
+              page_size: int = 32, max_len: int = 256,
+              n_pages: int = 22, slots: int = 2, seed: int = 0,
+              warmup: bool = True) -> dict:
+    """Affinity-vs-random routing A/B on an EngineFleet.
+
+    ``n_pages`` is deliberately tight: each replica's pool holds ~2-3
+    cached prefix chains plus the working set, so under random routing
+    the ``prefixes`` hot chains churn every replica's LRU while affinity
+    keeps each chain resident on exactly one ring owner — the fleet-level
+    locality the router exists for. The workload interleaves the prefix
+    families round-robin (the adversarial order for per-replica LRU)."""
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    # a small bucket so a prefix-hit suffix prefill dispatches a short
+    # program instead of padding back up to the cold-prefill bucket
+    buckets = tuple(sorted({min(16, max_len), min(128, max_len), max_len}))
+
+    def prompt_of(length):
+        return rng.integers(0, config.vocab_size, length).tolist()
+
+    families = [prompt_of(prefix_tokens) for _ in range(prefixes)]
+    repeated = []
+    for _ in range(requests_per_prefix):
+        for family in families:
+            repeated.append(family + prompt_of(suffix_tokens))
+    unique = [prompt_of(prefix_tokens + suffix_tokens)
+              for _ in range(2 * replicas)]
+
+    def make_fleet(policy):
+        def factory(role):
+            return PagedContinuousBatchingEngine(
+                config, params, max_len=max_len, slots=slots,
+                page_size=page_size, n_pages=n_pages,
+                prefill_buckets=buckets)
+
+        fleet = EngineFleet(factory, replicas=replicas, routing=policy,
+                            seed=seed)
+        if warmup:
+            fleet.warmup()
+        fleet.start()
+        return fleet
+
+    out = {"replicas": replicas, "prefixes": prefixes,
+           "requests": len(repeated), "prefix_tokens": prefix_tokens,
+           "page_size": page_size, "n_pages": n_pages, "model": "tiny",
+           "policies": {}}
+    for policy in ("affinity", "random"):
+        fleet = make_fleet(policy)
+        try:
+            ttfts = _ttft_series(fleet, repeated, max_new)
+            stats = fleet.stats
+            unique_ttfts = _ttft_series(fleet, unique, max_new)
+        finally:
+            fleet.stop()
+        out["policies"][policy] = {
+            "prefix_hit_rate": round(stats["prefix_hit_rate"], 3),
+            "p50_ttft_ms": round(_percentile(ttfts, 0.50) * 1000, 2),
+            "p95_ttft_ms": round(_percentile(ttfts, 0.95) * 1000, 2),
+            "unique_p50_ttft_ms": round(
+                _percentile(unique_ttfts, 0.50) * 1000, 2),
+            "redispatches": stats["redispatches"],
+            "per_replica_hit_rate": {
+                rid: round(r["prefix_hit_rate"], 3)
+                for rid, r in stats["per_replica"].items()},
+        }
+    affinity = out["policies"]["affinity"]
+    rand = out["policies"]["random"]
+    # None, not float("inf"): json.dumps would emit bare `Infinity`,
+    # breaking the one-valid-JSON-line contract for non-Python consumers
+    out["hit_rate_ratio"] = round(
+        affinity["prefix_hit_rate"] / rand["prefix_hit_rate"], 2) \
+        if rand["prefix_hit_rate"] > 0 else None
+    out["p50_ttft_speedup"] = round(
+        rand["p50_ttft_ms"] / affinity["p50_ttft_ms"], 2) \
+        if affinity["p50_ttft_ms"] > 0 else 0.0
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the engine-fleet routing A/B instead")
+    # shared flags default to None so each mode keeps its own scale:
+    # the prefix-cache bench stresses ONE engine with long prompts,
+    # while the fleet A/B spreads many short hot prefixes over pools
+    # deliberately too small to hold them all
     parser.add_argument("--requests", type=int, default=12)
-    parser.add_argument("--prefix-tokens", type=int, default=960)
-    parser.add_argument("--suffix-tokens", type=int, default=8)
-    parser.add_argument("--max-new", type=int, default=16)
-    parser.add_argument("--page-size", type=int, default=32)
-    parser.add_argument("--max-len", type=int, default=1024)
+    parser.add_argument("--prefix-tokens", type=int, default=None)
+    parser.add_argument("--suffix-tokens", type=int, default=None)
+    parser.add_argument("--max-new", type=int, default=None)
+    parser.add_argument("--page-size", type=int, default=None)
+    parser.add_argument("--max-len", type=int, default=None)
+    parser.add_argument("--replicas", type=int, default=4)
+    parser.add_argument("--prefixes", type=int, default=12)
+    parser.add_argument("--requests-per-prefix", type=int, default=5)
     args = parser.parse_args(argv)
-    result = run(requests=args.requests, prefix_tokens=args.prefix_tokens,
-                 suffix_tokens=args.suffix_tokens, max_new=args.max_new,
-                 page_size=args.page_size, max_len=args.max_len)
+
+    def overrides(**defaults):
+        return {key: (value if getattr(
+            args, key) is None else getattr(args, key))
+            for key, value in defaults.items()}
+
+    if args.fleet:
+        result = run_fleet(replicas=args.replicas, prefixes=args.prefixes,
+                           requests_per_prefix=args.requests_per_prefix,
+                           **overrides(prefix_tokens=96, suffix_tokens=8,
+                                       max_new=8, page_size=32,
+                                       max_len=256))
+    else:
+        result = run(requests=args.requests,
+                     **overrides(prefix_tokens=960, suffix_tokens=8,
+                                 max_new=16, page_size=32, max_len=1024))
     print(json.dumps(result))
     return result
 

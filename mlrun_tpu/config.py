@@ -178,6 +178,26 @@ default_config: dict[str, Any] = {
             # flash | kernel | reference override per engine.
             "attention_impl": "auto",
         },
+        # engine replica fleet (docs/serving.md "Engine fleet");
+        # EngineFleet / LLMModelServer class args override these
+        "fleet": {
+            # affinity = consistent-hash on prompt-prefix blocks (hot
+            # prefixes stay cache-resident on one replica); random is
+            # the bench baseline
+            "routing": "affinity",
+            # leading full blocks hashed into the routing key — deeper
+            # keys spread better, shallower keys group more traffic per
+            # hot prefix
+            "route_blocks": 4,
+            # virtual nodes per replica on the hash ring (bounds ring
+            # size; more vnodes = smoother key balance)
+            "vnodes": 64,
+            # bounded re-dispatch on 503-class replica failures
+            "max_dispatch_attempts": 3,
+            # first re-dispatch backoff, seconds (deterministic jitter
+            # via common/retry.compute_backoff)
+            "backoff": 0.05,
+        },
     },
     "observability": {
         # unified telemetry (docs/observability.md): the metrics registry

@@ -59,32 +59,60 @@ SERVER_INFLIGHT = REGISTRY.gauge(
     "mlt_server_inflight", "In-flight events on the graph server")
 
 # -- LLM engines -------------------------------------------------------------
+# every family carries a ``replica`` label (empty for standalone engines)
+# so a fleet's per-replica series are tellable apart; cardinality is
+# bounded and each engine removes its own series on stop (scale-down must
+# not leak series — serving/fleet.py)
 LLM_TTFT = REGISTRY.histogram(
-    "mlt_llm_ttft_seconds", "Time to first token (continuous batching)")
+    "mlt_llm_ttft_seconds", "Time to first token (continuous batching)",
+    labels=("replica",), max_label_sets=128, overflow="drop")
 LLM_ITL = REGISTRY.histogram(
     "mlt_llm_itl_seconds",
     "Inter-token latency: whole scheduler iterations that produced a "
     "decode step",
+    labels=("replica",), max_label_sets=128, overflow="drop",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
 LLM_DECODE_TICK = REGISTRY.histogram(
     "mlt_llm_decode_tick_seconds",
     "One decode dispatch (host-observed, admission prefill excluded) — "
     "the attention-dominated device step the paged/flash kernels target",
+    labels=("replica",), max_label_sets=128, overflow="drop",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
 LLM_QUEUE_DEPTH = REGISTRY.gauge(
     "mlt_llm_queue_depth", "Queued + pending admissions per engine",
-    labels=("engine",), overflow="drop")
+    labels=("engine", "replica"), overflow="drop")
 LLM_FREE_PAGE_FRAC = REGISTRY.gauge(
     "mlt_llm_free_page_frac",
     "Free (incl. reclaimable prefix) KV-page fraction, paged engines",
-    labels=("engine",), overflow="drop")
+    labels=("engine", "replica"), overflow="drop")
 LLM_EVENTS = REGISTRY.counter(
     "mlt_llm_events_total",
     "Cumulative engine events mirrored from stats() (requests, completed, "
     "shed, expired, prefix_hits, prefix_evictions, ...)",
-    labels=("engine", "event"), max_label_sets=1024, overflow="drop")
+    labels=("engine", "replica", "event"), max_label_sets=1024,
+    overflow="drop")
+
+# -- engine fleet (serving/fleet.py) -----------------------------------------
+FLEET_DISPATCHES = REGISTRY.counter(
+    "mlt_fleet_dispatches_total",
+    "Fleet routing outcomes per replica (ok / redispatch / failed / "
+    "no_replica)",
+    labels=("replica", "outcome"), max_label_sets=512, overflow="drop")
+FLEET_HANDOFF_BYTES = REGISTRY.counter(
+    "mlt_fleet_handoff_bytes_total",
+    "KV bytes moved prefill-replica -> decode-replica (the batch=1 "
+    "slot-cache serialization boundary)")
+FLEET_HANDOFF_LATENCY = REGISTRY.histogram(
+    "mlt_fleet_handoff_seconds",
+    "Prefill-complete -> decode-slot-active latency for disaggregated "
+    "requests (decode-side import + queueing)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+FLEET_REPLICAS = REGISTRY.gauge(
+    "mlt_fleet_replicas", "Live fleet replicas by role",
+    labels=("role",), overflow="drop")
 
 # -- run lifecycle -----------------------------------------------------------
 RUN_SUBMITS = REGISTRY.counter(

@@ -1,3 +1,8 @@
+from .fleet import (  # noqa: F401
+    ConsistentHashRing,
+    EngineFleet,
+    EngineReplica,
+)
 from .remote import BatchHttpRequests, RemoteCallError, RemoteStep  # noqa: F401
 from .resilience import (  # noqa: F401
     AdmissionController,
@@ -9,6 +14,7 @@ from .resilience import (  # noqa: F401
     EngineStoppedError,
     PromptTooLongError,
     QueueFullError,
+    ReplicaUnavailableError,
     ResilienceError,
     ServerDrainingError,
     StepResilience,
@@ -18,6 +24,7 @@ from .routers import (  # noqa: F401
     EnrichmentVotingEnsemble,
     ModelRouter,
     ParallelRun,
+    PrefixAffinityRouter,
     VotingEnsemble,
 )
 from .server import (  # noqa: F401

@@ -1,0 +1,683 @@
+"""Engine replica fleet: prefix-affinity routing + prefill/decode
+disaggregation (docs/serving.md "Engine fleet").
+
+One continuous-batching engine per process caps throughput far below the
+"millions of users" north star, and naive round-robin/random routing
+across replicas destroys the prefix-cache locality serving/prefix.py
+pays for — every replica re-prefills the hot prefixes its siblings
+already cached. This module is the fleet layer above the engines:
+
+- :class:`ConsistentHashRing` — bounded ring with virtual nodes; keys
+  are the prompt's leading full-page-size block chains
+  (``prefix.block_chain_key``, the same block identity the radix index
+  keys on), so requests sharing a hot prefix land on the SAME replica
+  where the KV pages already live, and a replica join/leave moves only
+  ~1/N of the keyspace.
+- :class:`EngineFleet` — owns N engine replicas (in-process workers;
+  the dispatch seam is a Future-returning ``submit``, so a
+  ``RemoteStep``-backed process replica slots in behind the same
+  interface). Dispatch re-routes 503-class failures
+  (``EngineStoppedError``, draining, shed) to the next ring node with
+  bounded deterministic backoff (``common/retry.compute_backoff``)
+  instead of surfacing them to the client.
+- Prefill/decode disaggregation: with ``prefill_replicas`` > 0 the
+  fleet splits into a prefill pool (affinity-routed — the prefix caches
+  live there) and a decode pool (least-loaded). A prefill replica runs
+  the (chunked) prefill and exports the slot's KV
+  (``KVHandoff``, the batch=1 slot-cache serialization boundary that
+  ``gather_prefix_pages``/``insert_prompt_pages`` already define); a
+  decode replica imports it and ticks — a fleet-wide long prompt can
+  never appear between two decode ticks, generalizing chunked prefill
+  across processes.
+
+Everything here is host-side Python with no jax import at module level —
+the router must stay importable below the engines (serving/__init__.py
+pulls routers.py in eagerly, and routers.py uses the ring).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from ..common.retry import RetryPolicy, compute_backoff
+from ..config import mlconf
+from ..obs import (
+    FLEET_DISPATCHES,
+    FLEET_HANDOFF_BYTES,
+    FLEET_HANDOFF_LATENCY,
+    FLEET_REPLICAS,
+    get_tracer,
+)
+from ..utils import logger
+from .prefix import block_chain_key
+from .resilience import (
+    CircuitOpenError,
+    EngineStoppedError,
+    QueueFullError,
+    ReplicaUnavailableError,
+    ServerDrainingError,
+)
+
+# process-unique fleet ids so two fleets' replica labels never collide
+_FLEET_SEQUENCE = iter(range(1, 1 << 30))
+
+
+def redispatchable(exc: Exception) -> bool:
+    """Failures worth re-routing to another replica: the REPLICA is
+    unavailable (stopped, draining, breaker-open, shedding) — not the
+    request (400-class stays fatal). Remote process replicas surface the
+    same classes as ``RemoteCallError`` with a 429/502/503 status."""
+    if isinstance(exc, (EngineStoppedError, ServerDrainingError,
+                        QueueFullError, CircuitOpenError)):
+        return True
+    from .remote import RemoteCallError
+
+    if isinstance(exc, RemoteCallError):
+        return getattr(exc, "status_code", None) in (429, 502, 503)
+    return False
+
+
+class ConsistentHashRing:
+    """Bounded consistent-hash ring with virtual nodes.
+
+    Each node owns ``vnodes`` deterministic points on a 64-bit ring
+    (sha256 of ``"node#i"`` — stable across processes); a key maps to
+    the first point clockwise from it. Adding/removing a node moves only
+    the keys whose nearest point belonged to it (~1/N of the keyspace),
+    so a replica join/leave relocates a bounded slice of prefix
+    residency instead of reshuffling every hot prefix."""
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes <= 0:
+            raise ValueError(f"vnodes must be > 0, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._points: list[int] = []      # sorted ring positions
+        self._owners: list[str] = []      # owner node per position
+        self._nodes: set[str] = set()
+
+    @staticmethod
+    def _point(data: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(data.encode()).digest()[:8], "big")
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> list[str]:
+        return sorted(self._nodes)
+
+    def add(self, node: str):
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            point = self._point(f"{node}#{i}")
+            idx = bisect.bisect(self._points, point)
+            self._points.insert(idx, point)
+            self._owners.insert(idx, node)
+
+    def remove(self, node: str):
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        keep = [(p, o) for p, o in zip(self._points, self._owners)
+                if o != node]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def lookup(self, key: int) -> str:
+        """The node owning ``key``; raises when the ring is empty."""
+        if not self._points:
+            raise ReplicaUnavailableError("hash ring has no nodes")
+        idx = bisect.bisect(self._points, key) % len(self._points)
+        return self._owners[idx]
+
+    def preference(self, key: int, exclude=()) -> list[str]:
+        """Distinct nodes in ring order starting at ``key``'s owner —
+        the re-dispatch order (primary first, then each next ring
+        node)."""
+        if not self._points:
+            return []
+        exclude = set(exclude)
+        start = bisect.bisect(self._points, key) % len(self._points)
+        seen: set[str] = set()
+        order: list[str] = []
+        for offset in range(len(self._points)):
+            owner = self._owners[(start + offset) % len(self._points)]
+            if owner in seen or owner in exclude:
+                continue
+            seen.add(owner)
+            order.append(owner)
+        return order
+
+
+class EngineReplica:
+    """One engine behind a fleet id + role (unified | prefill | decode).
+
+    The dispatch contract is duck-typed on ``submit``/``submit_prefill``/
+    ``submit_prefilled`` returning Futures — a remote process replica
+    (RemoteStep-backed client) satisfies it without the fleet changing."""
+
+    def __init__(self, replica_id: str, engine, role: str = "unified"):
+        self.id = replica_id
+        self.engine = engine
+        self.role = role
+        self.draining = False
+        # stamp the replica label BEFORE the engine registers metrics
+        engine.replica = replica_id
+
+    @property
+    def healthy(self) -> bool:
+        return not self.draining and not getattr(
+            self.engine, "_stopped", False)
+
+    def load(self) -> int:
+        """Cheap congestion signal for decode-pool placement: active
+        slots + queued admissions (host-side ints, no stats() walk)."""
+        engine = self.engine
+        active = sum(1 for s in getattr(engine, "_slot_state", ())
+                     if s.active)
+        return active + engine._queue_depth()
+
+
+class EngineFleet:
+    """N engine replicas behind one ``submit()``.
+
+    ``engine_factory(role)`` builds one engine per replica ("unified",
+    or "prefill"/"decode" when ``prefill_replicas`` > 0). Routing:
+
+    - ``"affinity"`` (default): consistent-hash on the prompt's leading
+      prefix blocks — hot prefixes stay cache-resident on one replica.
+    - ``"random"``: uniform choice (the bench baseline affinity is
+      measured against).
+
+    The fleet duck-types the engine surface ``LLMModelServer.predict``
+    uses (``submit``/``generate``/``warmup``/``start``/``stop``/
+    ``stats``), so it drops in wherever a single engine did.
+    """
+
+    ROUTING = ("affinity", "random")
+
+    def __init__(self, engine_factory: Callable[[str], object],
+                 replicas: int = 2, prefill_replicas: int = 0,
+                 routing: str | None = None,
+                 route_blocks: int | None = None,
+                 route_block_tokens: int | None = None,
+                 vnodes: int | None = None,
+                 max_dispatch_attempts: int | None = None,
+                 backoff: float | None = None, seed: int = 0):
+        fleet_conf = mlconf.serving.fleet
+        if routing is None:
+            routing = str(fleet_conf.routing)
+        if routing not in self.ROUTING:
+            raise ValueError(
+                f"unknown routing '{routing}' (one of {self.ROUTING})")
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if prefill_replicas < 0:
+            raise ValueError(
+                f"prefill_replicas must be >= 0, got {prefill_replicas}")
+        self.routing = routing
+        self.route_blocks = int(route_blocks
+                                if route_blocks is not None
+                                else fleet_conf.route_blocks)
+        self._factory = engine_factory
+        self._fleet_id = f"f{next(_FLEET_SEQUENCE)}"
+        self._lock = threading.RLock()
+        self._rng = random.Random(seed)
+        attempts = (max_dispatch_attempts
+                    if max_dispatch_attempts is not None
+                    else int(fleet_conf.max_dispatch_attempts))
+        if attempts < 1:
+            raise ValueError("max_dispatch_attempts must be >= 1")
+        self.max_dispatch_attempts = attempts
+        self._retry_policy = RetryPolicy(
+            max_retries=attempts,
+            backoff=(float(backoff) if backoff is not None
+                     else float(fleet_conf.backoff)),
+            backoff_factor=2.0, backoff_max=1.0, jitter=0.1)
+        self._started = False
+        self._stopped = False
+        self._replica_seq = 0
+        self._stats = {"dispatches": 0, "redispatches": 0, "failed": 0,
+                       "no_replica": 0, "handoffs": 0, "handoff_bytes": 0}
+        self._ttft_ring: list = []            # end-to-end, bounded below
+        self._ttft_ring_max = 512
+        # pools: unified fleets route over _workers; disaggregated fleets
+        # affinity-route prefills over _prefill and place decodes
+        # least-loaded over _workers
+        self._workers: dict[str, EngineReplica] = {}
+        self._prefill: dict[str, EngineReplica] = {}
+        vnode_count = int(vnodes if vnodes is not None
+                          else fleet_conf.vnodes)
+        self._ring = ConsistentHashRing(vnodes=vnode_count)
+        worker_role = "decode" if prefill_replicas else "unified"
+        for _ in range(replicas):
+            self.add_replica(worker_role)
+        for _ in range(prefill_replicas):
+            self.add_replica("prefill")
+        # routing-key block size: align with the engines' page size so
+        # the routing identity IS the radix index's block identity
+        if route_block_tokens is None:
+            first = next(iter(self._route_pool().values()))
+            route_block_tokens = getattr(first.engine, "page_size", 64)
+        self.route_block_tokens = int(route_block_tokens)
+
+    # -- topology ------------------------------------------------------------
+    def _route_pool(self) -> dict[str, EngineReplica]:
+        """The pool affinity routing runs over: prefill replicas when
+        disaggregated (their prefix caches are the locality that
+        matters), the whole fleet otherwise."""
+        return self._prefill if self._prefill else self._workers
+
+    def _sync_ring(self):
+        """Ring membership == non-draining routing-pool membership.
+        Caller holds the lock. Adding the first prefill replica flips the
+        routing pool from workers to prefill; the sweep keeps the ring
+        consistent through that flip and through drains."""
+        route = self._route_pool()
+        for node in list(self._ring.nodes()):
+            if node not in route or route[node].draining:
+                self._ring.remove(node)
+        for rid, replica in route.items():
+            if not replica.draining:
+                self._ring.add(rid)
+
+    @property
+    def replicas(self) -> list[EngineReplica]:
+        with self._lock:
+            return list(self._workers.values()) + list(
+                self._prefill.values())
+
+    def add_replica(self, role: str = "unified") -> str:
+        """Scale up: build + ring-join one replica (keys move ~1/N)."""
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown replica role '{role}'")
+        with self._lock:
+            rid = f"{self._fleet_id}-{role[0]}{self._replica_seq}"
+            self._replica_seq += 1
+            engine = self._factory(role)
+            replica = EngineReplica(rid, engine, role)
+            pool = self._prefill if role == "prefill" else self._workers
+            pool[rid] = replica
+            self._sync_ring()
+            FLEET_REPLICAS.set(
+                sum(1 for r in self.replicas if r.role == role), role=role)
+            if self._started:
+                engine.start()
+        logger.info("fleet replica added", replica=rid, role=role,
+                    fleet=self._fleet_id)
+        return rid
+
+    def remove_replica(self, replica_id: str):
+        """Scale down: ring-leave (only this replica's keys move), stop
+        the engine (queued work fails with EngineStoppedError and the
+        dispatch layer re-routes it), and let the engine retire its own
+        metric series."""
+        with self._lock:
+            replica = self._workers.pop(replica_id, None) or \
+                self._prefill.pop(replica_id, None)
+            if replica is None:
+                raise KeyError(f"unknown replica '{replica_id}'")
+            replica.draining = True
+            self._sync_ring()
+            FLEET_REPLICAS.set(
+                sum(1 for r in self.replicas if r.role == replica.role),
+                role=replica.role)
+        replica.engine.stop()
+        # the engine retired its mlt_llm_* series in stop(); retire the
+        # fleet's per-replica dispatch series too, or a churning fleet
+        # pins dead replicas until the family's cardinality bound bites
+        for outcome in ("ok", "redispatch", "failed"):
+            FLEET_DISPATCHES.remove(replica=replica_id, outcome=outcome)
+        logger.info("fleet replica removed", replica=replica_id,
+                    fleet=self._fleet_id)
+
+    def drain_replica(self, replica_id: str):
+        """Stop routing NEW work to a replica (in-flight work finishes);
+        the ring drops its points so its keyspace moves to neighbors."""
+        with self._lock:
+            for pool in (self._workers, self._prefill):
+                if replica_id in pool:
+                    pool[replica_id].draining = True
+                    self._sync_ring()
+                    return
+        raise KeyError(f"unknown replica '{replica_id}'")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        with self._lock:
+            self._started = True
+            replicas = self.replicas
+        for replica in replicas:
+            replica.engine.start()
+
+    def warmup(self):
+        for replica in self.replicas:
+            replica.engine.warmup()
+
+    def stop(self, timeout: float = 10.0):
+        with self._lock:
+            self._stopped = True
+            replicas = self.replicas
+        for replica in replicas:
+            replica.engine.stop(timeout=timeout)
+
+    def close(self):
+        self.stop()
+
+    # -- routing -------------------------------------------------------------
+    def routing_key(self, prompt_tokens) -> int:
+        return block_chain_key(prompt_tokens, self.route_block_tokens,
+                               max_blocks=self.route_blocks)
+
+    def _pick(self, pool: dict, key: int, tried: list,
+              affinity: bool) -> Optional[EngineReplica]:
+        """Next replica for a key: ring preference order under affinity,
+        uniform under random; draining/stopped/already-tried replicas are
+        skipped, with a healthy fallback off-ring so a request never
+        fails while ANY replica could serve it."""
+        with self._lock:
+            candidates = [r for r in pool.values()
+                          if r.healthy and r.id not in tried]
+            if not candidates:
+                return None
+            if not affinity or self.routing == "random":
+                return self._rng.choice(candidates)
+            by_id = {r.id: r for r in candidates}
+            for rid in self._ring.preference(key, exclude=tried):
+                if rid in by_id:
+                    return by_id[rid]
+            # ring points may lag a drain — any healthy replica beats a
+            # client-visible failure
+            return candidates[0]
+
+    def _pick_decode(self, tried: list) -> Optional[EngineReplica]:
+        """Decode placement is load-, not locality-driven: the KV arrives
+        with the handoff, so the least-loaded healthy replica wins."""
+        with self._lock:
+            candidates = [r for r in self._workers.values()
+                          if r.healthy and r.id not in tried]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.load(), r.id))
+
+    # -- dispatch ------------------------------------------------------------
+    def submit(self, prompt_tokens, max_new_tokens: int = 64,
+               eos_id: int | None = None, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0,
+               max_wait: float | None = None) -> Future:
+        """Route one request into the fleet; resolves to (tokens, stats)
+        exactly like an engine future, with ``stats`` gaining ``replica``
+        (and ``prefill_replica``/``prefill_s``/``handoff_bytes`` when
+        disaggregated). 503-class replica failures re-dispatch to the
+        next ring node up to ``max_dispatch_attempts`` times."""
+        out: Future = Future()
+        if self._stopped:
+            out.set_exception(EngineStoppedError(
+                "fleet is stopped, not accepting requests"))
+            return out
+        span = get_tracer().current()
+        state = {
+            "prompt": list(prompt_tokens),
+            "max_new": max_new_tokens, "eos_id": eos_id,
+            "sampling": (float(temperature), int(top_k), float(top_p)),
+            "max_wait": max_wait,
+            "key": self.routing_key(prompt_tokens),
+            "t0": time.perf_counter(),
+            "attempts": 0, "tried": [], "tried_decode": [],
+            "trace": ((span.trace_id, span.span_id)
+                      if span is not None else None),
+        }
+        if self._prefill:
+            self._dispatch_prefill(out, state)
+        else:
+            self._dispatch_unified(out, state)
+        return out
+
+    def generate(self, prompt_tokens, max_new_tokens: int = 64,
+                 eos_id: int | None = None, timeout: float = 300.0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
+        return self.submit(prompt_tokens, max_new_tokens, eos_id,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p).result(timeout=timeout)
+
+    def _fail(self, out: Future, state: dict, exc: Exception):
+        with self._lock:
+            self._stats["failed"] += 1
+        if not out.done():
+            out.set_exception(exc)
+
+    def _retry_later(self, out: Future, state: dict, redo: Callable):
+        """Deterministic-jitter backoff off-thread: the done-callback
+        runs on a replica's scheduler thread, which must never sleep."""
+        with self._lock:
+            self._stats["redispatches"] += 1
+        delay = compute_backoff(
+            state["attempts"] - 1, self._retry_policy,
+            seed=f"fleet:{state['key']}")
+        timer = threading.Timer(delay, redo)
+        timer.daemon = True
+        timer.start()
+
+    def _no_replica(self, out: Future, state: dict, pool: str):
+        with self._lock:
+            self._stats["no_replica"] += 1
+        FLEET_DISPATCHES.inc(replica="", outcome="no_replica")
+        self._fail(out, state, ReplicaUnavailableError(
+            f"no healthy {pool} replica left after "
+            f"{state['attempts']} attempt(s) "
+            f"(tried {state['tried'] or state['tried_decode']})"))
+
+    def _budget_left(self, out: Future, state: dict,
+                     exc: Exception) -> bool:
+        state["attempts"] += 1
+        if state["attempts"] < self.max_dispatch_attempts:
+            return True
+        self._fail(out, state, exc)
+        return False
+
+    # unified fleet: one replica runs prefill AND decode
+    def _dispatch_unified(self, out: Future, state: dict):
+        # dispatch runs on done-callback / Timer threads, where an
+        # uncaught raise is swallowed by the Future machinery and the
+        # client future hangs to its timeout — a synchronous submit()
+        # failure (duck-typed remote replica, bad handoff) must fail the
+        # request loudly instead
+        try:
+            replica = self._pick(self._workers, state["key"],
+                                 state["tried"], affinity=True)
+            if replica is None:
+                self._no_replica(out, state, "fleet")
+                return
+            state["tried"].append(replica.id)
+            inner = replica.engine.submit(
+                state["prompt"], max_new_tokens=state["max_new"],
+                eos_id=state["eos_id"], temperature=state["sampling"][0],
+                top_k=state["sampling"][1], top_p=state["sampling"][2],
+                max_wait=state["max_wait"], _trace=state["trace"])
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            self._fail(out, state, exc)
+            return
+        inner.add_done_callback(
+            lambda fut: self._unified_done(out, state, replica, fut))
+
+    def _unified_done(self, out: Future, state: dict,
+                      replica: EngineReplica, fut: Future):
+        exc = fut.exception()
+        if exc is None:
+            tokens, stats = fut.result()
+            self._finalize(out, state, replica, tokens, dict(stats))
+            return
+        if redispatchable(exc):
+            FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            logger.warning("fleet re-dispatching request",
+                           replica=replica.id, error=str(exc),
+                           attempt=state["attempts"] + 1)
+            if self._budget_left(out, state, exc):
+                self._retry_later(
+                    out, state, lambda: self._dispatch_unified(out, state))
+            return
+        FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._fail(out, state, exc)
+
+    # disaggregated fleet: prefill pool → KV handoff → decode pool
+    def _dispatch_prefill(self, out: Future, state: dict):
+        try:
+            replica = self._pick(self._prefill, state["key"],
+                                 state["tried"], affinity=True)
+            if replica is None:
+                self._no_replica(out, state, "prefill")
+                return
+            state["tried"].append(replica.id)
+            inner = replica.engine.submit_prefill(
+                state["prompt"], eos_id=state["eos_id"],
+                temperature=state["sampling"][0],
+                top_k=state["sampling"][1], top_p=state["sampling"][2],
+                max_wait=state["max_wait"], _trace=state["trace"])
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            self._fail(out, state, exc)
+            return
+        inner.add_done_callback(
+            lambda fut: self._prefill_done(out, state, replica, fut))
+
+    def _prefill_done(self, out: Future, state: dict,
+                      replica: EngineReplica, fut: Future):
+        exc = fut.exception()
+        if exc is None:
+            handoff = fut.result()
+            with self._lock:
+                self._stats["handoffs"] += 1
+                self._stats["handoff_bytes"] += handoff.nbytes()
+            FLEET_HANDOFF_BYTES.inc(handoff.nbytes())
+            state["handoff"] = handoff
+            self._dispatch_decode(out, state)
+            return
+        if redispatchable(exc):
+            FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            if self._budget_left(out, state, exc):
+                self._retry_later(
+                    out, state, lambda: self._dispatch_prefill(out, state))
+            return
+        FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._fail(out, state, exc)
+
+    def _dispatch_decode(self, out: Future, state: dict):
+        try:
+            replica = self._pick_decode(state["tried_decode"])
+            if replica is None:
+                self._no_replica(out, state, "decode")
+                return
+            state["tried_decode"].append(replica.id)
+            inner = replica.engine.submit_prefilled(
+                state["handoff"], max_new_tokens=state["max_new"],
+                eos_id=state["eos_id"], max_wait=state["max_wait"],
+                _trace=state["trace"])
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            # e.g. submit_prefilled's synchronous KV-dtype mismatch — this
+            # runs inside the prefill future's done-callback, which eats
+            # uncaught raises
+            self._fail(out, state, exc)
+            return
+        inner.add_done_callback(
+            lambda fut: self._decode_done(out, state, replica, fut))
+
+    def _decode_done(self, out: Future, state: dict,
+                     replica: EngineReplica, fut: Future):
+        exc = fut.exception()
+        if exc is None:
+            tokens, stats = fut.result()
+            stats = dict(stats)
+            handoff = state["handoff"]
+            # decode-side ttft is the import+queue cost — the handoff
+            # latency; end-to-end TTFT = prefill + handoff
+            FLEET_HANDOFF_LATENCY.observe(stats.get("ttft_s", 0.0))
+            stats["handoff_s"] = stats.get("ttft_s", 0.0)
+            stats["handoff_bytes"] = handoff.nbytes()
+            stats["prefill_replica"] = handoff.replica
+            stats["prefill_s"] = handoff.prefill_s
+            stats["cached_prefix"] = handoff.cached_prefix
+            stats["ttft_s"] = handoff.prefill_s + stats["handoff_s"]
+            self._finalize(out, state, replica, tokens, stats)
+            return
+        if redispatchable(exc):
+            # the handoff is plain host data — replayable on the next
+            # decode replica without touching the prefill pool again
+            FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            if self._budget_left(out, state, exc):
+                self._retry_later(
+                    out, state, lambda: self._dispatch_decode(out, state))
+            return
+        FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._fail(out, state, exc)
+
+    def _finalize(self, out: Future, state: dict,
+                  replica: EngineReplica, tokens, stats: dict):
+        stats["replica"] = replica.id
+        stats["dispatch_attempts"] = state["attempts"] + 1
+        FLEET_DISPATCHES.inc(replica=replica.id, outcome="ok")
+        with self._lock:
+            self._stats["dispatches"] += 1
+            self._ttft_ring.append(stats.get("ttft_s", 0.0))
+            if len(self._ttft_ring) > self._ttft_ring_max:
+                del self._ttft_ring[:len(self._ttft_ring)
+                                    - self._ttft_ring_max]
+        if not out.done():
+            out.set_result((tokens, stats))
+
+    # -- observability -------------------------------------------------------
+    @property
+    def stats(self) -> dict:
+        """Fleet-level view: routing counters, aggregate prefix hit rate
+        (total hits over total queries — the bench/acceptance number),
+        end-to-end TTFT percentiles from the fleet's own ring, and a
+        ``per_replica`` breakdown feeding the future autoscaler."""
+        from .llm_batch import _percentile
+
+        with self._lock:
+            out = dict(self._stats)
+            ttfts = sorted(self._ttft_ring)
+            replicas = self.replicas
+        out["routing"] = self.routing
+        out["replicas"] = len(replicas)
+        out["prefill_replicas"] = sum(
+            1 for r in replicas if r.role == "prefill")
+        hits = queries = completed = depth = 0
+        per: dict[str, dict] = {}
+        for replica in replicas:
+            stats = replica.engine.stats
+            hits += stats.get("prefix_hits", 0)
+            queries += stats.get("prefix_queries", 0)
+            completed += stats.get("completed", 0)
+            depth += stats.get("queue_depth", 0)
+            per[replica.id] = {
+                "role": replica.role,
+                "draining": replica.draining,
+                "requests": stats.get("requests", 0),
+                "completed": stats.get("completed", 0),
+                "queue_depth": stats.get("queue_depth", 0),
+                "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+                "handoffs_out": stats.get("handoffs_out", 0),
+                "handoffs_in": stats.get("handoffs_in", 0),
+            }
+            for key in ("ttft_p50_s", "ttft_p95_s", "decode_tick_p50_s",
+                        "decode_tick_p95_s", "prefill_chunks"):
+                if key in stats:
+                    per[replica.id][key] = stats[key]
+        out["completed"] = completed
+        out["queue_depth"] = depth
+        out["prefix_hits"] = hits
+        out["prefix_queries"] = queries
+        out["prefix_hit_rate"] = hits / queries if queries else 0.0
+        if ttfts:
+            out["ttft_p50_s"] = _percentile(ttfts, 0.50)
+            out["ttft_p95_s"] = _percentile(ttfts, 0.95)
+        out["per_replica"] = per
+        return out

@@ -486,7 +486,10 @@ class LLMModelServer:
                          degradation: dict | None = None,
                          prefill_chunk: int | None = None,
                          prefix_cache: bool | None = None,
-                         attention_impl: str | None = None, **kw):
+                         attention_impl: str | None = None,
+                         replicas: int = 0,
+                         prefill_replicas: int = 0,
+                         routing: str | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -515,6 +518,13 @@ class LLMModelServer:
                 # attention kernel dispatch (docs/serving.md "Attention
                 # kernels"): auto | flash | kernel | reference
                 self.attention_impl = attention_impl
+                # engine fleet (docs/serving.md "Engine fleet"):
+                # replicas >= 2 builds an EngineFleet instead of one
+                # engine; prefill_replicas > 0 additionally splits
+                # prefill and decode into separate pools with KV handoff
+                self.replicas = replicas
+                self.prefill_replicas = prefill_replicas
+                self.routing = routing
                 self._tokenizer = None
                 self.engine = None
 
@@ -540,26 +550,26 @@ class LLMModelServer:
                     # slot-based scheduler: concurrent requests interleave
                     # on one decode batch; per-request sampling settings
                     # ride the shared dispatch (serving/sampling.py)
-                    if self.paged:
-                        # paged KV pool: oversubscribable long-prompt
-                        # serving (serving/paged.py)
-                        from .paged import PagedContinuousBatchingEngine
+                    def build_engine(role="unified"):
+                        if self.paged:
+                            # paged KV pool: oversubscribable long-prompt
+                            # serving (serving/paged.py)
+                            from .paged import PagedContinuousBatchingEngine
 
-                        self.engine = PagedContinuousBatchingEngine(
-                            config, params, max_len=self.max_len,
-                            slots=self.slots, kv_dtype=self.kv_dtype,
-                            page_size=self.page_size,
-                            n_pages=self.n_pages,
-                            max_queue_size=self.max_queue_size,
-                            max_wait=self.max_wait,
-                            degradation=self.degradation,
-                            prefill_chunk=self.prefill_chunk,
-                            prefix_cache=self.prefix_cache,
-                            attention_impl=self.attention_impl)
-                    else:
+                            return PagedContinuousBatchingEngine(
+                                config, params, max_len=self.max_len,
+                                slots=self.slots, kv_dtype=self.kv_dtype,
+                                page_size=self.page_size,
+                                n_pages=self.n_pages,
+                                max_queue_size=self.max_queue_size,
+                                max_wait=self.max_wait,
+                                degradation=self.degradation,
+                                prefill_chunk=self.prefill_chunk,
+                                prefix_cache=self.prefix_cache,
+                                attention_impl=self.attention_impl)
                         from .llm_batch import ContinuousBatchingEngine
 
-                        self.engine = ContinuousBatchingEngine(
+                        return ContinuousBatchingEngine(
                             config, params, max_len=self.max_len,
                             slots=self.slots, kv_dtype=self.kv_dtype,
                             max_queue_size=self.max_queue_size,
@@ -567,6 +577,20 @@ class LLMModelServer:
                             degradation=self.degradation,
                             prefill_chunk=self.prefill_chunk,
                             attention_impl=self.attention_impl)
+
+                    if self.replicas >= 2 or self.prefill_replicas:
+                        # replica fleet: prefix-affinity routing across
+                        # N engines, optional prefill/decode pools with
+                        # KV handoff (docs/serving.md "Engine fleet")
+                        from .fleet import EngineFleet
+
+                        self.engine = EngineFleet(
+                            build_engine,
+                            replicas=max(1, self.replicas),
+                            prefill_replicas=self.prefill_replicas,
+                            routing=self.routing)
+                    else:
+                        self.engine = build_engine()
                     if self._warmup:
                         self.engine.warmup()
                     self.engine.start()

@@ -155,6 +155,34 @@ def _percentile(sorted_samples: list, q: float) -> float:
 
 
 @dataclass
+class KVHandoff:
+    """Prefill→decode KV handoff payload (docs/serving.md "Engine fleet").
+
+    The serialization boundary is the batch=1 admission slot-cache — the
+    same pytree ``gather_prefix_pages``/``insert_prompt_pages`` already
+    move between the page pool and a slot — held as HOST numpy arrays
+    trimmed to the prompt rows, so the payload can cross a process
+    boundary as plain arrays. A prefill replica produces one via
+    ``submit_prefill()``; a decode replica consumes it via
+    ``submit_prefilled()`` and decodes token-identically to the
+    single-engine path (greedy)."""
+
+    prompt: list
+    first_token: int
+    kv: dict                     # {"k","v"[, "k_scale","v_scale"]}: numpy
+    prompt_len: int
+    cached_prefix: int = 0       # prompt tokens served from the prefill
+    #                              replica's prefix cache
+    sampling: tuple = (0.0, 0, 1.0)
+    prefill_s: float = 0.0       # submit→export wall time on the prefill
+    #                              replica (chunk scheduling included)
+    replica: str = ""            # prefill replica id (fleet bookkeeping)
+
+    def nbytes(self) -> int:
+        return int(sum(arr.nbytes for arr in self.kv.values()))
+
+
+@dataclass
 class _Admission:
     """A request claimed off the queue and being prefilled into a slot.
 
@@ -186,6 +214,12 @@ class _Admission:
     page_ids: object = None
     pages: list = field(default_factory=list)
     prefix_nodes: list = field(default_factory=list)
+    # fleet disaggregation (docs/serving.md "Engine fleet"): an export
+    # admission resolves its future with a KVHandoff instead of
+    # activating a decode slot; a prefilled admission arrived WITH its
+    # KV (imported handoff) and skips the prefill dispatch entirely
+    export: bool = False
+    prefilled: bool = False
 
 
 @dataclass
@@ -326,9 +360,12 @@ class ContinuousBatchingEngine:
         self._epoch = 0
         self._dead_epochs: set = set()
         self._stale_epochs: set = set()
-        # /metrics identity + scrape-time collector handle
+        # /metrics identity + scrape-time collector handle; ``replica`` is
+        # the fleet-assigned label on every mlt_llm_* series ("" for a
+        # standalone engine) — set it BEFORE start()/first submit()
         self._obs_name = (f"{type(self).__name__}-"
                           f"{next(_ENGINE_SEQUENCE)}")
+        self.replica = ""
         self._metrics_collector = None
         self._next_id = 0
         # RLock: the expiry sweep holds it across drain/re-put while the
@@ -340,7 +377,9 @@ class ContinuousBatchingEngine:
         self._stats = {"requests": 0, "completed": 0, "ttft_sum": 0.0,
                        "tokens_out": 0, "shed": 0, "expired": 0,
                        "degraded": 0, "rejected_too_long": 0,
-                       "prefill_chunks": 0, "prefill_tokens_tick_max": 0}
+                       "prefill_chunks": 0, "prefill_tokens_tick_max": 0,
+                       "handoffs_out": 0, "handoff_bytes_out": 0,
+                       "handoffs_in": 0, "handoff_bytes_in": 0}
 
     def _make_cache(self):
         """Slot KV storage (hook: the paged engine swaps in a page pool)."""
@@ -409,7 +448,9 @@ class ContinuousBatchingEngine:
     _COUNTER_STATS = ("requests", "completed", "tokens_out", "shed",
                       "expired", "degraded", "rejected_too_long",
                       "prefill_chunks", "prefix_queries", "prefix_hits",
-                      "prefix_evictions", "prefix_cached_tokens")
+                      "prefix_evictions", "prefix_cached_tokens",
+                      "handoffs_out", "handoff_bytes_out", "handoffs_in",
+                      "handoff_bytes_in")
 
     def _register_metrics(self):
         """Expose this engine on the process registry: queue-depth /
@@ -421,14 +462,21 @@ class ContinuousBatchingEngine:
 
         ref = weakref.ref(self)
         name = self._obs_name
+        replica = self.replica
 
         counter_stats = self._COUNTER_STATS
 
         def remove_series():
-            LLM_QUEUE_DEPTH.remove(engine=name)
-            LLM_FREE_PAGE_FRAC.remove(engine=name)
+            LLM_QUEUE_DEPTH.remove(engine=name, replica=replica)
+            LLM_FREE_PAGE_FRAC.remove(engine=name, replica=replica)
             for key in counter_stats:
-                LLM_EVENTS.remove(engine=name, event=key)
+                LLM_EVENTS.remove(engine=name, replica=replica, event=key)
+            if replica:
+                # fleet replicas own their latency-histogram series too —
+                # a scaled-down replica must not pin them; standalone
+                # engines (replica "") share one series, never removed
+                for family in (LLM_TTFT, LLM_ITL, LLM_DECODE_TICK):
+                    family.remove(replica=replica)
 
         def collect():
             engine = ref()
@@ -436,13 +484,15 @@ class ContinuousBatchingEngine:
                 remove_series()
                 return False
             stats = engine.stats
-            LLM_QUEUE_DEPTH.set(stats.get("queue_depth", 0), engine=name)
+            LLM_QUEUE_DEPTH.set(stats.get("queue_depth", 0), engine=name,
+                                replica=replica)
             frac = engine._free_page_frac()
             if frac is not None:
-                LLM_FREE_PAGE_FRAC.set(frac, engine=name)
+                LLM_FREE_PAGE_FRAC.set(frac, engine=name, replica=replica)
             for key in engine._COUNTER_STATS:
                 if key in stats:
-                    LLM_EVENTS.set_total(stats[key], engine=name, event=key)
+                    LLM_EVENTS.set_total(stats[key], engine=name,
+                                         replica=replica, event=key)
             return None
 
         self._metrics_collector = collect
@@ -517,11 +567,18 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_tokens, max_new_tokens: int = 64,
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               max_wait: float | None = None) -> Future:
+               max_wait: float | None = None, _extra=None,
+               _trace=None) -> Future:
         """Thread-safe request submission. ``max_wait`` overrides the
         engine-level queue-time budget for this request. The returned
         future fails FAST — QueueFullError when shedding,
-        EngineStoppedError after stop/crash — never silently hangs."""
+        EngineStoppedError after stop/crash — never silently hangs.
+
+        ``_extra``/``_trace`` are the fleet's internal channel: ``_extra``
+        marks an export ("export") or carries an imported
+        :class:`KVHandoff`; ``_trace`` overrides the thread-local span
+        capture so a router dispatching from a callback thread still
+        parents the engine's llm.* spans on the originating request."""
         future: Future = Future()
         if self._stopped and not self._running:
             cause = f": {self._crash_exc}" if self._crash_exc else ""
@@ -568,9 +625,10 @@ class ContinuousBatchingEngine:
         # trace context crosses the thread boundary inside the queue item:
         # the scheduler emits llm.prefill/llm.decode spans parented on the
         # submitting step's span (docs/observability.md)
-        current_span = get_tracer().current()
-        trace = ((current_span.trace_id, current_span.span_id)
-                 if current_span is not None else None)
+        if _trace is None:
+            current_span = get_tracer().current()
+            _trace = ((current_span.trace_id, current_span.span_id)
+                      if current_span is not None else None)
         # enqueue under the lock: the expiry sweep drains and re-puts the
         # queue atomically, so a racing put must not land mid-sweep and
         # jump ahead of older requests
@@ -584,10 +642,98 @@ class ContinuousBatchingEngine:
                              max_new_tokens, eos_id, future,
                              time.perf_counter(),
                              (float(temperature), int(top_k), float(top_p)),
-                             expires, trace))
+                             expires, _trace, _extra))
         if not self._running:
             self.start()
         return future
+
+    # -- prefill/decode disaggregation (docs/serving.md "Engine fleet") ------
+    def submit_prefill(self, prompt_tokens, eos_id: int | None = None,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, max_wait: float | None = None,
+                       _trace=None) -> Future:
+        """Run ONLY the (chunked) prefill for a prompt; the returned future
+        resolves to a :class:`KVHandoff` a decode replica can import via
+        :meth:`submit_prefilled`. The prompt's KV still lands in this
+        engine's prefix cache (paged), so hot prefixes stay cache-resident
+        on the prefill pool. ``max_new_tokens=1`` bounds the paged page
+        reservation to the prompt itself."""
+        return self.submit(prompt_tokens, max_new_tokens=1, eos_id=eos_id,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, max_wait=max_wait, _extra="export",
+                           _trace=_trace)
+
+    def submit_prefilled(self, handoff: KVHandoff,
+                         max_new_tokens: int = 64,
+                         eos_id: int | None = None,
+                         max_wait: float | None = None,
+                         _trace=None) -> Future:
+        """Admit an already-prefilled request: the handoff's KV is imported
+        into the admission slot-cache and decode starts immediately — no
+        prefill dispatch ever runs on this engine, so a decode pool's tick
+        cadence is immune to fleet-wide long prompts."""
+        expects_scales = self.kv_dtype == "int8"
+        if ("k_scale" in handoff.kv) != expects_scales:
+            raise ValueError(
+                f"KV handoff dtype mismatch: engine kv_dtype="
+                f"'{self.kv_dtype}' cannot import "
+                f"{'bf16/native' if expects_scales else 'int8'} pages")
+        temperature, top_k, top_p = handoff.sampling
+        return self.submit(handoff.prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id, temperature=temperature,
+                           top_k=top_k, top_p=top_p, max_wait=max_wait,
+                           _extra=handoff, _trace=_trace)
+
+    def _import_small(self, handoff: KVHandoff) -> dict:
+        """Deserialize a handoff into the batch=1 admission cache (the
+        inverse of :meth:`_export_admission`'s trim): prompt rows from the
+        payload, zeros beyond — decode overwrites position >= prompt_len
+        before ever attending over it."""
+        shape = (self.config.n_layers, 1, self.max_len,
+                 self.config.n_kv_heads, self.config.head_dim)
+        dtypes = {"k": self.config.dtype, "v": self.config.dtype}
+        if self.kv_dtype == "int8":
+            dtypes = {"k": jnp.int8, "v": jnp.int8,
+                      "k_scale": jnp.float32, "v_scale": jnp.float32}
+        small = {}
+        for name, dtype in dtypes.items():
+            full_shape = shape if name in ("k", "v") else shape[:-1]
+            host = np.zeros(full_shape, dtype)
+            payload = handoff.kv.get(name)
+            if payload is not None:
+                rows = min(payload.shape[1], self.max_len)
+                host[:, 0, :rows] = payload[:, :rows]
+            small[name] = jnp.asarray(host)
+        small["pos"] = jnp.full((1,), handoff.prompt_len, jnp.int32)
+        return small
+
+    def _export_admission(self, adm: _Admission):
+        """Resolve an export admission's future with the KV handoff and
+        free the slot storage immediately — a prefill replica never holds
+        a decode slot. The paged engine's `_complete_storage` already
+        registered the prompt blocks, so the prefix stays cache-resident
+        here for the next request sharing it."""
+        rows = len(adm.prompt)
+        kv = {}
+        for name in ("k", "v", "k_scale", "v_scale"):
+            if name in adm.small:
+                kv[name] = np.asarray(adm.small[name][:, 0, :rows])
+        prefill_s = time.perf_counter() - adm.submitted
+        handoff = KVHandoff(
+            prompt=list(adm.prompt), first_token=adm.first_token, kv=kv,
+            prompt_len=len(adm.prompt), cached_prefix=adm.base,
+            sampling=adm.sampling, prefill_s=prefill_s,
+            replica=self.replica)
+        self._release_slot_storage(adm.slot)
+        with self._lock:
+            self._stats["handoffs_out"] += 1
+            self._stats["handoff_bytes_out"] += handoff.nbytes()
+            # a prefill replica's TTFT ring IS its prefill latency — the
+            # first token ships inside the handoff
+            self._ttft_ring.append(prefill_s)
+        LLM_TTFT.observe(prefill_s, replica=self.replica)
+        if not adm.future.done():
+            adm.future.set_result(handoff)
 
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None, timeout: float = 300.0,
@@ -717,7 +863,7 @@ class ContinuousBatchingEngine:
         slot.decode_started = time.time()
         with self._lock:
             self._ttft_ring.append(slot.ttft)
-        LLM_TTFT.observe(slot.ttft)
+        LLM_TTFT.observe(slot.ttft, replica=self.replica)
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
@@ -756,14 +902,18 @@ class ContinuousBatchingEngine:
                 continue
             (request_id, prompt, max_new, eos_id, future, submitted,
              sampling, expires) = item[:8]
+            extra = item[9] if len(item) > 9 else None
             try:
-                return _Admission(
+                adm = _Admission(
                     slot=free, request_id=request_id, prompt=prompt,
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
-                    expires=expires, trace=item[8], claimed=time.time(),
-                    small=init_kv_cache(self.config, 1, self.max_len,
-                                        kv_dtype=self.kv_dtype))
+                    expires=expires, trace=item[8], claimed=time.time())
+                self._apply_directive(adm, extra)
+                if adm.small is None:
+                    adm.small = init_kv_cache(self.config, 1, self.max_len,
+                                              kv_dtype=self.kv_dtype)
+                return adm
             except Exception as exc:
                 # dequeued but not yet tracked in self._admission — fail
                 # the future before the scheduler dies or it would hang
@@ -771,6 +921,22 @@ class ContinuousBatchingEngine:
                 if not future.done():
                     future.set_exception(exc)
                 raise
+
+    def _apply_directive(self, adm: _Admission, extra):
+        """Fold the fleet directive (item[9]) into a fresh admission:
+        "export" flags a prefill-only request; a KVHandoff means the
+        prefill already happened on another replica — import its KV and
+        skip straight to slot activation."""
+        if extra == "export":
+            adm.export = True
+        elif isinstance(extra, KVHandoff):
+            adm.small = self._import_small(extra)
+            adm.offset = len(adm.prompt)
+            adm.first_token = extra.first_token
+            adm.prefilled = True
+            with self._lock:
+                self._stats["handoffs_in"] += 1
+                self._stats["handoff_bytes_in"] += extra.nbytes()
 
     def _complete_storage(self, adm: _Admission):
         """Move the prefilled batch=1 cache into slot storage (the paged
@@ -783,11 +949,16 @@ class ContinuousBatchingEngine:
         if adm.trace is not None:
             # the prefill scheduler phase as a span under the submitting
             # step — chunk count and cached-prefix length ride as attrs
+            # (imported=True marks a KV-handoff import: no prefill ran)
             get_tracer().emit(
                 "llm.prefill", adm.trace[0], adm.trace[1],
                 start=adm.claimed, attrs={
                     "slot": adm.slot, "prompt_len": len(adm.prompt),
-                    "chunks": adm.chunks, "cached_prefix": adm.base})
+                    "chunks": adm.chunks, "cached_prefix": adm.base,
+                    "imported": adm.prefilled, "exported": adm.export})
+        if adm.export:
+            self._export_admission(adm)
+            return
         self._activate_slot(adm.slot, adm.request_id, adm.first_token,
                             adm.max_new, adm.eos_id, adm.future,
                             adm.submitted, len(adm.prompt), adm.sampling,
@@ -808,7 +979,8 @@ class ContinuousBatchingEngine:
         if adm is None:
             return False
         self._admission = adm
-        self._run_prefill(adm, limit=None)
+        if not adm.prefilled:
+            self._run_prefill(adm, limit=None)
         self._finish_admission(adm)
         self._admission = None
         return True
@@ -834,7 +1006,7 @@ class ContinuousBatchingEngine:
         # moment the request was dequeued in _prepare_admission — a
         # mid-prefill admission is being served, not waiting (the
         # unchunked path behaves the same)
-        if self._run_prefill(adm, limit=self.prefill_chunk):
+        if adm.prefilled or self._run_prefill(adm, limit=self.prefill_chunk):
             self._finish_admission(adm)
             self._admission = None
 
@@ -970,8 +1142,8 @@ class ContinuousBatchingEngine:
                         # excluded): the per-tick attention cost the
                         # kernel work targets
                         self._tick_ring.append(tick_s)
-                    LLM_ITL.observe(elapsed)
-                    LLM_DECODE_TICK.observe(tick_s)
+                    LLM_ITL.observe(elapsed, replica=self.replica)
+                    LLM_DECODE_TICK.observe(tick_s, replica=self.replica)
         except Exception as exc:  # noqa: BLE001 - a dead scheduler must
             # fail pending work loudly, not leave futures hanging forever
             logger.error("continuous batching scheduler died",

@@ -53,7 +53,7 @@ from ..config import mlconf
 from ..models.llama import LlamaConfig
 from ..utils import logger
 from .llm import init_kv_cache
-from .llm_batch import ContinuousBatchingEngine, _Admission
+from .llm_batch import ContinuousBatchingEngine, KVHandoff, _Admission
 from .prefix import PrefixCache
 
 
@@ -487,6 +487,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 continue
             (request_id, prompt, max_new, eos_id, future, submitted,
              sampling, expires) = item[:8]
+            extra = item[9] if len(item) > 9 else None
             prompt_len = len(prompt)
             needed = -(-(prompt_len + max_new) // self.page_size)
             if needed > self.n_pages:
@@ -500,7 +501,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 continue
             matched_pages: list = []
             matched_nodes: list = []
-            if self._prefix is not None:
+            # an imported handoff arrives with its full prompt KV — a
+            # local prefix match would only re-gather what the payload
+            # already carries, so imports always take fresh pages
+            if self._prefix is not None and not isinstance(extra, KVHandoff):
                 matched_pages, matched_nodes = self._prefix.match(prompt)
             k = len(matched_pages)
             fresh_needed = needed - k
@@ -516,7 +520,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._pending.popleft()
             fresh: list = []
             try:
-                if self._prefix is not None:
+                if self._prefix is not None \
+                        and not isinstance(extra, KVHandoff):
                     self._prefix.queries += 1
                     if k:
                         self._prefix.hits += 1
@@ -532,12 +537,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
                     expires=expires, trace=item[8], claimed=time.time(),
-                    small=init_kv_cache(self.config, 1, self.max_len,
-                                        kv_dtype=self.kv_dtype),
                     base=k * self.page_size, offset=k * self.page_size)
                 adm.page_ids = ids
                 adm.pages = fresh
                 adm.prefix_nodes = matched_nodes
+                self._apply_directive(adm, extra)
+                if adm.small is None:
+                    adm.small = init_kv_cache(self.config, 1, self.max_len,
+                                              kv_dtype=self.kv_dtype)
                 if k:
                     # seed the batch=1 cache with the shared prefix KV;
                     # the suffix-only prefill attends over it from
@@ -568,7 +575,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                         jnp.asarray(insert_ids))
         held = list(adm.prefix_nodes)
         pages = list(adm.pages)
-        if self._prefix is not None:
+        # imported handoffs skip registration: a decode-pool replica never
+        # serves prefills, so caching their blocks would only displace
+        # pages without ever producing a hit
+        if self._prefix is not None and not adm.prefilled:
             # index this prompt's freshly written full blocks for future
             # reuse; claimed pages become cache-owned (not freed on
             # release — they stay cached until evicted)

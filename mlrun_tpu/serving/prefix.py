@@ -29,7 +29,41 @@ free-list it feeds).
 
 from __future__ import annotations
 
+import hashlib
 import heapq
+
+
+def block_chain_key(tokens, block_tokens: int,
+                    max_blocks: int | None = None) -> int:
+    """Stable 64-bit hash of a prompt's leading full ``block_tokens``-sized
+    token blocks — the fleet routing key (serving/fleet.py).
+
+    This is the same block-chain identity the radix index keys on: two
+    prompts sharing their leading full blocks (a system prompt, a few-shot
+    template) produce the SAME key, so a consistent-hash router sends them
+    to the same replica and the prefix KV stays cache-resident there.
+    ``max_blocks`` caps how deep the chain reaches into the prompt (the
+    router wants prefix locality, not whole-prompt uniqueness — without
+    the cap, two prompts sharing a hot prefix but differing later would
+    route apart and re-prefill the shared blocks on both replicas).
+    Prompts shorter than one full block hash their raw tokens, namespaced
+    so a short prompt can never collide with a block chain. Uses sha256,
+    not ``hash()``: the key must agree across processes and runs."""
+    if block_tokens <= 0:
+        raise ValueError(f"block_tokens must be > 0, got {block_tokens}")
+    digest = hashlib.sha256()
+    full = len(tokens) // block_tokens
+    if max_blocks is not None:
+        full = min(full, int(max_blocks))
+    if full <= 0:
+        digest.update(b"short:")
+        digest.update(",".join(str(int(t)) for t in tokens).encode())
+    else:
+        for i in range(full):
+            block = tokens[i * block_tokens:(i + 1) * block_tokens]
+            digest.update(b"|")
+            digest.update(",".join(str(int(t)) for t in block).encode())
+    return int.from_bytes(digest.digest()[:8], "big")
 
 
 class _Node:

@@ -97,6 +97,14 @@ class ServerDrainingError(ResilienceError):
     status_code = 503
 
 
+class ReplicaUnavailableError(ResilienceError):
+    """Every fleet replica eligible for a request is stopped, draining,
+    or already failed it — the router exhausted its re-dispatch budget
+    (serving/fleet.py)."""
+
+    status_code = 503
+
+
 # -- deadline propagation ----------------------------------------------------
 def deadline_from_headers(headers: dict | None,
                           clock: Callable[[], float] = time.monotonic

@@ -5,12 +5,14 @@ from __future__ import annotations
 
 import concurrent.futures
 import copy
+import time
 from typing import Optional, Union
 
 import numpy as np
 
+from ..common.retry import RetryPolicy, compute_backoff
 from ..utils import logger
-from .resilience import check_deadline
+from .resilience import check_deadline, deadline_remaining
 
 
 class BaseModelRouter:
@@ -122,6 +124,118 @@ class ParallelRun(BaseModelRouter):
         body = event.body if isinstance(event.body, dict) else {}
         event.body = self.merger(body, results)
         return event
+
+
+class PrefixAffinityRouter(BaseModelRouter):
+    """Consistent-hash prefix-affinity routing over LLM replica routes
+    (docs/serving.md "Engine fleet").
+
+    Routes are interchangeable model replicas (each typically an
+    ``LLMModelServer`` — in-process engine or a ``RemoteStep``-backed
+    process); the router keys each request on the prompt's leading
+    prefix blocks (``prefix.block_chain_key``) so requests sharing a hot
+    prefix hit the replica whose KV cache already holds it. A 503-class
+    failure (draining or stopped replica, open breaker, shed) re-routes
+    to the next ring node with bounded deterministic backoff instead of
+    surfacing the failure to the client; an explicit
+    ``/v2/models/<name>`` path still addresses one replica directly.
+    """
+
+    def __init__(self, *args, route_block_tokens: int = 64,
+                 route_blocks: int = 4, vnodes: int = 64,
+                 max_dispatch_attempts: int = 3, backoff: float = 0.05,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.route_block_tokens = int(route_block_tokens)
+        self.route_blocks = int(route_blocks)
+        self.max_dispatch_attempts = int(max_dispatch_attempts)
+        self._retry_policy = RetryPolicy(
+            max_retries=self.max_dispatch_attempts, backoff=float(backoff),
+            backoff_factor=2.0, backoff_max=1.0, jitter=0.1)
+        from .fleet import ConsistentHashRing
+
+        self._ring = ConsistentHashRing(vnodes=int(vnodes))
+        self.redispatches = 0
+
+    def post_init(self, mode: str = "sync"):
+        for name in self.routes:
+            self._ring.add(name)
+
+    def _routing_key(self, event) -> int:
+        """Key on the first input's leading blocks: token lists hash
+        token blocks (the radix-index identity); strings hash byte
+        blocks, which is the same shared-prefix grouping one tokenizer
+        hop earlier."""
+        from .prefix import block_chain_key
+
+        body = event.body if isinstance(event.body, dict) else {}
+        inputs = body.get(self.inputs_key) or []
+        first = inputs[0] if inputs else ""
+        if isinstance(first, str):
+            first = list(first.encode())
+        return block_chain_key(list(first), self.route_block_tokens,
+                               max_blocks=self.route_blocks)
+
+    def do_event(self, event, *args, **kwargs):
+        from .fleet import redispatchable
+
+        event = self.parse_event(event)
+        path = getattr(event, "path", "/") or "/"
+        if path.startswith(self.health_prefix):
+            event.body = {"models": list(self.routes.keys()),
+                          "router": self.name}
+            return event
+        model, _ = self._resolve_route(event)
+        if model:
+            # an explicit replica address bypasses affinity
+            # (ops/debugging); an UNKNOWN one is an addressing error the
+            # caller must see (base-router contract), not traffic to
+            # silently affinity-route — a stale address after scale-down
+            # would otherwise look like a healthy replica
+            if model not in self.routes:
+                raise ValueError(
+                    f"model '{model}' not found in routes "
+                    f"{list(self.routes)}")
+            check_deadline(event, f"{self.name}/{model}")
+            return self.routes[model].run(event)
+        if getattr(event, "method", "POST") == "GET" or not isinstance(
+                event.body, dict):
+            event.body = {"models": list(self.routes.keys()),
+                          "router": self.name}
+            return event
+        key = self._routing_key(event)
+        order = self._ring.preference(key)
+        last_exc = None
+        for attempt, name in enumerate(order[:self.max_dispatch_attempts]):
+            check_deadline(event, f"{self.name}/{name}")
+            if attempt:
+                delay = compute_backoff(
+                    attempt - 1, self._retry_policy,
+                    seed=f"{self.name}:{key}")
+                remaining = deadline_remaining(event)
+                if remaining is not None and delay >= remaining:
+                    break  # no budget for another replica
+                if delay > 0:
+                    time.sleep(delay)
+            try:
+                return self.routes[name].run(copy.copy(event))
+            except Exception as exc:  # noqa: BLE001 - classified below
+                if not redispatchable(exc):
+                    raise
+                last_exc = exc
+                self.redispatches += 1
+                incr = getattr(self.context, "incr", None)
+                if callable(incr):
+                    incr(f"router.{self.name}.redispatched")
+                logger.warning("affinity router re-dispatching",
+                               router=self.name, replica=name,
+                               attempt=attempt + 1, error=str(exc))
+        from .resilience import ReplicaUnavailableError
+
+        raise ReplicaUnavailableError(
+            f"router '{self.name}' exhausted its replicas "
+            f"({min(len(order), self.max_dispatch_attempts)} tried)"
+        ) from last_exc
 
 
 class VotingTypes:

@@ -293,6 +293,21 @@ class RouterStep(TaskStep):
         self.routes[key] = route
         return route
 
+    def add_replica_routes(self, count: int, class_name=None,
+                           key_prefix: str = "replica",
+                           **class_args) -> list["TaskStep"]:
+        """Declare ``count`` identical replica routes
+        (``<key_prefix>-0`` … ``<key_prefix>-N-1``) — the fleet topology
+        behind ``PrefixAffinityRouter``, where every route is an
+        interchangeable model replica rather than a distinct model."""
+        if count < 1:
+            raise GraphError(
+                f"router '{self.name}': replica count must be >= 1, "
+                f"got {count}")
+        return [self.add_route(f"{key_prefix}-{i}", class_name=class_name,
+                               **dict(class_args))
+                for i in range(count)]
+
     def clear_children(self, routes: list[str] | None = None):
         if routes is None:
             self.routes = {}

@@ -1,0 +1,401 @@
+"""Engine replica fleet (serving/fleet.py): consistent-hash ring
+invariants (deterministic mapping, ~1/N movement on join/leave,
+shared-prefix affinity), re-dispatch on dead/draining replicas,
+prefill→decode KV handoff greedy parity (incl. a prefix-cache-hit
+prefill), decode-pool isolation from long prefills
+(``decode_tick_p95_s``), per-replica metric series lifecycle, the
+``PrefixAffinityRouter`` graph topology, and the fleet bench smoke.
+CPU-only; the dispatch-logic tests run on jax-free fake engines."""
+
+import importlib.util
+import pathlib
+import time
+from concurrent.futures import Future
+
+import pytest
+
+import mlrun_tpu
+from mlrun_tpu.serving.fleet import ConsistentHashRing, EngineFleet
+from mlrun_tpu.serving.prefix import block_chain_key
+from mlrun_tpu.serving.resilience import (
+    EngineStoppedError,
+    ReplicaUnavailableError,
+)
+from mlrun_tpu.serving.v2_serving import V2ModelServer
+
+
+# -- consistent-hash ring (no jax) -------------------------------------------
+def _keys(n=1000):
+    return [block_chain_key(list(range(i, i + 40)), 8, max_blocks=4)
+            for i in range(n)]
+
+
+def test_ring_deterministic_mapping():
+    keys = _keys()
+    ring_a = ConsistentHashRing(vnodes=32)
+    ring_b = ConsistentHashRing(vnodes=32)
+    for node in ("r0", "r1", "r2", "r3"):
+        ring_a.add(node)
+        ring_b.add(node)
+    mapping = {key: ring_a.lookup(key) for key in keys}
+    # same nodes -> identical mapping, in a fresh ring too (sha256-based
+    # points, no process-local hash())
+    assert all(ring_b.lookup(key) == owner for key, owner in mapping.items())
+    # every node owns a share
+    assert set(mapping.values()) == {"r0", "r1", "r2", "r3"}
+    # preference order starts at the owner and covers all distinct nodes
+    for key in keys[:20]:
+        order = ring_a.preference(key)
+        assert order[0] == mapping[key]
+        assert sorted(order) == ["r0", "r1", "r2", "r3"]
+
+
+def test_ring_minimal_movement_on_join_leave():
+    keys = _keys()
+    ring = ConsistentHashRing(vnodes=64)
+    for node in ("r0", "r1", "r2", "r3"):
+        ring.add(node)
+    before = {key: ring.lookup(key) for key in keys}
+    ring.add("r4")
+    after_join = {key: ring.lookup(key) for key in keys}
+    moved = sum(1 for key in keys if after_join[key] != before[key])
+    # consistent hashing moves ~1/(N+1) of the keyspace to the newcomer
+    assert moved / len(keys) <= 0.35, moved
+    # moved keys all went TO the new node, none shuffled between old ones
+    assert all(after_join[key] == "r4"
+               for key in keys if after_join[key] != before[key])
+    ring.remove("r4")
+    # leave restores the exact prior mapping (only r4's keys move back)
+    assert all(ring.lookup(key) == before[key] for key in keys)
+    ring.remove("r1")
+    after_leave = {key: ring.lookup(key) for key in keys}
+    # only the removed node's keys moved
+    assert all(after_leave[key] == before[key]
+               for key in keys if before[key] != "r1")
+
+
+def test_routing_key_groups_shared_prefixes():
+    # same leading blocks, different suffixes -> same key (the cap keeps
+    # deep-prompt divergence out of the routing identity)
+    base = list(range(100))
+    other = list(range(64)) + [9] * 40
+    assert block_chain_key(base, 16, max_blocks=4) == \
+        block_chain_key(other, 16, max_blocks=4)
+    # a different prefix routes apart
+    assert block_chain_key(base, 16, max_blocks=4) != \
+        block_chain_key([7] + base[1:], 16, max_blocks=4)
+    # short prompts (no full block) key on their raw tokens, namespaced
+    # away from block chains
+    assert block_chain_key([1, 2, 3], 16) != block_chain_key([1, 2, 4], 16)
+    ring = ConsistentHashRing(vnodes=32)
+    for node in ("r0", "r1", "r2"):
+        ring.add(node)
+    assert ring.lookup(block_chain_key(base, 16, max_blocks=4)) == \
+        ring.lookup(block_chain_key(other, 16, max_blocks=4))
+
+
+# -- dispatch logic on fake engines (no jax) ---------------------------------
+class _FakeEngine:
+    """Duck-typed engine: resolves futures instantly, optionally with a
+    scripted failure — exercises the fleet's future-failure re-dispatch
+    path (distinct from the pick-time health check)."""
+
+    page_size = 8
+
+    def __init__(self, fail_with=None):
+        self.replica = ""
+        self._stopped = False
+        self._slot_state = ()
+        self.fail_with = fail_with
+        self.prompts = []
+
+    def _queue_depth(self):
+        return 0
+
+    def start(self):
+        pass
+
+    def warmup(self):
+        pass
+
+    def stop(self, timeout=10.0):
+        self._stopped = True
+
+    def submit(self, prompt, **kwargs):
+        future = Future()
+        self.prompts.append(list(prompt))
+        if self.fail_with is not None:
+            future.set_exception(self.fail_with)
+        else:
+            future.set_result((list(prompt)[:1], {"ttft_s": 0.001}))
+        return future
+
+    @property
+    def stats(self):
+        return {"requests": len(self.prompts), "completed": 0,
+                "queue_depth": 0}
+
+
+def _fake_fleet(engines, **kwargs):
+    pool = list(engines)
+    return EngineFleet(lambda role: pool.pop(0), replicas=len(engines),
+                       route_block_tokens=8, backoff=0.001, **kwargs)
+
+
+def test_fleet_redispatch_on_failing_future():
+    engines = [_FakeEngine(), _FakeEngine()]
+    fleet = _fake_fleet(engines)
+    prompt = list(range(32))
+    # make the key's RING OWNER the dying replica, deterministically —
+    # this exercises the future-failure path, not the pick-time health
+    # check (the fake stays "healthy", its futures just fail)
+    primary_id = fleet._ring.lookup(fleet.routing_key(prompt))
+    primary = next(r.engine for r in fleet.replicas if r.id == primary_id)
+    primary.fail_with = EngineStoppedError("replica died")
+    tokens, stats = fleet.submit(prompt, max_new_tokens=4).result(timeout=10)
+    assert tokens == prompt[:1]
+    assert stats["replica"] != primary_id
+    assert stats["dispatch_attempts"] == 2
+    assert primary.prompts == [prompt]  # the failed attempt reached it
+    assert fleet.stats["redispatches"] >= 1
+
+
+def test_fleet_redispatch_exhaustion_and_fatal_errors():
+    engines = [_FakeEngine(fail_with=EngineStoppedError("down")),
+               _FakeEngine(fail_with=EngineStoppedError("down"))]
+    fleet = _fake_fleet(engines, max_dispatch_attempts=2)
+    with pytest.raises(EngineStoppedError):
+        fleet.submit(list(range(16))).result(timeout=10)
+    # a 400-class error is the request's fault — no re-dispatch
+    fatal = _FakeEngine(fail_with=ValueError("bad request"))
+    spare = _FakeEngine()
+    fleet = _fake_fleet([fatal, spare])
+    futures = [fleet.submit([i] * 16) for i in range(8)]
+    for future in futures:
+        try:
+            future.result(timeout=10)
+        except ValueError:
+            pass
+    assert fleet.stats["redispatches"] == 0
+
+
+def test_fleet_drain_and_no_replica():
+    engines = [_FakeEngine(), _FakeEngine()]
+    fleet = _fake_fleet(engines)
+    replicas = [r.id for r in fleet.replicas]
+    fleet.drain_replica(replicas[0])
+    for i in range(6):
+        _, stats = fleet.submit([i] * 16).result(timeout=10)
+        assert stats["replica"] == replicas[1]  # drained gets NO new work
+    fleet.drain_replica(replicas[1])
+    with pytest.raises(ReplicaUnavailableError):
+        fleet.submit([1] * 16).result(timeout=10)
+    assert fleet.stats["no_replica"] == 1
+
+
+def test_fleet_affinity_vs_random_spread():
+    engines = [_FakeEngine() for _ in range(4)]
+    fleet = _fake_fleet(engines)
+    shared = list(range(64))
+    for i in range(8):
+        fleet.submit(shared + [i] * 4).result(timeout=10)
+    # affinity: every shared-prefix request on ONE replica
+    assert sum(1 for e in engines if e.prompts) == 1
+    engines = [_FakeEngine() for _ in range(4)]
+    fleet = _fake_fleet(engines, routing="random", seed=7)
+    for i in range(16):
+        fleet.submit(shared + [i] * 4).result(timeout=10)
+    # random: the same workload spreads (>= 2 replicas see traffic)
+    assert sum(1 for e in engines if e.prompts) >= 2
+
+
+# -- real engines: handoff parity + decode-pool isolation --------------------
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    cfg = tiny_llama(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _paged_factory(cfg, params, **overrides):
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    defaults = dict(max_len=64, slots=2, prefill_buckets=(16,), page_size=8)
+    defaults.update(overrides)
+
+    def factory(role):
+        return PagedContinuousBatchingEngine(cfg, params, **defaults)
+
+    return factory
+
+
+def test_kv_handoff_greedy_token_identical(setup):
+    cfg, params = setup
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    single = PagedContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                           prefill_buckets=(16,),
+                                           page_size=8)
+    single.start()
+    prompt = [1, 7, 3, 9, 2, 4, 6, 8, 5, 3, 1, 2]
+    try:
+        ref, _ = single.generate(prompt, max_new_tokens=6)
+    finally:
+        single.stop()
+
+    fleet = EngineFleet(_paged_factory(cfg, params), replicas=1,
+                        prefill_replicas=1)
+    try:
+        cold, cold_stats = fleet.generate(prompt, max_new_tokens=6)
+        warm, warm_stats = fleet.generate(prompt, max_new_tokens=6)
+        stats = fleet.stats
+    finally:
+        fleet.stop()
+    # disaggregated decode (prefill replica -> KV handoff -> decode
+    # replica) is token-identical to the single-engine path, cold AND
+    # through a prefix-cache-hit prefill on the prefill replica
+    assert cold == ref
+    assert warm == ref
+    assert cold_stats["cached_prefix"] == 0
+    assert warm_stats["cached_prefix"] >= 8  # prefill-side prefix hit
+    assert warm_stats["prefill_replica"] != warm_stats["replica"]
+    assert warm_stats["handoff_bytes"] > 0
+    assert stats["handoffs"] == 2
+    assert stats["handoff_bytes"] > 0
+    per = stats["per_replica"]
+    decode = next(r for r in per.values() if r["role"] == "decode")
+    prefill = next(r for r in per.values() if r["role"] == "prefill")
+    assert decode["handoffs_in"] == 2 and prefill["handoffs_out"] == 2
+    # the decode replica NEVER ran a prefill dispatch
+    assert decode["prefill_chunks"] == 0
+
+
+def test_long_prefill_does_not_stall_decode_pool(setup):
+    cfg, params = setup
+    fleet = EngineFleet(
+        _paged_factory(cfg, params, max_len=256, page_size=16,
+                       prefill_buckets=(16, 256)),
+        replicas=1, prefill_replicas=1)
+    short = [5, 3, 8, 1, 9, 2, 4, 7]
+    long_prompt = [(i * 13 + 7) % 512 for i in range(230)]
+    try:
+        # decode pool busy ticking a long generation...
+        running = fleet.submit(short, max_new_tokens=48)
+        time.sleep(0.05)
+        # ...while an UNCHUNKED long prefill runs on the prefill pool
+        long_future = fleet.submit(long_prompt, max_new_tokens=4)
+        running.result(timeout=300)
+        _, long_stats = long_future.result(timeout=300)
+        per = fleet.stats["per_replica"]
+        decode = next(r for r in per.values() if r["role"] == "decode")
+    finally:
+        fleet.stop()
+    assert long_stats["prefill_s"] > 0
+    # the acceptance assertion: no prefill compute ever appears between
+    # two decode ticks on the decode pool — its tick p95 stays far below
+    # the long prefill's wall time (a single mixed engine running this
+    # prompt unchunked absorbs the whole prefill between two ticks)
+    assert decode["prefill_chunks"] == 0
+    assert decode["decode_tick_p95_s"] < long_stats["prefill_s"] * 0.5, (
+        decode["decode_tick_p95_s"], long_stats["prefill_s"])
+
+
+def test_scale_down_removes_replica_metric_series(setup):
+    cfg, params = setup
+    from mlrun_tpu.obs import LLM_EVENTS, LLM_QUEUE_DEPTH, REGISTRY
+
+    fleet = EngineFleet(_paged_factory(cfg, params), replicas=2)
+    prompt = list(range(1, 13))
+    try:
+        _, stats = fleet.generate(prompt, max_new_tokens=4)
+        REGISTRY.render()  # collectors materialize the labeled series
+        victim = stats["replica"]
+        assert any(victim in key for key in LLM_EVENTS._series)
+        fleet.remove_replica(victim)
+        rendered = REGISTRY.render()
+        # scale-down retired every series carrying the replica label
+        assert victim not in rendered
+        assert not any(victim in key for key in LLM_EVENTS._series)
+        assert not any(victim in key for key in LLM_QUEUE_DEPTH._series)
+        # the surviving replica still serves the same key (re-routed)
+        tokens, stats2 = fleet.generate(prompt, max_new_tokens=4)
+        assert stats2["replica"] != victim
+    finally:
+        fleet.stop()
+
+
+# -- graph topology: RouterStep + PrefixAffinityRouter -----------------------
+class _ReplicaModel(V2ModelServer):
+    """Jax-free stand-in for an LLM replica route."""
+
+    def load(self):
+        self.model = True
+        self.calls = 0
+
+    def predict(self, request):
+        if self.class_args.get("fail"):
+            raise EngineStoppedError("replica stopped")
+        self.calls += 1
+        return [f"{self.name}:{item[0]}" for item in request["inputs"]]
+
+
+def test_prefix_affinity_router_topology_and_redispatch():
+    fn = mlrun_tpu.new_function("fleet", kind="serving")
+    router_step = fn.set_topology("router",
+                                  class_name="PrefixAffinityRouter",
+                                  route_block_tokens=4, route_blocks=2,
+                                  backoff=0.0)
+    routes = router_step.add_replica_routes(
+        3, class_name=_ReplicaModel, model_path="")
+    assert [r.name for r in routes] == ["replica-0", "replica-1",
+                                        "replica-2"]
+    server = fn.to_mock_server()
+    shared = [1, 2, 3, 4, 5, 6, 7, 8]
+    out_a = server.test("/", body={"inputs": [shared + [9]]})
+    out_b = server.test("/", body={"inputs": [shared + [11]]})
+    # shared leading blocks -> the same replica route served both
+    assert out_a["outputs"][0] == out_b["outputs"][0]
+    served = out_a["outputs"][0].split(":")[0]
+    router = server.graph.steps["router"].object
+    replica = router.routes[served].object
+    # kill the serving replica: the router re-dispatches to a ring
+    # neighbor instead of failing the request
+    replica.class_args["fail"] = True
+    out_c = server.test("/", body={"inputs": [shared + [13]]})
+    assert out_c["outputs"][0].split(":")[0] != served
+    assert router.redispatches >= 1
+    # explicit path still addresses one replica directly (a healthy one;
+    # direct addressing deliberately bypasses the affinity/re-dispatch
+    # machinery, so a dead target is the caller's 503 to handle)
+    healthy = out_c["outputs"][0].split(":")[0]
+    direct = server.test(f"/v2/models/{healthy}/infer",
+                         body={"inputs": [[42]]})
+    assert direct["outputs"][0].startswith(f"{healthy}:")
+    # an UNKNOWN explicit address is an addressing error (base-router
+    # contract, a 400-class graph error) — never silently
+    # affinity-routed to some replica
+    with pytest.raises(RuntimeError, match="replica-9.*not found"):
+        server.test("/v2/models/replica-9/infer", body={"inputs": [[1]]})
+
+
+# -- bench smoke (tier-1: affinity must beat random every run) ---------------
+def test_bench_fleet_smoke():
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench_serve.py"
+    spec = importlib.util.spec_from_file_location("bench_serve", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_fleet(replicas=4, prefixes=6, requests_per_prefix=3,
+                        prefix_tokens=24, suffix_tokens=4, max_new=4,
+                        page_size=8, max_len=64, n_pages=14, slots=2,
+                        warmup=False)
+    affinity = out["policies"]["affinity"]
+    rand = out["policies"]["random"]
+    assert affinity["prefix_hit_rate"] > 0
+    assert affinity["p50_ttft_ms"] > 0 and rand["p50_ttft_ms"] > 0
+    assert affinity["unique_p50_ttft_ms"] > 0
+    # the acceptance shape at smoke scale: affinity >= 2x random hit rate
+    assert affinity["prefix_hit_rate"] >= 2 * rand["prefix_hit_rate"], out
