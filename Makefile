@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -34,13 +34,18 @@ bench-serve:     ## prefix-cache / chunked-prefill microbench, CPU-runnable (one
 bench-fleet:     ## engine-fleet routing A/B at replicas=4: affinity vs random, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --fleet
 
+bench-autoscale: ## closed-loop autoscaling A/B under a synthetic load ramp (docs/observability.md "Autoscaler"); rewrites BENCH_r08.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --autoscale > BENCH_r08.tmp \
+		&& tail -n 1 BENCH_r08.tmp > BENCH_r08.json \
+		&& rm BENCH_r08.tmp && cat BENCH_r08.json
+
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train
 
 bench-attn:      ## attention kernels vs reference (flash v1/v2 + paged decode), CPU interpret mode; rewrites BENCH_ATTN_CPU.json
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_attention_cpu.py
 
-obs-smoke:       ## boot a graph, scrape /metrics, assert a span artifact (docs/observability.md)
+obs-smoke:       ## graph + 2-replica fleet smoke: scrape /metrics, federate, SLO status, span artifact (docs/observability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dryrun on 8 virtual CPU devices

@@ -74,6 +74,12 @@ class FaultPoints:
     # per evicted page with page_id/refcount context; an action() here
     # observes eviction order, an error models a poisoned reclaim
     llm_prefix_evict = "llm.prefix_evict"
+    # one autoscaler evaluation (service/autoscaler.py tick) — fires
+    # with a mutable ``box`` carrying the computed decision; an
+    # action() may overwrite box["action"]/box["reason"] for
+    # deterministic scale-event injection, an error models a failed
+    # scale evaluation
+    obs_autoscale = "obs.autoscale"
     # training device-prefetch stage (training/data.py
     # DevicePrefetchIterator): fires on the background thread once per
     # host batch BEFORE the H2D transfer — a delay() stalls the input
@@ -92,7 +98,7 @@ class FaultPoints:
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
-            FaultPoints.train_prefetch,
+            FaultPoints.obs_autoscale, FaultPoints.train_prefetch,
         ]
 
 

@@ -198,6 +198,36 @@ default_config: dict[str, Any] = {
             # via common/retry.compute_backoff)
             "backoff": 0.05,
         },
+        # metrics-driven fleet autoscaling (docs/observability.md
+        # "Autoscaler"); FleetAutoscaler class args override these
+        "autoscale": {
+            "enabled": False,
+            # dry_run records mlt_autoscaler_recommendations_total and
+            # touches nothing — flip to act
+            "dry_run": True,
+            "min_replicas": 1,
+            "max_replicas": 4,
+            # consecutive ticks a condition must hold before a
+            # recommendation is made (hysteresis against signal noise)
+            "hysteresis_ticks": 2,
+            # seconds between applied actions, per direction (scale-down
+            # waits longer: adding capacity is cheap, thrash is not)
+            "cooldown_up_s": 5.0,
+            "cooldown_down_s": 30.0,
+            # a draining replica is force-removed after this many
+            # seconds even if in-flight work remains
+            "drain_grace_s": 30.0,
+            # scale-up triggers: mean queued+active work per replica,
+            # min free-KV-page fraction, p95 TTFT seconds (0 = take the
+            # latency SLO target), dispatch failure rate per tick window
+            "queue_high": 4.0,
+            "free_page_frac_low": 0.15,
+            "ttft_p95_high_s": 0.0,
+            "failure_rate_high": 0.05,
+            # scale-down trigger: mean per-replica load below this AND
+            # every scale-up signal clear
+            "queue_low": 1.0,
+        },
     },
     "observability": {
         # unified telemetry (docs/observability.md): the metrics registry
@@ -216,6 +246,34 @@ default_config: dict[str, Any] = {
         # names (utils/profiler.annotate) so XLA device traces join
         # request spans in TensorBoard
         "xla_annotations": True,
+        # metrics federation (obs/federation.py): per-replica scrape
+        # staleness bound and the merged-view cardinality budget
+        "federation": {
+            "stale_after_s": 60.0,
+            "max_series": 4096,
+        },
+        # aggregated time-series store (obs/timeseries.py): retention =
+        # resolution_s * capacity per series, bounded series count
+        "timeseries": {
+            "resolution_s": 5.0,
+            "capacity": 720,
+            "max_series": 2048,
+        },
+        # SLO burn-rate evaluation (obs/slo.py): multi-window thresholds
+        # (SRE-workbook fast+slow pattern) + declarative objectives
+        # ([{"name","kind","target",...}] — see docs/observability.md)
+        "slo": {
+            "enabled": True,
+            "evaluation_interval_s": 15.0,
+            "fast_window_s": 60.0,
+            "slow_window_s": 300.0,
+            "fast_burn": 14.4,
+            "slow_burn": 6.0,
+            # a sustained breach re-fires through the alert machinery at
+            # most this often (0 = every evaluation tick)
+            "refire_after_s": 300.0,
+            "objectives": [],
+        },
     },
     "model_monitoring": {
         "window_seconds": 60,

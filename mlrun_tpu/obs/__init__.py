@@ -20,6 +20,25 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     REGISTRY,
 )
+from .federation import (  # noqa: F401
+    MetricsAggregator,
+    PromParseError,
+    check_histogram_consistency,
+    parse_prometheus,
+)
+from .slo import (  # noqa: F401
+    SLO,
+    SLO_EVENT_KIND,
+    SLOEvaluator,
+    SLOStatus,
+)
+from .timeseries import (  # noqa: F401
+    TimeSeriesStore,
+    get_store,
+    grafana_query,
+    parse_target,
+    set_store,
+)
 from .tracing import (  # noqa: F401
     TRACE_HEADER,
     Span,
@@ -125,6 +144,20 @@ RUN_RETRIES = REGISTRY.counter(
 RUN_STALL_ABORTS = REGISTRY.counter(
     "mlt_run_stall_aborts_total",
     "Runs aborted by the heartbeat-stall watchdog")
+
+# -- autoscaler (service/autoscaler.py) --------------------------------------
+AUTOSCALER_RECOMMENDATIONS = REGISTRY.counter(
+    "mlt_autoscaler_recommendations_total",
+    "Scale recommendations the signal evaluation produced (recorded in "
+    "dry-run too — the act/observe seam)",
+    labels=("action", "reason"), overflow="drop")
+AUTOSCALER_ACTIONS = REGISTRY.counter(
+    "mlt_autoscaler_actions_total",
+    "Scale actions actually applied to the fleet (add / drain / remove)",
+    labels=("action",), overflow="drop")
+AUTOSCALER_DESIRED = REGISTRY.gauge(
+    "mlt_autoscaler_desired_replicas",
+    "Worker-replica count the autoscaler currently wants")
 
 # -- chaos / training --------------------------------------------------------
 CHAOS_FIRED = REGISTRY.counter(

@@ -1,0 +1,281 @@
+"""Declarative SLOs with fast+slow multi-window burn-rate evaluation
+(docs/observability.md "SLOs & burn rates").
+
+An objective declares what "good" means (p95 TTFT under a target,
+dispatch error rate under a budget, availability over a floor); the
+evaluator turns the federated time-series store into burn rates — how
+fast the error budget is being consumed relative to steady-state — over
+a FAST window (catches a sharp regression in seconds) and a SLOW window
+(confirms it isn't a blip). An alert fires only when BOTH windows burn
+over their thresholds (the SRE-workbook multi-window pattern: fast-only
+is noise, slow-only is a stale incident), and it fires through the
+existing ``service/alerts.process_event`` machinery — alert configs,
+silencing windows, and notification fan-out work unchanged.
+
+Burn-rate definitions (budget = allowed bad fraction):
+
+- ``latency``: objective "q-quantile of ``family`` ≤ ``target``
+  seconds". Budget is ``1 - q`` (a p95 objective tolerates 5% of
+  requests over target); the observed bad fraction is the windowed
+  fraction of histogram observations above ``target``.
+- ``error_rate``: objective "``bad`` events / ``total`` events ≤
+  ``target``". Budget is ``target`` itself.
+- ``availability``: objective "good / total ≥ ``target``" — an
+  error-rate objective with budget ``1 - target``.
+
+``burn = bad_fraction / budget``; burn 1.0 = exactly on budget.
+
+Stdlib-only at module level (``from_mlconf`` / ``process`` lazy-import
+config and the service alert machinery).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+from .metrics import REGISTRY
+
+SLO_BURN_RATE = REGISTRY.gauge(
+    "mlt_slo_burn_rate",
+    "Error-budget burn rate per objective and window (1.0 = consuming "
+    "budget exactly at the allowed steady-state rate)",
+    labels=("slo", "window"), overflow="drop")
+SLO_STATUS = REGISTRY.gauge(
+    "mlt_slo_status",
+    "Objective state: 0 ok, 1 fast-window burning (unconfirmed), "
+    "2 breach (fast AND slow windows over threshold)",
+    labels=("slo",), overflow="drop")
+SLO_BREACHES = REGISTRY.counter(
+    "mlt_slo_breaches_total",
+    "Multi-window burn-rate breaches emitted to the alert machinery",
+    labels=("slo",), overflow="drop")
+
+_KINDS = ("latency", "error_rate", "availability")
+
+# default event kind SLO breaches are emitted under — alert configs list
+# it in trigger_events (see service/alerts.ALERT_TEMPLATES["SLOBurnRate"])
+SLO_EVENT_KIND = "slo_burn_rate"
+
+
+class SLO:
+    """One declarative objective. ``family``/``bad``/``total`` name
+    metric families in the time-series store; ``labels`` narrows the
+    series the objective evaluates over (e.g. one engine)."""
+
+    def __init__(self, name: str, kind: str, target: float,
+                 family: str = "mlt_llm_ttft_seconds", q: float = 0.95,
+                 bad: str = "mlt_fleet_dispatches_total",
+                 bad_labels: Optional[dict] = None,
+                 total: str = "mlt_fleet_dispatches_total",
+                 total_labels: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 severity: str = "high"):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown SLO kind '{kind}' (one of {_KINDS})")
+        if kind == "latency":
+            if not 0 < q < 1:
+                raise ValueError(f"latency SLO needs 0 < q < 1, got {q}")
+            if target <= 0:
+                raise ValueError("latency SLO target must be > 0 seconds")
+        elif not 0 < target < 1:
+            raise ValueError(
+                f"{kind} SLO target must be a fraction in (0, 1)")
+        if kind != "latency" and bad == total \
+                and dict(bad_labels or {}) == dict(total_labels or {}):
+            # bad/total over the identical series is always 1.0 — a
+            # constant max-burn false breach, never a real objective
+            raise ValueError(
+                f"{kind} SLO needs bad_labels (or a distinct bad "
+                f"family) to tell bad events apart from the total")
+        self.name = name
+        self.kind = kind
+        self.target = float(target)
+        self.family = family
+        self.q = float(q)
+        self.bad = bad
+        self.bad_labels = dict(bad_labels or {})
+        self.total = total
+        self.total_labels = dict(total_labels or {})
+        self.labels = dict(labels or {})
+        self.severity = severity
+
+    @classmethod
+    def from_config(cls, config: dict) -> "SLO":
+        known = ("name", "kind", "target", "family", "q", "bad",
+                 "bad_labels", "total", "total_labels", "labels",
+                 "severity")
+        unknown = set(config) - set(known)
+        if unknown:
+            raise ValueError(
+                f"unknown SLO objective keys: {sorted(unknown)}")
+        return cls(**config)
+
+    @property
+    def budget(self) -> float:
+        """Allowed bad fraction."""
+        if self.kind == "latency":
+            return 1.0 - self.q
+        if self.kind == "availability":
+            return 1.0 - self.target
+        return self.target
+
+    def bad_fraction(self, store, window: float,
+                     at: float) -> Optional[float]:
+        """Observed bad fraction over ``window`` — None when the window
+        carries no signal (an empty window neither burns nor clears)."""
+        if self.kind == "latency":
+            return store.fraction_over(self.family, self.target, window,
+                                       at, labels=self.labels or None)
+        total = store.increase(self.total, window, at,
+                               labels=self.total_labels or None)
+        if total <= 0:
+            return None
+        bad = store.increase(self.bad, window, at,
+                             labels=self.bad_labels or None)
+        return max(0.0, min(1.0, bad / total))
+
+    def describe(self) -> dict:
+        out = {"name": self.name, "kind": self.kind, "target": self.target,
+               "budget": self.budget, "severity": self.severity}
+        if self.kind == "latency":
+            out.update(family=self.family, q=self.q)
+        else:
+            out.update(bad=self.bad, total=self.total)
+        return out
+
+
+class SLOStatus(dict):
+    """Evaluation result — a plain dict (JSON-friendly for the status
+    endpoints) with attribute sugar for the hot keys."""
+
+    @property
+    def breaching(self) -> bool:
+        return bool(self["breaching"])
+
+    @property
+    def burn_fast(self) -> Optional[float]:
+        return self["burn"]["fast"]
+
+    @property
+    def burn_slow(self) -> Optional[float]:
+        return self["burn"]["slow"]
+
+
+class SLOEvaluator:
+    """Evaluates objectives against a :class:`TimeSeriesStore` and
+    pushes confirmed breaches through the alert machinery."""
+
+    def __init__(self, store, slos: Iterable[SLO] = (),
+                 fast_window: float = 60.0, slow_window: float = 300.0,
+                 fast_burn: float = 14.4, slow_burn: float = 6.0,
+                 refire_after: float = 0.0, project: str = ""):
+        if fast_window <= 0 or slow_window <= fast_window:
+            raise ValueError("need 0 < fast_window < slow_window")
+        self.store = store
+        self.slos = list(slos)
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.refire_after = float(refire_after)
+        self.project = project
+        self._lock = threading.Lock()
+        self._last: list[SLOStatus] = []
+        self._fired_at: dict[str, float] = {}  # slo name -> last fire t
+
+    @classmethod
+    def from_mlconf(cls, store, slos: Iterable[SLO] = None,
+                    project: str = "") -> "SLOEvaluator":
+        from ..config import mlconf
+
+        conf = mlconf.observability.slo
+        if slos is None:
+            slos = [SLO.from_config(dict(obj))
+                    for obj in (conf.objectives or [])]
+        return cls(store, slos,
+                   fast_window=float(conf.fast_window_s),
+                   slow_window=float(conf.slow_window_s),
+                   fast_burn=float(conf.fast_burn),
+                   slow_burn=float(conf.slow_burn),
+                   refire_after=float(conf.refire_after_s),
+                   project=project)
+
+    def evaluate(self, at: float) -> list[SLOStatus]:
+        """Burn rates for every objective at ``at``. Breach = fast AND
+        slow windows over their thresholds; fast-only = "burning"
+        (unconfirmed, surfaced but not alerted)."""
+        out = []
+        for slo in self.slos:
+            burns = {}
+            for window_name, window, threshold in (
+                    ("fast", self.fast_window, self.fast_burn),
+                    ("slow", self.slow_window, self.slow_burn)):
+                frac = slo.bad_fraction(self.store, window, at)
+                burn = (frac / slo.budget) if frac is not None else None
+                burns[window_name] = burn
+                # an empty window exports 0, not the last value — a
+                # stale breach-level gauge after traffic stops would
+                # contradict mlt_slo_status forever
+                SLO_BURN_RATE.set(burn if burn is not None else 0.0,
+                                  slo=slo.name, window=window_name)
+            fast_over = (burns["fast"] is not None
+                         and burns["fast"] >= self.fast_burn)
+            slow_over = (burns["slow"] is not None
+                         and burns["slow"] >= self.slow_burn)
+            breaching = fast_over and slow_over
+            status = SLOStatus(slo.describe())
+            status.update(
+                burn=burns, burning=fast_over, breaching=breaching,
+                thresholds={"fast": self.fast_burn,
+                            "slow": self.slow_burn},
+                windows={"fast": self.fast_window,
+                         "slow": self.slow_window},
+                at=at)
+            SLO_STATUS.set(2 if breaching else 1 if fast_over else 0,
+                           slo=slo.name)
+            out.append(status)
+        with self._lock:
+            self._last = out
+        return out
+
+    def status(self) -> list[SLOStatus]:
+        """Last evaluation (the cheap read the smoke/status endpoints
+        use; empty before the first evaluate())."""
+        with self._lock:
+            return list(self._last)
+
+    def process(self, db, at: float, project: str = None) -> list:
+        """Evaluate and push each confirmed breach through
+        ``service/alerts.process_event`` — the event is also persisted
+        via ``db.emit_event`` first so count-over-period criteria see
+        it. Returns the names of alert configs that fired (an active
+        silence window keeps a breach out of this list — silencing is
+        ``process_event``'s job, not re-implemented here). A SUSTAINED
+        breach re-fires only every ``refire_after`` seconds (0 = every
+        call): the service loop evaluates every few seconds, and one
+        long incident must not page once per tick. Recovery resets the
+        damper, so a fresh incident fires immediately."""
+        from ..service.alerts import process_event
+
+        project = self.project if project is None else project
+        fired = []
+        for status in self.evaluate(at):
+            if not status.breaching:
+                self._fired_at.pop(status["name"], None)
+                continue
+            last = self._fired_at.get(status["name"])
+            if last is not None and self.refire_after > 0 \
+                    and at - last < self.refire_after:
+                continue
+            self._fired_at[status["name"]] = at
+            SLO_BREACHES.inc(slo=status["name"])
+            event = {"entity_id": status["name"],
+                     "slo": status["name"], "kind": status["kind"],
+                     "severity": status["severity"],
+                     "burn_fast": status.burn_fast,
+                     "burn_slow": status.burn_slow,
+                     "target": status["target"]}
+            db.emit_event(SLO_EVENT_KIND, event, project)
+            fired.extend(process_event(db, project, SLO_EVENT_KIND, event))
+        return fired

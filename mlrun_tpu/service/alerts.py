@@ -7,13 +7,30 @@ from datetime import datetime, timedelta, timezone
 
 from ..utils import logger, now_iso
 
+# (project, name) pairs already warned about a missing trigger_events
+# list — one warning per config, not one per processed event
+_warned_no_triggers: set = set()
+
 
 def process_event(db, project: str, event_kind: str, event: dict) -> list:
     """Evaluate alert configs against an incoming event; fire notifications
     when criteria (count within period) are met. Returns fired alert names."""
     fired = []
     for config in db.list_alert_configs(project):
-        if event_kind not in (config.get("trigger_events") or [event_kind]):
+        # explicit trigger matching: a missing/empty trigger_events list
+        # matches NOTHING (it used to silently match every event kind —
+        # a config created without triggers would fire on anything);
+        # catch-all is opt-in via the explicit "*" wildcard
+        triggers = config.get("trigger_events") or []
+        if not triggers:
+            if (project, config.get("name")) not in _warned_no_triggers:
+                _warned_no_triggers.add((project, config.get("name")))
+                logger.warning(
+                    "alert config has no trigger_events; it will never "
+                    "fire (use [\"*\"] for an explicit catch-all)",
+                    alert=config.get("name"), project=project)
+            continue
+        if "*" not in triggers and event_kind not in triggers:
             continue
         entity = config.get("entity_id", "*")
         if entity not in ("*", event.get("entity_id", "*")):
@@ -98,6 +115,15 @@ ALERT_TEMPLATES: dict[str, dict] = {
         "trigger_events": ["data_drift_suspected"],
         "severity": "medium",
         "criteria": {"count": 3, "period_seconds": 3600},
+        "reset_policy": "auto",
+    },
+    "SLOBurnRate": {
+        "description": "an SLO is burning error budget on both the fast "
+                       "and slow windows (obs/slo.py multi-window "
+                       "burn-rate evaluation)",
+        "trigger_events": ["slo_burn_rate"],
+        "severity": "high",
+        "criteria": {"count": 1, "period_seconds": 600},
         "reset_policy": "auto",
     },
     "SystemPerformance": {

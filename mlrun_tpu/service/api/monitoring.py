@@ -1,13 +1,34 @@
 """Model endpoints, metric time-series, and the grafana proxy
 (reference: crud/model_monitoring/; endpoints/grafana_proxy.py —
-simpleJSON datasource contract)."""
+simpleJSON datasource contract).
+
+Two grafana datasources live here: ``grafana-proxy/model-endpoints``
+(table-shaped, over the model-monitoring DB) and
+``grafana-proxy/metrics`` (timeserie-shaped, over the federated
+``obs.TimeSeriesStore`` — the fleet-wide series the SLO evaluator and
+autoscaler read; docs/observability.md "Federation")."""
 
 from __future__ import annotations
+
+from datetime import datetime, timezone
 
 from aiohttp import web
 
 from ...config import mlconf
 from ..http_utils import API, error_response, json_response
+
+
+def _parse_range_ts(value) -> float:
+    """Grafana sends ISO-8601 range bounds; accept epoch numbers too
+    (epoch milliseconds are detected and converted — a millis bound
+    read as seconds would put the range ~50k years out)."""
+    if isinstance(value, (int, float)):
+        value = float(value)
+        return value / 1000.0 if value > 1e11 else value
+    parsed = datetime.fromisoformat(str(value).replace("Z", "+00:00"))
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=timezone.utc)
+    return parsed.timestamp()
 
 
 def register(r: web.RouteTableDef, state):
@@ -101,3 +122,56 @@ def register(r: web.RouteTableDef, state):
                     endpoint.get("drift_status", "")])
         return json_response([{"type": "table", "columns": columns,
                                "rows": rows}])
+
+    # -- grafana proxy: federated metrics time series ------------------------
+    @r.get(API + "/grafana-proxy/metrics")
+    async def grafana_metrics_health(request):
+        return json_response({"status": "ok"})
+
+    @r.post(API + "/grafana-proxy/metrics/search")
+    async def grafana_metrics_search(request):
+        from ...obs.timeseries import get_store
+
+        body = await request.json() if request.can_read_body else {}
+        return json_response(
+            get_store().search(str(body.get("target") or "")))
+
+    @r.post(API + "/grafana-proxy/metrics/query")
+    async def grafana_metrics_query(request):
+        """simpleJSON ``timeserie`` query over the aggregated store.
+        Targets: ``name{label="v"}``, ``rate(name)[60]``,
+        ``p95(histogram_family)[60]`` (obs/timeseries.parse_target)."""
+        from ...obs.timeseries import get_store, grafana_query
+
+        body = await request.json()
+        try:
+            start = _parse_range_ts((body.get("range") or {})
+                                    .get("from", 0))
+            end = _parse_range_ts((body.get("range") or {}).get("to", 0))
+        except ValueError:
+            return error_response("bad time range", 400)
+        store = get_store()
+
+        def run_queries():
+            # per-bucket rate/quantile evaluation over a wide dashboard
+            # range is real CPU — keep it off the service event loop
+            out = []
+            for target in body.get("targets", []):
+                spec = (target.get("target") or "").strip()
+                if not spec:
+                    continue
+                try:
+                    out.append(grafana_query(store, spec, start, end))
+                except (ValueError, KeyError) as exc:
+                    raise web.HTTPBadRequest(
+                        reason=f"bad target {spec!r}: {exc}")
+            return out
+
+        import asyncio
+
+        try:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, run_queries)
+        except web.HTTPBadRequest as exc:
+            return error_response(exc.reason, 400)
+        return json_response(out)

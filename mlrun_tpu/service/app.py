@@ -158,6 +158,44 @@ async def _start_periodic(app: web.Application):
         asyncio.create_task(scheduler_loop()),
     ]
 
+    slo_conf = mlconf.observability.slo
+    if bool(mlconf.observability.metrics_enabled):
+        # scrape→store(→burn-rate) loop over the service's own registry
+        # (docs/observability.md "Federation" / "SLOs & burn rates").
+        # The store ingestion always runs — it backs the grafana
+        # /grafana-proxy/metrics datasource — while SLO evaluation only
+        # runs when objectives are declared; fleet processes run their
+        # own evaluator next to the autoscaler
+        async def obs_loop():
+            from ..obs import REGISTRY, MetricsAggregator, SLOEvaluator
+            from ..obs.timeseries import get_store
+
+            aggregator = MetricsAggregator.from_mlconf()
+            evaluator = None
+            if bool(slo_conf.enabled) and list(slo_conf.objectives or []):
+                evaluator = SLOEvaluator.from_mlconf(
+                    get_store(), project=mlconf.default_project)
+            state.slo_evaluator = evaluator
+
+            def evaluate():
+                now = time.time()
+                aggregator.ingest_text("service", REGISTRY.render(),
+                                       at=now)
+                aggregator.snapshot_to(get_store(), now)
+                if evaluator is not None:
+                    evaluator.process(state.db, now)
+
+            while True:
+                await asyncio.sleep(float(slo_conf.evaluation_interval_s))
+                try:
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, evaluate)
+                except Exception as exc:  # noqa: BLE001 - keep the loop
+                    logger.warning("obs ingest/slo evaluation failed",
+                                   error=str(exc))
+
+        app["_periodic"].append(asyncio.create_task(obs_loop()))
+
     if state.projects_follower.enabled:
         async def projects_sync_loop():
             while True:

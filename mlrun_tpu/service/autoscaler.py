@@ -1,0 +1,335 @@
+"""Metrics-driven fleet autoscaler (docs/observability.md "Autoscaler").
+
+Closes the ROADMAP's scrape→scale loop: a recommendation loop over the
+federated signals — per-replica load (queued + active work), KV-page
+headroom, fleet p95 TTFT, dispatch failures — driving
+``EngineFleet.add_replica`` / drain-and-remove. TPU serving economics
+hinge on keeping the pod-slice fleet sized to traffic (idle replicas
+burn accelerator-hours; an undersized fleet burns the latency SLO), so
+the loop is deliberately conservative:
+
+- **hysteresis** — a condition must hold for ``hysteresis_ticks``
+  consecutive ticks before it becomes a recommendation;
+- **cooldowns** — per-direction minimum spacing between applied
+  actions (scale-down cools longer than scale-up: adding capacity is
+  cheap, thrash is not);
+- **bounds** — ``min_replicas``/``max_replicas`` clamp the worker pool;
+- **drain-first scale-down** — the victim is drained (no new routing,
+  ring keys move to neighbors) and only removed once its in-flight work
+  hits zero or ``drain_grace_s`` expires; the engine then retires its
+  own ``replica``-labeled series, so scale-down leaks nothing;
+- **dry-run** — the default mode evaluates everything and records only
+  ``mlt_autoscaler_recommendations_total{action,reason}``; flip
+  ``dry_run=False`` to act.
+
+Every tick fires the ``obs.autoscale`` chaos point with a mutable
+``box``: a test's ``action()`` can overwrite ``box["action"]`` /
+``box["reason"]`` and set ``box["force"]=True`` to bypass hysteresis and
+cooldown — deterministic scale-event injection with no wall-clock
+sleeps. Time is an explicit ``now`` argument to :meth:`tick` for the
+same reason.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..chaos import FaultPoints, fire
+from ..config import mlconf
+from ..obs import (
+    AUTOSCALER_ACTIONS,
+    AUTOSCALER_DESIRED,
+    AUTOSCALER_RECOMMENDATIONS,
+)
+from ..utils import logger
+
+_WORKER_ROLES = ("unified", "decode")
+
+
+class FleetAutoscaler:
+    """One autoscaler per :class:`~mlrun_tpu.serving.fleet.EngineFleet`.
+
+    ``store`` (an ``obs.TimeSeriesStore``) upgrades the p95-TTFT signal
+    from the fleet's in-process sample ring to the federated windowed
+    quantile; ``aggregator`` (an ``obs.MetricsAggregator``) upgrades
+    queue depth / page headroom to the merged multi-source view. Both
+    are optional — without them the loop runs off ``fleet.stats`` alone,
+    so a single-process fleet needs no federation plumbing.
+    """
+
+    def __init__(self, fleet, store=None, aggregator=None,
+                 slo=None, ttft_window: float = 60.0, **overrides):
+        conf = mlconf.serving.autoscale
+        def knob(name, cast=float):
+            if name in overrides:
+                return cast(overrides.pop(name))
+            return cast(getattr(conf, name))
+
+        self.fleet = fleet
+        self.store = store
+        self.aggregator = aggregator
+        self.dry_run = knob("dry_run", bool)
+        self.min_replicas = knob("min_replicas", int)
+        self.max_replicas = knob("max_replicas", int)
+        self.hysteresis_ticks = knob("hysteresis_ticks", int)
+        self.cooldown_up_s = knob("cooldown_up_s")
+        self.cooldown_down_s = knob("cooldown_down_s")
+        self.drain_grace_s = knob("drain_grace_s")
+        self.queue_high = knob("queue_high")
+        self.queue_low = knob("queue_low")
+        self.free_page_frac_low = knob("free_page_frac_low")
+        self.failure_rate_high = knob("failure_rate_high")
+        ttft_high = knob("ttft_p95_high_s")
+        if ttft_high <= 0 and slo is not None:
+            ttft_high = float(slo.target)
+        self.ttft_p95_high_s = ttft_high  # <= 0 disables the signal
+        self.ttft_window = float(ttft_window)
+        if overrides:
+            raise ValueError(
+                f"unknown autoscaler knobs: {sorted(overrides)}")
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        self._lock = threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_at: Optional[float] = None
+        self._draining: dict[str, float] = {}   # replica id -> drain t0
+        self._last_dispatch_counts: Optional[dict] = None
+
+    # -- signal plane --------------------------------------------------------
+    def _workers(self):
+        return [r for r in self.fleet.replicas
+                if r.role in _WORKER_ROLES and not r.draining]
+
+    def _worker_role(self) -> str:
+        return "decode" if any(r.role == "prefill"
+                               for r in self.fleet.replicas) else "unified"
+
+    def signals(self, now: float, advance: bool = False) -> dict:
+        """The aggregated decision inputs at ``now`` (also the chaos
+        context, so an injected action can assert what the loop saw).
+        The dispatch-failure rate is a delta since the last baseline;
+        only :meth:`tick` passes ``advance=True`` to move it — an
+        out-of-band status read must not zero the next tick's window."""
+        stats = self.fleet.stats
+        # fleet.stats already walked every replica's load() (and eats a
+        # dying replica's errors) — read it, don't walk the engines a
+        # second time per tick
+        worker_stats = [per for per in
+                        (stats.get("per_replica") or {}).values()
+                        if per.get("role") in _WORKER_ROLES
+                        and not per.get("draining")]
+        count = len(worker_stats)
+        loads = [per.get("load") or 0 for per in worker_stats]
+        if self.aggregator is not None:
+            # merged multi-source view, minus the local replicas that
+            # are NOT scale targets (prefill pool, draining victims) —
+            # their gauges must not inflate the per-worker load or pin
+            # an exhausted page pool into the min (the fallback branch
+            # below filters by role/draining the same way; remote
+            # replicas' series pass through untouched)
+            excluded = {r.id for r in self.fleet.replicas
+                        if r.draining or r.role not in _WORKER_ROLES}
+            queue_total = 0.0
+            contributing = set()
+            for labels, value in self.aggregator.family(
+                    "mlt_llm_queue_depth", now).items():
+                rid = dict(labels).get("replica")
+                if rid in excluded:
+                    continue
+                queue_total += value
+                contributing.add(rid)
+            fracs = [value for labels, value in self.aggregator.family(
+                "mlt_llm_free_page_frac", now).items()
+                if dict(labels).get("replica") not in excluded]
+            free_frac = min(fracs) if fracs else None
+            load_total = max(float(sum(loads)), queue_total)
+            # the federated queue total may include REMOTE replicas'
+            # series — per-replica load divides by every replica that
+            # contributed, not just the local workers, or remote load
+            # reads as local overload
+            serving = max(count, len(contributing))
+        else:
+            load_total = float(sum(loads))
+            serving = count
+            fracs = [per["free_page_frac"]
+                     for per in stats.get("per_replica", {}).values()
+                     if per.get("free_page_frac") is not None
+                     and per.get("role") in _WORKER_ROLES
+                     and not per.get("draining")]
+            free_frac = min(fracs) if fracs else None
+        ttft_p95 = None
+        if self.store is not None:
+            ttft_p95 = self.store.quantile(
+                "mlt_llm_ttft_seconds", 0.95, self.ttft_window, now)
+        if ttft_p95 is None:
+            ttft_p95 = stats.get("ttft_p95_s")
+        counts = {key: stats.get(key, 0)
+                  for key in ("dispatches", "redispatches", "failed",
+                              "no_replica")}
+        last = self._last_dispatch_counts or counts
+        if advance:
+            self._last_dispatch_counts = counts
+        bad = max(0, (counts["failed"] - last["failed"])
+                  + (counts["no_replica"] - last["no_replica"]))
+        total = max(0, sum(counts.values()) - sum(last.values()))
+        return {
+            "replicas": count,
+            "draining": len(self._draining),
+            "load_total": load_total,
+            "load_per_replica": load_total / serving if serving else 0.0,
+            "free_page_frac_min": free_frac,
+            "ttft_p95_s": ttft_p95,
+            "dispatch_failure_rate": bad / total if total else 0.0,
+        }
+
+    # -- decision loop -------------------------------------------------------
+    def _evaluate(self, sig: dict) -> tuple[str, str]:
+        """Raw (action, reason) from thresholds — before hysteresis,
+        cooldown, and bounds."""
+        reasons = []
+        if sig["load_per_replica"] > self.queue_high:
+            reasons.append("queue_depth")
+        frac = sig["free_page_frac_min"]
+        if frac is not None and frac < self.free_page_frac_low:
+            reasons.append("kv_pressure")
+        ttft = sig["ttft_p95_s"]
+        if self.ttft_p95_high_s > 0 and ttft is not None \
+                and ttft > self.ttft_p95_high_s:
+            reasons.append("ttft_slo")
+        if sig["dispatch_failure_rate"] > self.failure_rate_high:
+            reasons.append("dispatch_failures")
+        if reasons:
+            return "up", "+".join(reasons)
+        # scale-down keys on live load only: the p95 signal is
+        # backward-looking (windowed or ring history), and an empty
+        # queue means nothing is currently suffering — hysteresis plus
+        # the down-cooldown damp any flap
+        if sig["load_per_replica"] < self.queue_low \
+                and not sig["draining"]:
+            return "down", "idle"
+        return "hold", ""
+
+    def _cooled(self, action: str, now: float) -> bool:
+        if self._last_action_at is None:
+            return True
+        cooldown = (self.cooldown_up_s if action == "up"
+                    else self.cooldown_down_s)
+        return now - self._last_action_at >= cooldown
+
+    def tick(self, now: float) -> dict:
+        """One evaluation: gather signals, decide, (maybe) act, and
+        advance draining replicas toward removal. Deterministic — no
+        internal clock reads, no sleeps."""
+        with self._lock:
+            sig = self.signals(now, advance=True)
+            action, reason = self._evaluate(sig)
+            box = {"action": action, "reason": reason, "force": False}
+            fire(FaultPoints.obs_autoscale, box=box, signals=sig, now=now)
+            action, reason = box["action"], box["reason"]
+            forced = bool(box["force"])
+
+            if action == "up":
+                self._up_streak += 1
+                self._down_streak = 0
+            elif action == "down":
+                self._down_streak += 1
+                self._up_streak = 0
+            else:
+                self._up_streak = self._down_streak = 0
+
+            current = sig["replicas"]
+            streak = (self._up_streak if action == "up"
+                      else self._down_streak)
+            recommended = action != "hold" and (
+                forced or streak >= self.hysteresis_ticks)
+            bounded = recommended and (
+                (action == "up" and current < self.max_replicas)
+                or (action == "down" and current > self.min_replicas))
+            desired = current
+            if bounded:
+                desired = current + (1 if action == "up" else -1)
+            if recommended:
+                AUTOSCALER_RECOMMENDATIONS.inc(
+                    action=action if bounded
+                    else f"{action}_at_bound", reason=reason)
+            AUTOSCALER_DESIRED.set(desired)
+
+            acted = None
+            if bounded and not self.dry_run and (
+                    forced or self._cooled(action, now)):
+                acted = self._act(action, now)
+            removed = self._sweep_draining(now)
+        return {"action": action, "reason": reason, "recommended":
+                recommended, "desired": desired, "current": current,
+                "acted": acted, "removed": removed, "forced": forced,
+                "signals": sig, "dry_run": self.dry_run}
+
+    def _act(self, action: str, now: float) -> Optional[dict]:
+        if action == "up":
+            rid = self.fleet.add_replica(self._worker_role())
+            AUTOSCALER_ACTIONS.inc(action="add")
+            self._last_action_at = now
+            self._up_streak = 0
+            logger.info("autoscaler added replica", replica=rid)
+            return {"action": "add", "replica": rid}
+        victim = self._scale_down_victim()
+        if victim is None:
+            return None
+        self.fleet.drain_replica(victim.id)
+        self._draining[victim.id] = now
+        AUTOSCALER_ACTIONS.inc(action="drain")
+        self._last_action_at = now
+        self._down_streak = 0
+        logger.info("autoscaler draining replica", replica=victim.id)
+        return {"action": "drain", "replica": victim.id}
+
+    def _scale_down_victim(self):
+        """Least-loaded non-draining worker — the cheapest replica to
+        take out of rotation (its keyspace moves to ring neighbors; its
+        few in-flight requests finish during the drain)."""
+        workers = self._workers()
+        if len(workers) <= self.min_replicas:
+            return None
+
+        def load_of(replica):
+            try:
+                return replica.load()
+            except Exception:  # noqa: BLE001
+                return 0
+
+        return min(workers, key=lambda r: (load_of(r), r.id))
+
+    def _sweep_draining(self, now: float) -> list[str]:
+        """Remove drained replicas whose in-flight work hit zero (or
+        whose grace expired). The engine stop retires its own
+        ``replica``-labeled series — asserted in tests; see
+        serving/fleet.py remove_replica."""
+        removed = []
+        for rid, since in list(self._draining.items()):
+            replica = next((r for r in self.fleet.replicas
+                            if r.id == rid), None)
+            if replica is None:
+                self._draining.pop(rid)
+                continue
+            try:
+                busy = replica.load() > 0
+            except Exception:  # noqa: BLE001
+                busy = False
+            if busy and now - since < self.drain_grace_s:
+                continue
+            self.fleet.remove_replica(rid)
+            if self.store is not None:
+                # the engine retires its registry series on stop; the
+                # windowed store keeps its own rings, so retire the
+                # removed replica's series here too — a churning fleet
+                # must not fill the store's series budget with dead ids
+                self.store.drop_series(labels={"replica": rid})
+            AUTOSCALER_ACTIONS.inc(action="remove")
+            self._draining.pop(rid)
+            removed.append(rid)
+            logger.info("autoscaler removed drained replica", replica=rid)
+        return removed

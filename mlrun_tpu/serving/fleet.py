@@ -657,12 +657,22 @@ class EngineFleet:
             queries += stats.get("prefix_queries", 0)
             completed += stats.get("completed", 0)
             depth += stats.get("queue_depth", 0)
+            # page headroom + live load feed the autoscaler's signals
+            # (service/autoscaler.py) and the federation stats ingest
+            # (obs/federation.py ingest_stats)
+            frac_fn = getattr(replica.engine, "_free_page_frac", None)
+            try:
+                load = replica.load()
+            except Exception:  # noqa: BLE001 - a stopping replica's
+                load = 0       # queue may already be torn down
             per[replica.id] = {
                 "role": replica.role,
                 "draining": replica.draining,
                 "requests": stats.get("requests", 0),
                 "completed": stats.get("completed", 0),
                 "queue_depth": stats.get("queue_depth", 0),
+                "free_page_frac": frac_fn() if frac_fn else None,
+                "load": load,
                 "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
                 "handoffs_out": stats.get("handoffs_out", 0),
                 "handoffs_in": stats.get("handoffs_in", 0),

@@ -1,11 +1,18 @@
 """Observability smoke check (``make obs-smoke``): boot a small serving
 graph on the ASGI gateway, drive one traced request through it, scrape
-``GET /metrics``, and assert a non-empty span JSONL artifact.
+``GET /metrics``, and assert a non-empty span JSONL artifact. Then the
+control-plane leg: boot a 2-replica engine fleet (tiny model, CPU),
+drive requests through it, scrape both replicas' series over HTTP,
+federate the scrapes through ``obs.MetricsAggregator`` into a
+``TimeSeriesStore``, read an SLO status off the windowed view, and
+assert the federation cardinality budget holds (re-scraping must not
+multiply series).
 
-Pure host-side — no jax compute — so it runs in seconds on any machine.
 Exits non-zero (with a reason) on the first broken contract: metrics
 exposition missing core families, the trace id not honored end to end,
-or the span artifact empty.
+the span artifact empty, a replica's series missing from the merged
+view, the SLO evaluation carrying no signal, or the series count
+growing across identical scrapes.
 """
 
 from __future__ import annotations
@@ -27,6 +34,105 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def _fail(reason: str):
     print(f"obs-smoke FAILED: {reason}")
     sys.exit(1)
+
+
+def _fleet_leg(base: str):
+    """Control-plane smoke: 2-replica fleet → HTTP scrape → federation
+    → windowed store → SLO status, with the cardinality budget held.
+    Timestamps fed to the aggregator/store are logical (the scrape
+    sequence), so the windowed reads are deterministic — no sleeps."""
+    import jax
+    import requests
+
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs import (
+        SLO,
+        MetricsAggregator,
+        SLOEvaluator,
+        TimeSeriesStore,
+        check_histogram_consistency,
+    )
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    def factory(role):
+        return PagedContinuousBatchingEngine(
+            config, params, max_len=64, slots=2, page_size=16,
+            prefill_buckets=(64,))
+
+    def scrape():
+        resp = requests.get(base + "/metrics", timeout=10)
+        if resp.status_code != 200:
+            _fail(f"/metrics returned {resp.status_code} on fleet leg")
+        return resp.text
+
+    def drive(fleet, n):
+        futures = [fleet.submit([7, 11, 13, 17], max_new_tokens=2)
+                   for _ in range(n)]
+        for future in futures:
+            future.result(timeout=120)
+
+    aggregator = MetricsAggregator.from_mlconf()
+    store = TimeSeriesStore(resolution_s=1.0)
+    fleet = EngineFleet(factory, replicas=2)
+    fleet.start()
+    try:
+        replica_ids = {r.id for r in fleet.replicas}
+        if len(replica_ids) != 2:
+            _fail(f"fleet did not boot 2 replicas: {replica_ids}")
+        drive(fleet, 4)
+        text1 = scrape()
+        aggregator.ingest_text("gateway", text1, at=100.0)
+        aggregator.snapshot_to(store, 100.0)
+        drive(fleet, 4)
+        aggregator.ingest_text("gateway", scrape(), at=110.0)
+        aggregator.snapshot_to(store, 110.0)
+
+        # both replicas' series made it through the scrape→merge path
+        seen = aggregator.label_values("mlt_llm_events_total", "replica",
+                                       110.0)
+        if not replica_ids <= seen:
+            _fail(f"replica series missing from the merged view: "
+                  f"wanted {sorted(replica_ids)}, saw {sorted(seen)}")
+        samples, _ = aggregator.merged(110.0)
+        check_histogram_consistency(samples, "mlt_llm_ttft_seconds")
+
+        # SLO status read off the windowed store (generous target — the
+        # smoke asserts signal flow, not latency)
+        evaluator = SLOEvaluator(
+            store, [SLO("smoke-ttft", "latency", target=30.0, q=0.95)],
+            fast_window=10, slow_window=20)
+        status = evaluator.evaluate(110.0)[0]
+        if status.burn_fast is None:
+            _fail("SLO evaluation saw no TTFT signal in the fast window")
+        if status.breaching:
+            _fail(f"smoke SLO breached (target 30s?!): {dict(status)}")
+        if evaluator.status()[0] != status:
+            _fail("SLOEvaluator.status() does not return the last eval")
+
+        # cardinality budget: within bounds, and an identical re-scrape
+        # must not multiply series
+        count = aggregator.series_count(110.0)
+        budget = int(mlconf.observability.federation.max_series)
+        if not 0 < count <= budget:
+            _fail(f"merged series count {count} outside budget {budget}")
+        if aggregator.dropped_series:
+            _fail(f"federation dropped {aggregator.dropped_series} "
+                  f"series inside the budget")
+        aggregator.ingest_text("gateway", text1, at=120.0)
+        if aggregator.series_count(120.0) > count:
+            _fail("re-ingesting one source grew the merged series count")
+        return {
+            "fleet_replicas": sorted(replica_ids),
+            "merged_series": count,
+            "slo_burn_fast": status.burn_fast,
+        }
+    finally:
+        fleet.stop()
 
 
 def main() -> int:
@@ -106,6 +212,8 @@ def main() -> int:
                 _fail(f"/metrics missing family {family}")
         if "mlt_request_latency_seconds_count 1" not in body:
             _fail("request latency histogram did not count the request")
+
+        fleet_summary = _fleet_leg(base)
     finally:
         box["stop"] = True
         thread.join(timeout=5)
@@ -131,6 +239,7 @@ def main() -> int:
         "ok": True, "spans": len(spans),
         "traced_span_names": sorted(names),
         "span_artifact": spans_path,
+        **fleet_summary,
     }))
     return 0
 

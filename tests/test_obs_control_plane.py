@@ -1,0 +1,849 @@
+"""Fleet observability control plane (obs/federation.py, obs/timeseries.py,
+obs/slo.py, service/autoscaler.py): scrape→aggregate→window→burn-rate→scale.
+
+Everything is deterministic — explicit timestamps everywhere, fake
+clocks for the autoscaler, the ``obs.autoscale`` chaos point for forced
+scale events, and jax-free fake engines behind the REAL ``EngineFleet``
+for the dispatch topology. The one real-engine test is the autoscale
+bench smoke at the bottom (tiny model, CPU).
+"""
+
+import pytest
+
+from mlrun_tpu.chaos import always, chaos
+from mlrun_tpu.obs import (
+    CHAOS_FIRED,
+    SLO,
+    MetricsAggregator,
+    PromParseError,
+    SLOEvaluator,
+    TimeSeriesStore,
+    check_histogram_consistency,
+)
+from mlrun_tpu.obs.metrics import MetricsRegistry
+from mlrun_tpu.obs.timeseries import grafana_query, parse_target
+from mlrun_tpu.serving.fleet import EngineFleet
+from mlrun_tpu.service.autoscaler import FleetAutoscaler
+
+
+# -- federation ---------------------------------------------------------------
+def _replica_registry(rid: str, queue: float, requests: float = 5.0):
+    reg = MetricsRegistry()
+    reg.counter("mlt_llm_events_total", "events",
+                labels=("engine", "replica", "event")).inc(
+        requests, engine="e", replica=rid, event="requests")
+    hist = reg.histogram("mlt_llm_ttft_seconds", "ttft",
+                         labels=("replica",), buckets=(0.01, 0.1, 1.0))
+    hist.observe(0.05, replica=rid)
+    hist.observe(0.5, replica=rid)
+    reg.gauge("mlt_llm_queue_depth", "queue",
+              labels=("engine", "replica")).set(
+        queue, engine="e", replica=rid)
+    reg.gauge("mlt_server_inflight", "inflight").set(queue)
+    return reg
+
+
+def test_federation_merge_semantics_preserve_replica_label():
+    agg = MetricsAggregator(stale_after=60)
+    agg.ingest_text("rep0", _replica_registry("r0", 3).render(), at=100.0)
+    agg.ingest_text("rep1", _replica_registry("r1", 7).render(), at=105.0)
+    samples, types = agg.merged(106.0)
+    # per-replica series stay distinct (the PR 7 label is the identity)
+    assert agg.label_values("mlt_llm_queue_depth", "replica", 106.0) == \
+        {"r0", "r1"}
+    assert agg.sum_family("mlt_llm_queue_depth", 106.0) == 10.0
+    # histograms merged across sources stay valid histograms
+    check_histogram_consistency(samples, "mlt_llm_ttft_seconds")
+    # identical label-set gauge from two sources: last-write-wins by
+    # source timestamp (rep1 scraped later)
+    assert agg.value("mlt_server_inflight", 106.0) == 7.0
+    # ... unless the family is configured to sum
+    agg_sum = MetricsAggregator(
+        gauge_merge={"mlt_server_inflight": "sum"})
+    agg_sum.ingest_text("rep0", _replica_registry("r0", 3).render(),
+                        at=100.0)
+    agg_sum.ingest_text("rep1", _replica_registry("r1", 7).render(),
+                        at=105.0)
+    assert agg_sum.value("mlt_server_inflight", 106.0) == 10.0
+
+
+def test_federation_counters_sum_across_sources():
+    # the same series scraped from two processes adds up — and
+    # re-ingesting ONE source replaces its samples instead of
+    # double-counting (scrape idempotence)
+    agg = MetricsAggregator()
+    text = _replica_registry("r0", 1).render()
+    agg.ingest_text("a", text, at=1.0)
+    agg.ingest_text("b", text, at=2.0)
+    key = dict(engine="e", replica="r0", event="requests")
+    assert agg.value("mlt_llm_events_total", 3.0, **key) == 10.0
+    before = agg.series_count(3.0)
+    agg.ingest_text("b", text, at=3.0)
+    assert agg.value("mlt_llm_events_total", 4.0, **key) == 10.0
+    assert agg.series_count(4.0) == before
+
+
+def test_federation_staleness_bound_and_forget():
+    agg = MetricsAggregator(stale_after=10)
+    agg.ingest_text("rep0", _replica_registry("r0", 3).render(), at=100.0)
+    agg.ingest_text("rep1", _replica_registry("r1", 7).render(), at=105.0)
+    # rep0 ages out at 110; a dead replica must not pin its last gauge
+    assert agg.label_values("mlt_llm_queue_depth", "replica", 112.0) == \
+        {"r1"}
+    sources = agg.sources(112.0)
+    assert sources["rep0"]["fresh"] is False
+    assert sources["rep1"]["fresh"] is True
+    agg.forget("rep1")
+    assert agg.series_count(112.0) == 0
+    # a dead source stops consuming the cardinality budget: the next
+    # ingest evicts anything already past the staleness bound
+    agg2 = MetricsAggregator(stale_after=10, max_series=12)
+    agg2.ingest_text("dead", _replica_registry("r0", 1).render(), at=0.0)
+    agg2.ingest_text("live", _replica_registry("r1", 1).render(),
+                     at=100.0)
+    assert "dead" not in agg2.sources(100.0)
+    assert agg2.dropped_series == 0
+
+
+def test_federation_cardinality_budget_is_deterministic():
+    reg = MetricsRegistry()
+    counter = reg.counter("mlt_x_total", "x", labels=("k",))
+    for i in range(30):
+        counter.inc(1, k=f"v{i:02d}")
+    agg = MetricsAggregator(max_series=10)
+    agg.ingest_text("big", reg.render(), at=1.0)
+    assert agg.dropped_series == 20
+    assert agg.series_count(2.0) == 10
+    kept = sorted(dict(labels)["k"]
+                  for labels in agg.family("mlt_x_total", 2.0))
+    # re-ingesting drops the SAME tail — series cannot multiply or churn
+    agg.ingest_text("big", reg.render(), at=3.0)
+    assert agg.series_count(4.0) == 10
+    assert sorted(dict(labels)["k"]
+                  for labels in agg.family("mlt_x_total", 4.0)) == kept
+
+
+def test_federation_rejects_malformed_scrape():
+    agg = MetricsAggregator()
+    with pytest.raises(PromParseError):
+        agg.ingest_text("bad", "# TYPE x counter\nx 1", at=1.0)
+
+
+def test_federation_ingest_stats_maps_fleet_feed():
+    agg = MetricsAggregator()
+    agg.ingest_stats("fleet", {
+        "dispatches": 90, "redispatches": 3, "failed": 2, "no_replica": 1,
+        "ttft_p50_s": 0.01, "ttft_p95_s": 0.2,
+        "per_replica": {
+            "f1-u0": {"queue_depth": 4, "free_page_frac": 0.5,
+                      "requests": 50, "completed": 48},
+            "f1-u1": {"queue_depth": 2, "free_page_frac": 0.25,
+                      "requests": 40, "completed": 40},
+        },
+    }, at=10.0)
+    assert agg.sum_family("mlt_llm_queue_depth", 11.0) == 6.0
+    assert agg.min_family("mlt_llm_free_page_frac", 11.0) == 0.25
+    assert agg.value("mlt_fleet_dispatches_total", 11.0,
+                     replica="", outcome="failed") == 2.0
+    assert agg.value("mlt_fleet_ttft_seconds", 11.0,
+                     quantile="0.95") == 0.2
+    assert agg.value("mlt_llm_events_total", 11.0, engine="fleet",
+                     replica="f1-u1", event="completed") == 40.0
+
+
+def test_snapshot_to_survives_source_loss_without_phantom_increase():
+    """Counters snapshot into the store PER SOURCE: when a source
+    vanishes, its rings just go quiet. A summed series would drop and
+    read as a counter reset, inflating windowed increase() by the
+    survivors' full cumulative totals (a false SLO breach)."""
+    agg = MetricsAggregator(stale_after=60)
+    store = TimeSeriesStore(resolution_s=1.0)
+    agg.ingest_text("a", _replica_registry("r0", 1, requests=100).render(),
+                    at=0.0)
+    agg.ingest_text("b", _replica_registry("r0", 1, requests=50).render(),
+                    at=0.0)
+    agg.snapshot_to(store, 0.0)
+    agg.ingest_text("a", _replica_registry("r0", 1, requests=110).render(),
+                    at=10.0)
+    agg.ingest_text("b", _replica_registry("r0", 1, requests=60).render(),
+                    at=10.0)
+    agg.snapshot_to(store, 10.0)
+    agg.forget("b")  # replica removed; its scrape target is gone
+    agg.ingest_text("a", _replica_registry("r0", 1, requests=120).render(),
+                    at=20.0)
+    agg.snapshot_to(store, 20.0)
+    # a advanced +20, b advanced +10 then vanished: the true fleet
+    # increase is 30 — not 140 (20 + a 120-sized phantom "reset")
+    assert store.increase("mlt_llm_events_total", 25.0, 20.0) == \
+        pytest.approx(30.0)
+
+
+# -- time series --------------------------------------------------------------
+def test_store_ring_bounds_and_counter_reset():
+    store = TimeSeriesStore(resolution_s=1.0, capacity=5)
+    for t in range(10):
+        store.record("c_total", float(t * 2), at=t, kind="counter")
+    # retention = 5 buckets: t<5 evicted
+    pts = store.points("c_total", 0, 9)
+    assert [t for t, _ in pts] == [5.0, 6.0, 7.0, 8.0, 9.0]
+    assert store.rate("c_total", 4.0, 9.0) == 2.0
+    # counter reset: the post-reset value counts, never a negative delta
+    store.record("c_total", 1.0, at=10, kind="counter")
+    assert store.increase("c_total", 2.0, 10.0) == 1.0 + 2.0
+    # per-series memory is O(capacity): a sparse write far ahead clears
+    # the lapped slots
+    store.record("c_total", 100.0, at=1000, kind="counter")
+    assert store.points("c_total", 0, 1000) == [(1000.0, 100.0)]
+
+
+def test_store_max_series_bound():
+    store = TimeSeriesStore(resolution_s=1.0, capacity=4, max_series=3)
+    for i in range(5):
+        store.record("g", float(i), at=1.0, labels={"k": str(i)})
+    assert len(store.series()) == 3
+    assert store.dropped_series == 2
+
+
+def test_store_drop_series_across_families():
+    store = TimeSeriesStore(resolution_s=1.0)
+    store.record("a", 1.0, at=0, labels={"replica": "x"})
+    store.record("b_total", 2.0, at=0, labels={"replica": "x"},
+                 kind="counter")
+    store.record("a", 3.0, at=0, labels={"replica": "y"})
+    store.drop_series(labels={"replica": "x"})  # name=None: all families
+    assert store.search('replica="x"') == []
+    assert len(store.series()) == 1
+
+
+def _feed_histogram(store, spans):
+    """spans: [(t0, t1, per_tick_under, per_tick_over)] — cumulative
+    bucket counters for mlt_llm_ttft_seconds with bounds 0.05/0.25;
+    'over' observations land past 0.25 (in +Inf)."""
+    cum_005 = cum_025 = cum_inf = 0.0
+    for t0, t1, under, over in spans:
+        for t in range(t0, t1):
+            cum_005 += under
+            cum_025 += under
+            cum_inf += under + over
+            for le, value in (("0.05", cum_005), ("0.25", cum_025),
+                              ("+Inf", cum_inf)):
+                store.record("mlt_llm_ttft_seconds_bucket", value, at=t,
+                             labels={"le": le}, kind="counter")
+            store.record("mlt_llm_ttft_seconds_count", cum_inf, at=t,
+                         kind="counter")
+
+
+def test_store_windowed_quantile_and_fraction():
+    store = TimeSeriesStore(resolution_s=1.0)
+    # 0..49: all fast; 50..99: half the traffic lands over 0.25
+    _feed_histogram(store, [(0, 50, 10, 0), (50, 100, 10, 10)])
+    assert store.quantile("mlt_llm_ttft_seconds", 0.95, 30, 40) <= 0.05
+    late_p95 = store.quantile("mlt_llm_ttft_seconds", 0.95, 30, 99)
+    assert late_p95 == 0.25  # +Inf bucket answers the highest bound
+    frac = store.fraction_over("mlt_llm_ttft_seconds", 0.25, 30, 99)
+    assert frac == pytest.approx(0.5, abs=0.02)
+    # empty window: no signal, not zero
+    assert store.quantile("mlt_llm_ttft_seconds", 0.95, 30, 500) is None
+    assert store.fraction_over("mlt_llm_ttft_seconds", 0.25, 30,
+                               500) is None
+    # threshold past the highest finite bound: +Inf-bucket mass counts
+    # as OVER — a total outage whose histogram tops out below the
+    # target must not read as 0.0 bad fraction
+    assert store.fraction_over("mlt_llm_ttft_seconds", 5.0, 30, 99) == \
+        pytest.approx(0.5, abs=0.02)
+
+
+def test_grafana_target_parse_and_query():
+    assert parse_target("mlt_llm_queue_depth") == \
+        (None, "mlt_llm_queue_depth", {}, 60.0)
+    assert parse_target('x{replica="r0",engine="e"}[30]') == \
+        (None, "x", {"replica": "r0", "engine": "e"}, 30.0)
+    assert parse_target("rate(mlt_fleet_dispatches_total)[10]") == \
+        ("rate", "mlt_fleet_dispatches_total", {}, 10.0)
+    assert parse_target("p95(mlt_llm_ttft_seconds)")[0] == "p95"
+    with pytest.raises(ValueError):
+        parse_target("not a target!!")
+
+    store = TimeSeriesStore(resolution_s=1.0)
+    for t in range(20):
+        store.record("mlt_llm_queue_depth", float(t), at=t,
+                     labels={"replica": "r0"})
+        store.record("mlt_fleet_dispatches_total", float(t * 3), at=t,
+                     kind="counter")
+    raw = grafana_query(store, 'mlt_llm_queue_depth{replica="r0"}', 5, 8)
+    assert raw["datapoints"] == [[5.0, 5000.0], [6.0, 6000.0],
+                                 [7.0, 7000.0], [8.0, 8000.0]]
+    rate = grafana_query(store, "rate(mlt_fleet_dispatches_total)[4]",
+                         10, 12)
+    assert all(value == pytest.approx(3.0) for value, _ in
+               rate["datapoints"])
+    assert store.search("queue") == ['mlt_llm_queue_depth{replica="r0"}']
+    # an inverted range is a 400, not an infinite evaluation loop
+    with pytest.raises(ValueError, match="before start"):
+        grafana_query(store, "rate(mlt_fleet_dispatches_total)[4]",
+                      100, 50)
+    # a never-recorded series yields NO datapoints (rate() returns 0.0,
+    # not None — "no data" must stay distinguishable from zero traffic)
+    assert grafana_query(store, "rate(mlt_nope_total)[4]",
+                         0, 19)["datapoints"] == []
+    # wide ranges stride down to the point cap instead of evaluating
+    # one quantile per bucket forever
+    from mlrun_tpu.obs.timeseries import GRAFANA_MAX_POINTS
+
+    wide = grafana_query(store, "rate(mlt_fleet_dispatches_total)[4]",
+                         0, 10_000_000)
+    assert len(wide["datapoints"]) <= GRAFANA_MAX_POINTS
+    # grafana epoch-millisecond bounds are detected, not read as
+    # seconds ~50k years out
+    from mlrun_tpu.service.api.monitoring import _parse_range_ts
+
+    assert _parse_range_ts(1_700_000_000_000) == 1_700_000_000.0
+    assert _parse_range_ts(1_700_000_000) == 1_700_000_000.0
+
+
+def test_grafana_metrics_proxy_over_http(service, http_db):
+    """The simpleJSON contract in service/api/monitoring.py over real
+    HTTP (the PR 4 /metrics test pattern): /search lists series from the
+    process-global store, /query answers raw + function targets with
+    grafana's ISO-8601 range bounds, bad targets get a 400."""
+    from mlrun_tpu.db.base import RunDBError
+    from mlrun_tpu.obs.timeseries import set_store
+
+    store = TimeSeriesStore(resolution_s=1.0)
+    for t in range(20):
+        store.record("mlt_llm_queue_depth", float(t), at=float(t),
+                     labels={"replica": "r0"})
+        store.record("mlt_fleet_dispatches_total", float(t * 3),
+                     at=float(t), kind="counter")
+    set_store(store)
+    try:
+        assert http_db.api_call(
+            "GET", "grafana-proxy/metrics")["status"] == "ok"
+        found = http_db.api_call("POST", "grafana-proxy/metrics/search",
+                                 json_body={"target": "queue"})
+        assert found == ['mlt_llm_queue_depth{replica="r0"}']
+        out = http_db.api_call(
+            "POST", "grafana-proxy/metrics/query",
+            json_body={
+                "range": {"from": "1970-01-01T00:00:05Z",
+                          "to": "1970-01-01T00:00:08Z"},
+                "targets": [
+                    {"target": 'mlt_llm_queue_depth{replica="r0"}'},
+                    {"target": "rate(mlt_fleet_dispatches_total)[4]"},
+                ]})
+        assert out[0]["datapoints"] == [[5.0, 5000.0], [6.0, 6000.0],
+                                        [7.0, 7000.0], [8.0, 8000.0]]
+        assert out[1]["target"] == "rate(mlt_fleet_dispatches_total)[4]"
+        assert all(value == pytest.approx(3.0)
+                   for value, _ in out[1]["datapoints"])
+        with pytest.raises(RunDBError, match="400"):
+            http_db.api_call("POST", "grafana-proxy/metrics/query",
+                             json_body={"range": {"from": 0, "to": 10},
+                                        "targets": [{"target": "!!"}]})
+        with pytest.raises(RunDBError, match="400"):
+            http_db.api_call("POST", "grafana-proxy/metrics/query",
+                             json_body={"range": {"from": "not-a-time",
+                                                  "to": 10},
+                                        "targets": []})
+    finally:
+        set_store(None)
+
+
+# -- SLOs ---------------------------------------------------------------------
+def test_latency_slo_multiwindow_burn():
+    store = TimeSeriesStore(resolution_s=1.0)
+    # healthy history, then a sharp regression from t=90
+    _feed_histogram(store, [(0, 90, 10, 0), (90, 120, 0, 10)])
+    slo = SLO("ttft", "latency", target=0.25, q=0.95)
+    ev = SLOEvaluator(store, [slo], fast_window=10, slow_window=60,
+                      fast_burn=5.0, slow_burn=6.0)
+    # shortly after the regression: the fast window [91,101] is all-bad
+    # (burn = 1/budget = 20) but the slow window [41,101] still carries
+    # the healthy majority (bad fraction 0.2, burn 4 < 6) — burning, not
+    # breaching (the multi-window pattern suppresses blips)
+    early = ev.evaluate(101)[0]
+    assert early["burning"] and not early.breaching
+    assert early.burn_fast == pytest.approx(1.0 / slo.budget, rel=0.05)
+    assert early.burn_slow == pytest.approx(4.0, rel=0.1)
+    # once the slow window fills with bad traffic: confirmed breach
+    late = ev.evaluate(119)[0]
+    assert late.breaching
+    # healthy steady state: neither window burns
+    ok = ev.evaluate(80)[0]
+    assert not ok["burning"] and not ok.breaching
+    assert ev.status()[0] == ok  # status() returns the last evaluation
+
+
+def test_error_rate_slo():
+    store = TimeSeriesStore(resolution_s=1.0)
+    ok = bad = 0.0
+    for t in range(100):
+        ok += 10
+        bad += 2 if t >= 60 else 0  # ~17% failures from t=60
+        store.record("mlt_fleet_dispatches_total", ok, at=t,
+                     labels={"outcome": "ok"}, kind="counter")
+        store.record("mlt_fleet_dispatches_total", bad, at=t,
+                     labels={"outcome": "failed"}, kind="counter")
+    slo = SLO("dispatch-errors", "error_rate", target=0.05,
+              bad="mlt_fleet_dispatches_total",
+              bad_labels={"outcome": "failed"},
+              total="mlt_fleet_dispatches_total")
+    ev = SLOEvaluator(store, [slo], fast_window=10, slow_window=30,
+                      fast_burn=2.0, slow_burn=1.5)
+    assert not ev.evaluate(50)[0].breaching
+    status = ev.evaluate(99)[0]
+    assert status.breaching
+    assert status.burn_fast == pytest.approx((2 / 12) / 0.05, rel=0.1)
+
+
+def test_slo_process_fires_alert_and_respects_silence(tmp_path):
+    from datetime import datetime, timedelta, timezone
+
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.alerts import get_alert_template
+
+    store = TimeSeriesStore(resolution_s=1.0)
+    _feed_histogram(store, [(0, 100, 0, 10)])  # everything is slow
+    slo = SLO("ttft", "latency", target=0.25, q=0.95)
+    ev = SLOEvaluator(store, [slo], fast_window=10, slow_window=30,
+                      fast_burn=1.0, slow_burn=1.0, project="p1")
+    db = SQLiteRunDB(str(tmp_path / "slo.db"))
+    config = get_alert_template("SLOBurnRate")
+    config["name"] = "ttft-burn"
+    db.store_alert_config("ttft-burn", config, "p1")
+
+    fired = ev.process(db, at=99)
+    assert fired == ["ttft-burn"]
+    # the breach event is persisted for count-over-period criteria
+    events = db.list_events("p1", kind="slo_burn_rate")
+    assert events and events[-1]["slo"] == "ttft"
+
+    # an active silence window: the breach still evaluates (and is
+    # persisted), but nothing fires through the alert machinery
+    config = db.get_alert_config("ttft-burn", "p1")
+    config["silence_until"] = (datetime.now(timezone.utc)
+                               + timedelta(minutes=10)).isoformat()
+    db.store_alert_config("ttft-burn", config, "p1")
+    assert ev.process(db, at=99) == []
+    assert ev.status()[0].breaching
+
+    # silence expired: fires again
+    config = db.get_alert_config("ttft-burn", "p1")
+    config["silence_until"] = (datetime.now(timezone.utc)
+                               - timedelta(minutes=1)).isoformat()
+    db.store_alert_config("ttft-burn", config, "p1")
+    assert ev.process(db, at=99) == ["ttft-burn"]
+
+
+def test_slo_sustained_breach_refire_damping(tmp_path):
+    """A sustained breach re-fires only every refire_after seconds (the
+    service loop evaluates every few seconds — one incident must not
+    page per tick); recovery resets the damper."""
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.alerts import get_alert_template
+
+    store = TimeSeriesStore(resolution_s=1.0)
+    _feed_histogram(store, [(0, 200, 0, 10)])  # breaching throughout
+    slo = SLO("ttft", "latency", target=0.25, q=0.95)
+    ev = SLOEvaluator(store, [slo], fast_window=10, slow_window=30,
+                      fast_burn=1.0, slow_burn=1.0, refire_after=60.0,
+                      project="p1")
+    db = SQLiteRunDB(str(tmp_path / "refire.db"))
+    config = get_alert_template("SLOBurnRate")
+    config["name"] = "ttft-burn"
+    db.store_alert_config("ttft-burn", config, "p1")
+
+    assert ev.process(db, at=50) == ["ttft-burn"]
+    assert ev.process(db, at=65) == []     # damped, still breaching
+    assert ev.status()[0].breaching
+    assert ev.process(db, at=111) == ["ttft-burn"]  # refire window up
+    # recovery (healthy window) resets the damper: a NEW incident
+    # fires immediately even within refire_after
+    healthy = TimeSeriesStore(resolution_s=1.0)
+    _feed_histogram(healthy, [(0, 130, 10, 0)])
+    ev.store = healthy
+    assert ev.process(db, at=120) == []
+    ev.store = store
+    assert ev.process(db, at=125) == ["ttft-burn"]
+
+
+def test_alert_empty_trigger_events_matches_nothing(tmp_path):
+    """Regression: process_event used to treat a missing/empty
+    trigger_events list as "match every event kind" — a config created
+    without triggers would fire on anything. Now empty matches nothing
+    and the catch-all is the explicit "*" wildcard."""
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.alerts import process_event
+
+    db = SQLiteRunDB(str(tmp_path / "alerts.db"))
+    base = {"criteria": {"count": 1, "period_seconds": 3600},
+            "notifications": [{"kind": "console"}]}
+    db.store_alert_config("no-triggers", {
+        "name": "no-triggers", "project": "p1", **base}, "p1")
+    db.store_alert_config("empty-triggers", {
+        "name": "empty-triggers", "project": "p1",
+        "trigger_events": [], **base}, "p1")
+    db.store_alert_config("catch-all", {
+        "name": "catch-all", "project": "p1",
+        "trigger_events": ["*"], **base}, "p1")
+
+    db.emit_event("run_failed", {"entity_id": "job1"}, "p1")
+    fired = process_event(db, "p1", "run_failed", {"entity_id": "job1"})
+    assert fired == ["catch-all"]
+    db.emit_event("anything_else", {"entity_id": "job1"}, "p1")
+    fired = process_event(db, "p1", "anything_else",
+                          {"entity_id": "job1"})
+    assert fired == ["catch-all"]
+
+
+def test_slo_config_validation():
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        SLO("x", "latency_p95", target=0.1)
+    with pytest.raises(ValueError, match="fraction"):
+        SLO("x", "error_rate", target=5.0)
+    with pytest.raises(ValueError, match="unknown SLO objective keys"):
+        SLO.from_config({"name": "x", "kind": "latency", "target": 0.1,
+                         "threshold": 1})
+    # bad == total with no label filter means bad/total is always 1.0 —
+    # a constant max-burn false breach; reject at construction
+    with pytest.raises(ValueError, match="bad_labels"):
+        SLO("x", "error_rate", target=0.05)
+    SLO("x", "error_rate", target=0.05,
+        bad_labels={"outcome": "failed"})  # label filter: fine
+    SLO("x", "availability", target=0.99,
+        bad="mlt_other_total")  # distinct family: fine
+
+
+# -- autoscaler (fake engines behind the real fleet) --------------------------
+class _ScalableEngine:
+    """Jax-free engine whose load is scripted by the test."""
+
+    page_size = 8
+
+    def __init__(self):
+        self.replica = ""
+        self._stopped = False
+        self._slot_state = ()
+        self.queue = 0
+        self.free_frac = None
+
+    def _queue_depth(self):
+        return self.queue
+
+    def _free_page_frac(self):
+        return self.free_frac
+
+    def start(self):
+        pass
+
+    def warmup(self):
+        pass
+
+    def stop(self, timeout=10.0):
+        self._stopped = True
+        self.queue = 0
+
+    @property
+    def stats(self):
+        return {"requests": 0, "completed": 0,
+                "queue_depth": self.queue}
+
+
+def _scalable_fleet(replicas=1):
+    engines = []
+
+    def factory(role):
+        engine = _ScalableEngine()
+        engines.append(engine)
+        return engine
+
+    fleet = EngineFleet(factory, replicas=replicas, route_block_tokens=8)
+    return fleet, engines
+
+
+def _scaler(fleet, **overrides):
+    kwargs = dict(dry_run=False, min_replicas=1, max_replicas=3,
+                  hysteresis_ticks=1, cooldown_up_s=0.0,
+                  cooldown_down_s=0.0, drain_grace_s=100.0,
+                  queue_high=4.0, queue_low=1.0, free_page_frac_low=0.1,
+                  ttft_p95_high_s=0.0, failure_rate_high=0.5)
+    kwargs.update(overrides)
+    return FleetAutoscaler(fleet, **kwargs)
+
+
+def _live(fleet):
+    return [r for r in fleet.replicas if not r.draining]
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    fleet, engines = _scalable_fleet()
+    scaler = _scaler(fleet, hysteresis_ticks=2, cooldown_up_s=10.0)
+    engines[0].queue = 20
+    first = scaler.tick(now=0.0)
+    assert first["action"] == "up" and not first["recommended"]
+    assert len(_live(fleet)) == 1  # one hot tick is noise, not a signal
+    second = scaler.tick(now=1.0)
+    assert second["recommended"] and second["acted"]["action"] == "add"
+    assert len(_live(fleet)) == 2
+    # still hot, streak rebuilt — but the up-cooldown gates the action
+    engines[0].queue = engines[1].queue = 20
+    scaler.tick(now=2.0)
+    third = scaler.tick(now=3.0)
+    assert third["recommended"] and third["acted"] is None
+    assert len(_live(fleet)) == 2
+    cooled = scaler.tick(now=12.0)
+    assert cooled["acted"]["action"] == "add"
+    assert len(_live(fleet)) == 3
+
+
+def test_autoscaler_dry_run_records_recommendations_only():
+    fleet, engines = _scalable_fleet()
+    scaler = _scaler(fleet, dry_run=True)
+    engines[0].queue = 20
+    from mlrun_tpu.obs import AUTOSCALER_RECOMMENDATIONS
+
+    before = AUTOSCALER_RECOMMENDATIONS.value(action="up",
+                                              reason="queue_depth")
+    decision = scaler.tick(now=0.0)
+    assert decision["recommended"] and decision["acted"] is None
+    assert decision["dry_run"]
+    assert len(_live(fleet)) == 1
+    assert AUTOSCALER_RECOMMENDATIONS.value(
+        action="up", reason="queue_depth") == before + 1
+
+
+def test_autoscaler_bounds_and_signal_reasons():
+    fleet, engines = _scalable_fleet(replicas=3)
+    scaler = _scaler(fleet, max_replicas=3)
+    for engine in engines:
+        engine.queue = 20
+        engine.free_frac = 0.05
+    decision = scaler.tick(now=0.0)
+    # every up signal present, but the fleet is at max: recommendation
+    # recorded at the bound, nothing acted
+    assert decision["action"] == "up"
+    assert "queue_depth" in decision["reason"]
+    assert "kv_pressure" in decision["reason"]
+    assert decision["acted"] is None
+    assert decision["desired"] == 3
+    assert len(_live(fleet)) == 3
+    # and min_replicas floors scale-down symmetrically
+    fleet2, engines2 = _scalable_fleet()
+    scaler2 = _scaler(fleet2)
+    decision2 = scaler2.tick(now=0.0)
+    assert decision2["action"] == "down" and decision2["acted"] is None
+    assert len(_live(fleet2)) == 1
+
+
+def test_autoscaler_scale_down_picks_least_loaded_victim():
+    fleet, engines = _scalable_fleet(replicas=3)
+    store = TimeSeriesStore(resolution_s=1.0)
+    scaler = _scaler(fleet, store=store, drain_grace_s=100.0,
+                     queue_low=2.0)
+    engines[0].queue = engines[2].queue = 1
+    engines[1].queue = 0  # the cheapest replica to take out
+    idle_id = next(r.id for r in fleet.replicas
+                   if r.engine is engines[1])
+    for replica in fleet.replicas:  # windowed series per replica
+        store.record("mlt_llm_queue_depth", 1.0, at=0.0,
+                     labels={"replica": replica.id})
+    decision = scaler.tick(now=0.0)
+    assert decision["acted"] == {"action": "drain", "replica": idle_id}
+    assert len(_live(fleet)) == 2
+    # its queue was already empty, so the same tick's sweep removed it
+    assert decision["removed"] == [idle_id]
+    assert all(r.id != idle_id for r in fleet.replicas)
+    # ... and the removed replica's windowed-store series are retired
+    # (the engine retires its registry series; the store has its own)
+    assert store.search(f'replica="{idle_id}"') == []
+    assert len(store.series()) == 2
+
+
+def test_autoscaler_drain_grace_respects_inflight_work():
+    fleet, engines = _scalable_fleet(replicas=2)
+    scaler = _scaler(fleet, drain_grace_s=50.0, queue_low=5.0)
+    engines[0].queue = engines[1].queue = 1
+    decision = scaler.tick(now=0.0)
+    assert decision["acted"]["action"] == "drain"
+    victim_id = decision["acted"]["replica"]
+    victim_engine = next(r.engine for r in fleet.replicas
+                         if r.id == victim_id)
+    victim_engine.queue = 2  # still busy
+    assert scaler.tick(now=10.0)["removed"] == []
+    assert any(r.id == victim_id for r in fleet.replicas)
+    # grace expires: force-removed even though work remains
+    assert scaler.tick(now=60.0)["removed"] == [victim_id]
+
+
+@pytest.mark.chaos
+def test_autoscaler_chaos_forced_scale_and_failure():
+    fleet, engines = _scalable_fleet()
+    scaler = _scaler(fleet, hysteresis_ticks=5, queue_low=0.0)
+    before = CHAOS_FIRED.value(point="obs.autoscale")
+
+    def force_up(point, context):
+        context["box"].update(action="up", reason="injected", force=True)
+
+    with chaos.inject("obs.autoscale", always(), action=force_up):
+        decision = scaler.tick(now=0.0)
+    # forced injection bypasses hysteresis AND cooldown — deterministic
+    # scale-event injection for tests/staging
+    assert decision["forced"] and decision["acted"]["action"] == "add"
+    assert decision["reason"] == "injected"
+    assert len(_live(fleet)) == 2
+    assert CHAOS_FIRED.value(point="obs.autoscale") == before + 1
+
+    with chaos.inject("obs.autoscale", always(),
+                      error=RuntimeError("scale eval boom")):
+        with pytest.raises(RuntimeError, match="scale eval boom"):
+            scaler.tick(now=1.0)
+
+
+def test_autoscaler_uses_aggregated_signals():
+    fleet, engines = _scalable_fleet()
+    agg = MetricsAggregator()
+    store = TimeSeriesStore(resolution_s=1.0)
+    scaler = _scaler(fleet, aggregator=agg, store=store,
+                     ttft_p95_high_s=0.2, queue_high=100.0)
+    # local engines are idle — the federated view carries the pressure
+    agg.ingest_stats("fleet", {"per_replica": {
+        "remote-0": {"queue_depth": 0, "free_page_frac": 0.02}}},
+        at=10.0)
+    _feed_histogram(store, [(0, 11, 0, 10)])  # everything slow
+    sig = scaler.signals(11.0)
+    assert sig["free_page_frac_min"] == 0.02
+    assert sig["ttft_p95_s"] >= 0.25
+    decision = scaler.tick(now=11.0)
+    assert decision["action"] == "up"
+    assert "kv_pressure" in decision["reason"]
+    assert "ttft_slo" in decision["reason"]
+
+
+def test_autoscaler_remote_load_divides_by_contributing_replicas():
+    """Federated queue depth may come from replicas this autoscaler
+    does not own — per-replica load divides by every contributing
+    replica, or remote load reads as local overload."""
+    fleet, engines = _scalable_fleet()  # 1 local worker, idle
+    agg = MetricsAggregator()
+    agg.ingest_stats("fleet", {"per_replica": {
+        f"remote-{i}": {"queue_depth": 2} for i in range(4)}}, at=10.0)
+    scaler = _scaler(fleet, aggregator=agg, queue_high=4.0,
+                     queue_low=0.0)
+    sig = scaler.signals(10.0)
+    assert sig["load_per_replica"] == pytest.approx(2.0)
+    assert scaler.tick(now=10.0)["action"] != "up"
+
+
+def test_autoscaler_aggregated_signals_skip_draining_replicas():
+    """A locally-draining replica's federated gauges must not inflate
+    per-worker load or pin the page-pressure min — only scale-target
+    workers (and pass-through remote series) count."""
+    fleet, engines = _scalable_fleet(replicas=2)
+    agg = MetricsAggregator()
+    draining_id = fleet.replicas[1].id
+    fleet.drain_replica(draining_id)
+    agg.ingest_stats("fleet", {"per_replica": {
+        fleet.replicas[0].id: {"queue_depth": 1, "free_page_frac": 0.9},
+        draining_id: {"queue_depth": 50, "free_page_frac": 0.01},
+    }}, at=10.0)
+    scaler = _scaler(fleet, aggregator=agg, queue_high=4.0,
+                     free_page_frac_low=0.1)
+    sig = scaler.signals(10.0)
+    assert sig["load_per_replica"] <= 1.0
+    assert sig["free_page_frac_min"] == 0.9
+    assert scaler.tick(now=10.0)["action"] != "up"
+
+
+def test_closed_loop_ramp_scale_up_down_with_slo_alert(tmp_path):
+    """The acceptance loop on fake engines: a load ramp overwhelms one
+    replica (p95 TTFT over target → burn-rate alert through
+    service/alerts), the autoscaler absorbs it at 3 replicas (windowed
+    p95 back under target), and the ramp's end drains the fleet back to
+    min — all on a fake clock, no sleeps."""
+    from mlrun_tpu.db.sqlitedb import SQLiteRunDB
+    from mlrun_tpu.service.alerts import get_alert_template
+
+    fleet, engines = _scalable_fleet()
+    store = TimeSeriesStore(resolution_s=1.0)
+    slo = SLO("ttft", "latency", target=0.1, q=0.95)
+    evaluator = SLOEvaluator(store, [slo], fast_window=5, slow_window=20,
+                             fast_burn=1.0, slow_burn=1.0, project="p1")
+    db = SQLiteRunDB(str(tmp_path / "loop.db"))
+    config = get_alert_template("SLOBurnRate")
+    config["name"] = "ttft-burn"
+    db.store_alert_config("ttft-burn", config, "p1")
+    scaler = _scaler(fleet, store=store, max_replicas=3,
+                     ttft_p95_high_s=0.1, queue_high=4.0, queue_low=1.0,
+                     ttft_window=5.0)
+
+    offered = [12] * 30 + [0] * 6
+    trajectory = []
+    fired_at = []
+    cum = {"0.05": 0.0, "0.25": 0.0, "+Inf": 0.0}
+    for t, load in enumerate(offered):
+        live = _live(fleet)
+        per_replica = load // len(live) if load else 0
+        for replica in live:
+            replica.engine.queue = per_replica
+        # synthetic latency: a replica at <=4 in-flight serves under
+        # 50ms; an overloaded one spills past 250ms
+        good = per_replica <= 4
+        cum["0.05"] += load if good else 0
+        cum["0.25"] += load if good else 0
+        cum["+Inf"] += load
+        for le in ("0.05", "0.25", "+Inf"):
+            store.record("mlt_llm_ttft_seconds_bucket", cum[le], at=t,
+                         labels={"le": le}, kind="counter")
+        store.record("mlt_llm_ttft_seconds_count", cum["+Inf"], at=t,
+                     kind="counter")
+        if evaluator.process(db, at=float(t)):
+            fired_at.append(t)
+        scaler.tick(now=float(t))
+        trajectory.append(len(_live(fleet)))
+
+    # breach fired through the alert machinery during the overload
+    assert fired_at and fired_at[0] <= 3
+    # scaled up to absorb the ramp...
+    assert max(trajectory) == 3
+    assert trajectory[3] == 3
+    # ...which brought the windowed p95 back under the target
+    assert store.quantile("mlt_llm_ttft_seconds", 0.95, 5,
+                          len(offered) - 8) <= 0.1
+    # ...and the burn cleared once the slow window drained
+    assert not evaluator.status()[0].breaching
+    # ramp over: drained back down to min, nothing left draining
+    assert trajectory[-1] == 1
+    assert len(fleet.replicas) == 1 and not fleet.replicas[0].draining
+
+
+# -- bench smoke (real engines, tiny model, tier-1) --------------------------
+def test_bench_autoscale_smoke():
+    """The closed loop on REAL paged engines: scale up under the ramp,
+    beat the static baseline's peak p95 TTFT, drain back down, leak no
+    replica-labeled series. The absolute SLO-met-vs-violated claim is
+    asserted in the deterministic closed-loop test above (fake clock,
+    synthetic histograms) — here only contention-robust relative claims
+    are asserted, because the serial unloaded pass the SLO target is
+    derived from inflates faster than the batched loaded phases when
+    the whole test suite competes for the CPU."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", pathlib.Path(__file__).parent.parent
+        / "bench_serve.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    out = bench.run_autoscale(burst=4, ramp=(1, 2, 2, 0, 0),
+                              max_replicas=3, max_new=2,
+                              prompt_tokens=16, prefill_cost_s=0.02,
+                              slo_factor=6.0)
+    auto = out["autoscaled"]
+    assert auto["scale_ups"] >= 1
+    assert auto["scale_downs"] >= 1
+    assert auto["final_replicas"] == 1
+    assert auto["leaked_replica_series"] == []
+    # scaled peak p95 clearly beats the static single replica (observed
+    # ~2x with generous slack for a loaded machine)
+    assert out["p95_ttft_speedup"] >= 1.3

@@ -9,8 +9,6 @@ point (events, no sleeps beyond the join timeout under test).
 """
 
 import json
-import math
-import re
 import threading
 
 import pytest
@@ -23,70 +21,29 @@ from mlrun_tpu.obs import (
     CardinalityError,
     MetricError,
     MetricsRegistry,
+    PromParseError,
     Tracer,
+    # the Prometheus text parser lives in obs/federation.py (it is the
+    # federation ingest path); these tests consume the library version —
+    # one source of truth for the format contract
+    check_histogram_consistency,
+    parse_prometheus,
     parse_trace_header,
     trace_id_for,
 )
 
 
-# -- Prometheus text-format parser (the format contract under test) ----------
-_SAMPLE_RE = re.compile(
-    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?:\{(?P<labels>.*)\})?'
-    r' (?P<value>-?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|Inf|NaN))$',
-    re.IGNORECASE)
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-
-def parse_prometheus(text: str):
-    """Parse exposition text; assert-fail on any malformed line. Returns
-    (samples {(name, labels-frozenset): float}, types {family: type})."""
-    samples = {}
-    types = {}
-    helped = set()
-    for line in text.strip().splitlines():
-        if line.startswith("# HELP "):
-            helped.add(line.split()[2])
-            continue
-        if line.startswith("# TYPE "):
-            _, _, family, type_name = line.split(maxsplit=3)
-            assert type_name in ("counter", "gauge", "histogram"), line
-            types[family] = type_name
-            continue
-        assert not line.startswith("#"), f"unknown comment line: {line}"
-        match = _SAMPLE_RE.match(line)
-        assert match, f"malformed sample line: {line!r}"
-        labels = frozenset(_LABEL_RE.findall(match.group("labels") or ""))
-        value = match.group("value")
-        samples[(match.group("name"), labels)] = (
-            math.inf if value == "+Inf" else float(value))
-    # every family carries HELP + TYPE
-    assert set(types) <= helped
-    return samples, types
-
-
-def check_histogram_consistency(samples, family: str):
-    """Bucket counts cumulative & non-decreasing; +Inf == _count; _sum
-    present — per label group."""
-    groups = {}
-    for (name, labels), value in samples.items():
-        if not name.startswith(family):
-            continue
-        suffix = name[len(family):]
-        base = frozenset(kv for kv in labels if kv[0] != "le")
-        groups.setdefault(base, {})[
-            (suffix, dict(labels).get("le"))] = value
-    assert groups, f"no samples for histogram {family}"
-    for base, series in groups.items():
-        buckets = sorted(
-            ((math.inf if le == "+Inf" else float(le)), value)
-            for (suffix, le), value in series.items()
-            if suffix == "_bucket")
-        counts = [value for _, value in buckets]
-        assert counts == sorted(counts), f"non-cumulative buckets: {base}"
-        assert buckets[-1][0] == math.inf
-        assert buckets[-1][1] == series[("_count", None)]
-        assert series[("_sum", None)] >= 0
+def test_parser_rejects_malformed_exposition():
+    """The promoted parser is strict: malformed samples, unknown
+    comments, and typed families without HELP all raise."""
+    with pytest.raises(PromParseError, match="malformed sample"):
+        parse_prometheus("# HELP x x\n# TYPE x counter\nx{oops 1")
+    with pytest.raises(PromParseError, match="unknown comment"):
+        parse_prometheus("# EOF")
+    with pytest.raises(PromParseError, match="missing HELP"):
+        parse_prometheus("# TYPE x counter\nx 1")
+    with pytest.raises(PromParseError, match="unknown metric type"):
+        parse_prometheus("# HELP x x\n# TYPE x summary\nx 1")
 
 
 # -- registry unit behavior --------------------------------------------------
