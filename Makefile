@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -39,13 +39,18 @@ bench-autoscale: ## closed-loop autoscaling A/B under a synthetic load ramp (doc
 		&& tail -n 1 BENCH_r08.tmp > BENCH_r08.json \
 		&& rm BENCH_r08.tmp && cat BENCH_r08.json
 
+bench-lora:      ## multi-tenant LoRA A/B: batched multi-adapter engine vs sequential merged-weights swaps (docs/serving.md "Multi-tenant LoRA"); rewrites BENCH_r09.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --lora > BENCH_r09.tmp \
+		&& tail -n 1 BENCH_r09.tmp > BENCH_r09.json \
+		&& rm BENCH_r09.tmp && cat BENCH_r09.json
+
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train
 
 bench-attn:      ## attention kernels vs reference (flash v1/v2 + paged decode), CPU interpret mode; rewrites BENCH_ATTN_CPU.json
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_attention_cpu.py
 
-obs-smoke:       ## graph + 2-replica fleet smoke: scrape /metrics, federate, SLO status, span artifact (docs/observability.md)
+obs-smoke:       ## graph + 2-replica fleet + 2-tenant adapter smoke: scrape /metrics, federate, SLO status, adapter cardinality, span artifact (docs/observability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dryrun on 8 virtual CPU devices

@@ -449,12 +449,170 @@ def run_autoscale(min_replicas: int = 1, max_replicas: int = 4,
     }
 
 
+def run_lora(tenants: int = 4, requests_per_tenant: int = 6,
+             prompt_tokens: int = 48, max_new: int = 8,
+             page_size: int = 16, max_len: int = 128, slots: int = 4,
+             rank: int = 4, seed: int = 0, warmup: bool = True) -> dict:
+    """Multi-tenant LoRA serving A/B (docs/serving.md "Multi-tenant
+    LoRA"): N tenants round-robin on ONE batched multi-adapter engine vs
+    serving the same workload with sequential merged-weights swaps (one
+    dedicated engine per tenant, built/torn down in turn — the only
+    option without per-row adapters). Reports:
+
+    - ``throughput_ratio``: multi-tenant tokens/s over the sequential
+      path INCLUDING its per-tenant engine swap cost (the honest
+      comparison — avoiding weight swaps is the point), plus the
+      serving-only ratio with swaps excluded.
+    - ``one_tenant``: the no-regression guard — a single tenant through
+      the adapter path vs a dedicated merged-weights engine. The lora
+      math adds a bounded per-dispatch cost; the ratio must stay near 1.
+    - ``parity_ok``: greedy tokens for a sampled request are identical
+      between the multi-adapter engine and that tenant's merged engine.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlrun_tpu.models import (
+        init_lora_nonzero,
+        init_params,
+        merge_lora,
+        tiny_llama,
+    )
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    # f32 keeps the batched-delta vs merged-weights comparison at
+    # accumulation-order rounding (parity_ok is a token-identity claim)
+    config = tiny_llama(attention_impl="reference", dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(64, max_len), max_len}))
+
+    names = [f"tenant-{i}" for i in range(tenants)]
+    # nonzero-B synthetic adapters: each tenant's delta actually moves
+    # logits (models/lora.init_lora_nonzero — shared with tests/smoke)
+    adapters = {name: init_lora_nonzero(
+        config, jax.random.PRNGKey(100 + i), rank=rank)
+        for i, name in enumerate(names)}
+    prompts = {name: [rng.integers(0, config.vocab_size,
+                                   prompt_tokens).tolist()
+                      for _ in range(requests_per_tenant)]
+               for name in names}
+
+    def make_engine(engine_params, engine_adapters=None):
+        engine = PagedContinuousBatchingEngine(
+            config, engine_params, max_len=max_len, slots=slots,
+            page_size=page_size, prefill_buckets=buckets,
+            adapters=engine_adapters)
+        if warmup:
+            engine.warmup()
+        engine.start()
+        return engine
+
+    # -- multi-tenant: one engine, tenants round-robin interleaved ---------
+    engine = make_engine(params, adapters)
+    try:
+        started = time.perf_counter()
+        futures = []
+        for r in range(requests_per_tenant):
+            for name in names:
+                futures.append(engine.submit(
+                    prompts[name][r], max_new_tokens=max_new,
+                    adapter=name))
+        results = [f.result(timeout=600) for f in futures]
+        multi_wall = time.perf_counter() - started
+        multi_tokens = sum(len(tokens) for tokens, _ in results)
+        multi_stats = engine.stats
+        sample_multi = results[0][0]  # tenant-0's first request
+    finally:
+        engine.stop()
+
+    # -- sequential merged-weights swaps: one dedicated engine per tenant --
+    seq_serving = 0.0
+    seq_swap = 0.0
+    seq_tokens = 0
+    sample_merged = None
+    one_merged_wall = 0.0
+    merged_tokens = 0
+    for name in names:
+        t_swap = time.perf_counter()
+        merged_engine = make_engine(merge_lora(params, adapters[name]))
+        seq_swap += time.perf_counter() - t_swap
+        try:
+            t_serve = time.perf_counter()
+            futures = [merged_engine.submit(p, max_new_tokens=max_new)
+                       for p in prompts[name]]
+            tenant_results = [f.result(timeout=600) for f in futures]
+            wall = time.perf_counter() - t_serve
+            seq_serving += wall
+            seq_tokens += sum(len(tokens) for tokens, _ in tenant_results)
+            if name == names[0]:
+                sample_merged = tenant_results[0][0]
+                # this leg IS the 1-tenant merged-weights baseline —
+                # no extra engine build needed for the guard below
+                one_merged_wall = wall
+                merged_tokens = sum(len(tokens)
+                                    for tokens, _ in tenant_results)
+        finally:
+            merged_engine.stop()
+
+    # -- one-tenant no-regression guard ------------------------------------
+    # adapter-path leg; the merged-weights side was measured above as
+    # tenant-0's sequential serving leg (identical engine + workload)
+    one_prompts = prompts[names[0]]
+    engine = make_engine(params, {names[0]: adapters[names[0]]})
+    try:
+        t0 = time.perf_counter()
+        futures = [engine.submit(p, max_new_tokens=max_new,
+                                 adapter=names[0]) for p in one_prompts]
+        one_tokens = sum(len(f.result(timeout=600)[0]) for f in futures)
+        one_adapter_wall = time.perf_counter() - t0
+    finally:
+        engine.stop()
+
+    multi_tps = multi_tokens / multi_wall if multi_wall > 0 else 0.0
+    seq_tps = seq_tokens / seq_serving if seq_serving > 0 else 0.0
+    seq_incl_swap_tps = seq_tokens / (seq_serving + seq_swap) \
+        if seq_serving + seq_swap > 0 else 0.0
+    one_adapter_tps = one_tokens / one_adapter_wall \
+        if one_adapter_wall > 0 else 0.0
+    one_merged_tps = merged_tokens / one_merged_wall \
+        if one_merged_wall > 0 else 0.0
+    return {
+        "model": "tiny", "tenants": tenants,
+        "requests_per_tenant": requests_per_tenant,
+        "prompt_tokens": prompt_tokens, "rank": rank, "slots": slots,
+        "multi_tokens_per_sec": round(multi_tps, 1),
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "sequential_incl_swap_tokens_per_sec": round(seq_incl_swap_tps, 1),
+        "swap_s_total": round(seq_swap, 3),
+        "throughput_ratio": round(multi_tps / seq_incl_swap_tps, 2)
+        if seq_incl_swap_tps > 0 else 0.0,
+        "serving_only_ratio": round(multi_tps / seq_tps, 2)
+        if seq_tps > 0 else 0.0,
+        "one_tenant": {
+            "adapter_tokens_per_sec": round(one_adapter_tps, 1),
+            "merged_tokens_per_sec": round(one_merged_tps, 1),
+            "throughput_ratio": round(one_adapter_tps / one_merged_tps, 2)
+            if one_merged_tps > 0 else 0.0,
+        },
+        "parity_ok": sample_multi == sample_merged,
+        "adapter_loads": multi_stats.get("adapter_loads", 0),
+        "adapter_live": multi_stats.get("adapter_live", 0),
+        "metrics": _metrics_snapshot(multi_stats),
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--fleet", action="store_true",
                         help="run the engine-fleet routing A/B instead")
     parser.add_argument("--autoscale", action="store_true",
                         help="run the closed-loop autoscaling A/B instead")
+    parser.add_argument("--lora", action="store_true",
+                        help="run the multi-tenant LoRA serving A/B "
+                             "instead")
+    parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
     # the prefix-cache bench stresses ONE engine with long prompts,
     # while the fleet A/B spreads many short hot prefixes over pools
@@ -475,7 +633,11 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.autoscale:
+    if args.lora:
+        result = run_lora(tenants=args.tenants,
+                          **overrides(max_new=8, page_size=16,
+                                      max_len=128))
+    elif args.autoscale:
         result = run_autoscale(max_replicas=args.replicas)
     elif args.fleet:
         result = run_fleet(replicas=args.replicas, prefixes=args.prefixes,

@@ -74,6 +74,13 @@ class FaultPoints:
     # per evicted page with page_id/refcount context; an action() here
     # observes eviction order, an error models a poisoned reclaim
     llm_prefix_evict = "llm.prefix_evict"
+    # adapter registry load/evict (serving/adapters.py AdapterRegistry):
+    # fires with op="load" before an adapter's weights land in the
+    # device bank and op="evict" when an LRU refcount-0 resident is
+    # displaced — an action() observes residency churn, an error models
+    # a corrupt/unreachable adapter artifact (fails ONE request, never
+    # the engine)
+    llm_adapter_load = "llm.adapter_load"
     # one autoscaler evaluation (service/autoscaler.py tick) — fires
     # with a mutable ``box`` carrying the computed decision; an
     # action() may overwrite box["action"]/box["reason"] for
@@ -98,6 +105,7 @@ class FaultPoints:
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
+            FaultPoints.llm_adapter_load,
             FaultPoints.obs_autoscale, FaultPoints.train_prefetch,
         ]
 

@@ -177,6 +177,23 @@ default_config: dict[str, Any] = {
             # MLT_ATTN_INTERPRET=1 forces the kernels in interpret mode.
             # flash | kernel | reference override per engine.
             "attention_impl": "auto",
+            # multi-tenant LoRA serving (docs/serving.md "Multi-tenant
+            # LoRA"); engine / LLMModelServer class args override these
+            "adapters": {
+                # device-resident adapter working set per engine (bank
+                # slots beyond the base slot 0); pinning more DISTINCT
+                # adapters in flight than this 429s with
+                # AdapterCapacityError
+                "max_live_adapters": 8,
+                # deserialized adapter trees kept host-side so an
+                # evicted-then-reused adapter skips the artifact fetch
+                "host_cache": 16,
+                # per-tenant admission token bucket (requests/second +
+                # burst) in FRONT of the shared queue; 0 = fairness
+                # limiter off
+                "rate": 0.0,
+                "burst": 8.0,
+            },
         },
         # engine replica fleet (docs/serving.md "Engine fleet");
         # EngineFleet / LLMModelServer class args override these

@@ -9,7 +9,12 @@ from .llama import (  # noqa: F401
     param_shapes,
     tiny_llama,
 )
-from .lora import init_lora, lora_param_count, merge_lora  # noqa: F401
+from .lora import (  # noqa: F401
+    init_lora,
+    init_lora_nonzero,
+    lora_param_count,
+    merge_lora,
+)
 from .bert import (  # noqa: F401
     BertConfig,
     bert_base,

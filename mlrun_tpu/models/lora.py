@@ -28,6 +28,12 @@ _PROJ_DIMS = {
 }
 
 
+class LoraShapeError(ValueError):
+    """An adapter's rank/shape disagrees with the base params (or with
+    another adapter sharing a serving bank). Subclasses ValueError so
+    pre-typed callers keep working."""
+
+
 def init_lora(config: LlamaConfig, key: jax.Array, rank: int = 16,
               alpha: float = 32.0,
               targets: Sequence[str] = DEFAULT_TARGETS) -> Params:
@@ -50,6 +56,28 @@ def init_lora(config: LlamaConfig, key: jax.Array, rank: int = 16,
     return lora
 
 
+def init_lora_nonzero(config: LlamaConfig, key: jax.Array, rank: int = 16,
+                      alpha: float = 32.0,
+                      targets: Sequence[str] = DEFAULT_TARGETS,
+                      b_scale: float = 0.05) -> Params:
+    """:func:`init_lora` with a random (nonzero) B factor — a synthetic
+    "trained" adapter whose delta actually moves logits. ``init_lora``'s
+    B = 0 is the right training init but a zero delta, useless for
+    exercising the multi-tenant serving path; the benches, smokes, and
+    tests all need this same shape (one definition, not four copies)."""
+    lora = init_lora(config, key, rank=rank, alpha=alpha, targets=targets)
+    out: Params = {}
+    for i, (target, adapter) in enumerate(lora.items()):
+        k = jax.random.fold_in(jax.random.fold_in(key, 1 << 20), i)
+        out[target] = {
+            "lora_a": adapter["lora_a"],
+            "lora_b": (jax.random.normal(
+                k, adapter["lora_b"].shape, jnp.float32) * b_scale),
+            "scaling": adapter["scaling"],
+        }
+    return out
+
+
 def lora_param_count(config: LlamaConfig, rank: int = 16,
                      targets: Sequence[str] = DEFAULT_TARGETS) -> int:
     total = 0
@@ -59,8 +87,91 @@ def lora_param_count(config: LlamaConfig, rank: int = 16,
     return total
 
 
+def lora_rank(lora: Params) -> int:
+    """The adapter's rank, read off the first target's A factor."""
+    for adapter in lora.values():
+        return int(adapter["lora_a"].shape[-1])
+    raise LoraShapeError("adapter tree has no targets")
+
+
+def validate_lora(lora: Params, *, config: LlamaConfig | None = None,
+                  base: Params | None = None, rank: int | None = None,
+                  targets: Sequence[str] | None = None) -> int:
+    """Validate an adapter tree's internal consistency and, when
+    ``config``/``base``/``rank``/``targets`` are given, its agreement
+    with them. Returns the adapter's rank. Raises :class:`LoraShapeError`
+    on any mismatch — callers (``merge_lora``, the serving adapter bank)
+    fail typed instead of broadcasting garbage into the weights."""
+    if not lora:
+        raise LoraShapeError("adapter tree has no targets")
+    seen_rank = None
+    for target, adapter in lora.items():
+        for key in ("lora_a", "lora_b", "scaling"):
+            if key not in adapter:
+                raise LoraShapeError(
+                    f"adapter target '{target}' is missing '{key}'")
+        a, b, scaling = (adapter["lora_a"], adapter["lora_b"],
+                         adapter["scaling"])
+        if a.ndim != 3 or b.ndim != 3 or scaling.ndim != 1:
+            raise LoraShapeError(
+                f"adapter target '{target}' has wrong ranks: lora_a "
+                f"{a.shape}, lora_b {b.shape}, scaling {scaling.shape} "
+                f"(want [L, in, r], [L, r, out], [L])")
+        layers, d_in, r = a.shape
+        if b.shape[0] != layers or scaling.shape[0] != layers:
+            raise LoraShapeError(
+                f"adapter target '{target}' layer counts disagree: "
+                f"lora_a {layers}, lora_b {b.shape[0]}, "
+                f"scaling {scaling.shape[0]}")
+        if b.shape[1] != r:
+            raise LoraShapeError(
+                f"adapter target '{target}' rank disagrees between "
+                f"factors: lora_a rank {r}, lora_b rank {b.shape[1]}")
+        if seen_rank is None:
+            seen_rank = r
+        elif r != seen_rank:
+            raise LoraShapeError(
+                f"adapter target '{target}' rank {r} != rank {seen_rank} "
+                f"of the other targets")
+        if targets is not None and target not in targets:
+            raise LoraShapeError(
+                f"adapter target '{target}' not in the allowed targets "
+                f"{tuple(targets)}")
+        if config is not None:
+            if target not in _PROJ_DIMS:
+                raise LoraShapeError(f"unknown lora target '{target}'")
+            want_in, want_out = _PROJ_DIMS[target](config)
+            if layers != config.n_layers or d_in != want_in \
+                    or b.shape[2] != want_out:
+                raise LoraShapeError(
+                    f"adapter target '{target}' shape "
+                    f"[{layers}, {d_in}, {r}]x[{b.shape[0]}, {b.shape[1]}, "
+                    f"{b.shape[2]}] does not fit the config "
+                    f"([{config.n_layers}, {want_in}, r]x"
+                    f"[{config.n_layers}, r, {want_out}])")
+        if base is not None:
+            base_layers = base.get("layers", {})
+            if target not in base_layers:
+                raise LoraShapeError(
+                    f"adapter target '{target}' has no base projection")
+            bw = base_layers[target]
+            if bw.shape != (layers, d_in, b.shape[2]):
+                raise LoraShapeError(
+                    f"adapter target '{target}' delta shape "
+                    f"[{layers}, {d_in}, {b.shape[2]}] does not match "
+                    f"base weight shape {tuple(bw.shape)}")
+    if rank is not None and seen_rank != rank:
+        raise LoraShapeError(
+            f"adapter rank {seen_rank} != required rank {rank}")
+    return seen_rank
+
+
 def merge_lora(params: Params, lora: Params) -> Params:
-    """Fold adapters into the base weights (for serving without lora math)."""
+    """Fold adapters into the base weights (for serving without lora math).
+    Validates rank/shape agreement up front — a transposed factor or a
+    wrong-config adapter raises :class:`LoraShapeError` instead of
+    broadcasting garbage into the merged weights."""
+    validate_lora(lora, base=params)
     merged = jax.tree_util.tree_map(lambda x: x, params)  # shallow-ish copy
     layers = dict(merged["layers"])
     for target, adapter in lora.items():

@@ -79,17 +79,22 @@ SERVER_INFLIGHT = REGISTRY.gauge(
 
 # -- LLM engines -------------------------------------------------------------
 # every family carries a ``replica`` label (empty for standalone engines)
-# so a fleet's per-replica series are tellable apart; cardinality is
-# bounded and each engine removes its own series on stop (scale-down must
-# not leak series — serving/fleet.py)
+# so a fleet's per-replica series are tellable apart; the TTFT/ITL/queue
+# families additionally carry a bounded ``adapter`` label ("" = base
+# model) so per-tenant SLOs and the autoscaler see tenants, not just
+# replicas (docs/serving.md "Multi-tenant LoRA"). Cardinality is
+# bounded: fleet replicas retire a stale tenant's series at scrape time
+# and remove all their own series on stop (scale-down must not leak
+# series — serving/fleet.py); standalone engines share the replica=""
+# series, where max_label_sets + overflow="drop" is the backstop
 LLM_TTFT = REGISTRY.histogram(
     "mlt_llm_ttft_seconds", "Time to first token (continuous batching)",
-    labels=("replica",), max_label_sets=128, overflow="drop")
+    labels=("replica", "adapter"), max_label_sets=256, overflow="drop")
 LLM_ITL = REGISTRY.histogram(
     "mlt_llm_itl_seconds",
     "Inter-token latency: whole scheduler iterations that produced a "
-    "decode step",
-    labels=("replica",), max_label_sets=128, overflow="drop",
+    "decode step (observed once per adapter active in the tick)",
+    labels=("replica", "adapter"), max_label_sets=256, overflow="drop",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
 LLM_DECODE_TICK = REGISTRY.histogram(
@@ -100,8 +105,12 @@ LLM_DECODE_TICK = REGISTRY.histogram(
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
 LLM_QUEUE_DEPTH = REGISTRY.gauge(
-    "mlt_llm_queue_depth", "Queued + pending admissions per engine",
-    labels=("engine", "replica"), overflow="drop")
+    "mlt_llm_queue_depth",
+    "Queued + pending admissions per engine, split by adapter (the "
+    "adapter=\"\" series carries the base/untenanted remainder, so the "
+    "sum over adapter label values is the engine's total depth)",
+    labels=("engine", "replica", "adapter"), max_label_sets=512,
+    overflow="drop")
 LLM_FREE_PAGE_FRAC = REGISTRY.gauge(
     "mlt_llm_free_page_frac",
     "Free (incl. reclaimable prefix) KV-page fraction, paged engines",
@@ -111,6 +120,21 @@ LLM_EVENTS = REGISTRY.counter(
     "Cumulative engine events mirrored from stats() (requests, completed, "
     "shed, expired, prefix_hits, prefix_evictions, ...)",
     labels=("engine", "replica", "event"), max_label_sets=1024,
+    overflow="drop")
+
+# -- multi-tenant adapters (serving/adapters.py) -----------------------------
+ADAPTER_LIVE = REGISTRY.gauge(
+    "mlt_adapter_live",
+    "LoRA adapters currently resident in the engine's device bank "
+    "(working set, base slot excluded)",
+    labels=("engine", "replica"), overflow="drop")
+ADAPTER_LOADS = REGISTRY.counter(
+    "mlt_adapter_loads_total",
+    "Adapter registry outcomes: ok (device load), evict (LRU "
+    "displacement), error (failed artifact load), capacity (429 "
+    "working-set full), unknown (404 bad tenant id), rate_limited "
+    "(per-tenant fairness shed)",
+    labels=("engine", "replica", "outcome"), max_label_sets=512,
     overflow="drop")
 
 # -- engine fleet (serving/fleet.py) -----------------------------------------

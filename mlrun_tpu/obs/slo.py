@@ -70,9 +70,27 @@ class SLO:
                  total: str = "mlt_fleet_dispatches_total",
                  total_labels: Optional[dict] = None,
                  labels: Optional[dict] = None,
-                 severity: str = "high"):
+                 severity: str = "high",
+                 adapter: Optional[str] = None):
         if kind not in _KINDS:
             raise ValueError(f"unknown SLO kind '{kind}' (one of {_KINDS})")
+        if adapter is not None:
+            # per-tenant objective sugar (docs/observability.md "SLOs &
+            # burn rates"): fold the adapter id into the latency-family
+            # label filter so the windows evaluate ONE tenant's series —
+            # a breaching tenant pages without painting its neighbors
+            # red. Latency-only: the TTFT/ITL families carry the
+            # adapter label; the default error-rate/availability
+            # counters (fleet dispatches) do NOT, and silently matching
+            # zero series would disable the objective — counter kinds
+            # must put the adapter into bad_labels/total_labels against
+            # a family that actually carries it.
+            if kind != "latency":
+                raise ValueError(
+                    f"adapter= is latency-only sugar; a per-tenant "
+                    f"{kind} SLO needs explicit bad_labels/total_labels "
+                    f"over adapter-labeled families")
+            labels = {**(labels or {}), "adapter": adapter}
         if kind == "latency":
             if not 0 < q < 1:
                 raise ValueError(f"latency SLO needs 0 < q < 1, got {q}")
@@ -99,12 +117,13 @@ class SLO:
         self.total_labels = dict(total_labels or {})
         self.labels = dict(labels or {})
         self.severity = severity
+        self.adapter = adapter
 
     @classmethod
     def from_config(cls, config: dict) -> "SLO":
         known = ("name", "kind", "target", "family", "q", "bad",
                  "bad_labels", "total", "total_labels", "labels",
-                 "severity")
+                 "severity", "adapter")
         unknown = set(config) - set(known)
         if unknown:
             raise ValueError(
@@ -138,6 +157,8 @@ class SLO:
     def describe(self) -> dict:
         out = {"name": self.name, "kind": self.kind, "target": self.target,
                "budget": self.budget, "severity": self.severity}
+        if self.adapter is not None:
+            out["adapter"] = self.adapter
         if self.kind == "latency":
             out.update(family=self.family, q=self.q)
         else:

@@ -1,0 +1,486 @@
+"""Multi-tenant LoRA adapter serving: device bank + hot-swap registry
+(docs/serving.md "Multi-tenant LoRA").
+
+Thousands of fine-tunes sharing one base-model fleet is the scenario
+that makes scale-out economical: adapters produced by the training path
+(models/lora.py) are served per-request without dedicating a replica —
+or even a decode slot — per tenant. Three pieces:
+
+- :class:`AdapterBank` — the device-resident working set: per-target
+  stacked low-rank factors ``[n_slots, L, in, r]`` / ``[n_slots, L, r,
+  out]`` / ``[n_slots, L]`` gathered by a per-row adapter index inside
+  the batched forwards (llm.py ``_forward_with_cache``, llm_batch.py
+  ``_decode_rowwise``, paged.py ``_decode_rowwise_paged``). Slot 0 is
+  the base model (all-zero factors = zero delta), so padding rows and
+  adapterless requests ride the same compiled program. Shapes are
+  static: loading an adapter is an ``.at[slot].set`` content update,
+  never a recompile.
+- :class:`AdapterRegistry` — named adapters hot-loaded from the
+  artifact store/datastore (or an in-memory dict / callables), a
+  host-side LRU of deserialized trees in front of the device bank, and
+  refcounts pinning a resident adapter while ANY request uses it.
+  Capacity is ``mlconf.serving.llm.adapters.max_live_adapters``; typed
+  404/429 failures (:class:`UnknownAdapterError`,
+  :class:`AdapterCapacityError`) keep a bad tenant id or a full working
+  set a fast per-request error, never an engine failure. Load/evict
+  fire the ``llm.adapter_load`` chaos point.
+- :class:`TenantRateLimiter` — a token bucket per adapter id in front
+  of the shared admission queue, so one flooding tenant is shed with a
+  typed 429 (:class:`AdapterRateLimitError`) instead of starving every
+  other tenant's queue budget.
+
+Adapter identity is the NAME: the prefix cache and the fleet routing
+key are namespaced by it (serving/prefix.py), so KV computed under
+adapter A is never reused for adapter B. Names are treated as immutable
+versions (like artifact keys) — re-publishing different weights under
+the same name would serve stale prefix KV and must use a new name.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+from ..chaos import FaultPoints, fire
+from ..models.lora import (
+    DEFAULT_TARGETS,
+    LoraShapeError,
+    lora_rank,
+    validate_lora,
+)
+from .resilience import AdmissionRejected, ResilienceError
+
+
+# -- errors ------------------------------------------------------------------
+class AdapterError(ResilienceError):
+    """Base for adapter-registry failures (per-request, never fatal to
+    the engine)."""
+
+
+class UnknownAdapterError(AdapterError):
+    """The request names an adapter no source can provide — a client
+    error (404), rejected at submit() before any queueing."""
+
+    status_code = 404
+
+
+class AdapterCapacityError(AdapterError, AdmissionRejected):
+    """Every device bank slot is pinned by in-flight requests of OTHER
+    adapters — retry later (429), or raise
+    ``mlconf.serving.llm.adapters.max_live_adapters``."""
+
+    status_code = 429
+
+
+class AdapterRateLimitError(AdmissionRejected):
+    """The tenant's token bucket is empty — per-adapter admission
+    fairness shed this request (429) so one flooding tenant cannot
+    starve the shared queue."""
+
+    status_code = 429
+
+
+# -- artifact (de)serialization ----------------------------------------------
+def save_adapter(target_path: str, lora: dict):
+    """Serialize an adapter tree to one ``.npz`` at ``target_path``
+    (datastore url or local path) — the artifact the registry hot-loads.
+    Keys are ``<target>/<factor>``, e.g. ``wq/lora_a``."""
+    import numpy as np
+
+    validate_lora(lora)
+    flat = {}
+    for target, adapter in lora.items():
+        for key in ("lora_a", "lora_b", "scaling"):
+            flat[f"{target}/{key}"] = np.asarray(adapter[key])
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    if "://" in target_path:
+        from ..datastore import store_manager
+
+        store_manager.object(url=target_path).put(buf.getvalue())
+    else:
+        with open(target_path, "wb") as fp:
+            fp.write(buf.getvalue())
+
+
+def load_adapter(path: str) -> dict:
+    """Inverse of :func:`save_adapter`: read an ``.npz`` adapter artifact
+    from the datastore (``store://``/``s3://``/... urls ride DataItem,
+    composing with the ``datastore.read`` chaos point) or a local path,
+    back into the ``{target: {lora_a, lora_b, scaling}}`` tree."""
+    import numpy as np
+
+    if "://" in path:
+        from ..datastore import store_manager
+
+        data = store_manager.object(url=path).get()
+    else:
+        with open(path, "rb") as fp:
+            data = fp.read()
+    blob = np.load(io.BytesIO(data))
+    lora: dict = {}
+    for key in blob.files:
+        target, factor = key.rsplit("/", 1)
+        lora.setdefault(target, {})[factor] = blob[key]
+    return lora
+
+
+# -- device bank -------------------------------------------------------------
+class AdapterBank:
+    """Stacked per-target LoRA factors on device, indexed by bank slot.
+
+    ``tensors[target] = {"lora_a": [S, L, in, r], "lora_b": [S, L, r,
+    out], "scaling": [S, L]}`` with S = 1 + max_live (slot 0 = base,
+    all zeros). The batched forwards gather rows by a per-request /
+    per-decode-row slot index, so every batch row applies its own
+    (A, B) delta inside ONE compiled program.
+    """
+
+    def __init__(self, config, max_live: int, rank: int,
+                 targets: Sequence[str] = DEFAULT_TARGETS):
+        import jax.numpy as jnp
+
+        from ..models.lora import _PROJ_DIMS
+
+        if max_live < 1:
+            raise ValueError(f"max_live must be >= 1, got {max_live}")
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        self.config = config
+        self.max_live = int(max_live)
+        self.n_slots = self.max_live + 1
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+        tensors = {}
+        for target in self.targets:
+            if target not in _PROJ_DIMS:
+                raise LoraShapeError(f"unknown lora target '{target}'")
+            d_in, d_out = _PROJ_DIMS[target](config)
+            tensors[target] = {
+                "lora_a": jnp.zeros(
+                    (self.n_slots, config.n_layers, d_in, rank),
+                    jnp.float32),
+                "lora_b": jnp.zeros(
+                    (self.n_slots, config.n_layers, rank, d_out),
+                    jnp.float32),
+                "scaling": jnp.zeros((self.n_slots, config.n_layers),
+                                     jnp.float32),
+            }
+        self.tensors = tensors
+
+    def load_slot(self, slot: int, lora: dict):
+        """Write one adapter's factors into bank slot ``slot`` (content
+        update — shapes are static, nothing recompiles). Validates
+        rank/targets/shape agreement first (LoraShapeError on drift)."""
+        import jax.numpy as jnp
+
+        if not 1 <= slot < self.n_slots:
+            raise ValueError(f"bank slot {slot} out of range "
+                             f"[1, {self.n_slots})")
+        validate_lora(lora, config=self.config, rank=self.rank,
+                      targets=self.targets)
+        tensors = {t: dict(parts) for t, parts in self.tensors.items()}
+        for target in self.targets:
+            adapter = lora.get(target)
+            for key in ("lora_a", "lora_b", "scaling"):
+                if adapter is None:
+                    # an adapter may train fewer targets than the bank
+                    # carries — absent targets contribute a zero delta
+                    row = jnp.zeros_like(tensors[target][key][slot])
+                else:
+                    row = jnp.asarray(adapter[key], jnp.float32)
+                tensors[target][key] = tensors[target][key].at[slot].set(row)
+        self.tensors = tensors
+
+
+class _Resident:
+    __slots__ = ("slot", "refcount", "loaded", "last_used")
+
+    def __init__(self, slot: int):
+        self.slot = slot
+        self.refcount = 0
+        self.loaded = False
+        self.last_used = 0
+
+
+class AdapterRegistry:
+    """Named adapters behind a bounded device working set.
+
+    ``sources`` maps adapter name -> one of: a ready adapter tree
+    (dict), a datastore/local path string (loaded via
+    :func:`load_adapter`), or a zero-arg callable returning the tree.
+    Deserialized trees sit in a host-side LRU (``host_cache`` entries)
+    so an evicted-then-reused adapter re-lands in the bank without
+    another artifact fetch.
+
+    Thread-safe. ``pin``/``unpin`` bracket a request's lifetime (the
+    engines attach unpin as a future done-callback, so every completion
+    path — result, shed, expiry, stop — releases exactly once);
+    ``ensure_loaded`` runs on the engine's scheduler thread (the single
+    device owner) and performs the actual bank write.
+    """
+
+    def __init__(self, config, sources: Optional[dict] = None,
+                 max_live: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 targets: Optional[Sequence[str]] = None,
+                 host_cache: Optional[int] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        from ..config import mlconf
+
+        conf = mlconf.serving.llm.adapters
+        self.sources = dict(sources or {})
+        if max_live is None:
+            max_live = int(conf.max_live_adapters)
+        if host_cache is None:
+            host_cache = int(conf.host_cache)
+        self._now = now_fn
+        self._lock = threading.RLock()
+        # serializes device-bank writes: a registry SHARED across
+        # engines sees ensure_loaded from several scheduler threads,
+        # and load_slot's read-modify-write must not lose updates
+        self._bank_lock = threading.Lock()
+        self._host_cache: OrderedDict[str, dict] = OrderedDict()
+        self._host_cache_max = max(1, int(host_cache))
+        self._residents: dict[str, _Resident] = {}
+        self._tick = 0
+        self.stats = {"adapter_loads": 0, "adapter_evictions": 0,
+                      "adapter_load_errors": 0,
+                      "adapter_rejected_capacity": 0,
+                      "adapter_rejected_unknown": 0}
+        if rank is None or targets is None:
+            inferred = self._infer_shape()
+            rank = rank if rank is not None else inferred[0]
+            targets = targets if targets is not None else inferred[1]
+        self.bank = AdapterBank(config, max_live, rank, targets)
+        self._free_slots = list(range(1, self.bank.n_slots))
+
+    def _infer_shape(self) -> tuple[int, tuple]:
+        """Rank/targets from the first eagerly-available source (lazy
+        path/callable sources force one load — the bank's static shapes
+        must exist before traffic)."""
+        for name in self.sources:
+            lora = self._load_params(name)
+            return lora_rank(lora), tuple(lora.keys())
+        raise ValueError(
+            "cannot size the adapter bank: no sources to infer "
+            "rank/targets from — pass rank= (and targets=) explicitly")
+
+    # -- host-side loading ---------------------------------------------------
+    def known(self, name: str) -> bool:
+        with self._lock:
+            return name in self.sources or name in self._host_cache
+
+    def check_known(self, name: str):
+        """Typed 404 for an unknown name (counted) — the submit-path
+        gate that must run BEFORE any rate-limit bucket is touched."""
+        if not self.known(name):
+            with self._lock:
+                self.stats["adapter_rejected_unknown"] += 1
+            raise UnknownAdapterError(f"unknown adapter '{name}'")
+
+    def _load_params(self, name: str) -> dict:
+        with self._lock:
+            cached = self._host_cache.get(name)
+            if cached is not None:
+                self._host_cache.move_to_end(name)
+                return cached
+            source = self.sources.get(name)
+        if source is None:
+            raise UnknownAdapterError(f"unknown adapter '{name}'")
+        if callable(source):
+            lora = source()
+        elif isinstance(source, str):
+            lora = load_adapter(source)
+        else:
+            lora = source
+        with self._lock:
+            self._host_cache[name] = lora
+            self._host_cache.move_to_end(name)
+            while len(self._host_cache) > self._host_cache_max:
+                self._host_cache.popitem(last=False)
+        return lora
+
+    # -- device residency ----------------------------------------------------
+    def pinned_counts(self) -> dict:
+        """{adapter: in-flight refcount} snapshot (per-tenant queue-depth
+        telemetry)."""
+        with self._lock:
+            return {name: r.refcount for name, r in self._residents.items()
+                    if r.refcount > 0}
+
+    def live(self) -> int:
+        """Adapters currently loaded in the device bank."""
+        with self._lock:
+            return sum(1 for r in self._residents.values() if r.loaded)
+
+    def resident_names(self) -> list:
+        with self._lock:
+            return sorted(self._residents)
+
+    def pin(self, name: str):
+        """Reserve a bank slot for ``name`` and take one in-flight
+        reference. Raises :class:`UnknownAdapterError` (404) or, when
+        every slot is pinned by other adapters' in-flight requests,
+        :class:`AdapterCapacityError` (429). Never touches the device —
+        bookkeeping only, safe from any submit thread."""
+        if not name:
+            return
+        with self._lock:
+            self._tick += 1
+            resident = self._residents.get(name)
+            if resident is not None:
+                resident.refcount += 1
+                resident.last_used = self._tick
+                return
+            if not self.known(name):
+                self.stats["adapter_rejected_unknown"] += 1
+                raise UnknownAdapterError(f"unknown adapter '{name}'")
+            if self._free_slots:
+                slot = self._free_slots.pop()
+            else:
+                victim = min(
+                    (r for r in self._residents.values()
+                     if r.refcount == 0),
+                    key=lambda r: r.last_used, default=None)
+                if victim is None:
+                    self.stats["adapter_rejected_capacity"] += 1
+                    raise AdapterCapacityError(
+                        f"all {self.bank.max_live} adapter slots are "
+                        f"pinned by in-flight requests — cannot load "
+                        f"'{name}' (raise max_live_adapters or retry)")
+                victim_name = next(n for n, r in self._residents.items()
+                                   if r is victim)
+                del self._residents[victim_name]
+                slot = victim.slot
+                self.stats["adapter_evictions"] += 1
+                try:
+                    fire(FaultPoints.llm_adapter_load, op="evict",
+                         adapter=victim_name, slot=slot)
+                except BaseException:
+                    # an armed error must not leak the freed slot
+                    self._free_slots.append(slot)
+                    raise
+            resident = _Resident(slot)
+            resident.refcount = 1
+            resident.last_used = self._tick
+            self._residents[name] = resident
+
+    def unpin(self, name: str):
+        if not name:
+            return
+        with self._lock:
+            resident = self._residents.get(name)
+            if resident is not None and resident.refcount > 0:
+                resident.refcount -= 1
+
+    def ensure_loaded(self, name: str) -> int:
+        """Materialize a pinned adapter in the device bank; returns its
+        bank slot. Called on the scheduler thread at admission (the
+        single device owner). A failed load marks the slot free again
+        and raises — failing ONE request, never the engine."""
+        if not name:
+            return 0
+        with self._lock:
+            self._tick += 1
+            resident = self._residents.get(name)
+            if resident is None:
+                raise UnknownAdapterError(
+                    f"adapter '{name}' is not pinned (internal ordering "
+                    f"bug: pin() must precede ensure_loaded())")
+            resident.last_used = self._tick
+            if resident.loaded:
+                return resident.slot
+            slot = resident.slot
+        try:
+            fire(FaultPoints.llm_adapter_load, op="load", adapter=name,
+                 slot=slot)
+            lora = self._load_params(name)
+            with self._bank_lock:
+                # re-validate slot ownership before the write: the
+                # fetch above ran without locks, and with a SHARED
+                # registry the resident can lose its pins (engine stop
+                # fails its futures) and be evicted-and-reassigned
+                # meanwhile — a stale write here would overwrite the
+                # new tenant's factors while its resident still reads
+                # loaded=True. (A live request's pin prevents eviction,
+                # so this only trips under teardown/contention.)
+                with self._lock:
+                    current = self._residents.get(name)
+                    if current is not resident or current.slot != slot:
+                        raise AdapterCapacityError(
+                            f"adapter '{name}' lost its bank slot "
+                            f"during load (evicted under contention) — "
+                            f"retry")
+                self.bank.load_slot(slot, lora)
+        except Exception:
+            # keep the resident (slot stays reserved, loaded=False):
+            # OTHER requests may hold pins on it, and their admissions
+            # simply retry the load — a transient fetch failure fails
+            # one request, not every concurrently-pinned one. With all
+            # pins released the unloaded resident is refcount-0 and LRU
+            # eviction reclaims the slot normally.
+            with self._lock:
+                self.stats["adapter_load_errors"] += 1
+            raise
+        with self._lock:
+            resident.loaded = True
+            self.stats["adapter_loads"] += 1
+        return resident.slot
+
+    def slot_of(self, name: str) -> int:
+        if not name:
+            return 0
+        with self._lock:
+            resident = self._residents.get(name)
+            if resident is None or not resident.loaded:
+                raise UnknownAdapterError(
+                    f"adapter '{name}' is not device-resident")
+            return resident.slot
+
+
+# -- per-tenant admission fairness -------------------------------------------
+class TenantRateLimiter:
+    """One token bucket per adapter id (the base model, adapter "", is a
+    tenant too). ``rate`` tokens/second refill up to ``burst``; an empty
+    bucket sheds with :class:`AdapterRateLimitError` BEFORE the shared
+    queue, so a flooding tenant consumes its own budget, not the fleet's
+    queue capacity."""
+
+    def __init__(self, rate: float, burst: float,
+                 now_fn: Callable[[], float] = time.monotonic):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._buckets: dict[str, list] = {}   # tenant -> [tokens, last_t]
+
+    def try_acquire(self, tenant: str) -> bool:
+        now = self._now()
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = [self.burst, now]
+                self._buckets[tenant] = bucket
+            tokens = min(self.burst,
+                         bucket[0] + (now - bucket[1]) * self.rate)
+            bucket[1] = now
+            if tokens < 1.0:
+                bucket[0] = tokens
+                return False
+            bucket[0] = tokens - 1.0
+            return True
+
+    def check(self, tenant: str):
+        if not self.try_acquire(tenant):
+            raise AdapterRateLimitError(
+                f"tenant '{tenant or '<base>'}' is over its admission "
+                f"rate ({self.rate}/s, burst {self.burst}) — shed to "
+                f"protect the shared queue")

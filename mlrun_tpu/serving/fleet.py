@@ -70,14 +70,21 @@ _FLEET_SEQUENCE = iter(range(1, 1 << 30))
 
 def redispatchable(exc: Exception) -> bool:
     """Failures worth re-routing to another replica: the REPLICA is
-    unavailable (stopped, draining, breaker-open, shedding) — not the
-    request (400-class stays fatal). Remote process replicas surface the
+    unavailable (stopped, draining, breaker-open, shedding, adapter
+    working-set full) — not the request (400-class, unknown tenant,
+    and per-tenant rate-limit sheds stay fatal: those follow the
+    request wherever it routes). Remote process replicas surface the
     same classes as ``RemoteCallError`` with a 429/502/503 status."""
     if isinstance(exc, (EngineStoppedError, ServerDrainingError,
                         QueueFullError, CircuitOpenError)):
         return True
+    from .adapters import AdapterCapacityError
     from .remote import RemoteCallError
 
+    if isinstance(exc, AdapterCapacityError):
+        # THIS replica's bank slots are all pinned — another replica
+        # (its own registry, its own slots) may well have room
+        return True
     if isinstance(exc, RemoteCallError):
         return getattr(exc, "status_code", None) in (429, 502, 503)
     return False
@@ -373,9 +380,14 @@ class EngineFleet:
         self.stop()
 
     # -- routing -------------------------------------------------------------
-    def routing_key(self, prompt_tokens) -> int:
+    def routing_key(self, prompt_tokens, adapter: str = "") -> int:
+        """Prefix-block routing key, namespaced per tenant: the SAME
+        prompt under two adapters is two identities (its KV is not
+        shareable across them), while same-tenant shared prefixes still
+        land on one replica (docs/serving.md "Multi-tenant LoRA")."""
         return block_chain_key(prompt_tokens, self.route_block_tokens,
-                               max_blocks=self.route_blocks)
+                               max_blocks=self.route_blocks,
+                               adapter=adapter)
 
     def _pick(self, pool: dict, key: int, tried: list,
               affinity: bool) -> Optional[EngineReplica]:
@@ -412,12 +424,15 @@ class EngineFleet:
     def submit(self, prompt_tokens, max_new_tokens: int = 64,
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               max_wait: float | None = None) -> Future:
+               max_wait: float | None = None,
+               adapter: str = "") -> Future:
         """Route one request into the fleet; resolves to (tokens, stats)
         exactly like an engine future, with ``stats`` gaining ``replica``
         (and ``prefill_replica``/``prefill_s``/``handoff_bytes`` when
         disaggregated). 503-class replica failures re-dispatch to the
-        next ring node up to ``max_dispatch_attempts`` times."""
+        next ring node up to ``max_dispatch_attempts`` times.
+        ``adapter`` is the tenant id: it namespaces the routing key and
+        rides the dispatch (and any KV handoff) into the engines."""
         out: Future = Future()
         if self._stopped:
             out.set_exception(EngineStoppedError(
@@ -429,7 +444,8 @@ class EngineFleet:
             "max_new": max_new_tokens, "eos_id": eos_id,
             "sampling": (float(temperature), int(top_k), float(top_p)),
             "max_wait": max_wait,
-            "key": self.routing_key(prompt_tokens),
+            "adapter": adapter or "",
+            "key": self.routing_key(prompt_tokens, adapter=adapter or ""),
             "t0": time.perf_counter(),
             "attempts": 0, "tried": [], "tried_decode": [],
             "trace": ((span.trace_id, span.span_id)
@@ -444,10 +460,11 @@ class EngineFleet:
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None, timeout: float = 300.0,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, adapter: str = ""):
         return self.submit(prompt_tokens, max_new_tokens, eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p).result(timeout=timeout)
+                           top_p=top_p,
+                           adapter=adapter).result(timeout=timeout)
 
     def _fail(self, out: Future, state: dict, exc: Exception):
         with self._lock:
@@ -502,7 +519,8 @@ class EngineFleet:
                 state["prompt"], max_new_tokens=state["max_new"],
                 eos_id=state["eos_id"], temperature=state["sampling"][0],
                 top_k=state["sampling"][1], top_p=state["sampling"][2],
-                max_wait=state["max_wait"], _trace=state["trace"])
+                max_wait=state["max_wait"], adapter=state["adapter"],
+                _trace=state["trace"])
         except Exception as exc:  # noqa: BLE001 - routed to the client
             self._fail(out, state, exc)
             return
@@ -541,7 +559,8 @@ class EngineFleet:
                 state["prompt"], eos_id=state["eos_id"],
                 temperature=state["sampling"][0],
                 top_k=state["sampling"][1], top_p=state["sampling"][2],
-                max_wait=state["max_wait"], _trace=state["trace"])
+                max_wait=state["max_wait"], adapter=state["adapter"],
+                _trace=state["trace"])
         except Exception as exc:  # noqa: BLE001 - routed to the client
             self._fail(out, state, exc)
             return
@@ -622,6 +641,8 @@ class EngineFleet:
                   replica: EngineReplica, tokens, stats: dict):
         stats["replica"] = replica.id
         stats["dispatch_attempts"] = state["attempts"] + 1
+        if state.get("adapter"):
+            stats["adapter"] = state["adapter"]
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="ok")
         with self._lock:
             self._stats["dispatches"] += 1

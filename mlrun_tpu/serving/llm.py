@@ -82,15 +82,39 @@ def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v_cache)
 
 
+def _lora_delta(h_in, lora_target, layer, adapter_ids):
+    """Per-row low-rank delta for one projection: each batch row gathers
+    its OWN (A, B, scaling) from the stacked adapter bank
+    (serving/adapters.py AdapterBank) by its adapter slot index. Rows on
+    slot 0 (base model / padding) hit all-zero factors — a zero delta —
+    so every tenant mix runs the same compiled program. Accumulated in
+    f32 like the base einsum, so adding the delta pre-cast matches
+    ``merge_lora``-merged weights to accumulation-order rounding."""
+    a = lora_target["lora_a"][adapter_ids, layer]       # [B, in, r]
+    bb = lora_target["lora_b"][adapter_ids, layer]      # [B, r, out]
+    scaling = lora_target["scaling"][adapter_ids, layer]  # [B]
+    delta = jnp.einsum("bse,ber->bsr", h_in, a,
+                       preferred_element_type=jnp.float32)
+    delta = jnp.einsum("bsr,brh->bsh", delta, bb,
+                       preferred_element_type=jnp.float32)
+    return delta * scaling[:, None, None]
+
+
 def _forward_with_cache(config: LlamaConfig, params: Params,
                         tokens: jax.Array, cache: dict,
                         lora: Optional[Params] = None,
+                        adapter_ids: Optional[jax.Array] = None,
                         all_logits: bool = False,
                         attn_impl: str = "dense"):
     """Run tokens starting at cache['pos']; returns (logits_last, new_cache).
     ``all_logits=True`` returns [B, S, vocab] logits for every input
     position instead of just the last (speculative verification needs the
     target's distribution after each proposed token — serving/speculative.py).
+
+    ``lora``/``adapter_ids`` enable batched multi-tenant LoRA
+    (docs/serving.md "Multi-tenant LoRA"): ``lora`` is the stacked
+    adapter bank (``{target: {lora_a: [S, L, in, r], ...}}``) and
+    ``adapter_ids`` [B] selects each row's bank slot (0 = base model).
 
     ``attn_impl="flash"`` runs the attention over the cache through the
     offset-aware flash kernel (ops.attention.flash_attention_cached,
@@ -109,16 +133,19 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
         layer, lp = layer_idx_and_params
         h = rms_norm(x_in, lp["attn_norm_scale"], config.norm_eps)
 
-        def proj(h_in, w):
-            return jnp.einsum("bse,eh->bsh", h_in, w,
-                              preferred_element_type=jnp.float32
-                              ).astype(x_in.dtype)
+        def proj(h_in, w, t=None):
+            out = jnp.einsum("bse,eh->bsh", h_in, w,
+                             preferred_element_type=jnp.float32)
+            if lora is not None and t is not None and t in lora:
+                out = out + _lora_delta(h_in, lora[t], layer, adapter_ids)
+            return out.astype(x_in.dtype)
 
-        q = proj(h, lp["wq"]).reshape(b, s, config.n_heads, config.head_dim)
-        k = proj(h, lp["wk"]).reshape(b, s, config.n_kv_heads,
-                                      config.head_dim)
-        v = proj(h, lp["wv"]).reshape(b, s, config.n_kv_heads,
-                                      config.head_dim)
+        q = proj(h, lp["wq"], "wq").reshape(b, s, config.n_heads,
+                                            config.head_dim)
+        k = proj(h, lp["wk"], "wk").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
+        v = proj(h, lp["wv"], "wv").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         quantized = "k_scale" in cache
@@ -162,11 +189,11 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
             attn = _cached_attention(config, q, k_attn, v_attn, positions,
                                      max_len)
         attn = attn.reshape(b, s, config.qkv_dim)
-        x_mid = x_in + proj(attn, lp["wo"])
+        x_mid = x_in + proj(attn, lp["wo"], "wo")
         h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
-        gate = proj(h2, lp["w_gate"])
-        up = proj(h2, lp["w_up"])
-        out = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
+        gate = proj(h2, lp["w_gate"], "w_gate")
+        up = proj(h2, lp["w_up"], "w_up")
+        out = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
         return out, (k_cache, v_cache, scales)
 
     # python loop over layers: compiled once per bucket; exposes per-layer
@@ -206,7 +233,8 @@ class LLMEngine:
                  prefill_buckets: tuple = (128, 512, 1024),
                  temperature: float = 0.0, kv_dtype: str = "native",
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 attention_impl: str | None = None):
+                 attention_impl: str | None = None,
+                 adapters=None, max_live_adapters: int | None = None):
         from ..config import mlconf
         from ..ops.attention import resolve_prefill_impl
 
@@ -228,6 +256,17 @@ class LLMEngine:
         # flash prefill; decode stays dense — a 1-token q gains nothing
         # from blockwise streaming and the masked softmax is one fused op
         self.prefill_impl = resolve_prefill_impl(attention_impl)
+        # multi-tenant LoRA (docs/serving.md "Multi-tenant LoRA"):
+        # named adapters resolved per request/row through the registry
+        from .adapters import AdapterRegistry
+
+        if adapters is None:
+            self._adapters = None
+        elif isinstance(adapters, AdapterRegistry):
+            self._adapters = adapters
+        else:
+            self._adapters = AdapterRegistry(config, sources=adapters,
+                                             max_live=max_live_adapters)
 
         self._prefill = jax.jit(
             functools.partial(_forward_with_cache, config,
@@ -237,11 +276,13 @@ class LLMEngine:
             donate_argnums=(2,))
 
         # fused greedy decode: N tokens per dispatch via lax.scan
-        def decode_n(params, first_token, cache, n):
+        def decode_n(params, first_token, cache, n, lora=None,
+                     adapter_ids=None):
             def body(carry, _):
                 token, cache_in = carry
                 logits, cache_out = _forward_with_cache(
-                    config, params, token, cache_in)
+                    config, params, token, cache_in, lora=lora,
+                    adapter_ids=adapter_ids)
                 next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (next_token[:, None], cache_out), next_token
 
@@ -253,19 +294,38 @@ class LLMEngine:
                                  donate_argnums=(2,))
         self.decode_chunk = 32
 
+    def _lora_kwargs(self, slots=None) -> dict:
+        """jit kwargs threading the adapter bank + per-row slot indices
+        into the forward; empty (and compile-identical to the
+        pre-adapter programs) when no registry is attached. ``slots`` is
+        one bank slot per batch row (int or [batch] array); default all
+        rows on the base slot 0."""
+        if self._adapters is None:
+            return {}
+        import numpy as np
+
+        if slots is None:
+            ids = np.zeros((self.batch,), np.int32)
+        else:
+            ids = np.broadcast_to(
+                np.asarray(slots, np.int32), (self.batch,)).copy()
+        return {"lora": self._adapters.bank.tensors,
+                "adapter_ids": jnp.asarray(ids)}
+
     def warmup(self):
         """Compile every prefill bucket + the decode step ahead of traffic."""
         started = time.perf_counter()
+        kw = self._lora_kwargs()
         for bucket in self.prefill_buckets:
             cache = init_kv_cache(self.config, self.batch, self.max_len,
                               kv_dtype=self.kv_dtype)
             tokens = jnp.zeros((self.batch, bucket), jnp.int32)
-            logits, cache = self._prefill(self.params, tokens, cache)
+            logits, cache = self._prefill(self.params, tokens, cache, **kw)
             step_tok = jnp.zeros((self.batch, 1), jnp.int32)
-            logits, cache = self._decode(self.params, step_tok, cache)
+            logits, cache = self._decode(self.params, step_tok, cache, **kw)
             step_tok = jnp.zeros((self.batch, 1), jnp.int32)
             tokens_out, cache = self._decode_n(self.params, step_tok, cache,
-                                               self.decode_chunk)
+                                               self.decode_chunk, **kw)
             float(jnp.sum(logits))  # host fetch = real sync on the relay
         logger.info("llm engine warm", buckets=list(self.prefill_buckets),
                     warmup_s=round(time.perf_counter() - started, 2))
@@ -277,9 +337,12 @@ class LLMEngine:
         return self.max_len
 
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
-                 eos_id: int | None = None) -> tuple[list[int], dict]:
+                 eos_id: int | None = None,
+                 adapter: str = "") -> tuple[list[int], dict]:
         """Greedy/temperature generation for a single prompt (batch=1 row
-        replicated); returns (tokens, timing stats)."""
+        replicated); returns (tokens, timing stats). ``adapter`` names a
+        registry adapter applied to every row (404s typed when
+        unknown)."""
         import numpy as np
 
         prompt = np.asarray(prompt_tokens, dtype=np.int32).reshape(1, -1)
@@ -290,14 +353,47 @@ class LLMEngine:
             raise PromptTooLongError(
                 f"prompt_len {prompt_len} + max_new_tokens "
                 f"{max_new_tokens} exceeds max_len {self.max_len}")
+        if adapter and self._adapters is None:
+            from .adapters import UnknownAdapterError
+
+            raise UnknownAdapterError(
+                f"engine has no adapter registry (adapter='{adapter}')")
         bucket = self._bucket_for(prompt_len)
         padded = np.zeros((self.batch, bucket), np.int32)
         padded[:, :prompt_len] = prompt
 
         t0 = time.perf_counter()
+        kw = {}
+        if self._adapters is not None:
+            self._adapters.pin(adapter)
+        try:
+            if self._adapters is not None:
+                slot = self._adapters.ensure_loaded(adapter)
+                kw = self._lora_kwargs(slot)
+            out_tokens, ttft, t1 = self._generate_inner(
+                prompt, prompt_len, bucket, padded, max_new_tokens,
+                eos_id, t0, kw)
+        finally:
+            if self._adapters is not None:
+                self._adapters.unpin(adapter)
+        decode_time = time.perf_counter() - t1
+        stats = {
+            "ttft_s": ttft,
+            "decode_tokens_per_sec": (len(out_tokens) - 1) / decode_time
+            if decode_time > 0 and len(out_tokens) > 1 else 0.0,
+            "prompt_len": prompt_len,
+            "generated": len(out_tokens),
+        }
+        return out_tokens, stats
+
+    def _generate_inner(self, prompt, prompt_len, bucket, padded,
+                        max_new_tokens, eos_id, t0, kw):
+        import numpy as np
+
         cache = init_kv_cache(self.config, self.batch, self.max_len,
                               kv_dtype=self.kv_dtype)
-        logits, cache = self._prefill(self.params, jnp.asarray(padded), cache)
+        logits, cache = self._prefill(self.params, jnp.asarray(padded),
+                                      cache, **kw)
         # bucket padding advanced pos past prompt; rewind to prompt_len
         cache["pos"] = jnp.full((self.batch,), prompt_len, jnp.int32)
         # logits at the last *real* prompt position were computed only if
@@ -306,7 +402,7 @@ class LLMEngine:
         if prompt_len != bucket:
             cache["pos"] = jnp.full((self.batch,), prompt_len - 1, jnp.int32)
             last = jnp.asarray(prompt[:, -1:].repeat(self.batch, 0))
-            logits, cache = self._decode(self.params, last, cache)
+            logits, cache = self._decode(self.params, last, cache, **kw)
         next_token = self._sample(logits)
         jax.block_until_ready(next_token)
         ttft = time.perf_counter() - t0
@@ -320,7 +416,7 @@ class LLMEngine:
                 if eos_id is not None and out_tokens[-1] == eos_id:
                     break
                 step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
-                logits, cache = self._decode(self.params, step, cache)
+                logits, cache = self._decode(self.params, step, cache, **kw)
                 next_token = self._sample(logits)
                 out_tokens.append(int(np.asarray(next_token)[0]))
         else:
@@ -336,28 +432,26 @@ class LLMEngine:
                     break  # cache capacity: full chunk wouldn't fit
                 step = jnp.full((self.batch, 1), out_tokens[-1], jnp.int32)
                 tokens, cache = self._decode_n(self.params, step, cache,
-                                               self.decode_chunk)
+                                               self.decode_chunk, **kw)
                 chunk = np.asarray(tokens)[:, 0].tolist()[:remaining]
                 if eos_id is not None and eos_id in chunk:
                     chunk = chunk[: chunk.index(eos_id) + 1]
                 out_tokens.extend(int(t) for t in chunk)
                 remaining -= len(chunk)
-        decode_time = time.perf_counter() - t1
-        stats = {
-            "ttft_s": ttft,
-            "decode_tokens_per_sec": (len(out_tokens) - 1) / decode_time
-            if decode_time > 0 and len(out_tokens) > 1 else 0.0,
-            "prompt_len": prompt_len,
-            "generated": len(out_tokens),
-        }
-        return out_tokens, stats
+        return out_tokens, ttft, t1
 
     def generate_batch(self, prompts: list, max_new_tokens: int = 64,
-                       eos_id: int | None = None) -> tuple[list, dict]:
+                       eos_id: int | None = None,
+                       adapters: list | None = None) -> tuple[list, dict]:
         """Batched greedy generation for EQUAL-LENGTH prompts (one fused
         decode scan serves the whole batch). Mixed lengths fall back to a
         per-prompt loop — exact per-row positions/pad masking in the cache
         is R2 work.
+
+        ``adapters`` gives one registry adapter name per prompt ("" =
+        base): each batch row applies its OWN low-rank delta inside the
+        shared dispatch (docs/serving.md "Multi-tenant LoRA"); padding
+        rows ride the base slot.
 
         Engine must be built with batch >= len(prompts).
         """
@@ -370,6 +464,16 @@ class LLMEngine:
         if n > self.batch:
             raise ValueError(
                 f"{n} prompts exceed engine batch size {self.batch}")
+        if adapters is not None and len(adapters) != n:
+            raise ValueError(
+                f"adapters has {len(adapters)} entries for {n} prompts")
+        row_adapters = list(adapters or [""] * n)
+        if any(row_adapters) and self._adapters is None:
+            from .adapters import UnknownAdapterError
+
+            raise UnknownAdapterError(
+                "engine has no adapter registry "
+                f"(adapters={sorted(set(filter(None, row_adapters)))})")
         lengths = {len(p) for p in prompts}
         # sampled decoding carries host-side randomness — use the per-prompt
         # path so semantics match generate() exactly
@@ -377,8 +481,9 @@ class LLMEngine:
             outs = []
             started = time.perf_counter()
             first_ttft = None
-            for prompt in prompts:
-                tokens, stats = self.generate(prompt, max_new_tokens, eos_id)
+            for prompt, row_adapter in zip(prompts, row_adapters):
+                tokens, stats = self.generate(prompt, max_new_tokens,
+                                              eos_id, adapter=row_adapter)
                 outs.append(tokens)
                 first_ttft = first_ttft if first_ttft is not None \
                     else stats["ttft_s"]
@@ -399,14 +504,46 @@ class LLMEngine:
             padded[i, :prompt_len] = prompt
 
         t0 = time.perf_counter()
+        kw = {}
+        pinned = []
+        try:
+            if self._adapters is not None:
+                # pin every row's adapter for the whole batched dispatch;
+                # padding rows (>= n) stay on the base slot 0
+                slots = np.zeros((self.batch,), np.int32)
+                for i, row_adapter in enumerate(row_adapters):
+                    self._adapters.pin(row_adapter)
+                    pinned.append(row_adapter)
+                    slots[i] = self._adapters.ensure_loaded(row_adapter)
+                kw = self._lora_kwargs(slots)
+            out, ttft, t1, generated = self._generate_batch_inner(
+                n, prompt_len, bucket, padded, max_new_tokens, eos_id,
+                t0, kw)
+        finally:
+            if self._adapters is not None:
+                for row_adapter in pinned:
+                    self._adapters.unpin(row_adapter)
+        decode_time = time.perf_counter() - t1
+        stats = {
+            "ttft_s": ttft,
+            "decode_tokens_per_sec": generated / decode_time
+            if decode_time > 0 and generated else 0.0,
+            "batch": n,
+        }
+        return out, stats
+
+    def _generate_batch_inner(self, n, prompt_len, bucket, padded,
+                              max_new_tokens, eos_id, t0, kw):
+        import numpy as np
+
         cache = init_kv_cache(self.config, self.batch, self.max_len,
                               kv_dtype=self.kv_dtype)
         logits, cache = self._prefill(self.params, jnp.asarray(padded),
-                                      cache)
+                                      cache, **kw)
         if prompt_len != bucket:
             cache["pos"] = jnp.full((self.batch,), prompt_len - 1, jnp.int32)
             last = jnp.asarray(padded[:, prompt_len - 1:prompt_len])
-            logits, cache = self._decode(self.params, last, cache)
+            logits, cache = self._decode(self.params, last, cache, **kw)
         else:
             cache["pos"] = jnp.full((self.batch,), prompt_len, jnp.int32)
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -426,7 +563,7 @@ class LLMEngine:
                     > self.max_len:
                 break
             tokens, cache = self._decode_n(self.params, step, cache,
-                                           self.decode_chunk)
+                                           self.decode_chunk, **kw)
             chunk = np.asarray(tokens)  # [chunk, B]
             take = min(self.decode_chunk, remaining)
             for i in range(n):
@@ -439,15 +576,8 @@ class LLMEngine:
             step = tokens[-1][:, None]
             remaining -= take
             generated_so_far += self.decode_chunk  # cache rows consumed
-        decode_time = time.perf_counter() - t1
         generated = sum(len(o) for o in out) - n
-        stats = {
-            "ttft_s": ttft,
-            "decode_tokens_per_sec": generated / decode_time
-            if decode_time > 0 and generated else 0.0,
-            "batch": n,
-        }
-        return out, stats
+        return out, ttft, t1, generated
 
     def _sample(self, logits):
         if self.temperature and self.temperature > 0:
@@ -489,7 +619,11 @@ class LLMModelServer:
                          attention_impl: str | None = None,
                          replicas: int = 0,
                          prefill_replicas: int = 0,
-                         routing: str | None = None, **kw):
+                         routing: str | None = None,
+                         adapters: dict | None = None,
+                         max_live_adapters: int | None = None,
+                         adapter_rate: float | None = None,
+                         adapter_burst: float | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -525,6 +659,14 @@ class LLMModelServer:
                 self.replicas = replicas
                 self.prefill_replicas = prefill_replicas
                 self.routing = routing
+                # multi-tenant LoRA (docs/serving.md "Multi-tenant
+                # LoRA"): named adapter sources (tree | artifact path |
+                # callable), device working-set bound, and the
+                # per-tenant admission token bucket
+                self.adapters = adapters
+                self.max_live_adapters = max_live_adapters
+                self.adapter_rate = adapter_rate
+                self.adapter_burst = adapter_burst
                 self._tokenizer = None
                 self.engine = None
 
@@ -566,7 +708,11 @@ class LLMModelServer:
                                 degradation=self.degradation,
                                 prefill_chunk=self.prefill_chunk,
                                 prefix_cache=self.prefix_cache,
-                                attention_impl=self.attention_impl)
+                                attention_impl=self.attention_impl,
+                                adapters=self.adapters,
+                                max_live_adapters=self.max_live_adapters,
+                                adapter_rate=self.adapter_rate,
+                                adapter_burst=self.adapter_burst)
                         from .llm_batch import ContinuousBatchingEngine
 
                         return ContinuousBatchingEngine(
@@ -576,7 +722,11 @@ class LLMModelServer:
                             max_wait=self.max_wait,
                             degradation=self.degradation,
                             prefill_chunk=self.prefill_chunk,
-                            attention_impl=self.attention_impl)
+                            attention_impl=self.attention_impl,
+                            adapters=self.adapters,
+                            max_live_adapters=self.max_live_adapters,
+                            adapter_rate=self.adapter_rate,
+                            adapter_burst=self.adapter_burst)
 
                     if self.replicas >= 2 or self.prefill_replicas:
                         # replica fleet: prefix-affinity routing across
@@ -604,13 +754,20 @@ class LLMModelServer:
                         temperature=self.temperature,
                         top_k=self.top_k, top_p=self.top_p,
                         kv_dtype=self.kv_dtype,
-                        attention_impl=self.attention_impl)
+                        attention_impl=self.attention_impl,
+                        adapters=self.adapters,
+                        max_live_adapters=self.max_live_adapters)
                     if self._warmup:
                         self.engine.warmup()
                 self.model = self.engine
 
             def predict(self, request):
                 inputs = request["inputs"]
+                # v2 body tenant id: {"inputs": [...], "adapter": "t1"}
+                # threads through submit()/generate() to the batched
+                # multi-LoRA decode (docs/serving.md "Multi-tenant
+                # LoRA"); unknown names 404 typed, capacity/fairness 429
+                adapter = request.get("adapter", "") or ""
                 id_lists = []
                 for item in inputs:
                     if isinstance(item, str):
@@ -629,7 +786,8 @@ class LLMModelServer:
                     futures = [self.engine.submit(
                         ids, max_new_tokens=self.max_new_tokens,
                         temperature=self.temperature,
-                        top_k=self.top_k, top_p=self.top_p)
+                        top_k=self.top_k, top_p=self.top_p,
+                        adapter=adapter)
                         for ids in id_lists]
                     results = [f.result(timeout=600) for f in futures]
                     if results:
@@ -652,7 +810,8 @@ class LLMModelServer:
                     out_tokens = []
                     for ids in id_lists:
                         tokens, stats = self.engine.generate(
-                            ids, max_new_tokens=self.max_new_tokens)
+                            ids, max_new_tokens=self.max_new_tokens,
+                            adapter=adapter)
                         self.set_metric("ttft_s", stats["ttft_s"])
                         self.set_metric("decode_tps",
                                         stats["decode_tokens_per_sec"])

@@ -35,6 +35,8 @@ from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..models.llama import LlamaConfig, Params
 from ..obs import (
+    ADAPTER_LIVE,
+    ADAPTER_LOADS,
     LLM_DECODE_TICK,
     LLM_EVENTS,
     LLM_FREE_PAGE_FRAC,
@@ -60,13 +62,22 @@ from .resilience import (  # noqa: F401 - EngineStoppedError re-exported
 def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
                     cache: dict, rng: jax.Array = None,
                     temperature: jax.Array = None,
-                    top_k: jax.Array = None, top_p: jax.Array = None):
+                    top_k: jax.Array = None, top_p: jax.Array = None,
+                    lora=None, adapter_ids: jax.Array = None):
     """One decode token per row with PER-ROW positions (slots at different
     generation depths). tokens: [B, 1]; cache rows advance independently.
 
     Per-row sampling settings (temperature/top_k/top_p arrays) ride the
     same compiled program: greedy rows (temperature 0) take an exact
-    argmax via jnp.where — see serving/sampling.py."""
+    argmax via jnp.where — see serving/sampling.py.
+
+    ``lora``/``adapter_ids`` add per-row multi-tenant LoRA
+    (docs/serving.md "Multi-tenant LoRA"): each slot gathers its OWN
+    (A, B) factors from the stacked adapter bank by its [B] slot index
+    (0 = base model / inactive rows), so a mixed-tenant batch decodes in
+    one compiled program."""
+    from .llm import _lora_delta
+
     b = tokens.shape[0]
     start = cache["pos"]                      # [B]
     positions = start[:, None]                # [B, 1]
@@ -79,16 +90,19 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
         h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
 
-        def proj(h_in, w):
-            return jnp.einsum("bse,eh->bsh", h_in, w,
-                              preferred_element_type=jnp.float32
-                              ).astype(x.dtype)
+        def proj(h_in, w, t=None, _layer=layer):
+            out = jnp.einsum("bse,eh->bsh", h_in, w,
+                             preferred_element_type=jnp.float32)
+            if lora is not None and t is not None and t in lora:
+                out = out + _lora_delta(h_in, lora[t], _layer, adapter_ids)
+            return out.astype(x.dtype)
 
-        q = proj(h, lp["wq"]).reshape(b, 1, config.n_heads, config.head_dim)
-        k = proj(h, lp["wk"]).reshape(b, 1, config.n_kv_heads,
-                                      config.head_dim)
-        v = proj(h, lp["wv"]).reshape(b, 1, config.n_kv_heads,
-                                      config.head_dim)
+        q = proj(h, lp["wq"], "wq").reshape(b, 1, config.n_heads,
+                                            config.head_dim)
+        k = proj(h, lp["wk"], "wk").reshape(b, 1, config.n_kv_heads,
+                                            config.head_dim)
+        v = proj(h, lp["wv"], "wv").reshape(b, 1, config.n_kv_heads,
+                                            config.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         quantized = "k_scale" in cache
@@ -115,11 +129,11 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
         attn = _cached_attention(config, q, k_attn, v_attn, positions,
                                  cache["k"].shape[2])
         attn = attn.reshape(b, 1, config.qkv_dim)
-        x_mid = x + proj(attn, lp["wo"])
+        x_mid = x + proj(attn, lp["wo"], "wo")
         h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
-        gate = proj(h2, lp["w_gate"])
-        up = proj(h2, lp["w_up"])
-        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
+        gate = proj(h2, lp["w_gate"], "w_gate")
+        up = proj(h2, lp["w_up"], "w_up")
+        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
         new_k.append(k_cache)
         new_v.append(v_cache)
 
@@ -177,6 +191,10 @@ class KVHandoff:
     prefill_s: float = 0.0       # submit→export wall time on the prefill
     #                              replica (chunk scheduling included)
     replica: str = ""            # prefill replica id (fleet bookkeeping)
+    adapter: str = ""            # tenant id the KV was computed under —
+    #                              the decode replica MUST decode with the
+    #                              same adapter (docs/serving.md
+    #                              "Multi-tenant LoRA")
 
     def nbytes(self) -> int:
         return int(sum(arr.nbytes for arr in self.kv.values()))
@@ -220,6 +238,10 @@ class _Admission:
     # KV (imported handoff) and skips the prefill dispatch entirely
     export: bool = False
     prefilled: bool = False
+    # multi-tenant LoRA: the request's adapter name and its device bank
+    # slot (resolved at admission by AdapterRegistry.ensure_loaded)
+    adapter: str = ""
+    adapter_slot: int = 0
 
 
 @dataclass
@@ -239,6 +261,10 @@ class _Slot:
     # span emitted at finish
     trace: Optional[tuple] = None
     decode_started: float = 0.0
+    # multi-tenant LoRA: the occupying request's adapter + bank slot
+    # (the decode tick gathers per-row factors by adapter_slot)
+    adapter: str = ""
+    adapter_slot: int = 0
 
     @property
     def active(self) -> bool:
@@ -261,8 +287,12 @@ class ContinuousBatchingEngine:
                  degradation: dict | None = None,
                  prefill_chunk: int | None = None,
                  latency_window: int | None = None,
-                 attention_impl: str | None = None):
+                 attention_impl: str | None = None,
+                 adapters=None, max_live_adapters: int | None = None,
+                 adapter_rate: float | None = None,
+                 adapter_burst: float | None = None):
         from ..ops.attention import resolve_prefill_impl
+        from .adapters import AdapterRegistry, TenantRateLimiter
 
         self.config = config
         self.params = params
@@ -313,6 +343,40 @@ class ContinuousBatchingEngine:
                 llm_defaults.get("attention_impl", "auto"))
         self.attention_impl = attention_impl
         self.prefill_impl = resolve_prefill_impl(attention_impl)
+        # -- multi-tenant LoRA (docs/serving.md "Multi-tenant LoRA") -------
+        # named adapters hot-loaded from the artifact store into a
+        # device-resident bank; every prefill/decode dispatch gathers
+        # per-row (A, B) deltas by bank slot index. None = single-tenant
+        # engine, compile-identical to the pre-adapter programs.
+        if adapters is None:
+            self._adapters = None
+            self._owns_adapters = True
+        elif isinstance(adapters, AdapterRegistry):
+            # shared registry (advanced): engines share one device bank.
+            # Registry-level telemetry (mlt_adapter_*, registry stats,
+            # per-tenant queue split) is published by NO engine then —
+            # the registry's pins/loads are global, and each engine
+            # republishing them under its own labels would multiply
+            # every federated sum by the engine count.
+            self._adapters = adapters
+            self._owns_adapters = False
+        else:
+            self._adapters = AdapterRegistry(config, sources=adapters,
+                                             max_live=max_live_adapters)
+            self._owns_adapters = True
+        adapters_conf = llm_defaults.get("adapters", {})
+        if adapter_rate is None:
+            adapter_rate = float(adapters_conf.get("rate", 0.0))
+        if adapter_burst is None:
+            adapter_burst = float(adapters_conf.get("burst", 8.0))
+        # per-tenant admission fairness: a token bucket per adapter id in
+        # FRONT of the shared queue (0 = off)
+        self._tenant_limiter = (
+            TenantRateLimiter(adapter_rate, adapter_burst)
+            if adapter_rate > 0 else None)
+        # adapter label values this engine has emitted series for —
+        # removed with the rest of its series on stop()
+        self._adapter_labels_seen: set = set()
         # the admission being prefilled right now (chunked mode resumes it
         # across ticks; only ever touched by the scheduler thread)
         self._admission: Optional[_Admission] = None
@@ -379,12 +443,37 @@ class ContinuousBatchingEngine:
                        "degraded": 0, "rejected_too_long": 0,
                        "prefill_chunks": 0, "prefill_tokens_tick_max": 0,
                        "handoffs_out": 0, "handoff_bytes_out": 0,
-                       "handoffs_in": 0, "handoff_bytes_in": 0}
+                       "handoffs_in": 0, "handoff_bytes_in": 0,
+                       "adapter_rate_limited": 0}
 
     def _make_cache(self):
         """Slot KV storage (hook: the paged engine swaps in a page pool)."""
         return init_kv_cache(self.config, self.slots, self.max_len,
                              kv_dtype=self.kv_dtype)
+
+    def _lora_kwargs(self, slots=None) -> dict:
+        """jit kwargs threading the adapter bank + per-row bank-slot
+        indices into a dispatch; {} (compile-identical to the
+        pre-adapter programs) when no registry is attached. ``slots`` is
+        an int (batch=1 admission prefill) or a [slots] array (decode
+        tick); default = every row on the base slot 0."""
+        if self._adapters is None:
+            return {}
+        if slots is None:
+            ids = np.zeros((self.slots,), np.int32)
+        elif isinstance(slots, (int, np.integer)):
+            ids = np.full((1,), slots, np.int32)
+        else:
+            ids = np.asarray(slots, np.int32)
+        return {"lora": self._adapters.bank.tensors,
+                "adapter_ids": jnp.asarray(ids)}
+
+    def _slot_adapter_ids(self):
+        """Per-engine-slot bank indices for the decode dispatch (inactive
+        rows decode on the base slot — their outputs are discarded)."""
+        return np.fromiter(
+            (s.adapter_slot if s.active else 0 for s in self._slot_state),
+            np.int32, self.slots)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -445,6 +534,10 @@ class ContinuousBatchingEngine:
 
     # -- /metrics collector --------------------------------------------------
     # cumulative stats() keys mirrored as counter series at scrape time
+    # NOTE: the adapter_* stats keys are deliberately NOT mirrored here —
+    # mlt_adapter_loads_total{outcome} is their one canonical family
+    # (publishing them under mlt_llm_events_total too would double-count
+    # adapter activity in federated sums)
     _COUNTER_STATS = ("requests", "completed", "tokens_out", "shed",
                       "expired", "degraded", "rejected_too_long",
                       "prefill_chunks", "prefix_queries", "prefix_hits",
@@ -454,8 +547,9 @@ class ContinuousBatchingEngine:
 
     def _register_metrics(self):
         """Expose this engine on the process registry: queue-depth /
-        free-page-fraction gauges and the cumulative stats counters,
-        read at scrape time (weakly bound; retired on stop())."""
+        free-page-fraction gauges, the cumulative stats counters, and
+        the per-tenant adapter series, read at scrape time (weakly
+        bound; retired on stop())."""
         if self._metrics_collector is not None:
             return
         import weakref
@@ -463,20 +557,40 @@ class ContinuousBatchingEngine:
         ref = weakref.ref(self)
         name = self._obs_name
         replica = self.replica
+        # shared mutable set: the engine adds adapter label values as it
+        # serves tenants; removal drops exactly the series it created
+        adapter_labels = self._adapter_labels_seen
+        has_adapters = self._adapters is not None and self._owns_adapters
+        # the fairness limiter exists independently of any registry —
+        # its shed counter must be visible even on a base-model engine
+        has_limiter = self._tenant_limiter is not None
 
         counter_stats = self._COUNTER_STATS
 
         def remove_series():
-            LLM_QUEUE_DEPTH.remove(engine=name, replica=replica)
+            for adapter in adapter_labels | {""}:
+                LLM_QUEUE_DEPTH.remove(engine=name, replica=replica,
+                                       adapter=adapter)
             LLM_FREE_PAGE_FRAC.remove(engine=name, replica=replica)
             for key in counter_stats:
                 LLM_EVENTS.remove(engine=name, replica=replica, event=key)
+            if has_adapters:
+                ADAPTER_LIVE.remove(engine=name, replica=replica)
+                for outcome in ("ok", "evict", "error", "capacity",
+                                "unknown"):
+                    ADAPTER_LOADS.remove(engine=name, replica=replica,
+                                         outcome=outcome)
+            if has_adapters or has_limiter:
+                ADAPTER_LOADS.remove(engine=name, replica=replica,
+                                     outcome="rate_limited")
             if replica:
                 # fleet replicas own their latency-histogram series too —
                 # a scaled-down replica must not pin them; standalone
                 # engines (replica "") share one series, never removed
-                for family in (LLM_TTFT, LLM_ITL, LLM_DECODE_TICK):
-                    family.remove(replica=replica)
+                for adapter in adapter_labels | {""}:
+                    for family in (LLM_TTFT, LLM_ITL):
+                        family.remove(replica=replica, adapter=adapter)
+                LLM_DECODE_TICK.remove(replica=replica)
 
         def collect():
             engine = ref()
@@ -484,8 +598,36 @@ class ContinuousBatchingEngine:
                 remove_series()
                 return False
             stats = engine.stats
-            LLM_QUEUE_DEPTH.set(stats.get("queue_depth", 0), engine=name,
-                                replica=replica)
+            # per-tenant queue depth: every LIVE adapter (resident or
+            # active) gets its in-flight queued estimate — explicitly 0
+            # when idle, so a drained tenant's gauge can't freeze at its
+            # last busy value; "" carries the untenanted remainder, so
+            # the sum over adapter values is the engine's total depth
+            # (the autoscaler's federated sum stays correct)
+            depth = stats.get("queue_depth", 0)
+            named = engine._adapter_queue_depths()
+            live = engine._live_adapter_labels() | set(named)
+            for adapter in live:
+                LLM_QUEUE_DEPTH.set(named.get(adapter, 0), engine=name,
+                                    replica=replica, adapter=adapter)
+            LLM_QUEUE_DEPTH.set(max(0, depth - sum(named.values())),
+                                engine=name, replica=replica, adapter="")
+            # retire series of tenants that are gone (evicted, idle, no
+            # pins): lifetime ``adapter`` label values stay bounded by
+            # the resident working set, not by every tenant ever served
+            # — a rotating tenant population can't exhaust the families'
+            # label-set bounds (fleet replicas retire their TTFT/ITL
+            # series too; standalone engines share the replica="" series
+            # and leave them)
+            stale = adapter_labels - live - {""}
+            for adapter in stale:
+                LLM_QUEUE_DEPTH.remove(engine=name, replica=replica,
+                                       adapter=adapter)
+                if replica:
+                    for family in (LLM_TTFT, LLM_ITL):
+                        family.remove(replica=replica, adapter=adapter)
+            adapter_labels.difference_update(stale)
+            adapter_labels.update(live)
             frac = engine._free_page_frac()
             if frac is not None:
                 LLM_FREE_PAGE_FRAC.set(frac, engine=name, replica=replica)
@@ -493,11 +635,68 @@ class ContinuousBatchingEngine:
                 if key in stats:
                     LLM_EVENTS.set_total(stats[key], engine=name,
                                          replica=replica, event=key)
+            registry = engine._adapters if engine._owns_adapters else None
+            if registry is not None:
+                ADAPTER_LIVE.set(registry.live(), engine=name,
+                                 replica=replica)
+                reg_stats = registry.stats
+                for outcome, key in (
+                        ("ok", "adapter_loads"),
+                        ("evict", "adapter_evictions"),
+                        ("error", "adapter_load_errors"),
+                        ("capacity", "adapter_rejected_capacity"),
+                        ("unknown", "adapter_rejected_unknown")):
+                    ADAPTER_LOADS.set_total(reg_stats[key], engine=name,
+                                            replica=replica,
+                                            outcome=outcome)
+            if registry is not None or has_limiter:
+                ADAPTER_LOADS.set_total(
+                    stats.get("adapter_rate_limited", 0), engine=name,
+                    replica=replica, outcome="rate_limited")
             return None
 
         self._metrics_collector = collect
         self._remove_metric_series = remove_series
         REGISTRY.add_collector(collect)
+
+    def _adapter_queue_depths(self) -> dict:
+        """{adapter: queued-but-not-active} derived from registry pins
+        (one pin per in-flight request) minus rows already decoding —
+        consistent on every completion path because pins die with the
+        request future."""
+        if self._adapters is None or not self._owns_adapters:
+            # shared registry: pins are global across engines, so a
+            # per-engine split would claim other engines' queued work —
+            # the adapter="" series then carries this engine's full depth
+            return {}
+        pins = self._adapters.pinned_counts()
+        if not pins:
+            return {}
+        active: dict = {}
+        for slot in self._slot_state:
+            if slot.active and slot.adapter:
+                active[slot.adapter] = active.get(slot.adapter, 0) + 1
+        adm = self._admission
+        if adm is not None and adm.adapter:
+            active[adm.adapter] = active.get(adm.adapter, 0) + 1
+        return {adapter: max(0, count - active.get(adapter, 0))
+                for adapter, count in pins.items()}
+
+    def _live_adapter_labels(self) -> set:
+        """Adapter names that should keep metric series right now:
+        device residents (pinned or idle-cached) plus anything still
+        occupying a slot/admission (belt-and-braces — an active slot's
+        adapter is always pinned, hence resident)."""
+        if self._adapters is None:
+            return set()
+        live = set(self._adapters.resident_names()) \
+            if self._owns_adapters else set()
+        live.update(s.adapter for s in self._slot_state
+                    if s.active and s.adapter)
+        adm = self._admission
+        if adm is not None and adm.adapter:
+            live.add(adm.adapter)
+        return live
 
     def _unregister_metrics(self):
         """Drop the collector AND every labeled series this engine owns —
@@ -511,14 +710,21 @@ class ContinuousBatchingEngine:
     def warmup(self):
         """Compile prefill buckets, decode step, and insertion."""
         started = time.perf_counter()
+        # with a registry attached, warm the adapter-aware program
+        # structure (bank on the base slot) — the serving-time dispatch
+        # shape regardless of which tenant lands first
+        prefill_kw = self._lora_kwargs(0)
+        decode_kw = self._lora_kwargs()
         for bucket in self.prefill_buckets:
             small = init_kv_cache(self.config, 1, self.max_len,
                                   kv_dtype=self.kv_dtype)
             tokens = jnp.zeros((1, bucket), jnp.int32)
-            _, small = self._prefill(self.params, tokens, small)
+            _, small = self._prefill(self.params, tokens, small,
+                                     **prefill_kw)
             # the last-token replay used for non-bucket prompt lengths
             _, small = self._prefill(self.params,
-                                     jnp.zeros((1, 1), jnp.int32), small)
+                                     jnp.zeros((1, 1), jnp.int32), small,
+                                     **prefill_kw)
             self._cache = self._insert(self._cache, small, 0, bucket)
         if self.prefill_chunk and self.prefill_chunk not in \
                 self.prefill_buckets:
@@ -527,9 +733,10 @@ class ContinuousBatchingEngine:
                                   kv_dtype=self.kv_dtype)
             self._prefill(self.params,
                           jnp.zeros((1, self.prefill_chunk), jnp.int32),
-                          small)
+                          small, **prefill_kw)
         step = jnp.zeros((self.slots, 1), jnp.int32)
-        tok, self._cache = self._decode(self.params, step, self._cache)
+        tok, self._cache = self._decode(self.params, step, self._cache,
+                                        **decode_kw)
         float(jnp.sum(tok))  # host fetch = real sync on the relay
         # compile the sampled variant too (first sampled request must not
         # pay the compile)
@@ -537,7 +744,7 @@ class ContinuousBatchingEngine:
             self.params, step, self._cache, jax.random.PRNGKey(0),
             jnp.zeros((self.slots,), jnp.float32),
             jnp.zeros((self.slots,), jnp.int32),
-            jnp.ones((self.slots,), jnp.float32))
+            jnp.ones((self.slots,), jnp.float32), **decode_kw)
         float(jnp.sum(tok))
         self._cache["pos"] = jnp.zeros((self.slots,), jnp.int32)
         logger.info("continuous batching engine warm",
@@ -567,18 +774,26 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_tokens, max_new_tokens: int = 64,
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
-               max_wait: float | None = None, _extra=None,
-               _trace=None) -> Future:
+               max_wait: float | None = None, adapter: str = "",
+               _extra=None, _trace=None) -> Future:
         """Thread-safe request submission. ``max_wait`` overrides the
         engine-level queue-time budget for this request. The returned
         future fails FAST — QueueFullError when shedding,
         EngineStoppedError after stop/crash — never silently hangs.
+
+        ``adapter`` names a registry LoRA adapter applied to every
+        decode row of this request (docs/serving.md "Multi-tenant
+        LoRA"): unknown names fail typed 404, a pinned-full working set
+        429, and the per-tenant token bucket sheds a flooding tenant
+        429 BEFORE the shared queue.
 
         ``_extra``/``_trace`` are the fleet's internal channel: ``_extra``
         marks an export ("export") or carries an imported
         :class:`KVHandoff`; ``_trace`` overrides the thread-local span
         capture so a router dispatching from a callback thread still
         parents the engine's llm.* spans on the originating request."""
+        from .adapters import AdapterError, UnknownAdapterError
+
         future: Future = Future()
         if self._stopped and not self._running:
             cause = f": {self._crash_exc}" if self._crash_exc else ""
@@ -595,8 +810,75 @@ class ContinuousBatchingEngine:
                 f"prompt_len {prompt_len} + max_new_tokens "
                 f"{max_new_tokens} exceeds max_len {self.max_len}"))
             return future
+        adapter = adapter or ""
+        if adapter:
+            # the 404 check runs BEFORE the limiter: unknown names must
+            # never mint rate-limit buckets (an untrusted client would
+            # grow them unboundedly) and must fail 404, not 429
+            if self._adapters is None:
+                future.set_exception(UnknownAdapterError(
+                    f"engine has no adapter registry "
+                    f"(adapter='{adapter}')"))
+                return future
+            try:
+                self._adapters.check_known(adapter)
+            except AdapterError as exc:
+                future.set_exception(exc)
+                return future
+        # per-tenant fairness BEFORE the shared queue: a flooding tenant
+        # burns its own bucket, not everyone's queue capacity. The
+        # internal prefill→decode hop (an imported KVHandoff) was
+        # already charged once at its client-facing prefill admission —
+        # charging again would 429 a request whose prefill compute and
+        # handoff bytes are already spent.
+        if self._tenant_limiter is not None \
+                and not isinstance(_extra, KVHandoff) \
+                and not self._tenant_limiter.try_acquire(adapter):
+            from .adapters import AdapterRateLimitError
+
+            with self._lock:
+                self._stats["adapter_rate_limited"] += 1
+            future.set_exception(AdapterRateLimitError(
+                f"tenant '{adapter or '<base>'}' is over its admission "
+                f"rate — shed to protect the shared queue"))
+            return future
+        # the chaos point fires BEFORE the pin: an armed error here must
+        # not strand a refcount (the future below is the pin's lifetime
+        # authority, and it does not exist as a completion path yet)
         fire(FaultPoints.llm_submit, prompt_len=prompt_len,
-             max_new_tokens=max_new_tokens)
+             max_new_tokens=max_new_tokens, adapter=adapter)
+        if adapter:
+            try:
+                self._adapters.pin(adapter)
+            except AdapterError as exc:
+                future.set_exception(exc)
+                return future
+            # one pin per in-flight request, released on ANY completion
+            # path (result, shed, expiry, stop) — the future is the
+            # single lifetime authority
+            future.add_done_callback(
+                lambda _f, a=adapter: self._adapters.unpin(a))
+            try:
+                return self._enqueue(future, prompt_tokens,
+                                     max_new_tokens, eos_id, temperature,
+                                     top_k, top_p, max_wait, adapter,
+                                     _extra, _trace)
+            except Exception as exc:  # noqa: BLE001 - an exception past
+                # the pin must complete the future (that runs the unpin
+                # callback) instead of leaking a refcount forever
+                if not future.done():
+                    future.set_exception(exc)
+                return future
+        return self._enqueue(future, prompt_tokens, max_new_tokens,
+                             eos_id, temperature, top_k, top_p, max_wait,
+                             adapter, _extra, _trace)
+
+    def _enqueue(self, future: Future, prompt_tokens, max_new_tokens,
+                 eos_id, temperature, top_k, top_p, max_wait, adapter,
+                 _extra, _trace) -> Future:
+        """Pressure/degradation checks + the actual queue put (the tail
+        of :meth:`submit`, split out so the adapter-pinned path can
+        armor it)."""
         level = self.pressure_level()
         if level >= 2:
             with self._lock:
@@ -642,7 +924,7 @@ class ContinuousBatchingEngine:
                              max_new_tokens, eos_id, future,
                              time.perf_counter(),
                              (float(temperature), int(top_k), float(top_p)),
-                             expires, _trace, _extra))
+                             expires, _trace, _extra, adapter))
         if not self._running:
             self.start()
         return future
@@ -651,17 +933,18 @@ class ContinuousBatchingEngine:
     def submit_prefill(self, prompt_tokens, eos_id: int | None = None,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, max_wait: float | None = None,
-                       _trace=None) -> Future:
+                       adapter: str = "", _trace=None) -> Future:
         """Run ONLY the (chunked) prefill for a prompt; the returned future
         resolves to a :class:`KVHandoff` a decode replica can import via
         :meth:`submit_prefilled`. The prompt's KV still lands in this
-        engine's prefix cache (paged), so hot prefixes stay cache-resident
-        on the prefill pool. ``max_new_tokens=1`` bounds the paged page
-        reservation to the prompt itself."""
+        engine's prefix cache (paged) under ``adapter``'s root, so hot
+        prefixes stay cache-resident — per tenant — on the prefill pool.
+        ``max_new_tokens=1`` bounds the paged page reservation to the
+        prompt itself."""
         return self.submit(prompt_tokens, max_new_tokens=1, eos_id=eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p, max_wait=max_wait, _extra="export",
-                           _trace=_trace)
+                           top_p=top_p, max_wait=max_wait, adapter=adapter,
+                           _extra="export", _trace=_trace)
 
     def submit_prefilled(self, handoff: KVHandoff,
                          max_new_tokens: int = 64,
@@ -671,7 +954,9 @@ class ContinuousBatchingEngine:
         """Admit an already-prefilled request: the handoff's KV is imported
         into the admission slot-cache and decode starts immediately — no
         prefill dispatch ever runs on this engine, so a decode pool's tick
-        cadence is immune to fleet-wide long prompts."""
+        cadence is immune to fleet-wide long prompts. The handoff carries
+        its adapter id: decode runs under the SAME adapter the KV was
+        computed with."""
         expects_scales = self.kv_dtype == "int8"
         if ("k_scale" in handoff.kv) != expects_scales:
             raise ValueError(
@@ -682,7 +967,8 @@ class ContinuousBatchingEngine:
         return self.submit(handoff.prompt, max_new_tokens=max_new_tokens,
                            eos_id=eos_id, temperature=temperature,
                            top_k=top_k, top_p=top_p, max_wait=max_wait,
-                           _extra=handoff, _trace=_trace)
+                           adapter=handoff.adapter, _extra=handoff,
+                           _trace=_trace)
 
     def _import_small(self, handoff: KVHandoff) -> dict:
         """Deserialize a handoff into the batch=1 admission cache (the
@@ -723,7 +1009,7 @@ class ContinuousBatchingEngine:
             prompt=list(adm.prompt), first_token=adm.first_token, kv=kv,
             prompt_len=len(adm.prompt), cached_prefix=adm.base,
             sampling=adm.sampling, prefill_s=prefill_s,
-            replica=self.replica)
+            replica=self.replica, adapter=adm.adapter)
         self._release_slot_storage(adm.slot)
         with self._lock:
             self._stats["handoffs_out"] += 1
@@ -731,18 +1017,22 @@ class ContinuousBatchingEngine:
             # a prefill replica's TTFT ring IS its prefill latency — the
             # first token ships inside the handoff
             self._ttft_ring.append(prefill_s)
-        LLM_TTFT.observe(prefill_s, replica=self.replica)
+            if adm.adapter:
+                self._adapter_labels_seen.add(adm.adapter)
+        LLM_TTFT.observe(prefill_s, replica=self.replica,
+                         adapter=adm.adapter)
         if not adm.future.done():
             adm.future.set_result(handoff)
 
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None, timeout: float = 300.0,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, adapter: str = ""):
         """Synchronous convenience wrapper around submit()."""
         return self.submit(prompt_tokens, max_new_tokens, eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p).result(timeout=timeout)
+                           top_p=top_p,
+                           adapter=adapter).result(timeout=timeout)
 
     @property
     def stats(self) -> dict:
@@ -767,6 +1057,10 @@ class ContinuousBatchingEngine:
         out["queue_depth"] = self._queue_depth()
         out["pressure_level"] = self.pressure_level()
         out["speculative_enabled"] = self.speculative_enabled
+        if self._adapters is not None and self._owns_adapters:
+            out.update(self._adapters.stats)
+            out["adapter_live"] = self._adapters.live()
+            out["adapter_resident"] = self._adapters.resident_names()
         return out
 
     # -- scheduler ----------------------------------------------------------
@@ -818,8 +1112,9 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, pad_len), np.int32)
         padded[0, :take] = prompt[start:start + take]
         adm.small["pos"] = jnp.full((1,), start, jnp.int32)
+        lora_kw = self._lora_kwargs(adm.adapter_slot)
         logits, adm.small = self._prefill(self.params, jnp.asarray(padded),
-                                          adm.small)
+                                          adm.small, **lora_kw)
         adm.offset += take
         adm.chunks += 1
         with self._lock:
@@ -836,14 +1131,15 @@ class ContinuousBatchingEngine:
             adm.small["pos"] = jnp.full((1,), total - 1, jnp.int32)
             logits, adm.small = self._prefill(
                 self.params, jnp.asarray([[prompt[-1]]], dtype=jnp.int32),
-                adm.small)
+                adm.small, **lora_kw)
         adm.first_token = self._first_token(logits, adm.sampling)
         return True
 
     def _activate_slot(self, free: int, request_id: int, first_token: int,
                        max_new: int, eos_id, future, submitted: float,
                        prompt_len: int, sampling: tuple,
-                       trace: tuple | None = None):
+                       trace: tuple | None = None, adapter: str = "",
+                       adapter_slot: int = 0):
         """Fill slot bookkeeping after a successful prefill (shared by the
         dense and paged admission paths)."""
         temperature, top_k, top_p = sampling
@@ -860,10 +1156,14 @@ class ContinuousBatchingEngine:
         slot.top_k = top_k
         slot.top_p = top_p
         slot.trace = trace
+        slot.adapter = adapter
+        slot.adapter_slot = adapter_slot
         slot.decode_started = time.time()
         with self._lock:
             self._ttft_ring.append(slot.ttft)
-        LLM_TTFT.observe(slot.ttft, replica=self.replica)
+            if adapter:
+                self._adapter_labels_seen.add(adapter)
+        LLM_TTFT.observe(slot.ttft, replica=self.replica, adapter=adapter)
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
@@ -903,12 +1203,17 @@ class ContinuousBatchingEngine:
             (request_id, prompt, max_new, eos_id, future, submitted,
              sampling, expires) = item[:8]
             extra = item[9] if len(item) > 9 else None
+            adapter = item[10] if len(item) > 10 else ""
+            adapter_slot = self._resolve_adapter(adapter, future)
+            if adapter_slot is None:
+                continue  # adapter load failed — request failed typed
             try:
                 adm = _Admission(
                     slot=free, request_id=request_id, prompt=prompt,
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
-                    expires=expires, trace=item[8], claimed=time.time())
+                    expires=expires, trace=item[8], claimed=time.time(),
+                    adapter=adapter, adapter_slot=adapter_slot)
                 self._apply_directive(adm, extra)
                 if adm.small is None:
                     adm.small = init_kv_cache(self.config, 1, self.max_len,
@@ -921,6 +1226,23 @@ class ContinuousBatchingEngine:
                 if not future.done():
                     future.set_exception(exc)
                 raise
+
+    def _resolve_adapter(self, adapter: str, future: Future):
+        """Materialize the request's adapter in the device bank (on the
+        scheduler thread — the single device owner). Returns the bank
+        slot, or None after failing the request's future: a corrupt or
+        unreachable adapter artifact fails ONE request typed, never the
+        engine."""
+        if not adapter:
+            return 0
+        try:
+            return self._adapters.ensure_loaded(adapter)
+        except Exception as exc:  # noqa: BLE001 - per-request failure
+            logger.warning("adapter load failed", adapter=adapter,
+                           error=str(exc))
+            if not future.done():
+                future.set_exception(exc)
+            return None
 
     def _apply_directive(self, adm: _Admission, extra):
         """Fold the fleet directive (item[9]) into a fresh admission:
@@ -955,14 +1277,16 @@ class ContinuousBatchingEngine:
                 start=adm.claimed, attrs={
                     "slot": adm.slot, "prompt_len": len(adm.prompt),
                     "chunks": adm.chunks, "cached_prefix": adm.base,
-                    "imported": adm.prefilled, "exported": adm.export})
+                    "imported": adm.prefilled, "exported": adm.export,
+                    "adapter": adm.adapter})
         if adm.export:
             self._export_admission(adm)
             return
         self._activate_slot(adm.slot, adm.request_id, adm.first_token,
                             adm.max_new, adm.eos_id, adm.future,
                             adm.submitted, len(adm.prompt), adm.sampling,
-                            trace=adm.trace)
+                            trace=adm.trace, adapter=adm.adapter,
+                            adapter_slot=adm.adapter_slot)
 
     def _abort_admission(self, adm: _Admission):
         """Release admission-held storage (expiry mid-prefill, stop). The
@@ -1045,6 +1369,8 @@ class ContinuousBatchingEngine:
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]
+        lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
+            if self._adapters is not None else {}
         if any(self._slot_state[i].temperature > 0 for i in active):
             temp = np.zeros((self.slots,), np.float32)
             top_k = np.zeros((self.slots,), np.int32)
@@ -1057,10 +1383,11 @@ class ContinuousBatchingEngine:
             self._rng, sub = jax.random.split(self._rng)
             next_token, self._cache = self._decode_sampled(
                 self.params, jnp.asarray(last), self._cache, sub,
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                **lora_kw)
         else:
             next_token, self._cache = self._decode(
-                self.params, jnp.asarray(last), self._cache)
+                self.params, jnp.asarray(last), self._cache, **lora_kw)
         tokens_host = np.asarray(next_token)
         for i in active:
             slot = self._slot_state[i]
@@ -1132,6 +1459,11 @@ class ContinuousBatchingEngine:
                         time.sleep(0.002)  # idle: poll admissions at 2ms
                     continue
                 t_tick = time.perf_counter()
+                # per-tenant ITL: one observation per adapter active in
+                # the tick (captured BEFORE the tick — finished rows are
+                # reset inside it)
+                tick_adapters = {s.adapter for s in self._slot_state
+                                 if s.active}
                 if self._decode_tick():
                     now = time.perf_counter()
                     elapsed = now - started
@@ -1142,7 +1474,11 @@ class ContinuousBatchingEngine:
                         # excluded): the per-tick attention cost the
                         # kernel work targets
                         self._tick_ring.append(tick_s)
-                    LLM_ITL.observe(elapsed, replica=self.replica)
+                        self._adapter_labels_seen.update(
+                            a for a in tick_adapters if a)
+                    for tick_adapter in tick_adapters:
+                        LLM_ITL.observe(elapsed, replica=self.replica,
+                                        adapter=tick_adapter)
                     LLM_DECODE_TICK.observe(tick_s, replica=self.replica)
         except Exception as exc:  # noqa: BLE001 - a dead scheduler must
             # fail pending work loudly, not leave futures hanging forever

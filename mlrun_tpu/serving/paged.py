@@ -162,7 +162,8 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
                           page_table: jax.Array, pos: jax.Array,
                           rng: jax.Array = None,
                           temperature: jax.Array = None,
-                          top_k: jax.Array = None, top_p: jax.Array = None):
+                          top_k: jax.Array = None, top_p: jax.Array = None,
+                          lora=None, adapter_ids: jax.Array = None):
     """One decode token per slot against the page pool.
 
     ``attn_impl="reference"``: per layer, gather the slot's pages into a
@@ -177,13 +178,17 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
     store and read identical bits at identical positions, so greedy
     decoding is token-identical between them.
 
+    ``lora``/``adapter_ids`` add per-row multi-tenant LoRA exactly like
+    the dense ``_decode_rowwise`` (docs/serving.md "Multi-tenant LoRA"):
+    each slot gathers its own (A, B) bank factors by adapter slot index.
+
     tokens [slots, 1]; pos [slots] absolute positions.
     Returns (next_token, new_pool, new_pos).
     """
     from ..ops.norms import rms_norm
     from ..ops.paged_attention import paged_attention
     from ..ops.rotary import apply_rope, rope_table
-    from .llm import _cached_attention, _quantize_kv
+    from .llm import _cached_attention, _lora_delta, _quantize_kv
     from .sampling import sample_logits
 
     b = tokens.shape[0]
@@ -211,16 +216,19 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
         lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
         h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
 
-        def proj(h_in, w):
-            return jnp.einsum("bse,eh->bsh", h_in, w,
-                              preferred_element_type=jnp.float32
-                              ).astype(x.dtype)
+        def proj(h_in, w, t=None, _layer=layer):
+            out = jnp.einsum("bse,eh->bsh", h_in, w,
+                             preferred_element_type=jnp.float32)
+            if lora is not None and t is not None and t in lora:
+                out = out + _lora_delta(h_in, lora[t], _layer, adapter_ids)
+            return out.astype(x.dtype)
 
-        q = proj(h, lp["wq"]).reshape(b, 1, config.n_heads, config.head_dim)
-        k = proj(h, lp["wk"]).reshape(b, 1, config.n_kv_heads,
-                                      config.head_dim)
-        v = proj(h, lp["wv"]).reshape(b, 1, config.n_kv_heads,
-                                      config.head_dim)
+        q = proj(h, lp["wq"], "wq").reshape(b, 1, config.n_heads,
+                                            config.head_dim)
+        k = proj(h, lp["wk"], "wk").reshape(b, 1, config.n_kv_heads,
+                                            config.head_dim)
+        v = proj(h, lp["wv"], "wv").reshape(b, 1, config.n_kv_heads,
+                                            config.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -263,11 +271,11 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
             k_new.append(k[:, 0])
             v_new.append(v[:, 0])
         attn = attn.reshape(b, 1, config.qkv_dim)
-        x_mid = x + proj(attn, lp["wo"])
+        x_mid = x + proj(attn, lp["wo"], "wo")
         h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
-        gate = proj(h2, lp["w_gate"])
-        up = proj(h2, lp["w_up"])
-        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"])
+        gate = proj(h2, lp["w_gate"], "w_gate")
+        up = proj(h2, lp["w_up"], "w_up")
+        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
 
     x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
     head = params.get("lm_head")
@@ -318,7 +326,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  prefill_chunk: int | None = None,
                  latency_window: int | None = None,
                  prefix_cache: bool | None = None,
-                 attention_impl: str | None = None):
+                 attention_impl: str | None = None,
+                 adapters=None, max_live_adapters: int | None = None,
+                 adapter_rate: float | None = None,
+                 adapter_burst: float | None = None):
         from ..ops.paged_attention import resolve_paged_impl
 
         if max_len % page_size:
@@ -343,7 +354,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          max_wait=max_wait, degradation=degradation,
                          prefill_chunk=prefill_chunk,
                          latency_window=latency_window,
-                         attention_impl=attention_impl)
+                         attention_impl=attention_impl,
+                         adapters=adapters,
+                         max_live_adapters=max_live_adapters,
+                         adapter_rate=adapter_rate,
+                         adapter_burst=adapter_burst)
         # decode path: pallas paged kernel (page-table indexed) or the
         # gather+dense reference — resolved once, from the same knob the
         # base class resolved the prefill path from
@@ -385,13 +400,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def warmup(self):
         started = time.perf_counter()
         ids = jnp.full((self.pages_per_slot,), -1, jnp.int32)
+        prefill_kw = self._lora_kwargs(0)
+        decode_kw = self._lora_kwargs()
         for bucket in self.prefill_buckets:
             small = init_kv_cache(self.config, 1, self.max_len,
                                   kv_dtype=self.kv_dtype)
             _, small = self._prefill(
-                self.params, jnp.zeros((1, bucket), jnp.int32), small)
+                self.params, jnp.zeros((1, bucket), jnp.int32), small,
+                **prefill_kw)
             _, small = self._prefill(
-                self.params, jnp.zeros((1, 1), jnp.int32), small)
+                self.params, jnp.zeros((1, 1), jnp.int32), small,
+                **prefill_kw)
             self._pool = self._insert_paged(self._pool, small, ids)
         if self.prefill_chunk and self.prefill_chunk not in \
                 self.prefill_buckets:
@@ -399,7 +418,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                   kv_dtype=self.kv_dtype)
             self._prefill(self.params,
                           jnp.zeros((1, self.prefill_chunk), jnp.int32),
-                          small)
+                          small, **prefill_kw)
         if self._prefix is not None:
             # compile the prefix-page gather (first cache hit must not
             # pay the compile); all-(-1) ids touch no live page
@@ -412,14 +431,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         table = jnp.asarray(self._page_table)
         pos = jnp.asarray(self._pos)
         tok, self._pool, _ = self._decode_paged(
-            self.params, step, self._pool, table, pos)
+            self.params, step, self._pool, table, pos, **decode_kw)
         float(jnp.sum(tok))  # host fetch = real sync on the relay
         tok, self._pool, _ = self._decode_paged(
             self.params, step, self._pool, table, pos,
             jax.random.PRNGKey(0),
             jnp.zeros((self.slots,), jnp.float32),
             jnp.zeros((self.slots,), jnp.int32),
-            jnp.ones((self.slots,), jnp.float32))
+            jnp.ones((self.slots,), jnp.float32), **decode_kw)
         float(jnp.sum(tok))
         logger.info("paged engine warm", slots=self.slots,
                     pages=self.n_pages, page_size=self.page_size,
@@ -488,6 +507,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             (request_id, prompt, max_new, eos_id, future, submitted,
              sampling, expires) = item[:8]
             extra = item[9] if len(item) > 9 else None
+            adapter = item[10] if len(item) > 10 else ""
             prompt_len = len(prompt)
             needed = -(-(prompt_len + max_new) // self.page_size)
             if needed > self.n_pages:
@@ -503,9 +523,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             matched_nodes: list = []
             # an imported handoff arrives with its full prompt KV — a
             # local prefix match would only re-gather what the payload
-            # already carries, so imports always take fresh pages
+            # already carries, so imports always take fresh pages.
+            # Matching is per ADAPTER root: KV computed under adapter A
+            # is never served to adapter B (same-tenant hits still
+            # share — docs/serving.md "Multi-tenant LoRA")
             if self._prefix is not None and not isinstance(extra, KVHandoff):
-                matched_pages, matched_nodes = self._prefix.match(prompt)
+                matched_pages, matched_nodes = self._prefix.match(
+                    prompt, adapter=adapter)
             k = len(matched_pages)
             fresh_needed = needed - k
             available = len(self._free_pages)
@@ -517,6 +541,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 if self._prefix is not None:
                     self._prefix.release(matched_nodes)
                 return None
+            adapter_slot = self._resolve_adapter(adapter, future)
+            if adapter_slot is None:
+                # adapter load failed — request failed typed; release
+                # the match holds and move on
+                if self._prefix is not None:
+                    self._prefix.release(matched_nodes)
+                self._pending.popleft()
+                continue
             self._pending.popleft()
             fresh: list = []
             try:
@@ -537,7 +569,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
                     expires=expires, trace=item[8], claimed=time.time(),
-                    base=k * self.page_size, offset=k * self.page_size)
+                    base=k * self.page_size, offset=k * self.page_size,
+                    adapter=adapter, adapter_slot=adapter_slot)
                 adm.page_ids = ids
                 adm.pages = fresh
                 adm.prefix_nodes = matched_nodes
@@ -580,10 +613,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # pages without ever producing a hit
         if self._prefix is not None and not adm.prefilled:
             # index this prompt's freshly written full blocks for future
-            # reuse; claimed pages become cache-owned (not freed on
-            # release — they stay cached until evicted)
+            # reuse UNDER THE REQUEST'S ADAPTER ROOT; claimed pages
+            # become cache-owned (not freed on release — they stay
+            # cached until evicted)
             new_nodes, claimed = self._prefix.register(
-                adm.prompt, adm.page_ids, adm.prefix_nodes)
+                adm.prompt, adm.page_ids, adm.prefix_nodes,
+                adapter=adm.adapter)
             held.extend(new_nodes)
             if claimed:
                 claimed_set = set(claimed)
@@ -647,6 +682,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             last[i, 0] = self._slot_state[i].tokens[-1]
         table = jnp.asarray(self._page_table)
         pos = jnp.asarray(self._pos)
+        lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
+            if self._adapters is not None else {}
         if any(self._slot_state[i].temperature > 0 for i in active):
             temp = np.zeros((self.slots,), np.float32)
             top_k = np.zeros((self.slots,), np.int32)
@@ -660,10 +697,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             next_token, self._pool, _ = self._decode_paged(
                 self.params, jnp.asarray(last), self._pool, table, pos,
                 sub, jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p))
+                jnp.asarray(top_p), **lora_kw)
         else:
             next_token, self._pool, _ = self._decode_paged(
-                self.params, jnp.asarray(last), self._pool, table, pos)
+                self.params, jnp.asarray(last), self._pool, table, pos,
+                **lora_kw)
         tokens_host = np.asarray(next_token)
         with self._lock:
             # the microbench/acceptance stat: on the kernel path the tick

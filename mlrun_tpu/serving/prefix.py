@@ -34,7 +34,8 @@ import heapq
 
 
 def block_chain_key(tokens, block_tokens: int,
-                    max_blocks: int | None = None) -> int:
+                    max_blocks: int | None = None,
+                    adapter: str = "") -> int:
     """Stable 64-bit hash of a prompt's leading full ``block_tokens``-sized
     token blocks — the fleet routing key (serving/fleet.py).
 
@@ -48,10 +49,20 @@ def block_chain_key(tokens, block_tokens: int,
     route apart and re-prefill the shared blocks on both replicas).
     Prompts shorter than one full block hash their raw tokens, namespaced
     so a short prompt can never collide with a block chain. Uses sha256,
-    not ``hash()``: the key must agree across processes and runs."""
+    not ``hash()``: the key must agree across processes and runs.
+
+    ``adapter`` namespaces the key per tenant (docs/serving.md
+    "Multi-tenant LoRA"): KV computed under adapter A is useless to
+    adapter B, so the SAME prompt under different adapters must route —
+    and cache — as different identities. The empty adapter (base model)
+    hashes byte-identically to the pre-adapter key."""
     if block_tokens <= 0:
         raise ValueError(f"block_tokens must be > 0, got {block_tokens}")
     digest = hashlib.sha256()
+    if adapter:
+        digest.update(b"adapter:")
+        digest.update(adapter.encode())
+        digest.update(b"\n")
     full = len(tokens) // block_tokens
     if max_blocks is not None:
         full = min(full, int(max_blocks))
@@ -89,7 +100,12 @@ class PrefixCache:
         if page_size <= 0:
             raise ValueError(f"page_size must be > 0, got {page_size}")
         self.page_size = int(page_size)
-        self._root = _Node()
+        # one radix root per adapter id (docs/serving.md "Multi-tenant
+        # LoRA"): block-chain identity is (adapter, blocks), so KV
+        # computed under adapter A can never be matched — and served —
+        # to adapter B, while same-tenant traffic still shares. "" is
+        # the base model's root (the pre-adapter behavior).
+        self._roots: dict[str, _Node] = {"": _Node()}
         self._tick = 0          # monotonic LRU clock (deterministic)
         self._cached = 0        # page-bearing node count
         self._held = 0          # nodes with refcount > 0
@@ -98,6 +114,17 @@ class PrefixCache:
         self.hits = 0
         self.cached_tokens = 0  # prompt tokens served from cache
         self.evictions = 0
+
+    @property
+    def _root(self) -> _Node:
+        """The base model's root (back-compat accessor for tests)."""
+        return self._roots[""]
+
+    def _root_for(self, adapter: str) -> _Node:
+        root = self._roots.get(adapter)
+        if root is None:
+            root = self._roots[adapter] = _Node()
+        return root
 
     def _block(self, prompt, i: int) -> tuple:
         ps = self.page_size
@@ -110,17 +137,19 @@ class PrefixCache:
         node.last_used = self._tick
 
     # -- lookup --------------------------------------------------------------
-    def match(self, prompt) -> tuple[list[int], list[_Node]]:
-        """Longest cached chain of full blocks, capped at
-        ``(len(prompt) - 1) // page_size`` so at least one suffix token
-        remains to prefill. Increments refcounts on the matched nodes
-        (caller must :meth:`release` them when the slot frees). Returns
-        (page_ids, nodes), both possibly empty. The hit/query counters
-        are the ENGINE's to update — it may match-and-release repeatedly
-        while the head-of-line request waits for pages."""
+    def match(self, prompt,
+              adapter: str = "") -> tuple[list[int], list[_Node]]:
+        """Longest cached chain of full blocks UNDER ``adapter``'s root,
+        capped at ``(len(prompt) - 1) // page_size`` so at least one
+        suffix token remains to prefill. Increments refcounts on the
+        matched nodes (caller must :meth:`release` them when the slot
+        frees). Returns (page_ids, nodes), both possibly empty. The
+        hit/query counters are the ENGINE's to update — it may
+        match-and-release repeatedly while the head-of-line request
+        waits for pages."""
         self._tick += 1
         limit = max(0, (len(prompt) - 1) // self.page_size)
-        node = self._root
+        node = self._root_for(adapter)
         pages: list[int] = []
         nodes: list[_Node] = []
         for i in range(limit):
@@ -142,8 +171,8 @@ class PrefixCache:
                     self._held -= 1
 
     # -- registration --------------------------------------------------------
-    def register(self, prompt, page_ids,
-                 matched_nodes) -> tuple[list[_Node], list[int]]:
+    def register(self, prompt, page_ids, matched_nodes,
+                 adapter: str = "") -> tuple[list[_Node], list[int]]:
         """Index the prompt's full blocks past the matched chain, claiming
         the freshly written pages ``page_ids[i]`` for blocks that are not
         already cached. Returns (held_nodes, claimed_page_ids): claimed
@@ -155,7 +184,8 @@ class PrefixCache:
         :meth:`evictable_pages` count."""
         self._tick += 1
         k = len(matched_nodes)
-        node = matched_nodes[-1] if matched_nodes else self._root
+        node = matched_nodes[-1] if matched_nodes \
+            else self._root_for(adapter)
         full = len(prompt) // self.page_size
         held: list[_Node] = []
         claimed: list[int] = []
@@ -201,15 +231,17 @@ class PrefixCache:
         if n <= 0:
             return freed
         heap: list[tuple[int, int, _Node]] = []
+        roots = set(self._roots.values())
 
         def walk(node: _Node):
             for child in node.children.values():
                 walk(child)
-            if node is not self._root and not node.children \
+            if node not in roots and not node.children \
                     and node.refcount == 0:
                 heap.append((node.last_used, id(node), node))
 
-        walk(self._root)
+        for root in self._roots.values():
+            walk(root)
         heapq.heapify(heap)
         while heap and len(freed) < n:
             _, _, victim = heapq.heappop(heap)
@@ -220,8 +252,14 @@ class PrefixCache:
             self._cached -= 1
             self.evictions += 1
             freed.append(victim.page_id)
-            if parent is not self._root and not parent.children \
+            if parent not in roots and not parent.children \
                     and parent.refcount == 0:
                 heapq.heappush(heap,
                                (parent.last_used, id(parent), parent))
+        # drop per-adapter roots whose last chain just evicted (the base
+        # "" root stays): a rotating tenant population must not grow
+        # _roots — and the walk above — forever
+        for adapter in [a for a, root in self._roots.items()
+                        if a and not root.children]:
+            del self._roots[adapter]
         return freed

@@ -165,7 +165,9 @@ class PrefixAffinityRouter(BaseModelRouter):
         """Key on the first input's leading blocks: token lists hash
         token blocks (the radix-index identity); strings hash byte
         blocks, which is the same shared-prefix grouping one tokenizer
-        hop earlier."""
+        hop earlier. The v2 body's ``adapter`` id namespaces the key —
+        the same prompt under two tenants is two routing identities
+        (docs/serving.md "Multi-tenant LoRA")."""
         from .prefix import block_chain_key
 
         body = event.body if isinstance(event.body, dict) else {}
@@ -174,7 +176,8 @@ class PrefixAffinityRouter(BaseModelRouter):
         if isinstance(first, str):
             first = list(first.encode())
         return block_chain_key(list(first), self.route_block_tokens,
-                               max_blocks=self.route_blocks)
+                               max_blocks=self.route_blocks,
+                               adapter=str(body.get("adapter", "") or ""))
 
     def do_event(self, event, *args, **kwargs):
         from .fleet import redispatchable

@@ -6,7 +6,9 @@ drive requests through it, scrape both replicas' series over HTTP,
 federate the scrapes through ``obs.MetricsAggregator`` into a
 ``TimeSeriesStore``, read an SLO status off the windowed view, and
 assert the federation cardinality budget holds (re-scraping must not
-multiply series).
+multiply series). Finally the multi-tenant leg: a 2-tenant adapter
+engine, asserting the bounded ``adapter`` label cardinality holds
+across re-scrapes.
 
 Exits non-zero (with a reason) on the first broken contract: metrics
 exposition missing core families, the trace id not honored end to end,
@@ -135,6 +137,68 @@ def _fleet_leg(base: str):
         fleet.stop()
 
 
+def _adapter_leg(base: str):
+    """Multi-tenant smoke (docs/serving.md "Multi-tenant LoRA"): boot a
+    2-tenant engine, drive both tenants, scrape over HTTP, and assert
+    the bounded ``adapter`` label cardinality holds across re-scrapes —
+    serving the same two tenants again must not mint new series."""
+    import re
+
+    import jax
+    import requests
+
+    from mlrun_tpu.models import init_lora_nonzero, init_params, tiny_llama
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    def adapter(seed):
+        return init_lora_nonzero(config, jax.random.PRNGKey(seed), rank=4)
+
+    def scrape():
+        resp = requests.get(base + "/metrics", timeout=10)
+        if resp.status_code != 200:
+            _fail(f"/metrics returned {resp.status_code} on adapter leg")
+        return resp.text
+
+    def adapter_values(text):
+        return set(re.findall(r'adapter="([^"]*)"', text))
+
+    def drive(engine):
+        futures = [engine.submit([7, 11, 13, 17], max_new_tokens=2,
+                                 adapter=name)
+                   for name in ("smoke-a", "smoke-b") for _ in range(2)]
+        for future in futures:
+            future.result(timeout=120)
+
+    engine = PagedContinuousBatchingEngine(
+        config, params, max_len=64, slots=2, page_size=16,
+        prefill_buckets=(64,),
+        adapters={"smoke-a": adapter(1), "smoke-b": adapter(2)})
+    engine.start()
+    try:
+        drive(engine)
+        text1 = scrape()
+        values1 = adapter_values(text1)
+        if not {"smoke-a", "smoke-b"} <= values1:
+            _fail(f"per-tenant series missing from /metrics: {values1}")
+        for family in ("mlt_adapter_live", "mlt_adapter_loads_total"):
+            if f"# TYPE {family}" not in text1:
+                _fail(f"/metrics missing family {family}")
+        if 'outcome="ok"' not in text1:
+            _fail("mlt_adapter_loads_total carries no ok outcome")
+        # bounded cardinality: the same two tenants again mint NOTHING
+        drive(engine)
+        values2 = adapter_values(scrape())
+        if values2 != values1:
+            _fail(f"adapter label cardinality churned across re-scrapes: "
+                  f"{sorted(values1)} -> {sorted(values2)}")
+        return {"adapter_label_values": sorted(values1 - {""})}
+    finally:
+        engine.stop()
+
+
 def main() -> int:
     spans_path = os.path.join(tempfile.mkdtemp(prefix="obs-smoke-"),
                               "spans.jsonl")
@@ -214,6 +278,7 @@ def main() -> int:
             _fail("request latency histogram did not count the request")
 
         fleet_summary = _fleet_leg(base)
+        fleet_summary.update(_adapter_leg(base))
     finally:
         box["stop"] = True
         thread.join(timeout=5)

@@ -32,6 +32,45 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection tests (run via `make chaos`)")
 
 
+_COMPILE_CACHE_DIR = None
+
+# serving/engine suites share one persistent XLA compile cache: they
+# build dozens of near-identical tiny-model engines whose compiles
+# dominate their wall time. STRICTLY engine modules — enabling the
+# cache session-wide segfaults the trainer path (test_checkpoint's
+# preemption fit with a live device-prefetch producer thread), so
+# training modules run exactly as before.
+_COMPILE_CACHED_MODULES = {
+    "test_serving_prefix", "test_serving_fleet", "test_serving_adapters",
+    "test_serving_resilience", "test_llm_continuous", "test_llm_paged",
+    "test_llm_engine", "test_paged_attention", "test_speculative",
+    "test_observability", "test_obs_control_plane",
+}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _engine_shared_compile_cache(request, tmp_path_factory):
+    """One shared persistent compile cache across the engine-heavy
+    serving/LLM modules (allowlist above): every duplicate program after
+    the first loads its executable from disk — bit-identical results
+    (content-addressed executables), only the compile time goes away,
+    which is what keeps tier-1 inside its wall budget. Disabled on
+    module exit so non-engine modules are untouched."""
+    global _COMPILE_CACHE_DIR
+
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name not in _COMPILE_CACHED_MODULES:
+        yield None
+        return
+    from mlrun_tpu.utils import compile_cache
+
+    if _COMPILE_CACHE_DIR is None:
+        _COMPILE_CACHE_DIR = str(tmp_path_factory.mktemp("xla-cache"))
+    compile_cache.configure(_COMPILE_CACHE_DIR)
+    yield _COMPILE_CACHE_DIR
+    compile_cache.disable()
+
+
 @pytest.fixture(autouse=True)
 def _chaos_dark():
     """No armed fault survives a test — a leaked injection would poison

@@ -1,0 +1,579 @@
+"""Multi-tenant LoRA serving (serving/adapters.py + the batched
+multi-adapter decode in llm.py/llm_batch.py/paged.py): batched-vs-merged
+greedy token identity (dense + paged, through a prefix-cache hit and a
+prefill/decode KV handoff), cross-tenant prefix non-reuse, registry LRU
+eviction under ``llm.adapter_load`` chaos with in-flight pinning,
+per-tenant admission fairness, per-tenant SLOs over adapter-labeled
+windows, merge_lora validation, and the bench smoke. CPU-only,
+tier-1-fast."""
+
+import importlib.util
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mlrun_tpu.chaos import FaultPoints, chaos
+from mlrun_tpu.models import (
+    init_lora,
+    init_lora_nonzero,
+    init_params,
+    merge_lora,
+    tiny_llama,
+)
+from mlrun_tpu.models.lora import LoraShapeError, lora_param_count
+from mlrun_tpu.serving.adapters import (
+    AdapterCapacityError,
+    AdapterRateLimitError,
+    AdapterRegistry,
+    TenantRateLimiter,
+    UnknownAdapterError,
+    load_adapter,
+    save_adapter,
+)
+from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+from mlrun_tpu.serving.prefix import PrefixCache, block_chain_key
+
+
+def _adapter(cfg, seed, rank=4):
+    """A distinct nonzero adapter (init_lora's B=0 is a zero delta)."""
+    return init_lora_nonzero(cfg, jax.random.PRNGKey(seed), rank=rank,
+                             alpha=8.0)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # f32: the batched on-the-fly delta vs merged-weights comparison is a
+    # token-identity claim at accumulation-order rounding
+    cfg = tiny_llama(attention_impl="reference", dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    adapters = {"t1": _adapter(cfg, 1), "t2": _adapter(cfg, 2)}
+    merged = {name: merge_lora(params, lora)
+              for name, lora in adapters.items()}
+    return cfg, params, adapters, merged
+
+
+PROMPT = [1, 7, 3, 9, 2, 4, 6, 8, 5, 3, 1, 2]
+
+# merged-weights reference generations are pure functions of
+# (params identity, prompt, n, engine kind) — memoized so the suite
+# builds each reference engine once, not once per test (XLA compiles
+# dominate the wall time)
+_REFERENCE_MEMO: dict = {}
+
+
+def _merged_reference(cfg, merged_params, prompt, n, paged=False):
+    key = (id(merged_params), tuple(prompt), n, paged)
+    if key in _REFERENCE_MEMO:
+        return _REFERENCE_MEMO[key]
+    cls = PagedContinuousBatchingEngine if paged \
+        else ContinuousBatchingEngine
+    kwargs = {"page_size": 8} if paged else {}
+    engine = cls(cfg, merged_params, max_len=64, slots=2,
+                 prefill_buckets=(16,), **kwargs)
+    engine.start()
+    try:
+        tokens, _ = engine.generate(prompt, max_new_tokens=n)
+    finally:
+        engine.stop()
+    _REFERENCE_MEMO[key] = tokens
+    return tokens
+
+
+# -- lora validation (satellite) ---------------------------------------------
+def test_merge_lora_validates_shapes():
+    cfg = tiny_llama()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora(cfg, jax.random.PRNGKey(1), rank=4)
+    merge_lora(params, lora)  # well-formed: no raise
+    # transposed B factor (the classic broadcast-garbage bug)
+    bad = {t: dict(a) for t, a in lora.items()}
+    bad["wq"] = dict(bad["wq"],
+                     lora_b=jnp.swapaxes(bad["wq"]["lora_b"], 1, 2))
+    with pytest.raises(LoraShapeError):
+        merge_lora(params, bad)
+    # rank disagreement between A and B
+    bad = {t: dict(a) for t, a in lora.items()}
+    bad["wk"] = dict(bad["wk"], lora_b=bad["wk"]["lora_b"][:, :2])
+    with pytest.raises(LoraShapeError):
+        merge_lora(params, bad)
+    # adapter trained against a different config
+    other = tiny_llama(embed_dim=64, n_heads=2, head_dim=32, mlp_dim=128)
+    with pytest.raises(LoraShapeError):
+        merge_lora(params, init_lora(other, jax.random.PRNGKey(2), rank=4))
+    # unknown target name
+    with pytest.raises(LoraShapeError):
+        merge_lora(params, {"nope": lora["wq"]})
+    assert isinstance(LoraShapeError("x"), ValueError)  # pre-typed callers
+
+
+def test_lora_param_count_matches_init_lora():
+    cfg = tiny_llama()
+    for rank, targets in ((4, ("wq", "wk", "wv", "wo")),
+                          (8, ("wq", "w_gate", "w_down"))):
+        lora = init_lora(cfg, jax.random.PRNGKey(0), rank=rank,
+                         targets=targets)
+        actual = sum(int(a["lora_a"].size + a["lora_b"].size)
+                     for a in lora.values())
+        assert lora_param_count(cfg, rank=rank, targets=targets) == actual
+
+
+# -- registry unit behavior --------------------------------------------------
+def test_registry_pin_evict_capacity_unknown(setup):
+    cfg, params, adapters, _ = setup
+    sources = dict(adapters)
+    sources["t3"] = _adapter(cfg, 3)
+    reg = AdapterRegistry(cfg, sources=sources, max_live=2)
+    with pytest.raises(UnknownAdapterError) as exc_info:
+        reg.pin("nope")
+    assert exc_info.value.status_code == 404
+    reg.pin("t1")
+    reg.pin("t2")
+    assert reg.ensure_loaded("t1") != reg.ensure_loaded("t2")
+    assert reg.live() == 2
+    # both pinned: a third adapter cannot displace them
+    with pytest.raises(AdapterCapacityError) as exc_info:
+        reg.pin("t3")
+    assert exc_info.value.status_code == 429
+    # t1 released -> LRU refcount-0 victim for t3
+    reg.unpin("t1")
+    reg.pin("t3")
+    slot3 = reg.ensure_loaded("t3")
+    assert reg.stats["adapter_evictions"] == 1
+    assert "t1" not in reg.resident_names()
+    # re-pinning the evicted adapter reloads it (host cache hit)
+    reg.unpin("t2")
+    reg.pin("t1")
+    assert reg.ensure_loaded("t1") != slot3
+    assert reg.stats["adapter_loads"] == 4  # t1, t2, t3, t1-again
+
+
+def test_adapter_artifact_round_trip(tmp_path, setup):
+    import numpy as np
+
+    cfg, params, adapters, merged = setup
+    path = str(tmp_path / "t1.npz")
+    save_adapter(path, adapters["t1"])
+    loaded = load_adapter(path)
+    # bit-exact factor round trip — a path source through the registry
+    # therefore serves identically to the in-memory tree (the engine
+    # parity itself is test_dense_multi_adapter_parity's claim)
+    assert set(loaded) == set(adapters["t1"])
+    for target, parts in adapters["t1"].items():
+        for key in ("lora_a", "lora_b", "scaling"):
+            assert np.array_equal(loaded[target][key],
+                                  np.asarray(parts[key]))
+    # a path source hot-loads through the same registry machinery and
+    # lands in a real (non-base) bank slot
+    reg = AdapterRegistry(cfg, sources={"t1": path}, max_live=2)
+    reg.pin("t1")
+    slot = reg.ensure_loaded("t1")
+    assert slot >= 1
+    bank_row = reg.bank.tensors["wq"]["lora_a"][slot]
+    assert np.array_equal(np.asarray(bank_row),
+                          np.asarray(adapters["t1"]["wq"]["lora_a"]))
+
+
+# -- batched-vs-merged greedy parity -----------------------------------------
+def test_dense_multi_adapter_parity_and_series_lifecycle(setup):
+    cfg, params, adapters, merged = setup
+    from mlrun_tpu.obs import REGISTRY
+
+    eng = ContinuousBatchingEngine(cfg, params, max_len=64, slots=3,
+                                   prefill_buckets=(16,),
+                                   adapters=adapters)
+    eng.replica = "adapter-test-r0"  # fleet-style label: series retired
+    eng.start()
+    try:
+        # three tenants (incl. base) interleaved on ONE decode batch
+        f1 = eng.submit(PROMPT, max_new_tokens=6, adapter="t1")
+        f2 = eng.submit(PROMPT, max_new_tokens=6, adapter="t2")
+        f0 = eng.submit(PROMPT, max_new_tokens=6)
+        t1 = f1.result(timeout=300)[0]
+        t2 = f2.result(timeout=300)[0]
+        t0 = f0.result(timeout=300)[0]
+        live_text = REGISTRY.render()
+    finally:
+        eng.stop()
+    ref1 = _merged_reference(cfg, merged["t1"], PROMPT, 6)
+    ref2 = _merged_reference(cfg, merged["t2"], PROMPT, 6)
+    ref0 = _merged_reference(cfg, params, PROMPT, 6)
+    assert t1 == ref1
+    assert t2 == ref2
+    assert t0 == ref0
+    assert len({tuple(t0), tuple(t1), tuple(t2)}) == 3  # adapters diverge
+    # per-tenant series were live while serving...
+    assert 'adapter="t1"' in live_text and 'adapter="t2"' in live_text
+    assert "mlt_adapter_live" in live_text
+    assert "mlt_adapter_loads_total" in live_text
+    # ...and a stopped fleet replica retires ALL its adapter-labeled
+    # series (scale-down leaks nothing)
+    assert 'replica="adapter-test-r0"' not in REGISTRY.render()
+
+
+def test_paged_multi_adapter_parity_and_prefix_isolation(setup):
+    cfg, params, adapters, merged = setup
+    eng = PagedContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                        prefill_buckets=(16,), page_size=8,
+                                        adapters=adapters)
+    eng.start()
+    try:
+        f1 = eng.submit(PROMPT, max_new_tokens=6, adapter="t1")
+        f2 = eng.submit(PROMPT, max_new_tokens=6, adapter="t2")
+        t1 = f1.result(timeout=300)[0]
+        t2 = f2.result(timeout=300)[0]
+        # cross-tenant non-reuse: the SAME prompt under two adapters
+        # shares no prefix KV
+        assert eng.stats["prefix_hits"] == 0
+        # same-tenant re-run: prefix hit, still token-identical
+        warm, _ = eng.generate(PROMPT, max_new_tokens=6, adapter="t1")
+        stats = eng.stats
+    finally:
+        eng.stop()
+    ref1 = _merged_reference(cfg, merged["t1"], PROMPT, 6, paged=True)
+    ref2 = _merged_reference(cfg, merged["t2"], PROMPT, 6, paged=True)
+    assert t1 == ref1 and t2 == ref2 and t1 != t2
+    assert warm == ref1  # cache-hit path token-identical per tenant
+    assert stats["prefix_hits"] == 1
+    assert stats["adapter_live"] == 2
+
+
+def test_prefix_cache_unit_cross_tenant_non_reuse():
+    pc = PrefixCache(4)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    held, claimed = pc.register(prompt, [10, 11, -1], [], adapter="a")
+    assert claimed == [10, 11]
+    # tenant b sees nothing from tenant a's chain
+    assert pc.match(prompt, adapter="b") == ([], [])
+    pages, nodes = pc.match(prompt, adapter="a")
+    assert pages == [10, 11]
+    pc.release(nodes)
+    pc.release(held)
+    # eviction walks every tenant root
+    assert sorted(pc.evict(5)) == [10, 11]
+    assert pc.cached_pages() == 0
+    # the routing key is adapter-namespaced too (the fleet identity)
+    base = block_chain_key(prompt, 4, max_blocks=4)
+    assert block_chain_key(prompt, 4, max_blocks=4, adapter="a") != base
+    assert block_chain_key(prompt, 4, max_blocks=4, adapter="a") != \
+        block_chain_key(prompt, 4, max_blocks=4, adapter="b")
+    # "" namespace is byte-identical to the pre-adapter key
+    assert block_chain_key(prompt, 4, max_blocks=4, adapter="") == base
+
+
+# -- registry LRU under chaos with in-flight pinning -------------------------
+@pytest.mark.chaos
+def test_adapter_evict_never_touches_pinned_inflight(setup):
+    cfg, params, adapters, merged = setup
+    sources = dict(adapters)
+    sources["t3"] = _adapter(cfg, 3)
+    sources["t4"] = _adapter(cfg, 4)
+    evicted = []
+
+    def observe(point, ctx):
+        if ctx["op"] == "evict":
+            evicted.append(ctx["adapter"])
+
+    eng = PagedContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                        prefill_buckets=(16,), page_size=8,
+                                        adapters=sources,
+                                        max_live_adapters=2)
+    with chaos.inject(FaultPoints.llm_adapter_load, action=observe):
+        eng.start()
+        try:
+            # t1 pinned by a LONG in-flight generation...
+            long_future = eng.submit(PROMPT, max_new_tokens=40,
+                                     adapter="t1")
+            # ...while t2/t3/t4 churn through the other bank slot
+            for name in ("t2", "t3", "t4"):
+                eng.generate(PROMPT[:9], max_new_tokens=2, adapter=name)
+            long_tokens, _ = long_future.result(timeout=300)
+            stats = eng.stats
+            # stale-tenant series retirement: one scrape after the churn
+            # keeps queue-depth series only for live adapters (+ the ""
+            # remainder) — evicted tenants' label values don't accumulate
+            from mlrun_tpu.obs import LLM_QUEUE_DEPTH, REGISTRY
+
+            REGISTRY.render()
+            own_adapters = {key[2] for key in LLM_QUEUE_DEPTH._series
+                            if key[0] == eng._obs_name}
+            resident = set(eng._adapters.resident_names())
+        finally:
+            eng.stop()
+    # residency churned, but the pinned in-flight adapter was NEVER the
+    # victim and its request decoded unperturbed, token-identically
+    assert evicted and "t1" not in evicted
+    assert stats["adapter_evictions"] == len(evicted) >= 2
+    ref = _merged_reference(cfg, merged["t1"], PROMPT, 6, paged=True)
+    assert long_tokens[:6] == ref
+    assert own_adapters <= {""} | resident
+    assert "" in own_adapters  # the untenanted remainder series stays
+
+
+# -- prefill/decode disaggregation carries the adapter -----------------------
+def test_kv_handoff_carries_adapter_token_identical(setup):
+    cfg, params, adapters, merged = setup
+    from mlrun_tpu.serving.fleet import EngineFleet
+
+    def factory(role):
+        return PagedContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
+            page_size=8, adapters=adapters)
+
+    fleet = EngineFleet(factory, replicas=1, prefill_replicas=1)
+    try:
+        cold, cold_stats = fleet.generate(PROMPT, max_new_tokens=6,
+                                          adapter="t1")
+        warm, warm_stats = fleet.generate(PROMPT, max_new_tokens=6,
+                                          adapter="t1")
+        other, _ = fleet.generate(PROMPT, max_new_tokens=6, adapter="t2")
+    finally:
+        fleet.stop()
+    ref1 = _merged_reference(cfg, merged["t1"], PROMPT, 6, paged=True)
+    ref2 = _merged_reference(cfg, merged["t2"], PROMPT, 6, paged=True)
+    # prefill-pool prefill -> KV handoff -> decode-pool decode is
+    # token-identical per tenant, cold AND through a prefill-side
+    # prefix-cache hit
+    assert cold == ref1 and warm == ref1
+    assert other == ref2
+    assert cold_stats["adapter"] == "t1"
+    assert warm_stats["cached_prefix"] >= 8  # same-tenant prefill hit
+    assert warm_stats["prefill_replica"] != warm_stats["replica"]
+
+
+# -- per-tenant admission fairness -------------------------------------------
+def test_flooding_tenant_rate_limited_other_unaffected(setup):
+    cfg, params, adapters, _ = setup
+    # tiny refill rate: buckets effectively never refill within the test
+    eng = ContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                   prefill_buckets=(16,),
+                                   adapters=adapters,
+                                   adapter_rate=0.001, adapter_burst=3)
+    eng.start()
+    try:
+        flood = [eng.submit(PROMPT, max_new_tokens=2, adapter="t1")
+                 for _ in range(10)]
+        shed = [f for f in flood if f.done() and f.exception() is not None]
+        assert len(shed) == 7  # burst=3 admitted, the rest shed typed
+        for f in shed:
+            assert isinstance(f.exception(), AdapterRateLimitError)
+            assert f.exception().status_code == 429
+        # the OTHER tenant (and the base tenant) ride their own buckets
+        ok_t2 = [eng.submit(PROMPT, max_new_tokens=2, adapter="t2")
+                 for _ in range(3)]
+        ok_base = [eng.submit(PROMPT, max_new_tokens=2) for _ in range(3)]
+        for f in ok_t2 + ok_base:
+            tokens, _ = f.result(timeout=300)
+            assert len(tokens) == 2
+        stats = eng.stats
+    finally:
+        eng.stop()
+    assert stats["adapter_rate_limited"] == 7
+    assert stats["shed"] == 0  # fairness shed, not queue shed
+
+
+def test_handoff_import_not_double_rate_limited(setup):
+    """The prefill→decode hop is charged ONCE, at the client-facing
+    prefill admission — the decode-side import of a KVHandoff must not
+    draw from the tenant's bucket again (a tenant at exactly its
+    admitted rate would otherwise 429 after its prefill compute and
+    handoff bytes were already spent)."""
+    cfg, params, adapters, _ = setup
+    from mlrun_tpu.serving.fleet import EngineFleet
+
+    def factory(role):
+        return PagedContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
+            page_size=8, adapters=adapters, adapter_rate=0.001,
+            adapter_burst=3)
+
+    fleet = EngineFleet(factory, replicas=1, prefill_replicas=1)
+    try:
+        for _ in range(3):  # exactly the burst budget
+            tokens, _ = fleet.generate(PROMPT, max_new_tokens=2,
+                                       adapter="t1")
+            assert len(tokens) == 2
+        decode = next(r for r in fleet.replicas if r.role == "decode")
+        # the decode engine's limiter never saw the tenant at all
+        assert "t1" not in decode.engine._tenant_limiter._buckets
+        assert decode.engine.stats["adapter_rate_limited"] == 0
+    finally:
+        fleet.stop()
+
+
+def test_adapter_load_failure_fails_one_request_not_engine(setup):
+    """A transient artifact-fetch failure fails ONE request typed; the
+    resident survives (other pins keep their slot) and the next request
+    for the same adapter simply retries the load."""
+    cfg, params, adapters, merged = setup
+    from mlrun_tpu.chaos import fail_nth
+
+    eng = PagedContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                        prefill_buckets=(16,), page_size=8,
+                                        adapters=adapters)
+    with chaos.inject(FaultPoints.llm_adapter_load, fail_nth(1),
+                      error=RuntimeError("store down"),
+                      match=lambda ctx: ctx.get("op") == "load"):
+        eng.start()
+        try:
+            first = eng.submit(PROMPT, max_new_tokens=2, adapter="t1")
+            with pytest.raises(RuntimeError):
+                first.result(timeout=300)
+            # the engine survived and the SAME adapter loads on retry
+            retried, _ = eng.generate(PROMPT, max_new_tokens=6,
+                                      adapter="t1")
+            stats = eng.stats
+        finally:
+            eng.stop()
+    assert retried == _merged_reference(cfg, merged["t1"], PROMPT, 6,
+                                        paged=True)
+    assert stats["adapter_load_errors"] == 1
+    assert stats["adapter_loads"] >= 1
+
+
+def test_tenant_rate_limiter_refills_on_fake_clock():
+    clock = [0.0]
+    limiter = TenantRateLimiter(rate=1.0, burst=2, now_fn=lambda: clock[0])
+    assert limiter.try_acquire("a") and limiter.try_acquire("a")
+    assert not limiter.try_acquire("a")
+    assert limiter.try_acquire("b")  # independent bucket
+    clock[0] = 1.0
+    assert limiter.try_acquire("a")  # one token refilled
+    assert not limiter.try_acquire("a")
+
+
+def test_typed_rejections_resolve_futures_fast(setup):
+    cfg, params, adapters, _ = setup
+    eng = ContinuousBatchingEngine(cfg, params, max_len=64, slots=1,
+                                   prefill_buckets=(16,),
+                                   adapters=adapters,
+                                   max_live_adapters=1)
+    # unknown adapter: typed 404 without the scheduler ever running
+    future = eng.submit(PROMPT, max_new_tokens=2, adapter="nope")
+    assert future.done()
+    with pytest.raises(UnknownAdapterError):
+        future.result(timeout=0)
+    # no registry at all: adapter requests fail typed too
+    bare = ContinuousBatchingEngine(cfg, params, max_len=64, slots=1,
+                                    prefill_buckets=(16,))
+    future = bare.submit(PROMPT, max_new_tokens=2, adapter="t1")
+    assert future.done()
+    with pytest.raises(UnknownAdapterError):
+        future.result(timeout=0)
+    eng.stop()
+    bare.stop()
+    assert eng.stats["adapter_rejected_unknown"] == 1
+
+
+# -- per-tenant signal plane -------------------------------------------------
+def test_per_tenant_slo_breach_isolated():
+    from mlrun_tpu.obs import SLO, SLOEvaluator, TimeSeriesStore
+
+    store = TimeSeriesStore(resolution_s=1.0, capacity=256)
+    # tenant "slow" accumulates TTFT observations over 0.25s; tenant
+    # "fast" stays under — cumulative histogram counters per adapter
+    for t in range(0, 100, 5):
+        n = t // 5 + 1
+        for adapter, over in (("slow", True), ("fast", False)):
+            labels = {"adapter": adapter, "le": "0.25"}
+            store.record("mlt_llm_ttft_seconds_bucket",
+                         0 if over else n, at=t, kind="counter",
+                         labels=labels)
+            store.record("mlt_llm_ttft_seconds_bucket", n, at=t,
+                         kind="counter",
+                         labels={"adapter": adapter, "le": "+Inf"})
+            store.record("mlt_llm_ttft_seconds_count", n, at=t,
+                         kind="counter", labels={"adapter": adapter})
+    slos = [SLO(f"ttft-{name}", "latency", target=0.25, q=0.5,
+                adapter=name) for name in ("slow", "fast")]
+    evaluator = SLOEvaluator(store, slos, fast_window=20, slow_window=60,
+                             fast_burn=1.5, slow_burn=1.5)
+    statuses = {s["name"]: s for s in evaluator.evaluate(99.0)}
+    # one tenant breaches, the other stays green — label-filtered
+    # windows never bleed across tenants
+    assert statuses["ttft-slow"].breaching
+    assert not statuses["ttft-fast"].breaching
+    assert statuses["ttft-fast"].burn_fast == 0.0
+
+
+# -- LLMEngine (non-batching) per-row adapters -------------------------------
+def test_llm_engine_generate_batch_per_row_adapters(setup):
+    cfg, params, adapters, merged = setup
+    from mlrun_tpu.serving.llm import LLMEngine
+
+    def make(engine_params, engine_adapters=None):
+        engine = LLMEngine(cfg, engine_params, max_len=64, batch=2,
+                           prefill_buckets=(16,),
+                           adapters=engine_adapters)
+        engine.decode_chunk = 8  # smaller fused scan = smaller compile
+        return engine
+
+    eng = make(params, adapters)
+    outs, _ = eng.generate_batch([PROMPT, PROMPT], max_new_tokens=6,
+                                 adapters=["t1", "t2"])
+    # per-row deltas inside ONE fused dispatch, each row matching its
+    # own merged-weights engine
+    ref1 = make(merged["t1"]).generate(PROMPT, max_new_tokens=6)[0]
+    ref2 = make(merged["t2"]).generate(PROMPT, max_new_tokens=6)[0]
+    assert outs[0] == ref1
+    assert outs[1] == ref2
+    assert outs[0] != outs[1]
+
+
+# -- v2 request body ----------------------------------------------------------
+def test_v2_body_adapter_threads_to_engine(setup):
+    cfg, params, adapters, merged = setup
+    from mlrun_tpu.serving.llm import LLMModelServer
+
+    server = LLMModelServer(
+        None, name="lora-model", model_preset="tiny",
+        continuous_batching=True, slots=2, max_len=64,
+        max_new_tokens=6, warmup=False, adapters=adapters)
+    # the preset path re-inits params from seed 0 but with the default
+    # dtype — swap in OUR fixture engine to keep the parity claim exact
+    server.load = lambda: setattr(
+        server, "engine", _started_engine(cfg, params, adapters)) or \
+        setattr(server, "model", server.engine)
+    server.post_init()
+    try:
+        out = server.predict({"inputs": [PROMPT], "adapter": "t1"})
+        base = server.predict({"inputs": [PROMPT]})
+    finally:
+        server.engine.stop()
+    assert out[0] == _merged_reference(cfg, merged["t1"], PROMPT, 6)
+    assert base[0] == _merged_reference(cfg, params, PROMPT, 6)
+
+
+def _started_engine(cfg, params, adapters):
+    engine = ContinuousBatchingEngine(cfg, params, max_len=64, slots=2,
+                                      prefill_buckets=(16,),
+                                      adapters=adapters)
+    engine.start()
+    return engine
+
+
+# -- bench smoke (tier-1: exercises the multi-tenant path every run) ---------
+def test_bench_lora_smoke():
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench_serve.py"
+    spec = importlib.util.spec_from_file_location("bench_serve", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_lora(tenants=2, requests_per_tenant=2, prompt_tokens=12,
+                       max_new=4, page_size=8, max_len=64, slots=2,
+                       warmup=False)
+    # batched multi-adapter greedy == that tenant alone on merged weights
+    assert out["parity_ok"]
+    # structure + signal-flow claims only: the module's shared compile
+    # cache makes engine "swaps" nearly free here, so the absolute
+    # swap-dominated throughput_ratio (>1, ~30x cold) is BENCH_r09.json's
+    # claim (make bench-lora runs with cold per-engine compiles)
+    assert out["throughput_ratio"] > 0
+    assert out["sequential_incl_swap_tokens_per_sec"] > 0
+    # 1-tenant no-regression: the lora math is a bounded per-dispatch
+    # cost, not a collapse (generous bound — suite runs under CPU
+    # contention; BENCH_r09.json records ~0.9 on an idle machine)
+    assert out["one_tenant"]["throughput_ratio"] > 0.3
+    assert out["adapter_loads"] >= 2
+    assert out["multi_tokens_per_sec"] > 0
