@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-goodput obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -47,10 +47,15 @@ bench-lora:      ## multi-tenant LoRA A/B: batched multi-adapter engine vs seque
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train
 
+bench-goodput:   ## goodput/badput attribution of the train A-B (docs/observability.md "Goodput & badput"); rewrites BENCH_r10.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train --goodput > BENCH_r10.tmp \
+		&& tail -n 1 BENCH_r10.tmp > BENCH_r10.json \
+		&& rm BENCH_r10.tmp && cat BENCH_r10.json
+
 bench-attn:      ## attention kernels vs reference (flash v1/v2 + paged decode), CPU interpret mode; rewrites BENCH_ATTN_CPU.json
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_attention_cpu.py
 
-obs-smoke:       ## graph + 2-replica fleet + 2-tenant adapter smoke: scrape /metrics, federate, SLO status, adapter cardinality, span artifact (docs/observability.md)
+obs-smoke:       ## graph + fleet + adapter + training smoke: scrape /metrics, federate, SLO status, adapter cardinality, span artifact, goodput families + flight artifact on a forced preemption (docs/observability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_smoke.py
 
 dryrun:          ## multi-chip sharding dryrun on 8 virtual CPU devices

@@ -197,6 +197,9 @@ def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
         out = trainer.fit(stream, steps=steps, log_every=log_every,
                           prefetch=prefetch)
         tps = out["tokens_per_sec"]
+        # per-run goodput attribution (docs/observability.md "Goodput &
+        # badput"): fraction + per-bucket seconds from the fit's ledger
+        goodput = trainer.goodput.summary()
         return {
             "steps_per_sec": tps / (batch * seq),
             "tokens_per_sec": tps,
@@ -204,6 +207,8 @@ def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
             "compile_seconds": warm.get("compile_seconds", 0.0),
             "loss": out["loss"],
             "mfu": out["mfu"],
+            "goodput_fraction": goodput["goodput_fraction"],
+            "goodput": goodput,
         }
 
     try:
@@ -220,6 +225,11 @@ def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
             compile_cache.disable()
     ratio = (on["steps_per_sec"] / off["steps_per_sec"]
              if off["steps_per_sec"] else 0.0)
+
+    def _round(arm: dict) -> dict:
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in arm.items()}
+
     return {
         "metric": "train_prefetch_steps_per_sec_ratio",
         "value": round(ratio, 4),
@@ -227,8 +237,8 @@ def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
         # parity (1.0) is the floor: prefetch must never cost throughput
         "vs_baseline": round(ratio, 4),
         "detail": {
-            "prefetch_off": {k: round(v, 6) for k, v in off.items()},
-            "prefetch_on": {k: round(v, 6) for k, v in on.items()},
+            "prefetch_off": _round(off),
+            "prefetch_on": _round(on),
             "prefetch_depth": depth,
             "steps": steps, "batch": batch, "seq": seq,
             "input_delay_s": input_delay_s,
@@ -240,20 +250,69 @@ def run_train(steps: int = 20, batch: int = 8, seq: int = 128,
     }
 
 
+def run_goodput(**kwargs) -> dict:
+    """``bench.py --train --goodput`` (``make bench-goodput``): the same
+    A-B as ``run_train``, re-enveloped around the goodput ledger — the
+    headline is the pipelined (prefetch-on) arm's goodput fraction, the
+    detail the per-bucket badput seconds of both arms. The prefetch arm
+    should convert most ``data_wait`` badput into goodput; the compile
+    bucket dominates only because the bench run is seconds long."""
+    train = run_train(**kwargs)
+    detail = train["detail"]
+    off = detail["prefetch_off"]["goodput"]
+    on = detail["prefetch_on"]["goodput"]
+    return {
+        "metric": "train_goodput_fraction",
+        "value": round(on["goodput_fraction"], 4),
+        "unit": "fraction",
+        # the prefetch arm must not attribute WORSE than the sync arm
+        "vs_baseline": round(
+            on["goodput_fraction"] / off["goodput_fraction"], 4)
+        if off["goodput_fraction"] else 0.0,
+        "detail": {
+            "prefetch_off": {
+                "goodput_fraction": round(off["goodput_fraction"], 4),
+                "goodput_s": round(off["goodput_s"], 4),
+                "wall_s": round(off["wall_s"], 4),
+                "badput_s": {k: round(v, 4)
+                             for k, v in off["badput"].items()},
+            },
+            "prefetch_on": {
+                "goodput_fraction": round(on["goodput_fraction"], 4),
+                "goodput_s": round(on["goodput_s"], 4),
+                "wall_s": round(on["wall_s"], 4),
+                "badput_s": {k: round(v, 4)
+                             for k, v in on["badput"].items()},
+            },
+            "steps_per_sec_ratio": train["value"],
+            "attribution_closed": all(
+                abs(arm["goodput_s"] + sum(arm["badput"].values())
+                    - arm["wall_s"]) < 0.05 for arm in (off, on)),
+            "steps": detail["steps"], "batch": detail["batch"],
+            "seq": detail["seq"],
+            "input_delay_s": detail["input_delay_s"],
+        },
+    }
+
+
 def _train_main():
     import argparse
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--train", action="store_true")
+    parser.add_argument("--goodput", action="store_true",
+                        help="re-envelope the A-B around the goodput "
+                        "ledger (make bench-goodput -> BENCH_r10.json)")
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--depth", type=int, default=2)
     parser.add_argument("--input-delay-ms", type=float, default=25.0)
     args = parser.parse_args()
-    out = run_train(steps=args.steps, batch=args.batch, seq=args.seq,
-                    depth=args.depth,
-                    input_delay_s=args.input_delay_ms / 1000.0)
+    runner = run_goodput if args.goodput else run_train
+    out = runner(steps=args.steps, batch=args.batch, seq=args.seq,
+                 depth=args.depth,
+                 input_delay_s=args.input_delay_ms / 1000.0)
     print(json.dumps(out))
 
 

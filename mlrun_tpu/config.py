@@ -263,6 +263,14 @@ default_config: dict[str, Any] = {
         # names (utils/profiler.annotate) so XLA device traces join
         # request spans in TensorBoard
         "xla_annotations": True,
+        # black-box flight recorder (obs/flight.py): bounded event ring
+        # dumped as a JSONL post-mortem on crash/stall-abort/preemption
+        # and readable live at GET /debug/flight. dir "" = a mlt-flight
+        # folder under the system temp dir
+        "flight": {
+            "ring": 4096,
+            "dir": "",
+        },
         # metrics federation (obs/federation.py): per-replica scrape
         # staleness bound and the merged-view cardinality budget
         "federation": {

@@ -9,6 +9,8 @@ consumers render ``REGISTRY``.
 Naming: ``mlt_<area>_<what>[_total|_seconds]``, labels snake_case.
 """
 
+import threading as _threading
+
 from .metrics import (  # noqa: F401
     CONTENT_TYPE,
     DEFAULT_BUCKETS,
@@ -26,6 +28,21 @@ from .federation import (  # noqa: F401
     check_histogram_consistency,
     parse_prometheus,
 )
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+)
+from .flight import record as flight_record  # noqa: F401
+from .goodput import (  # noqa: F401
+    BADPUT_BUCKETS,
+    BADPUT_SECONDS,
+    GOODPUT_FRACTION,
+    GOODPUT_SECONDS,
+    WALL_SECONDS,
+    GoodputLedger,
+    record_badput,
+)
+from .stats import nearest_rank  # noqa: F401
 from .slo import (  # noqa: F401
     SLO,
     SLO_EVENT_KIND,
@@ -43,7 +60,6 @@ from .tracing import (  # noqa: F401
     TRACE_HEADER,
     Span,
     Tracer,
-    configure_from_mlconf,
     format_trace_header,
     get_tracer,
     new_trace_id,
@@ -51,6 +67,15 @@ from .tracing import (  # noqa: F401
     trace_id_for,
     tracer,
 )
+from .tracing import configure_from_mlconf as _configure_tracing
+from .flight import configure_from_mlconf as _configure_flight
+
+
+def configure_from_mlconf():
+    """Apply ``mlconf.observability`` to the process tracer AND flight
+    recorder (one call at every entrypoint: gateway, service, smoke)."""
+    _configure_flight()
+    return _configure_tracing()
 
 # -- serving path ------------------------------------------------------------
 REQUEST_LATENCY = REGISTRY.histogram(
@@ -217,14 +242,80 @@ TRAIN_LOADER_EVENTS = REGISTRY.counter(
     "(batches, consumer_waits, producer_waits, epochs)",
     labels=("loader", "event"), max_label_sets=512, overflow="drop")
 
+# -- memory (utils/profiler.memory_sample, scrape-time) ----------------------
+DEVICE_MEM = REGISTRY.gauge(
+    "mlt_device_mem_bytes",
+    "Device memory snapshot per accelerator (kind = in_use | peak | "
+    "limit), read at scrape time by the weakref collector trainers and "
+    "LLM engines register (register_memory_collector)",
+    labels=("device", "kind"), max_label_sets=512, overflow="drop")
+HOST_RSS = REGISTRY.gauge(
+    "mlt_host_rss_bytes",
+    "Resident set size of this process (VmRSS), scrape-time")
+
+
+# owners (trainers, engines) that asked for memory exposition; ONE shared
+# scrape-time collector serves them all — the sample is process-wide, so
+# a trainer and two engines registering must not triple the device reads
+_memory_lock = _threading.Lock()
+_memory_refs: set = set()
+_memory_active = [False]
+
+
+def register_memory_collector(owner) -> None:
+    """Publish ``mlt_device_mem_bytes{device,kind}`` + host RSS while
+    ``owner`` is alive (weakref; the collector retires itself when every
+    registered owner is gone — the standard scrape-collector contract)."""
+    import weakref
+
+    with _memory_lock:
+        try:
+            _memory_refs.add(weakref.ref(owner))
+        except TypeError:  # non-weakrefable owner: nothing to key
+            return         # liveness on — skip rather than pin it forever
+        if _memory_active[0]:
+            return
+        _memory_active[0] = True
+
+    def _collect():
+        with _memory_lock:
+            for ref in list(_memory_refs):
+                if ref() is None:
+                    _memory_refs.discard(ref)
+            if not _memory_refs:
+                _memory_active[0] = False
+                # the scrape-collector contract: retire the series WITH
+                # the collector, or every later scrape exports a frozen
+                # memory snapshot that looks live
+                DEVICE_MEM.clear()
+                HOST_RSS.clear()
+                return False
+        from ..utils.profiler import memory_sample
+
+        sample = memory_sample()
+        for device, kinds in sample.get("devices", {}).items():
+            for kind, value in kinds.items():
+                if value is not None:
+                    DEVICE_MEM.set(value, device=device, kind=kind)
+        rss = sample.get("host_rss_bytes")
+        if rss is not None:
+            HOST_RSS.set(rss)
+        return True
+
+    REGISTRY.add_collector(_collect)
+
 
 def _install_chaos_observer():
-    """Count fired injections without giving chaos/registry (a bottom
-    layer that must not import mlrun_tpu) a metrics dependency: the hook
-    is pushed in from above."""
+    """Count fired injections AND land them on the flight recorder
+    without giving chaos/registry (a bottom layer that must not import
+    mlrun_tpu) any dependency: the hook is pushed in from above."""
     from ..chaos.registry import set_fire_observer
 
-    set_fire_observer(lambda point: CHAOS_FIRED.inc(point=point))
+    def _observe(point):
+        CHAOS_FIRED.inc(point=point)
+        flight_record("chaos.fire", point=point)
+
+    set_fire_observer(_observe)
 
 
 _install_chaos_observer()

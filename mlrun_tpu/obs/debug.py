@@ -1,0 +1,70 @@
+"""Shared handler cores for the ``/debug`` endpoints
+(docs/observability.md "Flight recorder & debug endpoints").
+
+The serving gateway (``serving/asgi.py``) and the service API
+(``service/api/operations.py``) expose the same ``/debug/flight`` and
+``/debug/profile`` contract; the parsing, validation, and response
+shapes live HERE once so the two route layers stay thin and cannot
+drift. Both cores raise ``ValueError`` on a bad request — the route
+layer maps that to its own 400 envelope.
+
+Safety: the profile endpoints are reachable over HTTP (the gateway one
+without auth, like ``/__drain__``), so client-supplied ``output_dir``
+is REJECTED — traces always land under the process's default trace dir
+— and ``key`` is restricted to a path-segment-safe charset so it cannot
+traverse out of it.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .flight import get_flight_recorder
+
+_SAFE_KEY_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def flight_snapshot(kind: str = "", limit=0) -> dict:
+    """The GET /debug/flight payload: ring snapshot (oldest first,
+    optional exact/``prefix.*`` kind filter, newest-N ``limit``), dump
+    count, and the last post-mortem artifact path."""
+    try:
+        limit = int(limit or 0)
+    except (TypeError, ValueError):
+        raise ValueError("limit must be an int")
+    recorder = get_flight_recorder()
+    return {
+        "events": recorder.events(kind=(kind or None), limit=limit),
+        "ring": len(recorder),
+        "dumps": recorder.dumps,
+        "last_dump": recorder.last_dump_path,
+    }
+
+
+def profile_request(body: dict) -> dict:
+    """The POST /debug/profile core: disarm, or arm an on-demand XLA
+    capture for the next N steps/seconds (``utils/profiler``)."""
+    from ..utils.profiler import arm_profile, disarm_profile
+
+    body = body or {}
+    if body.get("disarm"):
+        # stop_active: the HTTP disarm is the operator remedy for a
+        # capture whose claiming loop went away
+        return {"disarmed": disarm_profile(stop_active=True)}
+    if body.get("output_dir"):
+        # the arm request crosses an HTTP boundary: a client-chosen
+        # directory would be an arbitrary-path write primitive
+        raise ValueError("output_dir is not accepted over HTTP — traces "
+                         "land under the process's default trace dir")
+    key = str(body.get("key", "") or "xla-trace")
+    if not _SAFE_KEY_RE.match(key) or not key.strip("."):
+        # a pure-dot key ("." / "..") would resolve OUT of the traces
+        # dir despite matching the charset
+        raise ValueError("key must match [A-Za-z0-9._-]{1,64} and "
+                         "contain a non-dot character")
+    try:
+        steps = int(body.get("steps", 0) or 0)
+        seconds = float(body.get("seconds", 0) or 0)
+    except (TypeError, ValueError):
+        raise ValueError("steps must be an int and seconds a number")
+    return arm_profile(steps=steps, seconds=seconds, key=key)

@@ -1,0 +1,332 @@
+"""Goodput/badput accounting for the run lifecycle
+(docs/observability.md "Goodput & badput").
+
+"Exploring the limits of Concurrency in ML Training on Google TPUs"
+(PAPERS.md) makes efficiency-per-wall-second the headline metric at pod
+scale — and a run that spends half its wall clock in
+preempt→resubmit→re-compile cycles used to report the same
+``mlt_train_step_seconds`` as a healthy one. This module attributes
+EVERY wall-second of a run to either **goodput** (productive step time)
+or a typed **badput** bucket, two ways:
+
+- :class:`GoodputLedger` — an in-process phase-transition ledger the
+  training loop drives (``Trainer.fit`` promotes its existing timings —
+  input wait, h2d, dispatch, compile, metric flush — to first-class
+  phases). Attribution sums to wall time *by construction*: entering a
+  phase closes the previous one at the same clock read, so no second is
+  ever counted twice or dropped.
+- :func:`record_badput` — out-of-band attribution for lifecycle gaps
+  the run process never sees (the monitor's retry backoff, the
+  preemption→resubmission downtime, a stall's silent window), written
+  straight onto the counters from the service side.
+
+Exported families (flowing through the existing federation/timeseries
+path, so ``SLO(kind="goodput")`` burn-rate objectives in ``obs/slo.py``
+evaluate them unchanged):
+
+- ``mlt_goodput_seconds_total{run}`` — productive step seconds
+- ``mlt_badput_seconds_total{run,bucket}`` — typed unproductive seconds
+- ``mlt_goodput_wall_seconds_total{run}`` — total attributed seconds
+  (= goodput + sum over badput buckets, the burn-rate denominator)
+- ``mlt_goodput_fraction{run}`` — rolling goodput / wall gauge
+
+Stdlib only at module level (bottom-layer rule shared with
+``obs/metrics.py`` / ``obs/flight.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Optional
+
+from .metrics import REGISTRY
+
+# the one productive phase; everything else is a badput bucket
+GOODPUT_PHASE = "step"
+
+# typed badput buckets (docs/observability.md has the table):
+#   compile              cold XLA compile blocking the first dispatch
+#   re_warm              first-dispatch warmup on a RESUMED run (trace +
+#                        persistent-cache load — the elasticity tax)
+#   data_wait            host blocked in next(data_iter) (input-bound)
+#   h2d                  host->device batch transfer on the sync path
+#   metric_flush         log-point metric reads/drains
+#   checkpoint           checkpoint save/restore (incl. the preemption
+#                        final save and the resume restore)
+#   preemption_downtime  eviction -> replacement-resource gap (monitor)
+#   resubmit_gap         retry backoff before a non-preemption resubmit
+#   stall                heartbeat-silent window before a stall abort
+#   init                 loop entry before the first phase transition
+#   other                attributable to no instrumented phase
+BADPUT_BUCKETS = ("compile", "re_warm", "data_wait", "h2d", "metric_flush",
+                  "checkpoint", "preemption_downtime", "resubmit_gap",
+                  "stall", "init", "other")
+
+# one run-admission gate bounds the ``run`` label across ALL four
+# families (below): per-family overflow="drop" alone would desync them
+# — e.g. a badput series landing while its wall series is dropped
+# breaks the bad<=total invariant SLO(kind="goodput") burn rates divide
+# on. The per-family max_label_sets are sized ABOVE the gate so the
+# gate is the only bound that ever fires.
+RUN_LABEL_BUDGET = 256
+
+GOODPUT_SECONDS = REGISTRY.counter(
+    "mlt_goodput_seconds_total",
+    "Productive (train-step dispatch) wall seconds per run",
+    labels=("run",), max_label_sets=512, overflow="drop")
+BADPUT_SECONDS = REGISTRY.counter(
+    "mlt_badput_seconds_total",
+    "Unproductive wall seconds per run by typed bucket (compile, "
+    "re_warm, data_wait, h2d, metric_flush, checkpoint, "
+    "preemption_downtime, resubmit_gap, stall, init, other)",
+    labels=("run", "bucket"), max_label_sets=8192, overflow="drop")
+WALL_SECONDS = REGISTRY.counter(
+    "mlt_goodput_wall_seconds_total",
+    "Total attributed wall seconds per run (goodput + every badput "
+    "bucket — the burn-rate denominator for SLO(kind='goodput'))",
+    labels=("run",), max_label_sets=512, overflow="drop")
+GOODPUT_FRACTION = REGISTRY.gauge(
+    "mlt_goodput_fraction",
+    "goodput seconds / attributed wall seconds per run (the paper's "
+    "efficiency-per-wall-second headline number)",
+    labels=("run",), max_label_sets=512, overflow="drop")
+
+_admit_lock = threading.Lock()
+_admitted_runs: set = set()
+
+
+def _admit_run(run: str) -> bool:
+    """Atomic cross-family admission for a ``run`` label value: either
+    every family gets the run's series or none does. ``""`` (the
+    anonymous shared series) is always admitted; a retired run frees
+    its slot."""
+    if not run:
+        return True
+    with _admit_lock:
+        if run in _admitted_runs:
+            return True
+        if len(_admitted_runs) >= RUN_LABEL_BUDGET:
+            return False
+        _admitted_runs.add(run)
+        return True
+
+
+def retire_run(run: str):
+    """Drop a run's per-run series from every goodput family — the same
+    series-lifecycle contract fleet replicas and adapters follow: a
+    long-lived service attributing badput for a rotating run population
+    must not consume the families' label-set budget forever (past it,
+    ``overflow="drop"`` silently stops attributing NEW runs)."""
+    if not run:
+        return  # "" is the shared anonymous series, never retired
+    GOODPUT_SECONDS.remove(run=run)
+    WALL_SECONDS.remove(run=run)
+    GOODPUT_FRACTION.remove(run=run)
+    for bucket in BADPUT_BUCKETS:
+        BADPUT_SECONDS.remove(run=run, bucket=bucket)
+    with _admit_lock:
+        _admitted_runs.discard(run)
+
+
+# finished runs whose series are KEPT so the terminal attribution (the
+# stall window, the final fraction) survives until federation scrapes
+# it; past the bound the oldest retires — bounded well inside the
+# families' label-set budgets
+RECENT_RUNS_KEPT = 64
+_recent_lock = threading.Lock()
+_recent_runs: list[str] = []
+
+
+def release_run(run: str):
+    """Queue a finished run for series retirement (the monitor calls
+    this when it forgets a run's resource). The most recent
+    ``RECENT_RUNS_KEPT`` finished runs stay scrapeable; older ones are
+    retired via :func:`retire_run`."""
+    if not run:
+        return
+    evicted = []
+    with _recent_lock:
+        if run in _recent_runs:
+            _recent_runs.remove(run)
+        _recent_runs.append(run)
+        while len(_recent_runs) > RECENT_RUNS_KEPT:
+            evicted.append(_recent_runs.pop(0))
+    for old in evicted:
+        retire_run(old)
+
+
+def record_badput(bucket: str, seconds: float, run: str = ""):
+    """Out-of-band badput attribution (service-side lifecycle gaps the
+    run process cannot time itself: retry backoff, preemption downtime,
+    stall windows). Also advances the wall denominator so the
+    goodput-fraction burn rate sees the downtime."""
+    seconds = float(seconds)
+    if seconds <= 0 or not _admit_run(run):
+        return
+    BADPUT_SECONDS.inc(seconds, run=run, bucket=bucket)
+    WALL_SECONDS.inc(seconds, run=run)
+
+
+class GoodputLedger:
+    """Per-run step-phase ledger. The owner calls :meth:`enter` at every
+    phase boundary; the elapsed clock time since the previous boundary is
+    attributed to the phase being LEFT, so the per-phase seconds sum to
+    the clock span exactly — the acceptance invariant
+    ``goodput + Σ badput == wall`` (± one tick) holds by construction.
+
+    ``clock`` is injectable (fake-clock tests); all methods are
+    single-owner (the training loop) except :meth:`attribute`, which is
+    thread-safe for out-of-band additions.
+    """
+
+    def __init__(self, run: str = "",
+                 clock: Callable[[], float] = time.monotonic):
+        self.run = run
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        self._t0 = now
+        self._t_last = now
+        self._phase = "init"
+        self._seconds: dict[str, float] = {}
+        self._out_of_band = 0.0   # attribute() seconds (not in the span)
+        self._exported: dict[str, float] = {}  # per-phase flushed seconds
+        self._exported_wall = 0.0
+        self._closed = False
+
+    # -- phase transitions ---------------------------------------------------
+    @property
+    def current_phase(self) -> str:
+        return self._phase
+
+    def enter(self, phase: str) -> float:
+        """Close the current phase at this instant and start ``phase``.
+        Returns the seconds attributed to the phase being left."""
+        now = self._clock()
+        with self._lock:
+            elapsed = max(0.0, now - self._t_last)
+            if elapsed:
+                self._seconds[self._phase] = \
+                    self._seconds.get(self._phase, 0.0) + elapsed
+            self._t_last = now
+            self._phase = phase
+        return elapsed
+
+    @contextlib.contextmanager
+    def phase(self, phase: str):
+        """Scoped phase: enter ``phase``, and on exit return to the phase
+        that was active before (its clock restarts at the exit instant)."""
+        previous = self._phase
+        self.enter(phase)
+        try:
+            yield self
+        finally:
+            self.enter(previous)
+
+    def attribute(self, phase: str, seconds: float):
+        """Add out-of-band seconds to ``phase`` (e.g. a warmup compile
+        that ran before this ledger's window). Advances the wall total
+        with them — attribution still sums to wall."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._seconds[phase] = self._seconds.get(phase, 0.0) + seconds
+            self._out_of_band += seconds
+
+    def transfer(self, src: str, dst: str, seconds: float):
+        """Reclassify seconds already attributed to ``src`` into ``dst``
+        (the first dispatch lands in ``step`` but is compile-class time;
+        the compile measurement arrives after the fact). Clamped to what
+        ``src`` actually holds — wall stays invariant."""
+        with self._lock:
+            available = self._seconds.get(src, 0.0)
+            moved = max(0.0, min(float(seconds), available))
+            if not moved:
+                return
+            self._seconds[src] = available - moved
+            self._seconds[dst] = self._seconds.get(dst, 0.0) + moved
+
+    def close(self, final_phase: str | None = None) -> dict:
+        """Attribute the trailing open interval (to ``final_phase`` when
+        given, else the current phase), export deltas to the metric
+        families, and return the summary. Idempotent."""
+        if not self._closed:
+            if final_phase is not None:
+                # rename the OPEN interval (no attribution yet): the
+                # trailing time belongs to final_phase, not to whatever
+                # phase the loop happened to be in when it died
+                with self._lock:
+                    self._phase = final_phase
+            self.enter(self._phase)
+            self._closed = True
+        self.export()
+        return self.summary()
+
+    # -- views ---------------------------------------------------------------
+    def wall_seconds(self) -> float:
+        """Attributed wall so far: the clock span plus out-of-band
+        additions (the open interval counts — a stalled loop's fraction
+        decays instead of freezing)."""
+        with self._lock:
+            span = max(0.0, self._clock() - self._t0) \
+                if not self._closed else \
+                sum(self._seconds.values()) - self._out_of_band
+            return span + self._out_of_band
+
+    def goodput_seconds(self) -> float:
+        with self._lock:
+            return self._seconds.get(GOODPUT_PHASE, 0.0)
+
+    def badput(self) -> dict[str, float]:
+        with self._lock:
+            return {phase: seconds
+                    for phase, seconds in sorted(self._seconds.items())
+                    if phase != GOODPUT_PHASE and seconds > 0}
+
+    def goodput_fraction(self) -> float:
+        wall = self.wall_seconds()
+        return (self.goodput_seconds() / wall) if wall > 0 else 0.0
+
+    def summary(self) -> dict:
+        """JSON-friendly breakdown (the bench/test/debug view)."""
+        with self._lock:
+            attributed = dict(self._seconds)
+        goodput = attributed.pop(GOODPUT_PHASE, 0.0)
+        return {
+            "run": self.run,
+            "wall_s": self.wall_seconds(),
+            "goodput_s": goodput,
+            "goodput_fraction": self.goodput_fraction(),
+            "badput": {k: v for k, v in sorted(attributed.items()) if v > 0},
+            "badput_s": sum(attributed.values()),
+        }
+
+    # -- metric export -------------------------------------------------------
+    def export(self):
+        """Flush attribution deltas since the last export onto the
+        counter families and refresh the fraction gauge. Called at log
+        points and at close — counters only ever advance, so federated
+        ``increase()`` windows stay correct across flushes."""
+        if not _admit_run(self.run):
+            return  # over the run-label budget: drop atomically
+        with self._lock:
+            snapshot = dict(self._seconds)
+        total = 0.0
+        for phase, seconds in snapshot.items():
+            delta = seconds - self._exported.get(phase, 0.0)
+            if delta <= 0:
+                continue
+            self._exported[phase] = seconds
+            total += delta
+            if phase == GOODPUT_PHASE:
+                GOODPUT_SECONDS.inc(delta, run=self.run)
+            else:
+                bucket = phase if phase in BADPUT_BUCKETS else "other"
+                BADPUT_SECONDS.inc(delta, run=self.run, bucket=bucket)
+        if total > 0:
+            WALL_SECONDS.inc(total, run=self.run)
+            self._exported_wall += total
+        GOODPUT_FRACTION.set(self.goodput_fraction(), run=self.run)

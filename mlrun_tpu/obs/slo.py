@@ -22,6 +22,15 @@ Burn-rate definitions (budget = allowed bad fraction):
   ``target``". Budget is ``target`` itself.
 - ``availability``: objective "good / total ≥ ``target``" — an
   error-rate objective with budget ``1 - target``.
+- ``goodput``: objective "goodput fraction ≥ ``target``" over the
+  run-lifecycle accounting families (``obs/goodput.py``): bad defaults
+  to ``mlt_badput_seconds_total`` and total to
+  ``mlt_goodput_wall_seconds_total``, budget is ``1 - target`` (a 0.9
+  goodput floor tolerates 10% badput seconds). ``run=`` narrows the
+  objective to one run's series; ``bad_labels={"bucket": ...}``
+  narrows to one badput class (e.g. alert on preemption downtime
+  alone). Evaluation rides the same windowed-increase path as
+  ``error_rate`` — nothing below this constructor changes.
 
 ``burn = bad_fraction / budget``; burn 1.0 = exactly on budget.
 
@@ -51,7 +60,7 @@ SLO_BREACHES = REGISTRY.counter(
     "Multi-window burn-rate breaches emitted to the alert machinery",
     labels=("slo",), overflow="drop")
 
-_KINDS = ("latency", "error_rate", "availability")
+_KINDS = ("latency", "error_rate", "availability", "goodput")
 
 # default event kind SLO breaches are emitted under — alert configs list
 # it in trigger_events (see service/alerts.ALERT_TEMPLATES["SLOBurnRate"])
@@ -71,9 +80,26 @@ class SLO:
                  total_labels: Optional[dict] = None,
                  labels: Optional[dict] = None,
                  severity: str = "high",
-                 adapter: Optional[str] = None):
+                 adapter: Optional[str] = None,
+                 run: Optional[str] = None):
         if kind not in _KINDS:
             raise ValueError(f"unknown SLO kind '{kind}' (one of {_KINDS})")
+        if kind == "goodput":
+            # goodput sugar: swap the serving-path default counters for
+            # the run-lifecycle accounting families and fold a run=
+            # filter into both sides; from here down the objective is an
+            # ordinary windowed-increase ratio (the error_rate path)
+            if bad == "mlt_fleet_dispatches_total":
+                bad = "mlt_badput_seconds_total"
+            if total == "mlt_fleet_dispatches_total":
+                total = "mlt_goodput_wall_seconds_total"
+            if run is not None:
+                bad_labels = {**(bad_labels or {}), "run": run}
+                total_labels = {**(total_labels or {}), "run": run}
+        elif run is not None:
+            raise ValueError(
+                "run= is goodput-only sugar; other kinds take explicit "
+                "bad_labels/total_labels")
         if adapter is not None:
             # per-tenant objective sugar (docs/observability.md "SLOs &
             # burn rates"): fold the adapter id into the latency-family
@@ -118,12 +144,13 @@ class SLO:
         self.labels = dict(labels or {})
         self.severity = severity
         self.adapter = adapter
+        self.run = run
 
     @classmethod
     def from_config(cls, config: dict) -> "SLO":
         known = ("name", "kind", "target", "family", "q", "bad",
                  "bad_labels", "total", "total_labels", "labels",
-                 "severity", "adapter")
+                 "severity", "adapter", "run")
         unknown = set(config) - set(known)
         if unknown:
             raise ValueError(
@@ -135,7 +162,7 @@ class SLO:
         """Allowed bad fraction."""
         if self.kind == "latency":
             return 1.0 - self.q
-        if self.kind == "availability":
+        if self.kind in ("availability", "goodput"):
             return 1.0 - self.target
         return self.target
 
@@ -159,6 +186,8 @@ class SLO:
                "budget": self.budget, "severity": self.severity}
         if self.adapter is not None:
             out["adapter"] = self.adapter
+        if self.run is not None:
+            out["run"] = self.run
         if self.kind == "latency":
             out.update(family=self.family, q=self.q)
         else:

@@ -36,6 +36,54 @@ def register(r: web.RouteTableDef, state):
         return web.Response(body=REGISTRY.render().encode(),
                             headers={"Content-Type": CONTENT_TYPE})
 
+    # -- debug endpoints (docs/observability.md "Flight recorder & debug
+    # endpoints"); root paths like /metrics, but NOT middleware-open —
+    # the flight ring and trace arming stay behind the service token
+    @r.get("/debug/flight")
+    async def debug_flight(request):
+        """Live read of the black-box flight ring: run-lifecycle
+        decisions (retries, stall detection), chaos fires, breaker
+        trips, engine scheduler events — the same sequence a
+        crash/stall post-mortem artifact carries. Handler core shared
+        with the serving gateway (obs/debug.py)."""
+        import json as _json
+
+        from ...obs.debug import flight_snapshot
+
+        try:
+            payload = flight_snapshot(request.query.get("kind", ""),
+                                      request.query.get("limit", 0))
+        except ValueError as exc:
+            return error_response(str(exc), 400)
+        return web.json_response(
+            payload, dumps=lambda d: _json.dumps(d, default=str))
+
+    @r.get("/debug/profile")
+    async def debug_profile_get(request):
+        from ...utils.profiler import profile_status
+
+        return json_response(profile_status())
+
+    @r.post("/debug/profile")
+    async def debug_profile_post(request):
+        """Arm ``utils/profiler`` for the next N steps/seconds on a live
+        trainer or engine in this process (hot loops tick the armed
+        capture; the XLA trace artifact registers on stop) — profile a
+        production hot loop without a restart."""
+        from ...obs.debug import profile_request
+
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except ValueError:
+                return error_response("body must be JSON", 400)
+        try:
+            out = profile_request(body)
+        except ValueError as exc:
+            return error_response(str(exc), 400)
+        return json_response(out)
+
     @r.get(f"{API}/client-spec")
     async def client_spec(request):
         return json_response({

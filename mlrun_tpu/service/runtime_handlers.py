@@ -41,7 +41,10 @@ from ..model import RunObject
 from ..obs import (
     RUN_RETRIES,
     RUN_STALL_ABORTS,
+    flight_record,
+    get_flight_recorder,
     get_tracer,
+    record_badput,
     trace_id_for,
 )
 from ..utils import get_in, logger, now_iso
@@ -186,6 +189,19 @@ class BaseRuntimeHandler:
             self._manifests.pop(uid, None)
             self._retry_at.pop(uid, None)
             self._probe_failures.pop(uid, None)
+        # series lifecycle: a finished run's per-run goodput label sets
+        # are queued for retirement (kept scrapeable for the most recent
+        # N finished runs so the terminal attribution survives until the
+        # federation loop reads it) — only once no sibling iteration is
+        # still tracked, since hyper children share the parent uid
+        bare_uid = self._split_key(uid)[0]
+        with self._lock:
+            siblings = any(self._split_key(key)[0] == bare_uid
+                           for key in self._resources)
+        if not siblings:
+            from ..obs.goodput import release_run
+
+            release_run(bare_uid)
         drop = getattr(self.db, "del_runtime_resource", None)
         if drop:
             try:
@@ -336,6 +352,8 @@ class BaseRuntimeHandler:
         if threshold > 0 and time.time() - started > threshold:
             logger.warning("aborting stuck run", uid=uid,
                            state=run_state, threshold=threshold)
+            flight_record("run.stuck_abort", uid=uid, state=run_state,
+                          threshold_s=threshold)
             self._delete_quietly(resource_id)
             self.db.update_run(
                 {"status.state": RunStates.aborted,
@@ -371,6 +389,18 @@ class BaseRuntimeHandler:
                      f"{retry_count + 1}/{policy.max_retries} "
                      f"in {delay:.1f}s"},
                     uid, project, iter=iteration)
+                # goodput accounting: the scheduled backoff is wall time
+                # this run spends NOT training — preemption downtime or a
+                # generic resubmit gap, attributed out-of-band because
+                # the run process is dead for its duration
+                record_badput(
+                    "preemption_downtime"
+                    if failure_class == FailureClass.preemption
+                    else "resubmit_gap", delay, run=uid)
+                flight_record("run.retry_scheduled", uid=uid,
+                              failure_class=failure_class,
+                              delay_s=round(delay, 3),
+                              attempt=retry_count + 1)
                 logger.info("scheduled run retry", uid=uid,
                             failure_class=failure_class, delay=delay,
                             attempt=retry_count + 1)
@@ -433,6 +463,9 @@ class BaseRuntimeHandler:
             "run.retry", trace_id_for(uid),
             attrs={"uid": uid, "failure_class": failure_class,
                    "attempt": attempt, "resource": new_id})
+        flight_record("run.resubmit", uid=uid,
+                      failure_class=failure_class, attempt=attempt,
+                      resource=new_id)
         logger.info("resubmitted run", uid=uid, resource=new_id,
                     failure_class=failure_class, attempt=attempt,
                     trace_id=trace_id_for(uid))
@@ -519,13 +552,24 @@ class BaseRuntimeHandler:
                        silent_seconds=round(silent, 1),
                        threshold=policy.stall_timeout,
                        escalation=policy.on_stall)
+        # flight + goodput: the silent window is badput, and the
+        # detection event opens the post-mortem sequence the artifact
+        # below must carry (stall detection -> retry decision)
+        flight_record("run.stall_detected", uid=uid,
+                      silent_s=round(silent, 1),
+                      threshold_s=policy.stall_timeout,
+                      escalation=policy.on_stall)
+        record_badput("stall", silent, run=uid)
         # on_stall is the explicit directive — it is NOT gated on
         # retry_on (a run retrying only preemptions but asking for stall
         # resubmission means exactly that); only the budget limits it
         if policy.on_stall == "resubmit" and \
                 policy.retries_left(retry_count):
-            return self._resubmit(key, resource_id, project, run,
-                                  retry_count + 1, FailureClass.stalled)
+            handled = self._resubmit(key, resource_id, project, run,
+                                     retry_count + 1, FailureClass.stalled)
+            get_flight_recorder().dump("stall-resubmit",
+                                       extra={"run": uid})
+            return handled
         self._delete_quietly(resource_id)
         self.db.update_run(
             {"status.state": RunStates.aborted,
@@ -539,6 +583,12 @@ class BaseRuntimeHandler:
             "run.stall_abort", trace_id_for(uid),
             attrs={"uid": uid, "silent_s": round(silent, 1),
                    "threshold_s": policy.stall_timeout})
+        flight_record("run.stall_abort", uid=uid,
+                      silent_s=round(silent, 1))
+        # the black-box artifact a stall-aborted run leaves behind: the
+        # event sequence into the abort (detection, prior retries, chaos
+        # fires) — ISSUE 10 acceptance
+        get_flight_recorder().dump("stall-abort", extra={"run": uid})
         self._forget(key, project)
         self._push_notifications(uid, project, run)
         return True

@@ -118,9 +118,55 @@ def build_serving_app(server: GraphServer) -> web.Application:
         return web.Response(body=REGISTRY.render().encode(),
                             headers={"Content-Type": CONTENT_TYPE})
 
+    # -- debug endpoints (docs/observability.md "Flight recorder & debug
+    # endpoints") — live reads of the black-box ring and on-demand
+    # profiling of whatever hot loop runs in this process; the handler
+    # cores (parsing, validation, path-safety) are shared with the
+    # service API in obs/debug.py
+    async def debug_flight(request):
+        from ..obs.debug import flight_snapshot
+
+        _probe("/debug/flight")
+        try:
+            payload = flight_snapshot(request.query.get("kind", ""),
+                                      request.query.get("limit", 0))
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(
+            payload, dumps=lambda d: json.dumps(d, default=str))
+
+    async def debug_profile_get(request):
+        from ..utils.profiler import profile_status
+
+        _probe("/debug/profile")
+        return web.json_response(profile_status())
+
+    async def debug_profile_post(request):
+        # arm utils/profiler for the next N steps/seconds on the live
+        # trainer or engine ticking in this process; the XLA trace is
+        # registered as an artifact when the bound is hit — a production
+        # hot loop gets profiled without a restart
+        from ..obs.debug import profile_request
+
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except ValueError:
+                return web.json_response({"error": "body must be JSON"},
+                                         status=400)
+        try:
+            out = profile_request(body)
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(out)
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_get("/debug/profile", debug_profile_get)
+    app.router.add_post("/debug/profile", debug_profile_post)
     app.router.add_post("/__drain__", drain)
     app.router.add_get("/__stats__", stats)
     app.router.add_route("*", "/{tail:.*}", handle)

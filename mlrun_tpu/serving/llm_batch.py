@@ -44,11 +44,16 @@ from ..obs import (
     LLM_QUEUE_DEPTH,
     LLM_TTFT,
     REGISTRY,
+    flight_record,
+    get_flight_recorder,
     get_tracer,
+    register_memory_collector,
 )
+from ..obs.stats import nearest_rank
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope, rope_table
 from ..utils import logger
+from ..utils.profiler import tick as profiler_tick
 from .llm import _cached_attention, _forward_with_cache, init_kv_cache
 from .resilience import (  # noqa: F401 - EngineStoppedError re-exported
     DeadlineExceeded,
@@ -162,10 +167,11 @@ _ENGINE_SEQUENCE = iter(range(1, 1 << 30))
 
 
 def _percentile(sorted_samples: list, q: float) -> float:
-    """Nearest-rank percentile (ceil(q*n)-th order statistic) over an
-    already-sorted sample list."""
-    idx = max(0, math.ceil(q * len(sorted_samples)) - 1)
-    return sorted_samples[min(idx, len(sorted_samples) - 1)]
+    """Nearest-rank percentile over an already-sorted sample list (the
+    shared ``obs.stats.nearest_rank`` helper — one definition for the
+    engine rings and the trainer's StepTimer; kept as a module name for
+    existing importers, e.g. serving/fleet.py)."""
+    return nearest_rank(sorted_samples, q)
 
 
 @dataclass
@@ -482,6 +488,9 @@ class ContinuousBatchingEngine:
         self._running = True
         self._epoch += 1
         self._register_metrics()
+        # device HBM / host RSS exposition while this engine lives
+        # (mlt_device_mem_bytes — weakref, shared across owners)
+        register_memory_collector(self)
         self._thread = threading.Thread(target=self._loop,
                                         args=(self._epoch,), daemon=True)
         self._thread.start()
@@ -883,6 +892,9 @@ class ContinuousBatchingEngine:
         if level >= 2:
             with self._lock:
                 self._stats["shed"] += 1
+            flight_record("engine.shed", engine=self._obs_name,
+                          queue_depth=self._queue.qsize(),
+                          adapter=adapter)
             future.set_exception(QueueFullError(
                 f"engine queue is full (max_queue_size="
                 f"{self.max_queue_size}, depth {self._queue.qsize()}) — "
@@ -1279,6 +1291,14 @@ class ContinuousBatchingEngine:
                     "chunks": adm.chunks, "cached_prefix": adm.base,
                     "imported": adm.prefilled, "exported": adm.export,
                     "adapter": adm.adapter})
+        # scheduler decision on the flight ring: one admission completed
+        # (prompt length, reused prefix, chunking — the inputs to every
+        # later latency question a post-mortem asks)
+        flight_record("engine.admit", engine=self._obs_name,
+                      request_id=adm.request_id,
+                      prompt_len=len(adm.prompt), cached_prefix=adm.base,
+                      chunks=adm.chunks, slot=adm.slot,
+                      adapter=adm.adapter, export=bool(adm.export))
         if adm.export:
             self._export_admission(adm)
             return
@@ -1452,6 +1472,9 @@ class ContinuousBatchingEngine:
                 # between two decode ticks IS the inter-token gap clients
                 # see, and the percentiles must show it
                 started = time.perf_counter()
+                # on-demand profiling (POST /debug/profile): claims or
+                # advances an armed capture — one global check when dark
+                profiler_tick(self._obs_name)
                 self._expire_queued()
                 self._admission_tick()
                 if not any(s.active for s in self._slot_state):
@@ -1484,6 +1507,9 @@ class ContinuousBatchingEngine:
             # fail pending work loudly, not leave futures hanging forever
             logger.error("continuous batching scheduler died",
                          error=str(exc))
+            flight_record("engine.crash", engine=self._obs_name,
+                          replica=self.replica, error=str(exc),
+                          error_type=type(exc).__name__)
             self._running = False
             self._stopped = True
             self._crash_exc = exc
@@ -1516,6 +1542,20 @@ class ContinuousBatchingEngine:
                 future.set_exception(exc)
 
     def _fail_pending(self, exc: Exception):
+        failed = int(self._admission is not None) \
+            + sum(1 for s in self._slot_state
+                  if s.active and s.future is not None
+                  and not s.future.done()) + self._queue.qsize()
+        flight_record("engine.fail_pending", engine=self._obs_name,
+                      replica=self.replica, failed=failed,
+                      error_type=type(exc).__name__)
+        if not isinstance(exc, EngineStoppedError):
+            # a crash teardown (scheduler death, not a clean stop) is a
+            # post-mortem moment: the decision sequence into it — chaos
+            # fires, admissions, breaker trips — is the debugging record
+            get_flight_recorder().dump(
+                "engine-crash", extra={"engine": self._obs_name,
+                                       "error": str(exc)})
         adm, self._admission = self._admission, None
         if adm is not None:
             # a request parked mid-chunked-prefill fails with everything

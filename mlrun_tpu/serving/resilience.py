@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..obs.flight import record as flight_record
 from ..utils import logger
 
 # headers GraphServer.run understands (case-insensitive):
@@ -279,6 +280,11 @@ class CircuitBreaker:
         self._state = self.OPEN
         self._opened_at = self._clock()
         self.opened_total += 1
+        # breaker trips are flight-recorder events: a post-mortem needs
+        # the trip sequence leading into an outage, not just the count
+        flight_record("breaker.open", breaker=self.name,
+                      consecutive_failures=self._consecutive_failures,
+                      opened_total=self.opened_total)
         logger.warning("circuit breaker opened", breaker=self.name,
                        consecutive_failures=self._consecutive_failures,
                        opened_total=self.opened_total)
@@ -317,6 +323,7 @@ class CircuitBreaker:
                     self._state = self.CLOSED
                     self._consecutive_failures = 0
                     self._outcomes.clear()
+                    flight_record("breaker.closed", breaker=self.name)
                     logger.info("circuit breaker closed (recovered)",
                                 breaker=self.name)
             else:

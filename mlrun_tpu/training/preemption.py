@@ -100,11 +100,28 @@ class PreemptionGuard:
         def _wait():
             if not self._event.wait(timeout):
                 return
+            # the latch set is the black-box event of record for an
+            # eviction; the dump AFTER the callback captures the drain
+            # decisions too (obs/flight.py — guarded: the grace-period
+            # drain must never be blocked by telemetry)
+            try:
+                from ..obs import flight_record
+
+                flight_record("preempt.signal", watcher=name)
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 callback()
             except Exception as exc:  # noqa: BLE001 - a crashing handler
                 # must not take the watcher (and the process teardown) down
                 logger.error("preemption callback failed", error=str(exc))
+            try:
+                from ..obs import get_flight_recorder
+
+                get_flight_recorder().dump("preemption",
+                                           extra={"watcher": name})
+            except Exception:  # noqa: BLE001
+                pass
 
         thread = threading.Thread(target=_wait, daemon=True, name=name)
         thread.start()

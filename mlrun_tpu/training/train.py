@@ -543,6 +543,12 @@ def _all_hosts_agree(flag: bool) -> bool:
     return bool(np.max(flags))
 
 
+# distinct on-demand-profiler tick source per trainer instance: two
+# concurrent fit loops must not jointly drain one steps-bound capture
+# (utils/profiler.tick counts down only the claiming source's ticks)
+_TRAINER_SEQUENCE = iter(range(1, 1 << 30))
+
+
 class Trainer:
     """High-level trainer used by the jax framework adapter and bench."""
 
@@ -574,6 +580,17 @@ class Trainer:
         self.compile_seconds: Optional[float] = None
         self._compiled = None
         self._warmed_shape: Optional[tuple] = None
+        # goodput accounting (docs/observability.md "Goodput & badput"):
+        # fit() builds a fresh per-run ledger here; after fit it holds
+        # the final attribution (bench/debug read .summary())
+        self.goodput = None
+        self._compile_attributed = False
+        self._profiler_source = f"trainer-{next(_TRAINER_SEQUENCE)}"
+        # device HBM + host RSS exposition while this trainer lives
+        # (mlt_device_mem_bytes / mlt_host_rss_bytes, scrape-time)
+        from ..obs import register_memory_collector
+
+        register_memory_collector(self)
 
     def init(self, seed: int = 0) -> TrainState:
         self.state = init_train_state(
@@ -623,6 +640,11 @@ class Trainer:
 
     def train_step(self, tokens, targets) -> dict:
         tokens, targets = self.shard_batch(tokens, targets)
+        return self._dispatch(tokens, targets)
+
+    def _dispatch(self, tokens, targets) -> dict:
+        """Dispatch one step on already-sharded batches (fit() times the
+        h2d placement and the dispatch as separate goodput phases)."""
         fn = self.step_fn
         if (self._compiled is not None
                 and tokens.shape == self._warmed_shape
@@ -631,13 +653,17 @@ class Trainer:
         self.state, metrics = fn(self.state, tokens, targets)
         return metrics
 
-    def _maybe_resume(self, checkpoint_manager, context):
+    def _maybe_resume(self, checkpoint_manager, context) -> bool:
         """Honor the service's checkpoint-resume directive
         (MLT_RESUME_FROM_CHECKPOINT / MLT_RESUME_STEP, written into a
         resubmitted JobSet by runtime_handlers.TpuJobHandler): restore the
         train state before the first step so the rescheduled slice resumes
         rather than restarting. No directive, no manager, or an
-        already-advanced state (explicit restore) → no-op."""
+        already-advanced state (explicit restore) → no-op. Returns
+        whether a directive was honored — a resumed run's first-dispatch
+        warmup is ``re_warm`` badput (elasticity tax), not a cold
+        ``compile`` (obs/goodput.py)."""
+        from ..obs import flight_record
         from .checkpoint import resume_directive
 
         directive = resume_directive()
@@ -645,11 +671,11 @@ class Trainer:
             # the common no-directive entry must not force a device sync:
             # int(state.step) blocks the host on everything in flight,
             # and fit() may be entered with steps still dispatching
-            return
+            return False
         if int(self.state.step) != 0:
             # a directive exists — only now is the sync warranted, to let
             # an explicit prior restore win over the env contract
-            return
+            return True
         path, step = directive
         try:
             self.state = checkpoint_manager.restore(self.state, step=step)
@@ -658,11 +684,14 @@ class Trainer:
             # training from step 0 is the correct degraded behavior
             logger.warning("checkpoint resume failed — starting fresh",
                            path=path, step=step, error=str(exc))
-            return
+            return True
         logger.info("resumed from checkpoint", path=path,
                     step=int(self.state.step))
+        flight_record("train.resume", path=str(path),
+                      step=int(self.state.step))
         if context is not None and hasattr(context, "log_result"):
             context.log_result("resumed_from_step", int(self.state.step))
+        return True
 
     def fit(self, data_iter, steps: int, context=None,
             log_every: int = 10, callbacks: list | None = None,
@@ -702,11 +731,31 @@ class Trainer:
             TRAIN_H2D_BYTES,
             TRAIN_INPUT_WAIT,
             TRAIN_STEP_TIME,
+            GoodputLedger,
+            flight_record,
+            get_flight_recorder,
         )
+        from ..utils import profiler as profiler_mod
         from .data import DevicePrefetchIterator
 
         assert self.state is not None, "call init() first"
-        self._maybe_resume(checkpoint_manager, context)
+        # goodput ledger: every wall-second of this fit lands in the
+        # 'step' goodput phase or a typed badput bucket, and the phase
+        # transitions below make the attribution sum to wall time by
+        # construction (docs/observability.md "Goodput & badput")
+        run_uid = str(getattr(context, "uid", "") or "") \
+            if context is not None else ""
+        ledger = self.goodput = GoodputLedger(run=run_uid)
+        with ledger.phase("checkpoint"):
+            resumed = self._maybe_resume(checkpoint_manager, context)
+        if self.compile_seconds is not None and not self._compile_attributed:
+            # warmup() compiled before this fit's wall window opened —
+            # attribute it out-of-band, once per trainer
+            self._compile_attributed = True
+            ledger.attribute("re_warm" if resumed else "compile",
+                             self.compile_seconds)
+        flight_record("train.fit_begin", run=run_uid, steps=steps,
+                      resumed=resumed)
         hooks = CallbackList(callbacks, context=context, trainer=self)
 
         train_cfg = mlconf.training
@@ -800,15 +849,19 @@ class Trainer:
                 if preemption_guard is not None and preemption_guard.agreed():
                     logger.warning("preempted — checkpointing before exit",
                                    step=int(self.state.step))
+                    flight_record("train.preempt", run=run_uid,
+                                  step=int(self.state.step))
                     # a staged log point must land before the early return —
                     # its metrics are what the post-mortem sees
                     if pending is not None:
-                        last = _drain(pending)
+                        with ledger.phase("metric_flush"):
+                            last = _drain(pending)
                         pending = None
                     if checkpoint_manager is not None:
-                        checkpoint_manager.save(int(self.state.step),
-                                                self.state, force=True)
-                        checkpoint_manager.wait()
+                        with ledger.phase("checkpoint"):
+                            checkpoint_manager.save(int(self.state.step),
+                                                    self.state, force=True)
+                            checkpoint_manager.wait()
                         if context is not None and \
                                 hasattr(context, "log_checkpoint"):
                             # the service reads status.checkpoint when it
@@ -822,11 +875,20 @@ class Trainer:
                     last["step"] = int(self.state.step)
                     if context is not None:
                         context.log_result("preempted", True)
+                    # the black-box artifact is what the post-eviction
+                    # debugging session reads — dump BEFORE the process
+                    # can be SIGKILLed at grace-period end
+                    flight_record("train.preempt_exit", run=run_uid,
+                                  step=int(self.state.step))
+                    get_flight_recorder().dump(
+                        "preemption", extra={"run": run_uid,
+                                             "step": int(self.state.step)})
                     # preempted runs still finalize callbacks (close writers,
                     # log the tensorboard dir) — they matter MOST here, since
                     # the artifacts are what survives the eviction
                     hooks.on_train_end(last)
                     return last
+                ledger.enter("data_wait")
                 t_input = time.perf_counter()
                 tokens, targets = next(data_iter)
                 input_wait += time.perf_counter() - t_input
@@ -834,14 +896,28 @@ class Trainer:
                 if prefetcher is None:
                     h2d_inline += (getattr(tokens, "nbytes", 0)
                                    + getattr(targets, "nbytes", 0))
+                ledger.enter("h2d")
+                tokens, targets = self.shard_batch(tokens, targets)
+                ledger.enter("step")
                 t_dispatch = time.perf_counter()
-                metrics = self.train_step(tokens, targets)
+                metrics = self._dispatch(tokens, targets)
                 if step == 0 and self.compile_seconds is None:
                     # tracing + XLA compile block the host inside the first
                     # dispatch (execution does not) — compile-class time,
                     # kept OUT of the steady-state throughput window
                     self.compile_seconds = time.perf_counter() - t_dispatch
                     TRAIN_COMPILE_SECONDS.set(self.compile_seconds)
+                    # ...and out of goodput: land the dispatch interval,
+                    # then reclassify the compile-class share (a RESUMED
+                    # run's warm re-compile is the elasticity tax bucket)
+                    self._compile_attributed = True
+                    ledger.enter("step")
+                    ledger.transfer(
+                        "step", "re_warm" if resumed else "compile",
+                        self.compile_seconds)
+                # on-demand profiling: claims/advances an armed
+                # POST /debug/profile capture; one global check when dark
+                profiler_mod.tick(self._profiler_source, context)
                 tracker.note_step(tokens.shape[0] * tokens.shape[1])
                 log_point = (step + 1) % log_every == 0 or step == steps - 1
                 # non-log steps hand callbacks the RAW device metrics — no
@@ -859,19 +935,31 @@ class Trainer:
                     }
                     if self.compile_seconds is not None:
                         extras["compile_seconds"] = self.compile_seconds
+                    extras["goodput_fraction"] = ledger.goodput_fraction()
                     if tps > 0:
                         TRAIN_STEP_TIME.set(
                             tokens.shape[0] * seq_len / tps, timer="fit")
                     _flush_obs()
+                    flight_record("train.step", run=run_uid,
+                                  step=step + 1,
+                                  goodput_fraction=round(
+                                      extras["goodput_fraction"], 4))
                     if defer:
                         if pending is not None:
-                            last = _drain(pending)
+                            with ledger.phase("metric_flush"):
+                                last = _drain(pending)
                         pending = _stage(metrics, extras)
                     else:
-                        step_metrics = {k: float(v) for k, v in metrics.items()}
-                        step_metrics.update(extras)
-                        step_metrics["step"] = int(self.state.step)
-                        last = _log_view(step_metrics)
+                        with ledger.phase("metric_flush"):
+                            step_metrics = {k: float(v)
+                                            for k, v in metrics.items()}
+                            step_metrics.update(extras)
+                            step_metrics["step"] = int(self.state.step)
+                            last = _log_view(step_metrics)
+                    # flush attribution deltas onto the mlt_goodput_*
+                    # counters at every log point (the federation loop
+                    # sees a live fraction, not an end-of-run dump)
+                    ledger.export()
                 if hooks.callbacks:
                     multihost = jax.process_count() > 1
                     if not hooks.on_step_end(step, step_metrics,
@@ -919,10 +1007,29 @@ class Trainer:
                         last.setdefault("step", int(self.state.step))
                         break
             if pending is not None:
-                last = _drain(pending)
+                with ledger.phase("metric_flush"):
+                    last = _drain(pending)
                 pending = None
             hooks.on_train_end(last)
             return last
+        except BaseException as unwinding:
+            # crash post-mortem: the event sequence into the failure is
+            # the artifact (docs/observability.md "Flight recorder &
+            # debug endpoints"). An explicit except — NOT
+            # sys.exc_info() in the finally, which also sees an
+            # exception a CALLER frame is busy handling and would dump
+            # a spurious crash artifact for a successful fit. Guarded:
+            # the original exception must win the unwind.
+            try:
+                flight_record("train.exception", run=run_uid,
+                              error=str(unwinding),
+                              error_type=type(unwinding).__name__)
+                get_flight_recorder().dump(
+                    "train-crash", extra={"run": run_uid,
+                                          "error": str(unwinding)})
+            except Exception:  # noqa: BLE001
+                pass
+            raise
         finally:
             if pending is not None:
                 # exception exit with a staged log point: land it in the
@@ -933,6 +1040,13 @@ class Trainer:
                 except Exception:  # noqa: BLE001 - the original
                     pass           # exception must win the unwind
             _flush_obs()
+            try:
+                # trailing open interval -> its current phase; final
+                # counter flush + fraction gauge. summary() stays
+                # readable on self.goodput
+                ledger.close()
+            except Exception:  # noqa: BLE001 - accounting must not
+                pass           # replace the loop's own outcome
             if owned is not None:
                 # created here -> closed here; drains staged batches so a
                 # producer blocked on a full queue can never outlive fit

@@ -6,9 +6,12 @@ drive requests through it, scrape both replicas' series over HTTP,
 federate the scrapes through ``obs.MetricsAggregator`` into a
 ``TimeSeriesStore``, read an SLO status off the windowed view, and
 assert the federation cardinality budget holds (re-scraping must not
-multiply series). Finally the multi-tenant leg: a 2-tenant adapter
+multiply series). Then the multi-tenant leg: a 2-tenant adapter
 engine, asserting the bounded ``adapter`` label cardinality holds
-across re-scrapes.
+across re-scrapes. Finally the training leg: a tiny ``Trainer.fit``
+with a forced preemption — the ``mlt_goodput_*`` families must carry
+samples, the attribution must sum to wall time, and the flight ring
+must drain to a JSONL preemption artifact with the event sequence.
 
 Exits non-zero (with a reason) on the first broken contract: metrics
 exposition missing core families, the trace id not honored end to end,
@@ -199,6 +202,83 @@ def _adapter_leg(base: str):
         engine.stop()
 
 
+def _training_leg(base: str):
+    """Goodput / flight-recorder smoke (docs/observability.md "Goodput &
+    badput"): run a tiny ``Trainer.fit`` with a forced preemption
+    mid-run, scrape the ``mlt_goodput_*`` families over HTTP, and assert
+    the flight ring drained to a JSONL post-mortem artifact carrying the
+    preemption events."""
+    import requests
+
+    from mlrun_tpu.models import tiny_llama
+    from mlrun_tpu.obs import get_flight_recorder
+    from mlrun_tpu.training import (
+        TrainConfig,
+        Trainer,
+        synthetic_token_stream,
+    )
+    from mlrun_tpu.training.preemption import PreemptionGuard
+
+    config = tiny_llama(attention_impl="reference")
+    trainer = Trainer(config, TrainConfig(total_steps=12))
+    trainer.init(0)
+    guard = PreemptionGuard()  # not installed — programmatic request()
+    fired = []
+
+    def preempt_at(step, metrics, _trainer):
+        if step >= 3 and not fired:
+            fired.append(step)
+            guard.request()
+        return True
+
+    recorder = get_flight_recorder()
+    dumps_before = recorder.dumps
+    stream = synthetic_token_stream(2, 32, config.vocab_size)
+    out = trainer.fit(stream, steps=10, log_every=2,
+                      callbacks=[preempt_at], preemption_guard=guard)
+    if not out.get("preempted"):
+        _fail(f"forced preemption did not stop the fit: {out}")
+
+    # the flight ring drained to a post-mortem artifact on the
+    # preemption exit, and the event sequence is in it
+    if recorder.dumps <= dumps_before or not recorder.last_dump_path \
+            or not os.path.exists(recorder.last_dump_path):
+        _fail("flight ring did not drain to a preemption artifact")
+    with open(recorder.last_dump_path) as fp:
+        lines = [json.loads(line) for line in fp if line.strip()]
+    if not lines or not lines[0].get("flight_dump"):
+        _fail(f"flight artifact {recorder.last_dump_path} has no header")
+    kinds = {line.get("kind") for line in lines[1:]}
+    for expected in ("train.fit_begin", "train.preempt",
+                     "train.preempt_exit"):
+        if expected not in kinds:
+            _fail(f"flight artifact missing {expected} "
+                  f"(got {sorted(k for k in kinds if k)})")
+
+    # goodput attribution closed (sums to wall) and exported
+    summary = trainer.goodput.summary()
+    closure = abs(summary["goodput_s"] + summary["badput_s"]
+                  - summary["wall_s"])
+    if closure > 0.1:
+        _fail(f"goodput attribution does not sum to wall: {summary}")
+    resp = requests.get(base + "/metrics", timeout=10)
+    if resp.status_code != 200:
+        _fail(f"/metrics returned {resp.status_code} on training leg")
+    text = resp.text
+    for family in ("mlt_goodput_seconds_total", "mlt_badput_seconds_total",
+                   "mlt_goodput_wall_seconds_total",
+                   "mlt_goodput_fraction"):
+        if f"# TYPE {family}" not in text:
+            _fail(f"/metrics missing family {family}")
+        if f"\n{family}{{" not in text and f"\n{family} " not in text:
+            _fail(f"family {family} carries no samples after the fit")
+    return {
+        "goodput_fraction": round(summary["goodput_fraction"], 4),
+        "badput_buckets": sorted(summary["badput"]),
+        "flight_artifact": recorder.last_dump_path,
+    }
+
+
 def main() -> int:
     spans_path = os.path.join(tempfile.mkdtemp(prefix="obs-smoke-"),
                               "spans.jsonl")
@@ -279,6 +359,7 @@ def main() -> int:
 
         fleet_summary = _fleet_leg(base)
         fleet_summary.update(_adapter_leg(base))
+        fleet_summary.update(_training_leg(base))
     finally:
         box["stop"] = True
         thread.join(timeout=5)
