@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-goodput obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -43,6 +43,11 @@ bench-lora:      ## multi-tenant LoRA A/B: batched multi-adapter engine vs seque
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --lora > BENCH_r09.tmp \
 		&& tail -n 1 BENCH_r09.tmp > BENCH_r09.json \
 		&& rm BENCH_r09.tmp && cat BENCH_r09.json
+
+bench-canary:    ## continuous fine-tune→canary→promote closed loop: injected drift → detection→promotion wall time + stable-path canary-split overhead (docs/continuous_tuning.md); rewrites BENCH_r11.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --canary > BENCH_r11.tmp \
+		&& tail -n 1 BENCH_r11.tmp > BENCH_r11.json \
+		&& rm BENCH_r11.tmp && cat BENCH_r11.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

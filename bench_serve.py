@@ -603,6 +603,170 @@ def run_lora(tenants: int = 4, requests_per_tenant: int = 6,
     }
 
 
+def _canary_tune_handler(context, tenant="", output_path="", **kwargs):
+    """The fine-tune job the canary bench's loop submits (local
+    launcher): a deterministic 'retrained' adapter artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlrun_tpu.models import init_lora_nonzero, tiny_llama
+    from mlrun_tpu.serving.adapters import save_adapter
+
+    config = tiny_llama(attention_impl="reference", dtype=jnp.float32)
+    lora = init_lora_nonzero(config, jax.random.PRNGKey(4242), rank=4,
+                             alpha=8.0)
+    save_adapter(output_path, lora)
+    context.log_result("adapter", output_path)
+
+
+def run_canary(requests_per_step: int = 6, steps: int = 10,
+               prompt_tokens: int = 24, max_new: int = 8,
+               max_len: int = 64, slots: int = 2, rank: int = 4,
+               fraction: float = 0.5, seed: int = 0,
+               warmup: bool = True) -> dict:
+    """Continuous fine-tune→canary→promote closed loop
+    (docs/continuous_tuning.md): drift is injected deterministically via
+    the ``monitor.drift`` chaos point, the loop runs on a virtual tick
+    clock (the controller takes an explicit ``now``), and the bench
+    measures the REAL wall costs the loop adds:
+
+    - ``detection_to_promotion_s``: wall seconds from the tick that
+      confirmed drift to the tick that promoted — retrain + canary
+      evaluation machinery end to end.
+    - ``stable_overhead_ratio``: p50 TTFT of STABLE-side requests while
+      monitoring + the canary hash split are active, over a baseline
+      engine with no monitoring at all (the no-regression guard for the
+      stable path).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlrun_tpu.chaos import FaultPoints, chaos
+    from mlrun_tpu.model_monitoring import ContinuousTuningController
+    from mlrun_tpu.models import init_lora_nonzero, init_params, tiny_llama
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference", dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    stable_adapter = init_lora_nonzero(config, jax.random.PRNGKey(100),
+                                       rank=rank, alpha=8.0)
+    tenant = "tenant-0"
+    prompts = [rng.integers(0, config.vocab_size,
+                            prompt_tokens).tolist()
+               for _ in range(requests_per_step)]
+    buckets = (min(32, max_len),)
+
+    def make_engine():
+        engine = ContinuousBatchingEngine(
+            config, params, max_len=max_len, slots=slots,
+            prefill_buckets=buckets, adapters={tenant: stable_adapter})
+        if warmup:
+            engine.warmup()
+        engine.start()
+        return engine
+
+    def drive(engine, step):
+        ttfts = []
+        for i, prompt in enumerate(prompts):
+            _, stats = engine.generate(prompt, max_new_tokens=max_new,
+                                       adapter=tenant,
+                                       request_key=f"s{step}-r{i}")
+            ttfts.append(stats["ttft_s"])
+        return ttfts
+
+    # -- baseline: same engine + workload, no monitoring anywhere ----------
+    engine = make_engine()
+    try:
+        baseline_ttfts = []
+        for step in range(steps):
+            baseline_ttfts += drive(engine, step)
+    finally:
+        engine.stop()
+
+    # -- monitored: the closed loop on a virtual tick clock ----------------
+    def drift_action(point, ctx):
+        box = ctx["box"]
+        if ctx["adapter"] == tenant:
+            box["drifted"] = True
+            box["stats"]["quality_mean"] = 0.5
+        elif ctx["adapter"].startswith(tenant + "@"):
+            box["stats"]["quality_mean"] = 0.9
+
+    engine = make_engine()
+    controller = ContinuousTuningController(
+        engine, project="bench-canary", retrain_kind="local",
+        retrain_handler=_canary_tune_handler, confirm_ticks=2,
+        cooldown_s=600.0, fraction=fraction, warmup_s=0.0,
+        fast_window_s=30.0, slow_window_s=60.0, ttft_target_s=10.0,
+        promote_ticks=2, rollback_ticks=2, reference_min=4,
+        window_min=4, vocab_size=config.vocab_size).start()
+    injection = chaos.inject(FaultPoints.monitor_drift,
+                             action=drift_action)
+    stable_ttfts = []
+    canary_requests = 0
+    detected_wall = promoted_wall = None
+    retrain_wall = 0.0
+    now = 0.0
+    started = time.perf_counter()
+    try:
+        for step in range(steps):
+            router = controller.router
+            for i, prompt in enumerate(prompts):
+                key = f"s{step}-r{i}"
+                _, stats = engine.generate(prompt, max_new_tokens=max_new,
+                                           adapter=tenant,
+                                           request_key=key)
+                _, side = router.resolve(tenant, key)
+                if side == "canary":
+                    canary_requests += 1
+                else:
+                    stable_ttfts.append(stats["ttft_s"])
+            now += 10.0
+            t_tick = time.perf_counter()
+            out = controller.tick(now)
+            tick_wall = time.perf_counter() - t_tick
+            for action in out["actions"]:
+                if action["action"] == "retrain":
+                    detected_wall = time.perf_counter() - started
+                    retrain_wall = tick_wall
+                if action["action"] == "promote" \
+                        and promoted_wall is None:
+                    promoted_wall = time.perf_counter() - started
+            if promoted_wall is not None:
+                break
+    finally:
+        injection.remove()
+        controller.stop()
+        engine.stop()
+
+    base_p50 = _percentile(sorted(baseline_ttfts), 0.50) \
+        if baseline_ttfts else 0.0
+    stable_p50 = _percentile(sorted(stable_ttfts), 0.50) \
+        if stable_ttfts else 0.0
+    return {
+        "model": "tiny", "steps": steps,
+        "requests_per_step": requests_per_step,
+        "prompt_tokens": prompt_tokens, "fraction": fraction,
+        "promoted": promoted_wall is not None,
+        "promoted_adapter": controller.router.stable_id(tenant),
+        "detection_wall_s": round(detected_wall, 3)
+        if detected_wall is not None else None,
+        "detection_to_promotion_s": round(
+            promoted_wall - detected_wall, 3)
+        if promoted_wall is not None and detected_wall is not None
+        else None,
+        "retrain_tick_wall_s": round(retrain_wall, 3),
+        "canary_requests": canary_requests,
+        "stable_requests": len(stable_ttfts),
+        "baseline_ttft_p50_s": round(base_p50, 5),
+        "stable_ttft_p50_monitoring_s": round(stable_p50, 5),
+        "stable_overhead_ratio": round(stable_p50 / base_p50, 3)
+        if base_p50 > 0 else 0.0,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--fleet", action="store_true",
@@ -612,6 +776,9 @@ def main(argv=None):
     parser.add_argument("--lora", action="store_true",
                         help="run the multi-tenant LoRA serving A/B "
                              "instead")
+    parser.add_argument("--canary", action="store_true",
+                        help="run the continuous fine-tune→canary→"
+                             "promote closed-loop bench instead")
     parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
     # the prefix-cache bench stresses ONE engine with long prompts,
@@ -633,7 +800,9 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.lora:
+    if args.canary:
+        result = run_canary(**overrides(max_new=8, max_len=64))
+    elif args.lora:
         result = run_lora(tenants=args.tenants,
                           **overrides(max_new=8, page_size=16,
                                       max_len=128))

@@ -87,6 +87,14 @@ class FaultPoints:
     # deterministic scale-event injection, an error models a failed
     # scale evaluation
     obs_autoscale = "obs.autoscale"
+    # one per-adapter drift evaluation (model_monitoring/
+    # stream_processing.py AdapterTrafficMonitor.evaluate) — fires with
+    # a mutable ``box`` carrying the computed windowed stats and the
+    # drifted verdict; an action() may overwrite box["stats"] /
+    # box["drifted"] for deterministic drift injection into the
+    # continuous fine-tune→canary→promote loop (docs/
+    # continuous_tuning.md), an error models a failed analyzer pass
+    monitor_drift = "monitor.drift"
     # training device-prefetch stage (training/data.py
     # DevicePrefetchIterator): fires on the background thread once per
     # host batch BEFORE the H2D transfer — a delay() stalls the input
@@ -106,7 +114,8 @@ class FaultPoints:
             FaultPoints.serving_queue, FaultPoints.llm_submit,
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
             FaultPoints.llm_adapter_load,
-            FaultPoints.obs_autoscale, FaultPoints.train_prefetch,
+            FaultPoints.obs_autoscale, FaultPoints.monitor_drift,
+            FaultPoints.train_prefetch,
         ]
 
 

@@ -306,6 +306,90 @@ default_config: dict[str, Any] = {
         # metric time-series retention (tsdb.py prune, applied by the
         # controller on each window pass)
         "tsdb_retention_days": 30.0,
+        # continuous fine-tune→canary→promote loop
+        # (docs/continuous_tuning.md): per-adapter drift monitoring over
+        # serving-side samples feeding automatic LoRA retraining, canary
+        # hash-split serving, and burn-rate promote/rollback — no human
+        # in the loop. ContinuousTuningController class args override
+        # these per instance.
+        "continuous": {
+            "enabled": False,
+            # controller tick spacing for callers running the loop off a
+            # timer (the tick itself takes an explicit ``now``, like
+            # service/autoscaler.py — no hidden wall-clock reads)
+            "tick_interval_s": 15.0,
+            # -- drift detection (AdapterTrafficMonitor) --
+            "drift": {
+                # bounded-histogram shape for the windowed token/output
+                # sketches (O(bins) memory per adapter, any volume)
+                "token_bins": 32,
+                "length_bins": 16,
+                # samples locked in as the per-adapter reference
+                # distribution before drift is ever evaluated
+                "reference_min": 32,
+                # samples a window needs before it yields a verdict
+                # (smaller windows return "no signal", never "no drift")
+                "window_min": 16,
+                # PSI over the reference vs window histograms at/over
+                # this = drifted (0.2 is the classic "significant
+                # population shift" PSI rule of thumb)
+                "psi_threshold": 0.2,
+                # consecutive drifted ticks before a retrain triggers
+                # (hysteresis against one bursty window)
+                "confirm_ticks": 2,
+                # distinct adapters the monitor tracks (bounded state)
+                "max_adapters": 64,
+            },
+            # -- drift → fine-tune trigger --
+            "retrain": {
+                # runtime kind the fine-tune submits as ("tpujob" on a
+                # cluster; tests use "local" with a handler override)
+                "kind": "tpujob",
+                # dotted "module.fn" handler for the fine-tune job; the
+                # job receives params {tenant, base_adapter, output_path,
+                # drift} and must write the adapter .npz to output_path
+                "handler": "",
+                "image": "",
+                # seconds after a retrain concludes (promote, rollback,
+                # or failure) before the same tenant may retrain again
+                "cooldown_s": 600.0,
+            },
+            # -- canary serving + promote/rollback --
+            "canary": {
+                # fraction of the tenant's traffic hash-split onto the
+                # canary adapter (deterministic per request key)
+                "fraction": 0.2,
+                # seconds of canary traffic before evaluation starts
+                "warmup_s": 30.0,
+                # multi-window burn-rate evaluation (obs/slo.py) windows
+                "fast_window_s": 60.0,
+                "slow_window_s": 300.0,
+                # p95 TTFT the canary must hold (latency objective);
+                # <= 0 skips the latency objective
+                "ttft_target_s": 0.0,
+                "ttft_q": 0.95,
+                # allowed quality-stat degradation canary-vs-stable
+                # (quality_delta objective over mlt_drift_stat)
+                "quality_target": 0.25,
+                # the monitor stat the quality objective compares
+                # (higher = better under "lower_worse")
+                "quality_stat": "quality_mean",
+                "quality_direction": "lower_worse",
+                # consecutive better/worse evaluations before the loop
+                # promotes / rolls back
+                "promote_ticks": 3,
+                "rollback_ticks": 2,
+                # a canary that reaches this age without a conclusive
+                # verdict (e.g. the tenant's traffic dried up mid-canary
+                # and the windows carry no signal) rolls back — the loop
+                # must always conclude, or the tenant stays debounced
+                # and the canary pins a bank slot forever
+                "max_age_s": 3600.0,
+                # burn level (fraction of the objective budget) the slow
+                # AND fast windows must stay under to count as "better"
+                "promote_max_burn": 0.5,
+            },
+        },
     },
     "packagers": {"enabled": True},
     "background_tasks": {"default_timeout": 600},

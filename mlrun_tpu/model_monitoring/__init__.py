@@ -6,15 +6,19 @@ from .applications import (  # noqa: F401
     MonitoringContext,
 )
 from .controller import (  # noqa: F401
+    ContinuousTuningController,
     ModelMonitoringWriter,
     MonitoringApplicationController,
 )
 from .metrics import (  # noqa: F401
+    FixedHistogram,
     hellinger_distance,
     kl_divergence,
+    psi,
     total_variance_distance,
 )
 from .stream_processing import (  # noqa: F401
+    AdapterTrafficMonitor,
     EventStreamProcessor,
     get_monitoring_parquet_dir,
     get_monitoring_stream,

@@ -35,6 +35,68 @@ def kl_divergence(p, q, symmetric: bool = True) -> float:
     return kl_pq + kl_qp
 
 
+def psi(actual, expected, eps: float = 1e-4) -> float:
+    """Population stability index between two histograms: ``Σ (a_i -
+    e_i) · ln(a_i / e_i)`` over normalized bins, epsilon-smoothed so an
+    empty bin contributes a large-but-finite term. Always >= 0; the
+    classic interpretation bands are < 0.1 stable, 0.1-0.2 moderate
+    shift, >= 0.2 significant shift (the default drift threshold in
+    ``mlconf.model_monitoring.continuous.drift``)."""
+    a = _normalize(actual) + eps
+    e = _normalize(expected) + eps
+    a, e = a / a.sum(), e / e.sum()
+    return float(np.sum((a - e) * np.log(a / e)))
+
+
+class FixedHistogram:
+    """Bounded histogram over a FIXED ``[lo, hi)`` range — the
+    serving-side token/length/latency sketch behind the drift monitor
+    (stream_processing.AdapterTrafficMonitor): O(bins) state at any
+    traffic volume, out-of-range values clip into the edge bins, and two
+    windows over the same shape compare directly (PSI/KL share support
+    by construction). Unlike :class:`StreamingHistogram` there is no
+    warmup/range-lock phase: the range is known up front (token ids in
+    [0, vocab), output lengths in [0, max_new], ...)."""
+
+    __slots__ = ("lo", "hi", "bins", "counts", "total")
+
+    def __init__(self, lo: float, hi: float, bins: int = 32):
+        if not hi > lo:
+            raise ValueError(f"need hi > lo, got [{lo}, {hi})")
+        if bins <= 0:
+            raise ValueError(f"bins must be > 0, got {bins}")
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.bins = int(bins)
+        self.counts = np.zeros(self.bins, dtype=np.int64)
+        self.total = 0
+
+    def update(self, values) -> None:
+        values = np.asarray(values, dtype=np.float64).ravel()
+        values = values[np.isfinite(values)]
+        if values.size == 0:
+            return
+        scaled = (values - self.lo) / (self.hi - self.lo) * self.bins
+        idx = np.clip(scaled.astype(np.int64), 0, self.bins - 1)
+        np.add.at(self.counts, idx, 1)
+        self.total += int(values.size)
+
+    def merge(self, other: "FixedHistogram") -> None:
+        if (other.lo, other.hi, other.bins) != (self.lo, self.hi,
+                                                self.bins):
+            raise ValueError("cannot merge FixedHistograms of different "
+                             "shape")
+        self.counts += other.counts
+        self.total += other.total
+
+    def snapshot(self) -> np.ndarray:
+        return self.counts.copy()
+
+    def reset(self) -> None:
+        self.counts[:] = 0
+        self.total = 0
+
+
 def histogram(values, bins: int = 20, range_=None) -> tuple[np.ndarray, np.ndarray]:
     values = np.asarray(values, dtype=np.float64)
     values = values[np.isfinite(values)]

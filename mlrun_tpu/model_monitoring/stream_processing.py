@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import json
 import os
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Optional
 
+from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..utils import logger, now_iso
 
@@ -189,3 +190,168 @@ class EventStreamProcessor:
         except Exception as exc:  # noqa: BLE001 - monitoring is best-effort
             logger.warning("failed to update model endpoint",
                            endpoint=endpoint_id, error=str(exc))
+
+
+# -- serving-side per-adapter traffic analysis (docs/continuous_tuning.md) ---
+class _AdapterTraffic:
+    """One adapter's monitoring state: a locked reference distribution
+    (the first ``reference_min`` samples after (re)baselining) plus the
+    current analysis window, all in fixed-memory sketches."""
+
+    __slots__ = ("ref_tokens", "ref_lengths", "cur_tokens", "cur_lengths",
+                 "ref_count", "locked", "quality", "ttft", "seen")
+
+    def __init__(self, monitor: "AdapterTrafficMonitor"):
+        from .metrics import FixedHistogram
+
+        shape = (0.0, float(monitor.vocab_size), monitor.token_bins)
+        len_shape = (0.0, float(monitor.max_output_len),
+                     monitor.length_bins)
+        self.ref_tokens = FixedHistogram(*shape)
+        self.ref_lengths = FixedHistogram(*len_shape)
+        self.cur_tokens = FixedHistogram(*shape)
+        self.cur_lengths = FixedHistogram(*len_shape)
+        self.ref_count = 0
+        self.locked = False
+        # rolling per-sample stats (bounded; survive window resets so a
+        # low-traffic canary still yields quality/latency points)
+        self.quality: deque = deque(maxlen=256)
+        self.ttft: deque = deque(maxlen=256)
+        self.seen = 0
+
+
+class AdapterTrafficMonitor:
+    """Per-adapter windowed token/logit/output statistics from
+    serving-side samples (``serving/samples.py``) — the drift half of
+    the continuous fine-tune→canary→promote loop.
+
+    Each adapter's first ``reference_min`` samples lock a reference
+    distribution (output token ids + output lengths in bounded
+    ``FixedHistogram`` sketches). After that, samples accumulate into
+    the current window; once it holds ``window_min`` samples,
+    :meth:`evaluate` yields a drift verdict — PSI (and symmetric KL)
+    between window and reference, drifted when PSI crosses
+    ``psi_threshold`` — and resets the window. Smaller windows yield
+    ``drifted=None`` ("no signal"), never "no drift".
+
+    Rolling per-sample stats (first-token logit margin as
+    ``quality_mean``, TTFT mean) ride every evaluation so the canary
+    evaluator's ``quality_delta`` objective has per-adapter series even
+    at canary traffic volumes.
+
+    Every evaluation fires the ``monitor.drift`` chaos point with a
+    mutable ``box`` — a test's ``action()`` can overwrite
+    ``box["stats"]`` / ``box["drifted"]`` for deterministic drift
+    injection with zero wall-clock coupling. Deterministic by
+    construction: no internal clock reads; ``now`` is the caller's.
+    """
+
+    def __init__(self, vocab_size: int = 32768,
+                 token_bins: int | None = None,
+                 length_bins: int | None = None,
+                 max_output_len: int = 512,
+                 reference_min: int | None = None,
+                 window_min: int | None = None,
+                 psi_threshold: float | None = None,
+                 max_adapters: int | None = None):
+        conf = mlconf.model_monitoring.continuous.drift
+
+        def knob(value, name, cast):
+            return cast(getattr(conf, name)) if value is None \
+                else cast(value)
+
+        self.vocab_size = int(vocab_size)
+        self.token_bins = knob(token_bins, "token_bins", int)
+        self.length_bins = knob(length_bins, "length_bins", int)
+        self.max_output_len = int(max_output_len)
+        self.reference_min = knob(reference_min, "reference_min", int)
+        self.window_min = knob(window_min, "window_min", int)
+        self.psi_threshold = knob(psi_threshold, "psi_threshold", float)
+        self.max_adapters = knob(max_adapters, "max_adapters", int)
+        self._state: dict[str, _AdapterTraffic] = {}
+        self.dropped_adapters = 0   # samples past the adapter cap
+
+    # -- ingestion -----------------------------------------------------------
+    def adapters(self) -> list:
+        return sorted(self._state)
+
+    def observe(self, sample: dict) -> None:
+        """Fold one completed-request sample (see
+        ``serving/samples.emit_sample`` for the schema)."""
+        adapter = sample.get("adapter", "") or ""
+        state = self._state.get(adapter)
+        if state is None:
+            if len(self._state) >= self.max_adapters:
+                self.dropped_adapters += 1
+                return
+            state = self._state[adapter] = _AdapterTraffic(self)
+        state.seen += 1
+        tokens = sample.get("tokens") or []
+        generated = sample.get("generated", len(tokens))
+        if not state.locked:
+            state.ref_tokens.update(tokens)
+            state.ref_lengths.update([generated])
+            state.ref_count += 1
+            if state.ref_count >= self.reference_min:
+                state.locked = True
+        else:
+            state.cur_tokens.update(tokens)
+            state.cur_lengths.update([generated])
+        margin = sample.get("logit_margin")
+        if margin is not None and margin == margin:  # finite, non-NaN
+            state.quality.append(float(margin))
+        ttft = sample.get("ttft_s")
+        if ttft is not None:
+            state.ttft.append(float(ttft))
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, adapter: str, now: float) -> tuple[dict, object]:
+        """One drift evaluation for ``adapter`` at ``now`` → ``(stats,
+        drifted)`` where ``drifted`` is True/False on a full window and
+        None while the window (or the reference) is still filling. A
+        True/False verdict consumes the window (the next one starts
+        fresh); rolling quality/latency stats are always present when
+        any sample carried them."""
+        from .metrics import kl_divergence, psi
+
+        state = self._state.get(adapter)
+        if state is None:
+            stats = {"sample_count": 0.0}
+            return self._fire(adapter, stats, None, now)
+        stats = {
+            # one per SAMPLE (the lengths sketch takes one value per
+            # request; the tokens sketch counts one per token id)
+            "sample_count": float(state.cur_lengths.total
+                                  if state.locked else 0),
+            "reference_count": float(state.ref_count),
+        }
+        if state.quality:
+            stats["quality_mean"] = sum(state.quality) / len(state.quality)
+        if state.ttft:
+            stats["ttft_mean_s"] = sum(state.ttft) / len(state.ttft)
+        drifted = None
+        if state.locked and state.cur_lengths.total >= self.window_min:
+            stats["token_psi"] = psi(state.cur_tokens.snapshot(),
+                                     state.ref_tokens.snapshot())
+            stats["token_kld"] = kl_divergence(
+                state.cur_tokens.snapshot(), state.ref_tokens.snapshot())
+            stats["length_psi"] = psi(state.cur_lengths.snapshot(),
+                                      state.ref_lengths.snapshot())
+            drifted = (stats["token_psi"] >= self.psi_threshold
+                       or stats["length_psi"] >= self.psi_threshold)
+            state.cur_tokens.reset()
+            state.cur_lengths.reset()
+        return self._fire(adapter, stats, drifted, now)
+
+    @staticmethod
+    def _fire(adapter: str, stats: dict, drifted, now: float):
+        box = {"adapter": adapter, "stats": stats, "drifted": drifted}
+        fire(FaultPoints.monitor_drift, box=box, adapter=adapter, now=now)
+        return box["stats"], box["drifted"]
+
+    def rebase(self, adapter: str) -> None:
+        """Drop the adapter's reference AND window so the NEXT
+        ``reference_min`` samples lock a fresh baseline — called after a
+        promotion (the drifted traffic is the new normal; keeping the
+        old reference would re-trigger a retrain forever)."""
+        self._state.pop(adapter, None)

@@ -182,6 +182,35 @@ FLEET_REPLICAS = REGISTRY.gauge(
     "mlt_fleet_replicas", "Live fleet replicas by role",
     labels=("role",), overflow="drop")
 
+# -- model monitoring / continuous tuning (model_monitoring/,
+# serving/canary.py — docs/continuous_tuning.md) -----------------------------
+DRIFT_STAT = REGISTRY.gauge(
+    "mlt_drift_stat",
+    "Windowed per-adapter traffic statistics from the serving-side "
+    "sample analyzer (stat = token_psi | token_kld | length_psi | "
+    "quality_mean | ttft_mean_s | sample_count); the quality_delta SLO "
+    "kind compares these canary-vs-stable",
+    labels=("adapter", "stat"), max_label_sets=512, overflow="drop")
+DRIFT_EVENTS = REGISTRY.counter(
+    "mlt_drift_events_total",
+    "Drift state-machine transitions per adapter (detected | confirmed "
+    "| retrain_submitted | retrain_failed)",
+    labels=("adapter", "event"), max_label_sets=512, overflow="drop")
+CANARY_REQUESTS = REGISTRY.counter(
+    "mlt_canary_requests_total",
+    "Requests resolved through the canary hash split, by side (the "
+    "adapter label is the TENANT id, not the versioned adapter id)",
+    labels=("adapter", "side"), max_label_sets=512, overflow="drop")
+CANARY_STATE = REGISTRY.gauge(
+    "mlt_canary_state",
+    "Canary lifecycle per tenant: 0 none, 1 canary serving a split, "
+    "2 last canary promoted, -1 last canary rolled back",
+    labels=("adapter",), max_label_sets=256, overflow="drop")
+CANARY_DECISIONS = REGISTRY.counter(
+    "mlt_canary_decisions_total",
+    "Closed-loop decisions per tenant (start | promote | rollback)",
+    labels=("adapter", "decision"), max_label_sets=512, overflow="drop")
+
 # -- run lifecycle -----------------------------------------------------------
 RUN_SUBMITS = REGISTRY.counter(
     "mlt_run_submits_total", "Runs launched via the server-side launcher",

@@ -31,6 +31,17 @@ Burn-rate definitions (budget = allowed bad fraction):
   narrows to one badput class (e.g. alert on preemption downtime
   alone). Evaluation rides the same windowed-increase path as
   ``error_rate`` — nothing below this constructor changes.
+- ``quality_delta``: objective "a gauge statistic under
+  ``canary_labels`` must not degrade more than ``target`` against the
+  same statistic under ``labels``" — the canary-vs-stable comparison
+  behind the continuous-tuning loop (docs/continuous_tuning.md), over
+  the per-adapter ``mlt_drift_stat`` series by default. Budget is 1.0
+  so ``burn == windowed degradation / target``: burn 1.0 means the
+  canary is worse by exactly the allowed delta; burn 0 means at least
+  as good. ``direction`` says which way is worse for the statistic
+  (``"higher_worse"`` — e.g. a drift score — or ``"lower_worse"`` —
+  e.g. a confidence/quality mean). Either side's window being empty is
+  "no signal", never a verdict.
 
 ``burn = bad_fraction / budget``; burn 1.0 = exactly on budget.
 
@@ -60,7 +71,8 @@ SLO_BREACHES = REGISTRY.counter(
     "Multi-window burn-rate breaches emitted to the alert machinery",
     labels=("slo",), overflow="drop")
 
-_KINDS = ("latency", "error_rate", "availability", "goodput")
+_KINDS = ("latency", "error_rate", "availability", "goodput",
+          "quality_delta")
 
 # default event kind SLO breaches are emitted under — alert configs list
 # it in trigger_events (see service/alerts.ALERT_TEMPLATES["SLOBurnRate"])
@@ -81,9 +93,36 @@ class SLO:
                  labels: Optional[dict] = None,
                  severity: str = "high",
                  adapter: Optional[str] = None,
-                 run: Optional[str] = None):
+                 run: Optional[str] = None,
+                 canary_labels: Optional[dict] = None,
+                 direction: str = "higher_worse"):
         if kind not in _KINDS:
             raise ValueError(f"unknown SLO kind '{kind}' (one of {_KINDS})")
+        if kind == "quality_delta":
+            # like the goodput sugar: swap the latency-family default
+            # for the drift-stat gauges the comparison is documented
+            # over — an explicit family= still wins
+            if family == "mlt_llm_ttft_seconds":
+                family = "mlt_drift_stat"
+            if not canary_labels:
+                raise ValueError(
+                    "quality_delta SLO needs canary_labels (the series "
+                    "compared against the stable-side labels)")
+            if dict(canary_labels) == dict(labels or {}):
+                raise ValueError(
+                    "quality_delta SLO canary_labels must differ from "
+                    "labels — identical sides always read delta 0")
+            if direction not in ("higher_worse", "lower_worse"):
+                raise ValueError(
+                    f"quality_delta direction must be 'higher_worse' or "
+                    f"'lower_worse', got '{direction}'")
+            if target <= 0:
+                raise ValueError(
+                    "quality_delta SLO target (allowed degradation) "
+                    "must be > 0")
+        elif canary_labels is not None:
+            raise ValueError(
+                "canary_labels is quality_delta-only sugar")
         if kind == "goodput":
             # goodput sugar: swap the serving-path default counters for
             # the run-lifecycle accounting families and fold a run=
@@ -122,10 +161,10 @@ class SLO:
                 raise ValueError(f"latency SLO needs 0 < q < 1, got {q}")
             if target <= 0:
                 raise ValueError("latency SLO target must be > 0 seconds")
-        elif not 0 < target < 1:
+        elif kind != "quality_delta" and not 0 < target < 1:
             raise ValueError(
                 f"{kind} SLO target must be a fraction in (0, 1)")
-        if kind != "latency" and bad == total \
+        if kind not in ("latency", "quality_delta") and bad == total \
                 and dict(bad_labels or {}) == dict(total_labels or {}):
             # bad/total over the identical series is always 1.0 — a
             # constant max-burn false breach, never a real objective
@@ -145,12 +184,15 @@ class SLO:
         self.severity = severity
         self.adapter = adapter
         self.run = run
+        self.canary_labels = dict(canary_labels or {})
+        self.direction = direction
 
     @classmethod
     def from_config(cls, config: dict) -> "SLO":
         known = ("name", "kind", "target", "family", "q", "bad",
                  "bad_labels", "total", "total_labels", "labels",
-                 "severity", "adapter", "run")
+                 "severity", "adapter", "run", "canary_labels",
+                 "direction")
         unknown = set(config) - set(known)
         if unknown:
             raise ValueError(
@@ -164,7 +206,21 @@ class SLO:
             return 1.0 - self.q
         if self.kind in ("availability", "goodput"):
             return 1.0 - self.target
+        if self.kind == "quality_delta":
+            # burn == bad_fraction == degradation / target directly:
+            # burn 1.0 = the canary is worse by exactly the allowed delta
+            return 1.0
         return self.target
+
+    def _window_mean(self, store, window: float, at: float,
+                     labels: dict) -> Optional[float]:
+        """Mean of one side's windowed gauge points (bucket-avg, then
+        time-avg) — None when the window carries no points."""
+        pts = store.points(self.family, at - window, at,
+                           labels=labels or None, agg="avg")
+        if not pts:
+            return None
+        return sum(v for _, v in pts) / len(pts)
 
     def bad_fraction(self, store, window: float,
                      at: float) -> Optional[float]:
@@ -173,6 +229,20 @@ class SLO:
         if self.kind == "latency":
             return store.fraction_over(self.family, self.target, window,
                                        at, labels=self.labels or None)
+        if self.kind == "quality_delta":
+            stable = self._window_mean(store, window, at, self.labels)
+            canary = self._window_mean(store, window, at,
+                                       self.canary_labels)
+            if stable is None or canary is None:
+                return None
+            delta = canary - stable
+            if self.direction == "lower_worse":
+                delta = -delta
+            # deliberately NOT clamped to 1.0: burn must be able to
+            # exceed the evaluator's thresholds (the global evaluator
+            # runs fast_burn 14.4 / slow_burn 6.0 — a capped burn could
+            # never breach there no matter how bad the canary got)
+            return max(0.0, delta / self.target)
         total = store.increase(self.total, window, at,
                                labels=self.total_labels or None)
         if total <= 0:
@@ -190,6 +260,9 @@ class SLO:
             out["run"] = self.run
         if self.kind == "latency":
             out.update(family=self.family, q=self.q)
+        elif self.kind == "quality_delta":
+            out.update(family=self.family, direction=self.direction,
+                       canary_labels=self.canary_labels)
         else:
             out.update(bad=self.bad, total=self.total)
         return out

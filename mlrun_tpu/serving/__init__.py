@@ -1,7 +1,19 @@
+from .canary import (  # noqa: F401
+    CanaryRouter,
+    get_canary_router,
+    set_canary_router,
+    split_key_for,
+)
 from .fleet import (  # noqa: F401
     ConsistentHashRing,
     EngineFleet,
     EngineReplica,
+)
+from .samples import (  # noqa: F401
+    SampleRing,
+    emit_sample,
+    sampling_enabled,
+    set_sample_observer,
 )
 from .remote import BatchHttpRequests, RemoteCallError, RemoteStep  # noqa: F401
 from .resilience import (  # noqa: F401

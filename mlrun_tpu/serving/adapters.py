@@ -269,6 +269,42 @@ class AdapterRegistry:
             "cannot size the adapter bank: no sources to infer "
             "rank/targets from — pass rank= (and targets=) explicitly")
 
+    # -- source lifecycle (continuous tuning, docs/continuous_tuning.md) -----
+    def add_source(self, name: str, source):
+        """Publish a new named adapter at runtime (tree | artifact path |
+        callable) — the canary hot-load path. Names are immutable
+        versions: refusing to overwrite an existing source keeps the
+        prefix-cache identity contract honest (publish under a NEW
+        versioned name instead)."""
+        with self._lock:
+            existing = self.sources.get(name)
+            if existing is not None and existing is not source:
+                raise ValueError(
+                    f"adapter '{name}' already has a source — adapter "
+                    f"names are immutable versions; publish new weights "
+                    f"under a new versioned name")
+            self.sources[name] = source
+
+    def retire(self, name: str, keep_source: bool = False):
+        """Take an adapter out of service: drop its source (unless
+        ``keep_source``) and host-cache entry, and free its bank slot if
+        no in-flight request pins it. A still-pinned resident keeps
+        serving its in-flight requests and becomes LRU-evictable once
+        the pins drain — retire never fails live traffic."""
+        with self._lock:
+            if not keep_source:
+                self.sources.pop(name, None)
+            self._host_cache.pop(name, None)
+            resident = self._residents.get(name)
+            if resident is None or resident.refcount > 0:
+                return
+            del self._residents[name]
+            slot = resident.slot
+            self._free_slots.append(slot)
+            self.stats["adapter_evictions"] += 1
+        fire(FaultPoints.llm_adapter_load, op="evict", adapter=name,
+             slot=slot)
+
     # -- host-side loading ---------------------------------------------------
     def known(self, name: str) -> bool:
         with self._lock:

@@ -425,19 +425,33 @@ class EngineFleet:
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
                max_wait: float | None = None,
-               adapter: str = "") -> Future:
+               adapter: str = "", request_key=None) -> Future:
         """Route one request into the fleet; resolves to (tokens, stats)
         exactly like an engine future, with ``stats`` gaining ``replica``
         (and ``prefill_replica``/``prefill_s``/``handoff_bytes`` when
         disaggregated). 503-class replica failures re-dispatch to the
         next ring node up to ``max_dispatch_attempts`` times.
         ``adapter`` is the tenant id: it namespaces the routing key and
-        rides the dispatch (and any KV handoff) into the engines."""
+        rides the dispatch (and any KV handoff) into the engines. A
+        tenant with canary-loop state resolves to its effective
+        versioned id BEFORE the routing key is computed
+        (serving/canary.py), so canary traffic routes — and caches — as
+        its own identity; ``request_key`` pins the split side."""
         out: Future = Future()
         if self._stopped:
             out.set_exception(EngineStoppedError(
                 "fleet is stopped, not accepting requests"))
             return out
+        route_adapter = adapter or ""
+        if adapter:
+            from .canary import resolve_adapter
+
+            # key computation only (count=False): the ENGINE is the
+            # single resolution/metering authority — it re-resolves with
+            # the SAME request key threaded below, so the routing key
+            # here and the identity there always agree
+            route_adapter = resolve_adapter(adapter, prompt_tokens,
+                                            request_key, count=False)
         span = get_tracer().current()
         state = {
             "prompt": list(prompt_tokens),
@@ -445,7 +459,8 @@ class EngineFleet:
             "sampling": (float(temperature), int(top_k), float(top_p)),
             "max_wait": max_wait,
             "adapter": adapter or "",
-            "key": self.routing_key(prompt_tokens, adapter=adapter or ""),
+            "request_key": request_key,
+            "key": self.routing_key(prompt_tokens, adapter=route_adapter),
             "t0": time.perf_counter(),
             "attempts": 0, "tried": [], "tried_decode": [],
             "trace": ((span.trace_id, span.span_id)
@@ -460,11 +475,27 @@ class EngineFleet:
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None, timeout: float = 300.0,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, adapter: str = ""):
+                 top_p: float = 1.0, adapter: str = "",
+                 request_key=None):
         return self.submit(prompt_tokens, max_new_tokens, eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p,
-                           adapter=adapter).result(timeout=timeout)
+                           top_p=top_p, adapter=adapter,
+                           request_key=request_key).result(timeout=timeout)
+
+    # -- adapter source lifecycle (docs/continuous_tuning.md) ----------------
+    def add_adapter_source(self, name: str, source):
+        """Publish a named adapter on every replica's registry (the
+        canary hot-load path) — idempotent for replicas sharing one
+        registry."""
+        for replica in self.replicas:
+            replica.engine.add_adapter_source(name, source)
+
+    def retire_adapter(self, name: str, keep_source: bool = False):
+        """Drop an adapter fleet-wide (promotion's old-stable evict / a
+        rollback's canary teardown); per-replica in-flight pins finish
+        first."""
+        for replica in self.replicas:
+            replica.engine.retire_adapter(name, keep_source=keep_source)
 
     def _fail(self, out: Future, state: dict, exc: Exception):
         with self._lock:
@@ -520,7 +551,7 @@ class EngineFleet:
                 eos_id=state["eos_id"], temperature=state["sampling"][0],
                 top_k=state["sampling"][1], top_p=state["sampling"][2],
                 max_wait=state["max_wait"], adapter=state["adapter"],
-                _trace=state["trace"])
+                request_key=state["request_key"], _trace=state["trace"])
         except Exception as exc:  # noqa: BLE001 - routed to the client
             self._fail(out, state, exc)
             return
@@ -560,7 +591,7 @@ class EngineFleet:
                 temperature=state["sampling"][0],
                 top_k=state["sampling"][1], top_p=state["sampling"][2],
                 max_wait=state["max_wait"], adapter=state["adapter"],
-                _trace=state["trace"])
+                request_key=state["request_key"], _trace=state["trace"])
         except Exception as exc:  # noqa: BLE001 - routed to the client
             self._fail(out, state, exc)
             return

@@ -338,11 +338,13 @@ class LLMEngine:
 
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None,
-                 adapter: str = "") -> tuple[list[int], dict]:
+                 adapter: str = "",
+                 request_key=None) -> tuple[list[int], dict]:
         """Greedy/temperature generation for a single prompt (batch=1 row
         replicated); returns (tokens, timing stats). ``adapter`` names a
         registry adapter applied to every row (404s typed when
-        unknown)."""
+        unknown); a tenant id with canary-loop state resolves to its
+        effective versioned id first (serving/canary.py)."""
         import numpy as np
 
         prompt = np.asarray(prompt_tokens, dtype=np.int32).reshape(1, -1)
@@ -353,6 +355,17 @@ class LLMEngine:
             raise PromptTooLongError(
                 f"prompt_len {prompt_len} + max_new_tokens "
                 f"{max_new_tokens} exceeds max_len {self.max_len}")
+        split_tenant = split_side = ""
+        if adapter:
+            from .canary import get_canary_router, split_key_for
+
+            router = get_canary_router()
+            if router is not None:
+                resolved, side = router.resolve(
+                    adapter, split_key_for(prompt_tokens, request_key))
+                if side:
+                    split_tenant, split_side = adapter, side
+                adapter = resolved
         if adapter and self._adapters is None:
             from .adapters import UnknownAdapterError
 
@@ -384,7 +397,35 @@ class LLMEngine:
             "prompt_len": prompt_len,
             "generated": len(out_tokens),
         }
+        if split_side:
+            # metered on SUCCESS only (a typed rejection above never
+            # reaches here) — the split-fraction telemetry counts
+            # served requests
+            from ..obs import CANARY_REQUESTS
+
+            CANARY_REQUESTS.inc(adapter=split_tenant, side=split_side)
+        from .samples import emit_sample, sampling_enabled
+
+        if sampling_enabled():
+            emit_sample(adapter=adapter, tokens=list(out_tokens),
+                        prompt_len=prompt_len, generated=len(out_tokens),
+                        ttft_s=ttft,
+                        total_s=time.perf_counter() - t0,
+                        logit_margin=float("nan"),
+                        engine=type(self).__name__, replica="")
         return out_tokens, stats
+
+    # -- adapter source lifecycle (docs/continuous_tuning.md) ----------------
+    def add_adapter_source(self, name: str, source):
+        if self._adapters is None:
+            raise ValueError(
+                "engine has no adapter registry (build it with "
+                "adapters=... to hot-load canaries)")
+        self._adapters.add_source(name, source)
+
+    def retire_adapter(self, name: str, keep_source: bool = False):
+        if self._adapters is not None:
+            self._adapters.retire(name, keep_source=keep_source)
 
     def _generate_inner(self, prompt, prompt_len, bucket, padded,
                         max_new_tokens, eos_id, t0, kw):
@@ -766,8 +807,13 @@ class LLMModelServer:
                 # v2 body tenant id: {"inputs": [...], "adapter": "t1"}
                 # threads through submit()/generate() to the batched
                 # multi-LoRA decode (docs/serving.md "Multi-tenant
-                # LoRA"); unknown names 404 typed, capacity/fairness 429
+                # LoRA"); unknown names 404 typed, capacity/fairness 429.
+                # An optional "request_key" (session/user id) pins the
+                # canary hash split's side for this client
+                # (docs/continuous_tuning.md) — absent, the prompt
+                # tokens decide deterministically.
                 adapter = request.get("adapter", "") or ""
+                request_key = request.get("request_key") or None
                 id_lists = []
                 for item in inputs:
                     if isinstance(item, str):
@@ -787,7 +833,7 @@ class LLMModelServer:
                         ids, max_new_tokens=self.max_new_tokens,
                         temperature=self.temperature,
                         top_k=self.top_k, top_p=self.top_p,
-                        adapter=adapter)
+                        adapter=adapter, request_key=request_key)
                         for ids in id_lists]
                     results = [f.result(timeout=600) for f in futures]
                     if results:
@@ -811,7 +857,7 @@ class LLMModelServer:
                     for ids in id_lists:
                         tokens, stats = self.engine.generate(
                             ids, max_new_tokens=self.max_new_tokens,
-                            adapter=adapter)
+                            adapter=adapter, request_key=request_key)
                         self.set_metric("ttft_s", stats["ttft_s"])
                         self.set_metric("decode_tps",
                                         stats["decode_tokens_per_sec"])

@@ -54,7 +54,9 @@ from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope, rope_table
 from ..utils import logger
 from ..utils.profiler import tick as profiler_tick
+from .canary import get_canary_router, split_key_for
 from .llm import _cached_attention, _forward_with_cache, init_kv_cache
+from .samples import emit_sample, sampling_enabled
 from .resilience import (  # noqa: F401 - EngineStoppedError re-exported
     DeadlineExceeded,
     DegradationLadder,
@@ -248,6 +250,9 @@ class _Admission:
     # slot (resolved at admission by AdapterRegistry.ensure_loaded)
     adapter: str = ""
     adapter_slot: int = 0
+    # monitoring tap (serving/samples.py): first-token top1-top2 logit
+    # gap, captured at prefill only while a sample observer is armed
+    logit_margin: float = float("nan")
 
 
 @dataclass
@@ -271,6 +276,8 @@ class _Slot:
     # (the decode tick gathers per-row factors by adapter_slot)
     adapter: str = ""
     adapter_slot: int = 0
+    # monitoring tap: threaded from the admission for the finish sample
+    logit_margin: float = float("nan")
 
     @property
     def active(self) -> bool:
@@ -784,7 +791,7 @@ class ContinuousBatchingEngine:
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0,
                max_wait: float | None = None, adapter: str = "",
-               _extra=None, _trace=None) -> Future:
+               request_key=None, _extra=None, _trace=None) -> Future:
         """Thread-safe request submission. ``max_wait`` overrides the
         engine-level queue-time budget for this request. The returned
         future fails FAST — QueueFullError when shedding,
@@ -820,6 +827,25 @@ class ContinuousBatchingEngine:
                 f"{max_new_tokens} exceeds max_len {self.max_len}"))
             return future
         adapter = adapter or ""
+        split_tenant = split_side = ""
+        if adapter and not isinstance(_extra, KVHandoff):
+            # canary/version resolution (serving/canary.py): a tenant id
+            # with loop state becomes its effective versioned id HERE,
+            # before the prefix cache, the rate limiter and the bank see
+            # it — canary traffic is a distinct identity end to end. An
+            # imported handoff arrives already resolved (the prefill
+            # side decided its side) and must not re-roll the split.
+            # ``request_key`` pins the split side across requests (a
+            # session id); absent, the prompt tokens decide. Metering
+            # happens at admission (_meter_split), not here — shed
+            # requests must not skew the split-fraction telemetry.
+            router = get_canary_router()
+            if router is not None:
+                resolved, side = router.resolve(
+                    adapter, split_key_for(prompt_tokens, request_key))
+                if side:
+                    split_tenant, split_side = adapter, side
+                adapter = resolved
         if adapter:
             # the 404 check runs BEFORE the limiter: unknown names must
             # never mint rate-limit buckets (an untrusted client would
@@ -868,19 +894,34 @@ class ContinuousBatchingEngine:
             future.add_done_callback(
                 lambda _f, a=adapter: self._adapters.unpin(a))
             try:
-                return self._enqueue(future, prompt_tokens,
-                                     max_new_tokens, eos_id, temperature,
-                                     top_k, top_p, max_wait, adapter,
-                                     _extra, _trace)
+                self._enqueue(future, prompt_tokens,
+                              max_new_tokens, eos_id, temperature,
+                              top_k, top_p, max_wait, adapter,
+                              _extra, _trace)
             except Exception as exc:  # noqa: BLE001 - an exception past
                 # the pin must complete the future (that runs the unpin
                 # callback) instead of leaking a refcount forever
                 if not future.done():
                     future.set_exception(exc)
                 return future
-        return self._enqueue(future, prompt_tokens, max_new_tokens,
-                             eos_id, temperature, top_k, top_p, max_wait,
-                             adapter, _extra, _trace)
+            self._meter_split(split_tenant, split_side, future)
+            return future
+        self._enqueue(future, prompt_tokens, max_new_tokens,
+                      eos_id, temperature, top_k, top_p, max_wait,
+                      adapter, _extra, _trace)
+        self._meter_split(split_tenant, split_side, future)
+        return future
+
+    @staticmethod
+    def _meter_split(tenant: str, side: str, future: Future):
+        """Count one ADMITTED request on the canary split telemetry —
+        called after the queue put, so sheds/rejections (whose futures
+        already failed) and fleet re-dispatch attempts that never
+        enqueued don't skew the canary/(canary+stable) fraction."""
+        from ..obs import CANARY_REQUESTS
+
+        if side and (not future.done() or future.exception() is None):
+            CANARY_REQUESTS.inc(adapter=tenant, side=side)
 
     def _enqueue(self, future: Future, prompt_tokens, max_new_tokens,
                  eos_id, temperature, top_k, top_p, max_wait, adapter,
@@ -945,7 +986,8 @@ class ContinuousBatchingEngine:
     def submit_prefill(self, prompt_tokens, eos_id: int | None = None,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, max_wait: float | None = None,
-                       adapter: str = "", _trace=None) -> Future:
+                       adapter: str = "", request_key=None,
+                       _trace=None) -> Future:
         """Run ONLY the (chunked) prefill for a prompt; the returned future
         resolves to a :class:`KVHandoff` a decode replica can import via
         :meth:`submit_prefilled`. The prompt's KV still lands in this
@@ -956,6 +998,7 @@ class ContinuousBatchingEngine:
         return self.submit(prompt_tokens, max_new_tokens=1, eos_id=eos_id,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, max_wait=max_wait, adapter=adapter,
+                           request_key=request_key,
                            _extra="export", _trace=_trace)
 
     def submit_prefilled(self, handoff: KVHandoff,
@@ -1039,12 +1082,31 @@ class ContinuousBatchingEngine:
     def generate(self, prompt_tokens, max_new_tokens: int = 64,
                  eos_id: int | None = None, timeout: float = 300.0,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, adapter: str = ""):
+                 top_p: float = 1.0, adapter: str = "",
+                 request_key=None):
         """Synchronous convenience wrapper around submit()."""
         return self.submit(prompt_tokens, max_new_tokens, eos_id,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p,
-                           adapter=adapter).result(timeout=timeout)
+                           top_p=top_p, adapter=adapter,
+                           request_key=request_key).result(timeout=timeout)
+
+    # -- adapter source lifecycle (docs/continuous_tuning.md) ----------------
+    def add_adapter_source(self, name: str, source):
+        """Publish a named adapter at runtime (the canary hot-load
+        path); requests naming it load through the normal pin/
+        ensure_loaded admission flow — no engine restart, no
+        recompile."""
+        if self._adapters is None:
+            raise ValueError(
+                "engine has no adapter registry (build it with "
+                "adapters=... to hot-load canaries)")
+        self._adapters.add_source(name, source)
+
+    def retire_adapter(self, name: str, keep_source: bool = False):
+        """Drop an adapter from service (promotion's old-stable evict /
+        a rollback's canary teardown); in-flight pins finish first."""
+        if self._adapters is not None:
+            self._adapters.retire(name, keep_source=keep_source)
 
     @property
     def stats(self) -> dict:
@@ -1144,6 +1206,15 @@ class ContinuousBatchingEngine:
             logits, adm.small = self._prefill(
                 self.params, jnp.asarray([[prompt[-1]]], dtype=jnp.int32),
                 adm.small, **lora_kw)
+        if sampling_enabled():
+            # monitoring tap: first-token top1-top2 logit gap (a cheap
+            # model-confidence proxy for the drift analyzer's "logit
+            # statistics"). Only while an observer is armed — the host
+            # transfer of one logits row is not paid when dark.
+            row = np.asarray(logits).reshape(-1)
+            if row.size >= 2:
+                top2 = np.partition(row, -2)[-2:]
+                adm.logit_margin = float(top2[1] - top2[0])
         adm.first_token = self._first_token(logits, adm.sampling)
         return True
 
@@ -1151,7 +1222,8 @@ class ContinuousBatchingEngine:
                        max_new: int, eos_id, future, submitted: float,
                        prompt_len: int, sampling: tuple,
                        trace: tuple | None = None, adapter: str = "",
-                       adapter_slot: int = 0):
+                       adapter_slot: int = 0,
+                       logit_margin: float = float("nan")):
         """Fill slot bookkeeping after a successful prefill (shared by the
         dense and paged admission paths)."""
         temperature, top_k, top_p = sampling
@@ -1170,6 +1242,7 @@ class ContinuousBatchingEngine:
         slot.trace = trace
         slot.adapter = adapter
         slot.adapter_slot = adapter_slot
+        slot.logit_margin = logit_margin
         slot.decode_started = time.time()
         with self._lock:
             self._ttft_ring.append(slot.ttft)
@@ -1306,7 +1379,8 @@ class ContinuousBatchingEngine:
                             adm.max_new, adm.eos_id, adm.future,
                             adm.submitted, len(adm.prompt), adm.sampling,
                             trace=adm.trace, adapter=adm.adapter,
-                            adapter_slot=adm.adapter_slot)
+                            adapter_slot=adm.adapter_slot,
+                            logit_margin=adm.logit_margin)
 
     def _abort_admission(self, adm: _Admission):
         """Release admission-held storage (expiry mid-prefill, stop). The
@@ -1371,6 +1445,16 @@ class ContinuousBatchingEngine:
                 "llm.decode", slot.trace[0], slot.trace[1],
                 start=slot.decode_started,
                 attrs={"slot": index, "generated": len(slot.tokens)})
+        if sampling_enabled():
+            # monitoring tap (docs/continuous_tuning.md): one bounded
+            # per-completion sample for the drift analyzer — output
+            # token ids, lengths, latency, first-token logit margin
+            emit_sample(adapter=slot.adapter, tokens=list(slot.tokens),
+                        prompt_len=slot.prompt_len,
+                        generated=len(slot.tokens), ttft_s=slot.ttft,
+                        total_s=stats["total_s"],
+                        logit_margin=slot.logit_margin,
+                        engine=self._obs_name, replica=self.replica)
         future, tokens = slot.future, slot.tokens
         self._slot_state[index] = _Slot()
         self._release_slot_storage(index)

@@ -167,7 +167,16 @@ class PrefixAffinityRouter(BaseModelRouter):
         blocks, which is the same shared-prefix grouping one tokenizer
         hop earlier. The v2 body's ``adapter`` id namespaces the key —
         the same prompt under two tenants is two routing identities
-        (docs/serving.md "Multi-tenant LoRA")."""
+        (docs/serving.md "Multi-tenant LoRA"). A tenant with canary-loop
+        state resolves to its effective versioned id first
+        (serving/canary.py, key computation only — the downstream server
+        meters and applies the split), so canary traffic routes as its
+        own identity. NOTE: with string inputs and no explicit
+        ``request_key`` the router's side guess keys on prompt BYTES
+        while the engine keys on tokens — pass ``request_key`` when
+        exact router/engine side agreement matters (locality-only skew
+        otherwise)."""
+        from .canary import resolve_adapter
         from .prefix import block_chain_key
 
         body = event.body if isinstance(event.body, dict) else {}
@@ -175,9 +184,14 @@ class PrefixAffinityRouter(BaseModelRouter):
         first = inputs[0] if inputs else ""
         if isinstance(first, str):
             first = list(first.encode())
+        adapter = str(body.get("adapter", "") or "")
+        if adapter:
+            adapter = resolve_adapter(
+                adapter, list(first),
+                body.get("request_key") or None, count=False)
         return block_chain_key(list(first), self.route_block_tokens,
                                max_blocks=self.route_blocks,
-                               adapter=str(body.get("adapter", "") or ""))
+                               adapter=adapter)
 
     def do_event(self, event, *args, **kwargs):
         from .fleet import redispatchable

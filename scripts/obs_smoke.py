@@ -8,7 +8,11 @@ federate the scrapes through ``obs.MetricsAggregator`` into a
 assert the federation cardinality budget holds (re-scraping must not
 multiply series). Then the multi-tenant leg: a 2-tenant adapter
 engine, asserting the bounded ``adapter`` label cardinality holds
-across re-scrapes. Finally the training leg: a tiny ``Trainer.fit``
+across re-scrapes. Then the canary leg: the continuous-tuning closed
+loop (drift injected via ``monitor.drift``) driven to an automatic
+promotion, with the ``mlt_canary_*`` / drift-stat families carrying
+bounded samples over HTTP and the promotion event in the flight ring.
+Finally the training leg: a tiny ``Trainer.fit``
 with a forced preemption — the ``mlt_goodput_*`` families must carry
 samples, the attribution must sum to wall time, and the flight ring
 must drain to a JSONL preemption artifact with the event sequence.
@@ -202,6 +206,134 @@ def _adapter_leg(base: str):
         engine.stop()
 
 
+def _canary_leg(base: str):
+    """Continuous-tuning smoke (docs/continuous_tuning.md): boot the
+    closed loop against a 2-tenant engine, inject drift deterministically
+    via ``monitor.drift``, run it to an automatic promotion on a logical
+    clock, and assert over HTTP that the ``mlt_canary_*`` and drift-stat
+    families carry samples with bounded cardinality across re-scrapes —
+    and that the promotion event landed in the flight ring."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import requests
+
+    from mlrun_tpu.chaos import FaultPoints, chaos
+    from mlrun_tpu.model_monitoring import ContinuousTuningController
+    from mlrun_tpu.models import init_lora_nonzero, init_params, tiny_llama
+    from mlrun_tpu.obs import get_flight_recorder
+    from mlrun_tpu.serving.adapters import save_adapter
+    from mlrun_tpu.serving.llm_batch import ContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference", dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    def adapter(seed):
+        return init_lora_nonzero(config, jax.random.PRNGKey(seed),
+                                 rank=4, alpha=8.0)
+
+    def scrape():
+        resp = requests.get(base + "/metrics", timeout=10)
+        if resp.status_code != 200:
+            _fail(f"/metrics returned {resp.status_code} on canary leg")
+        return resp.text
+
+    def tune_handler(context, tenant="", output_path="", **kwargs):
+        save_adapter(output_path, adapter(4242))
+        context.log_result("adapter", output_path)
+
+    def drift_action(point, ctx):
+        box = ctx["box"]
+        if ctx["adapter"] == "smoke-c":
+            box["drifted"] = True
+            box["stats"]["quality_mean"] = 0.5
+        elif ctx["adapter"].startswith("smoke-c@"):
+            box["stats"]["quality_mean"] = 0.9
+
+    engine = ContinuousBatchingEngine(
+        config, params, max_len=64, slots=2, prefill_buckets=(16,),
+        adapters={"smoke-c": adapter(1), "smoke-d": adapter(2)})
+    engine.start()
+    controller = ContinuousTuningController(
+        engine, project="obs-smoke", retrain_kind="local",
+        retrain_handler=tune_handler, confirm_ticks=2, cooldown_s=600.0,
+        fraction=0.5, warmup_s=0.0, fast_window_s=30.0,
+        slow_window_s=60.0, ttft_target_s=10.0, promote_ticks=2,
+        rollback_ticks=2, reference_min=4, window_min=4,
+        vocab_size=config.vocab_size).start()
+    injection = chaos.inject(FaultPoints.monitor_drift,
+                             action=drift_action)
+
+    def drive(step):
+        futures = [engine.submit([7, 11, 13, 17, 19 + i],
+                                 max_new_tokens=2, adapter=name,
+                                 request_key=f"s{step}-r{i}")
+                   for name in ("smoke-c", "smoke-d") for i in range(4)]
+        for future in futures:
+            future.result(timeout=120)
+
+    try:
+        now, promoted = 0.0, False
+        drive(0)
+        for step in range(1, 13):
+            now += 10.0
+            drive(step)
+            out = controller.tick(now)
+            if any(a["action"] == "promote" for a in out["actions"]):
+                promoted = True
+                break
+        if not promoted:
+            _fail("canary loop never reached an automatic promotion")
+
+        text1 = scrape()
+        for family in ("mlt_canary_requests_total", "mlt_canary_state",
+                       "mlt_canary_decisions_total", "mlt_drift_stat",
+                       "mlt_drift_events_total"):
+            if f"# TYPE {family}" not in text1:
+                _fail(f"/metrics missing family {family}")
+            if f"\n{family}{{" not in text1:
+                _fail(f"family {family} carries no samples")
+        if 'decision="promote"' not in text1:
+            _fail("mlt_canary_decisions_total carries no promotion")
+        for side in ("stable", "canary"):
+            if f'side="{side}"' not in text1:
+                _fail(f"mlt_canary_requests_total missing side {side}")
+
+        def drift_series(text):
+            return set(re.findall(
+                r'mlt_drift_stat\{adapter="([^"]*)",stat="([^"]*)"\}',
+                text))
+
+        series1 = drift_series(text1)
+        # bounded cardinality: more traffic + ticks may fill in stats
+        # for adapters already tracked, but must mint NO new adapter
+        # label values
+        drive(99)
+        controller.tick(now + 10.0)
+        series2 = drift_series(scrape())
+        adapters1 = {adapter_id for adapter_id, _ in series1}
+        adapters2 = {adapter_id for adapter_id, _ in series2}
+        if not adapters2 <= adapters1:
+            _fail(f"drift-stat adapter cardinality churned across "
+                  f"re-scrapes: {sorted(adapters2 - adapters1)}")
+        if len(series2) > 64 * 8:
+            _fail(f"drift-stat cardinality unbounded: {len(series2)}")
+
+        # the promotion event landed in the flight ring
+        ring = get_flight_recorder().events(kind="canary.promote")
+        if not any(e.get("adapter") == "smoke-c" for e in ring):
+            _fail("canary.promote event missing from the flight ring")
+        return {
+            "canary_promoted": controller.router.stable_id("smoke-c"),
+            "drift_stat_series": len(series1),
+        }
+    finally:
+        injection.remove()
+        controller.stop()
+        engine.stop()
+
+
 def _training_leg(base: str):
     """Goodput / flight-recorder smoke (docs/observability.md "Goodput &
     badput"): run a tiny ``Trainer.fit`` with a forced preemption
@@ -359,6 +491,7 @@ def main() -> int:
 
         fleet_summary = _fleet_leg(base)
         fleet_summary.update(_adapter_leg(base))
+        fleet_summary.update(_canary_leg(base))
         fleet_summary.update(_training_leg(base))
     finally:
         box["stop"] = True
