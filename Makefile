@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -48,6 +48,11 @@ bench-canary:    ## continuous fine-tune→canary→promote closed loop: injecte
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --canary > BENCH_r11.tmp \
 		&& tail -n 1 BENCH_r11.tmp > BENCH_r11.json \
 		&& rm BENCH_r11.tmp && cat BENCH_r11.json
+
+bench-reqtrace:  ## request-forensics A/B: phase ledger + exemplars on vs off on the repeated-prefix workload (docs/observability.md "Request attribution"); rewrites BENCH_r12.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --reqtrace > BENCH_r12.tmp \
+		&& tail -n 1 BENCH_r12.tmp > BENCH_r12.json \
+		&& rm BENCH_r12.tmp && cat BENCH_r12.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

@@ -172,6 +172,112 @@ def run(requests: int = 12, prefix_tokens: int = 960,
     return out
 
 
+def run_reqtrace(requests: int = 16, prefix_tokens: int = 384,
+                 suffix_tokens: int = 8, max_new: int = 8,
+                 page_size: int = 32, max_len: int = 512, seed: int = 0,
+                 rounds: int = 2, warmup: bool = True) -> dict:
+    """Request-forensics overhead A/B (docs/observability.md "Request
+    attribution, exemplars & trace assembly"): the SAME repeated-prefix
+    workload against the paged engine with the per-request phase ledger
+    + histogram exemplars ON vs OFF. Arms alternate across ``rounds``
+    and each arm keeps its best round (CPU scheduling noise averages
+    out of the RATIO, the acceptance number); the on-arm additionally
+    verifies every request's attribution closed (Σ phases == wall) and
+    that an exemplar trace id survives to the OpenMetrics render."""
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs import REGISTRY, get_tracer
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(64, max_len), max_len}))
+    prefix = rng.integers(0, config.vocab_size, prefix_tokens).tolist()
+    prompts = [prefix + rng.integers(0, config.vocab_size,
+                                     suffix_tokens).tolist()
+               for _ in range(requests)]
+    tracer = get_tracer()
+
+    def measure(ledger_on: bool):
+        engine = PagedContinuousBatchingEngine(
+            config, params, max_len=max_len, slots=4,
+            page_size=page_size, prefill_buckets=buckets,
+            prefix_cache=True, request_ledger=ledger_on)
+        if warmup:
+            engine.warmup()
+        engine.start()
+        try:
+            ttfts, timings, trace_ids = [], [], []
+            for prompt in prompts:
+                # the on-arm runs under an active span (the production
+                # shape: the gateway's server.run span is active), so
+                # TTFT/phase exemplars and llm.* spans are exercised
+                if ledger_on:
+                    with tracer.span("bench.reqtrace") as span:
+                        _, stats = engine.generate(prompt,
+                                                   max_new_tokens=max_new)
+                        trace_ids.append(span.trace_id)
+                else:
+                    _, stats = engine.generate(prompt,
+                                               max_new_tokens=max_new)
+                ttfts.append(stats["ttft_s"])
+                if "timing" in stats:
+                    timings.append(stats["timing"])
+            tput = _throughput(engine, prompts, max_new)
+        finally:
+            engine.stop()
+        warm = ttfts[1:] or ttfts
+        return {"p50_ttft_s": _percentile(sorted(warm), 0.50),
+                "p95_ttft_s": _percentile(sorted(warm), 0.95),
+                "tokens_per_sec": tput,
+                "timings": timings, "trace_ids": trace_ids}
+
+    arms = {"ledger_on": [], "ledger_off": []}
+    for _ in range(max(1, rounds)):
+        arms["ledger_off"].append(measure(False))
+        arms["ledger_on"].append(measure(True))
+
+    def best(arm, key, pick=min):
+        return pick(r[key] for r in arms[arm])
+
+    on_timings = [t for r in arms["ledger_on"] for t in r["timings"]]
+    closed = bool(on_timings) and all(t.get("attribution_closed")
+                                      for t in on_timings)
+    phases_sample = {k: round(v, 6) for k, v in sorted(
+        (on_timings[-1].get("phases") or {}).items())} \
+        if on_timings else {}
+    exemplar_present = 'trace_id="' in REGISTRY.render(openmetrics=True)
+    p50_on = best("ledger_on", "p50_ttft_s")
+    p50_off = best("ledger_off", "p50_ttft_s")
+    return {
+        "mode": "reqtrace", "requests": requests, "rounds": rounds,
+        "prefix_tokens": prefix_tokens, "model": "tiny",
+        "ledger_on": {
+            "p50_ttft_ms": round(p50_on * 1000, 3),
+            "p95_ttft_ms": round(
+                best("ledger_on", "p95_ttft_s") * 1000, 3),
+            "tokens_per_sec": round(
+                best("ledger_on", "tokens_per_sec", max), 1),
+        },
+        "ledger_off": {
+            "p50_ttft_ms": round(p50_off * 1000, 3),
+            "p95_ttft_ms": round(
+                best("ledger_off", "p95_ttft_s") * 1000, 3),
+            "tokens_per_sec": round(
+                best("ledger_off", "tokens_per_sec", max), 1),
+        },
+        "overhead_ratio_p50_ttft": round(p50_on / p50_off, 4)
+        if p50_off > 0 else 0.0,
+        "attribution_closed": closed,
+        "requests_with_timing": len(on_timings),
+        "exemplar_present": exemplar_present,
+        "phases_sample": phases_sample,
+    }
+
+
 def run_fleet(replicas: int = 4, prefixes: int = 12,
               requests_per_prefix: int = 5, prefix_tokens: int = 96,
               suffix_tokens: int = 8, max_new: int = 8,
@@ -779,6 +885,9 @@ def main(argv=None):
     parser.add_argument("--canary", action="store_true",
                         help="run the continuous fine-tune→canary→"
                              "promote closed-loop bench instead")
+    parser.add_argument("--reqtrace", action="store_true",
+                        help="run the request-forensics (phase ledger + "
+                             "exemplars) overhead A/B instead")
     parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
     # the prefix-cache bench stresses ONE engine with long prompts,
@@ -800,7 +909,12 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.canary:
+    if args.reqtrace:
+        result = run_reqtrace(requests=args.requests,
+                              **overrides(prefix_tokens=384,
+                                          suffix_tokens=8, max_new=8,
+                                          page_size=32, max_len=512))
+    elif args.canary:
         result = run_canary(**overrides(max_new=8, max_len=64))
     elif args.lora:
         result = run_lora(tenants=args.tenants,

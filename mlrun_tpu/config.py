@@ -177,6 +177,14 @@ default_config: dict[str, Any] = {
             # MLT_ATTN_INTERPRET=1 forces the kernels in interpret mode.
             # flash | kernel | reference override per engine.
             "attention_impl": "auto",
+            # per-request phase-transition ledger (obs/reqledger.py,
+            # docs/observability.md "Request attribution, exemplars &
+            # trace assembly"): every request's wall attributed to
+            # queue_wait/prefill/decode_active/... phases, exported as
+            # mlt_request_phase_seconds and returned under the v2
+            # response's opt-in "timing" field. Off = zero ledger work
+            # on the hot path (one None check per site)
+            "request_ledger": True,
             # multi-tenant LoRA serving (docs/serving.md "Multi-tenant
             # LoRA"); engine / LLMModelServer class args override these
             "adapters": {
@@ -259,6 +267,17 @@ default_config: dict[str, Any] = {
         # JSONL span export path ("" = ring only); each finished span is
         # appended as one JSON object per line
         "trace_path": "",
+        # size cap on the span JSONL (bytes): the active file rotates to
+        # a single `.1` predecessor before crossing it, so a long-running
+        # replica's on-disk span footprint never exceeds ~2x this
+        "trace_max_bytes": 64 * 1024 * 1024,
+        # peer base URLs GET /debug/trace fans out to when assembling a
+        # cross-replica waterfall (process replicas' gateways; [] for an
+        # in-process fleet — those share this process's span ring)
+        "trace_peers": [],
+        # per-peer fan-out timeout for the trace assembly (a dead
+        # replica degrades the waterfall after this, never 504s it)
+        "trace_peer_timeout_s": 1.0,
         # stamp active trace ids into jax.profiler.TraceAnnotation region
         # names (utils/profiler.annotate) so XLA device traces join
         # request spans in TensorBoard

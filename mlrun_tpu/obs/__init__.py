@@ -14,6 +14,7 @@ import threading as _threading
 from .metrics import (  # noqa: F401
     CONTENT_TYPE,
     DEFAULT_BUCKETS,
+    OPENMETRICS_CONTENT_TYPE,
     CardinalityError,
     Counter,
     Gauge,
@@ -21,12 +22,22 @@ from .metrics import (  # noqa: F401
     MetricError,
     MetricsRegistry,
     REGISTRY,
+    wants_openmetrics,
 )
 from .federation import (  # noqa: F401
     MetricsAggregator,
     PromParseError,
     check_histogram_consistency,
+    parse_exposition,
     parse_prometheus,
+)
+from .reqledger import (  # noqa: F401
+    REQUEST_PHASE_SECONDS,
+    RequestLedger,
+    export_phases,
+    ledger_enabled,
+    merge_timing,
+    retire_adapter_phases,
 )
 from .flight import (  # noqa: F401
     FlightRecorder,

@@ -2,17 +2,19 @@
 (docs/observability.md "Flight recorder & debug endpoints").
 
 The serving gateway (``serving/asgi.py``) and the service API
-(``service/api/operations.py``) expose the same ``/debug/flight`` and
-``/debug/profile`` contract; the parsing, validation, and response
-shapes live HERE once so the two route layers stay thin and cannot
-drift. Both cores raise ``ValueError`` on a bad request — the route
-layer maps that to its own 400 envelope.
+(``service/api/operations.py``) expose the same ``/debug/flight``,
+``/debug/trace/<trace_id>`` and ``/debug/profile`` contract; the
+parsing, validation, and response shapes live HERE once so the two
+route layers stay thin and cannot drift. Both cores raise
+``ValueError`` on a bad request — the route layer maps that to its own
+400 envelope.
 
 Safety: the profile endpoints are reachable over HTTP (the gateway one
 without auth, like ``/__drain__``), so client-supplied ``output_dir``
 is REJECTED — traces always land under the process's default trace dir
 — and ``key`` is restricted to a path-segment-safe charset so it cannot
-traverse out of it.
+traverse out of it. ``/debug/trace`` validates the trace id against the
+header contract's hex charset before it goes anywhere near a peer URL.
 """
 
 from __future__ import annotations
@@ -22,6 +24,7 @@ import re
 from .flight import get_flight_recorder
 
 _SAFE_KEY_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{1,64}$")
 
 
 def flight_snapshot(kind: str = "", limit=0) -> dict:
@@ -39,6 +42,108 @@ def flight_snapshot(kind: str = "", limit=0) -> dict:
         "dumps": recorder.dumps,
         "last_dump": recorder.last_dump_path,
     }
+
+
+def trace_peers() -> list[str]:
+    """Peer base URLs whose span rings join the waterfall
+    (``mlconf.observability.trace_peers`` — process replicas behind
+    ``RemoteStep``/the fleet; in-process replicas already share the
+    process tracer's ring)."""
+    try:
+        from ..config import mlconf
+
+        return [str(p) for p in
+                (mlconf.observability.get("trace_peers") or [])]
+    except Exception:  # noqa: BLE001 - config must not break a debug read
+        return []
+
+
+def trace_peer_timeout() -> float:
+    """Per-peer fan-out timeout (``mlconf.observability.
+    trace_peer_timeout_s``) — resolved HERE so the two route layers
+    stay thin and cannot drift."""
+    try:
+        from ..config import mlconf
+
+        return float(mlconf.observability.get("trace_peer_timeout_s",
+                                              1.0))
+    except Exception:  # noqa: BLE001 - config must not break a debug read
+        return 1.0
+
+
+def trace_snapshot(trace_id: str, peers=None, timeout: float | None = None,
+                   local_only: bool = False) -> dict:
+    """The GET /debug/trace/<trace_id> payload: one assembled waterfall
+    (docs/observability.md "Request attribution, exemplars & trace
+    assembly").
+
+    Reads the local span ring, then fans out to each peer replica's
+    ``/debug/trace`` (``local=1`` so peers never re-fan) with a
+    PER-REPLICA timeout — a dead replica degrades the waterfall (its
+    entry lands in ``sources`` with the error and ``partial`` flips
+    true), it never 504s the assembly. On the merged spans the blocking
+    critical path and per-phase totals are computed
+    (``obs/traceview.py``)."""
+    trace_id = str(trace_id or "").strip().lower()
+    if not _TRACE_ID_RE.match(trace_id):
+        raise ValueError("trace id must be 1-64 hex chars (the "
+                         "X-MLT-Trace contract)")
+    if timeout is None:
+        timeout = trace_peer_timeout()
+    from .tracing import get_tracer
+    from .traceview import assemble, merge_spans
+
+    local = [span.to_dict()
+             for span in get_tracer().spans(trace_id=trace_id)]
+    sources: dict = {"local": {"spans": len(local), "ok": True}}
+    span_sets = [local]
+    partial = False
+    if not local_only:
+        peer_list = trace_peers() if peers is None else list(peers)
+        if peer_list:
+            import concurrent.futures
+            import time
+
+            import requests
+
+            def fetch(peer):
+                url = (f"{str(peer).rstrip('/')}/debug/trace/"
+                       f"{trace_id}?local=1")
+                resp = requests.get(url, timeout=timeout)
+                resp.raise_for_status()
+                return resp.json().get("spans") or []
+
+            # concurrent fan-out under a WALL deadline: every peer gets
+            # one thread (bounded) and the whole assembly waits at most
+            # ~2x the per-peer timeout — a dead or byte-dribbling
+            # replica (requests' timeout= is per-read, not wall) lands
+            # in `sources` as failed instead of stalling the forensics
+            # read; its straggler thread is abandoned, never joined
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(32, len(peer_list)))
+            try:
+                futures = {str(peer): pool.submit(fetch, peer)
+                           for peer in peer_list}
+                deadline = time.monotonic() + 2.0 * timeout
+                for peer, future in futures.items():
+                    try:
+                        peer_spans = future.result(timeout=max(
+                            0.0, deadline - time.monotonic()))
+                        span_sets.append(peer_spans)
+                        sources[peer] = {"spans": len(peer_spans),
+                                         "ok": True}
+                    except Exception as exc:  # noqa: BLE001 - a dead
+                        # replica degrades the waterfall, never 504s it
+                        sources[peer] = {"ok": False,
+                                         "error": str(exc) or
+                                         type(exc).__name__}
+                        partial = True
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
+    out = assemble(trace_id, merge_spans(*span_sets))
+    out["sources"] = sources
+    out["partial"] = partial
+    return out
 
 
 def profile_request(body: dict) -> dict:

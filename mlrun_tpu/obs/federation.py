@@ -51,12 +51,28 @@ class PromParseError(ValueError):
     """A scrape violated the exposition format contract."""
 
 
-def parse_prometheus(text: str):
-    """Parse Prometheus text exposition (format 0.0.4).
+# quote-aware labels group (a literal "}" inside an escaped label value
+# must not end the clause early) + the same Inf/NaN value forms the
+# sample regex accepts — the renderer's own output must always parse,
+# or one odd exemplar poisons a replica's entire federated scrape
+_EXEMPLAR_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+_EXEMPLAR_RE = re.compile(
+    r'^\{(?P<labels>(?:' + _EXEMPLAR_LABEL +
+    r'(?:,' + _EXEMPLAR_LABEL + r')*)?)\} '
+    r'(?P<value>[+-]?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|Inf|NaN))'
+    r'(?: (?P<ts>[0-9]+(?:\.[0-9]+)?))?$', re.IGNORECASE)
 
-    Returns ``(samples, types)`` where ``samples`` maps
-    ``(name, frozenset((label, value), ...))`` to a float and ``types``
-    maps each family name to ``counter``/``gauge``/``histogram``.
+
+def parse_exposition(text: str):
+    """Parse Prometheus text exposition (format 0.0.4) — and the
+    OpenMetrics variant our renderer produces (``# EOF`` trailer plus
+    per-bucket exemplar clauses after `` # ``).
+
+    Returns ``(samples, types, exemplars)`` where ``samples`` maps
+    ``(name, frozenset((label, value), ...))`` to a float, ``types``
+    maps each family name to ``counter``/``gauge``/``histogram``, and
+    ``exemplars`` maps sample keys to
+    ``{"labels", "value", "ts"}`` dicts.
 
     Strict by design — this parses OUR renderer's output (and sibling
     replicas running the same code), so any malformed line, unknown
@@ -65,10 +81,13 @@ def parse_prometheus(text: str):
     """
     samples: dict[tuple, float] = {}
     types: dict[str, str] = {}
+    exemplars: dict[tuple, dict] = {}
     helped: set[str] = set()
     for line in text.strip().splitlines():
         if not line:
             continue
+        if line == "# EOF":
+            continue  # OpenMetrics trailer
         if line.startswith("# HELP "):
             helped.add(line.split()[2])
             continue
@@ -80,17 +99,48 @@ def parse_prometheus(text: str):
             continue
         if line.startswith("#"):
             raise PromParseError(f"unknown comment line: {line!r}")
+        exemplar = None
+        # exemplar detection guards: split at the LAST " # ", require a
+        # "{"-opening clause AND a well-formed sample on the left — a
+        # literal " # {" inside a quoted label value (label values are
+        # client-supplied, e.g. adapter ids) must fall through to the
+        # whole-line sample parse, not poison the scrape as a
+        # "malformed exemplar"
+        sample_part, sep, exemplar_part = line.rpartition(" # ")
+        if sep and exemplar_part.lstrip().startswith("{") \
+                and _SAMPLE_RE.match(sample_part):
+            ex_match = _EXEMPLAR_RE.match(exemplar_part.strip())
+            if not ex_match:
+                raise PromParseError(f"malformed exemplar: {line!r}")
+            exemplar = {
+                "labels": dict(_LABEL_RE.findall(
+                    ex_match.group("labels") or "")),
+                "value": float(ex_match.group("value")),
+                "ts": (float(ex_match.group("ts"))
+                       if ex_match.group("ts") else None),
+            }
+            line = sample_part
         match = _SAMPLE_RE.match(line)
         if not match:
             raise PromParseError(f"malformed sample line: {line!r}")
         labels = frozenset(_LABEL_RE.findall(match.group("labels") or ""))
         value = match.group("value")
-        samples[(match.group("name"), labels)] = (
+        key = (match.group("name"), labels)
+        samples[key] = (
             math.inf if value == "+Inf"
             else -math.inf if value == "-Inf" else float(value))
+        if exemplar is not None:
+            exemplars[key] = exemplar
     if not set(types) <= helped:
         raise PromParseError(
             f"typed families missing HELP: {sorted(set(types) - helped)}")
+    return samples, types, exemplars
+
+
+def parse_prometheus(text: str):
+    """Back-compat two-tuple view of :func:`parse_exposition` (the
+    format tests and every pre-exemplar caller use this shape)."""
+    samples, types, _ = parse_exposition(text)
     return samples, types
 
 
@@ -132,7 +182,9 @@ def check_histogram_consistency(samples: dict, family: str):
 def sample_kind(name: str, types: dict) -> tuple[str, str]:
     """Resolve a sample line's merge family + kind: histogram component
     samples (``_bucket``/``_sum``/``_count``) map back to their base
-    family; unknown names default to gauge semantics."""
+    family, an OpenMetrics counter sample (``foo_total`` under
+    ``# TYPE foo counter``) back to its stripped family; unknown names
+    default to gauge semantics."""
     if name in types:
         return name, types[name]
     for suffix in _HISTOGRAM_SUFFIXES:
@@ -140,16 +192,21 @@ def sample_kind(name: str, types: dict) -> tuple[str, str]:
             base = name[: -len(suffix)]
             if types.get(base) == "histogram":
                 return base, "histogram"
+    if name.endswith("_total") \
+            and types.get(name[: -len("_total")]) == "counter":
+        return name[: -len("_total")], "counter"
     return name, "gauge"
 
 
 class _Source:
-    __slots__ = ("samples", "types", "at")
+    __slots__ = ("samples", "types", "at", "exemplars")
 
-    def __init__(self, samples: dict, types: dict, at: float):
+    def __init__(self, samples: dict, types: dict, at: float,
+                 exemplars: Optional[dict] = None):
         self.samples = samples
         self.types = types
         self.at = float(at)
+        self.exemplars = exemplars or {}
 
 
 class MetricsAggregator:
@@ -194,9 +251,13 @@ class MetricsAggregator:
         """Ingest one ``/metrics`` scrape from ``source`` (replaces the
         source's previous sample set). ``at`` is the scrape timestamp —
         passed explicitly so staleness is testable without wall-clock
-        sleeps. Raises :class:`PromParseError` on a malformed scrape."""
-        samples, types = parse_prometheus(text)
-        self._store(source, samples, types, at)
+        sleeps. Raises :class:`PromParseError` on a malformed scrape.
+        An OpenMetrics scrape's exemplars ride along with their bucket
+        samples (readable via :meth:`exemplars`) without counting
+        against the cardinality budget — they are annotations on
+        existing series, not series."""
+        samples, types, exemplars = parse_exposition(text)
+        self._store(source, samples, types, at, exemplars)
 
     def ingest_stats(self, source: str, stats: dict, at: float,
                      engine: str = "fleet"):
@@ -247,7 +308,8 @@ class MetricsAggregator:
                     quantile=quantile)
         self._store(source, samples, types, at)
 
-    def _store(self, source: str, samples: dict, types: dict, at: float):
+    def _store(self, source: str, samples: dict, types: dict, at: float,
+               exemplars: Optional[dict] = None):
         with self._lock:
             # evict sources already past the staleness bound relative to
             # this scrape — a dead replica's frozen sample set must not
@@ -268,7 +330,12 @@ class MetricsAggregator:
                 self.dropped_series += dropped
                 samples = {key: samples[key]
                            for key in keep[:max(allowed, 0)]}
-            self._sources[source] = _Source(samples, types, at)
+            if exemplars:
+                # exemplars never extend the series set — one whose
+                # bucket sample fell to the truncation goes with it
+                exemplars = {key: ex for key, ex in exemplars.items()
+                             if key in samples}
+            self._sources[source] = _Source(samples, types, at, exemplars)
 
     def forget(self, source: str):
         """Drop a source outright (a removed replica's scrape target)."""
@@ -380,3 +447,52 @@ class MetricsAggregator:
     def min_family(self, name: str, now: float) -> Optional[float]:
         values = list(self.family(name, now).values())
         return min(values) if values else None
+
+    # -- exemplars -----------------------------------------------------------
+    def exemplars(self, family: str, now: float,
+                  match: Optional[dict] = None) -> list[dict]:
+        """Exemplars carried through fresh sources for ``family``'s
+        bucket series (label-subset filtered): ``{source, series, le,
+        value, labels, ts}`` entries, the same shape the in-process
+        ``Histogram.exemplars`` read produces — so the SLO evaluator's
+        breach-forensics lookup works over a federated view too."""
+        match_items = set((k, str(v)) for k, v in (match or {}).items())
+        with self._lock:
+            fresh = self._fresh(now)
+        out = []
+        for src_name, src in fresh:
+            for (name, labels), exemplar in src.exemplars.items():
+                if name != family + "_bucket":
+                    continue
+                series = dict(labels)
+                le = series.pop("le", None)
+                if not match_items <= set(
+                        (k, str(v)) for k, v in series.items()):
+                    continue
+                out.append({
+                    "source": src_name, "series": series,
+                    "le": (math.inf if le == "+Inf"
+                           else float(le) if le else None),
+                    "value": exemplar["value"],
+                    "labels": dict(exemplar["labels"]),
+                    "ts": exemplar.get("ts"),
+                })
+        return out
+
+    def breach_exemplars(self, family: str, labels: Optional[dict],
+                         threshold: float, k: int,
+                         now: Optional[float] = None) -> list[dict]:
+        """The federated counterpart of ``obs.slo.registry_exemplars``
+        — same (family, labels, threshold, k) lookup signature, so a
+        central evaluator over remote replicas' OpenMetrics scrapes
+        wires it in as ``SLOEvaluator(..., exemplar_lookup=
+        aggregator.breach_exemplars)``: top-``k`` carried exemplars by
+        value over ``threshold``. ``now`` defaults to the wall clock
+        (the production adapter; tests pass it explicitly)."""
+        import time
+
+        found = self.exemplars(family,
+                               time.time() if now is None else now,
+                               match=labels)
+        over = [e for e in found if e["value"] > threshold]
+        return sorted(over, key=lambda e: -e["value"])[:max(0, int(k))]

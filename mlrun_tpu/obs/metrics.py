@@ -28,6 +28,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -143,7 +144,7 @@ class _Metric:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
-    def render(self) -> list[str]:
+    def render(self, openmetrics: bool = False) -> list[str]:
         raise NotImplementedError
 
 
@@ -175,10 +176,24 @@ class Counter(_Metric):
         with self._lock:
             return float(self._series.get(key, 0.0))
 
-    def render(self) -> list[str]:
+    def om_family(self) -> str:
+        """OpenMetrics family name: the spec says a counter family
+        ``foo`` exposes samples ``foo_total`` — so the TYPE/HELP lines
+        must strip our ``_total`` suffix, or a strict scraper (stock
+        Prometheus negotiates OpenMetrics by default) rejects the whole
+        scrape expecting ``foo_total_total`` samples."""
+        return self.name[:-len("_total")] \
+            if self.name.endswith("_total") else self.name
+
+    def render(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
             items = sorted(self._series.items())
-        return [f"{self.name}{self._labels_suffix(key)} {_fmt(value)}"
+        # OpenMetrics: sample name = family + "_total". Families already
+        # named *_total keep their sample names byte-identical (only the
+        # TYPE/HELP family name changes); the rare counter without the
+        # suffix gains it in the OM variant only.
+        name = self.om_family() + "_total" if openmetrics else self.name
+        return [f"{name}{self._labels_suffix(key)} {_fmt(value)}"
                 for key, value in items]
 
 
@@ -208,7 +223,7 @@ class Gauge(_Metric):
         with self._lock:
             return float(self._series.get(key, 0.0))
 
-    def render(self) -> list[str]:
+    def render(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
             items = sorted(self._series.items())
         return [f"{self.name}{self._labels_suffix(key)} {_fmt(value)}"
@@ -216,12 +231,16 @@ class Gauge(_Metric):
 
 
 class _HistogramSeries:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+        # one exemplar slot per bucket (+Inf included), allocated on the
+        # first exemplar so exemplar-free histograms pay nothing; each
+        # slot is (value, labels-dict, unix-ts), last write wins
+        self.exemplars: Optional[list] = None
 
 
 class Histogram(_Metric):
@@ -241,19 +260,34 @@ class Histogram(_Metric):
             raise MetricError(f"histogram '{name}' needs >= 1 bucket bound")
         self.buckets = bounds
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar=None, **labels):
+        """``exemplar`` optionally attaches a trace reference to the
+        observation's bucket (docs/observability.md "Request
+        attribution, exemplars & trace assembly"): a trace-id string or
+        a small labels dict. One slot per bucket, last write wins —
+        lock-cheap (the same per-metric lock the counts take, one tuple
+        assignment), rendered only on the OpenMetrics content type."""
         key = self._key(labels)
         with self._lock:
             series = self._get_or_create(
                 key, lambda: _HistogramSeries(len(self.buckets)))
             if series is None:
                 return
+            bucket_index = len(self.buckets)  # +Inf slot
             for index, bound in enumerate(self.buckets):
                 if value <= bound:
                     series.counts[index] += 1
+                    bucket_index = index
                     break
             series.sum += value
             series.count += 1
+            if exemplar is not None:
+                if series.exemplars is None:
+                    series.exemplars = [None] * (len(self.buckets) + 1)
+                if not isinstance(exemplar, dict):
+                    exemplar = {"trace_id": str(exemplar)}
+                series.exemplars[bucket_index] = (
+                    float(value), exemplar, time.time())
 
     def value(self, **labels) -> dict:
         key = self._key(labels)
@@ -263,22 +297,67 @@ class Histogram(_Metric):
                 return {"count": 0, "sum": 0.0}
             return {"count": series.count, "sum": series.sum}
 
-    def render(self) -> list[str]:
+    def exemplars(self, match: Optional[dict] = None) -> list[dict]:
+        """Exemplars across series whose labels contain ``match`` (the
+        SLO evaluator's breach-forensics read): one entry per occupied
+        bucket slot — ``{series, le, value, labels, ts}`` — so "worst
+        offenders" is a sort by value over this list."""
+        match_items = set((k, str(v)) for k, v in (match or {}).items())
+        out = []
+        with self._lock:
+            items = [(key, series.exemplars)
+                     for key, series in self._series.items()
+                     if series.exemplars is not None]
+        bounds = list(self.buckets) + [math.inf]
+        for key, slots in items:
+            series_labels = dict(zip(self.labelnames, key))
+            if not match_items <= set(
+                    (k, str(v)) for k, v in series_labels.items()):
+                continue
+            for index, slot in enumerate(slots):
+                if slot is None:
+                    continue
+                value, labels, ts = slot
+                out.append({"series": series_labels, "le": bounds[index],
+                            "value": value, "labels": dict(labels),
+                            "ts": ts})
+        return out
+
+    @staticmethod
+    def _exemplar_suffix(slot) -> str:
+        """OpenMetrics exemplar clause for one bucket line:
+        `` # {label="value",...} <value> <timestamp>``."""
+        if slot is None:
+            return ""
+        value, labels, ts = slot
+        body = ",".join(f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items()))
+        return f" # {{{body}}} {_fmt(value)} {ts:.3f}"
+
+    def render(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
             items = sorted(
-                (key, list(series.counts), series.sum, series.count)
+                (key, list(series.counts), series.sum, series.count,
+                 list(series.exemplars) if series.exemplars else None)
                 for key, series in self._series.items())
         lines = []
-        for key, counts, total, count in items:
+        for key, counts, total, count, exemplars in items:
             cumulative = 0
-            for bound, bucket_count in zip(self.buckets, counts):
+            for index, (bound, bucket_count) in enumerate(
+                    zip(self.buckets, counts)):
                 cumulative += bucket_count
                 le = 'le="' + _fmt(bound) + '"'
+                extra = self._exemplar_suffix(exemplars[index]) \
+                    if openmetrics and exemplars else ""
                 lines.append(f"{self.name}_bucket"
-                             f"{self._labels_suffix(key, le)} {cumulative}")
+                             f"{self._labels_suffix(key, le)} "
+                             f"{cumulative}{extra}")
             le_inf = 'le="+Inf"'
+            extra = self._exemplar_suffix(exemplars[-1]) \
+                if openmetrics and exemplars else ""
             lines.append(f"{self.name}_bucket"
-                         f"{self._labels_suffix(key, le_inf)} {count}")
+                         f"{self._labels_suffix(key, le_inf)} "
+                         f"{count}{extra}")
             lines.append(
                 f"{self.name}_sum{self._labels_suffix(key)} {_fmt(total)}")
             lines.append(
@@ -358,17 +437,26 @@ class MetricsRegistry:
         for collector in retired:
             self.remove_collector(collector)
 
-    def render(self) -> str:
-        """Prometheus text exposition (format version 0.0.4)."""
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition: Prometheus 0.0.4 by default; with
+        ``openmetrics`` the histogram bucket lines additionally carry
+        their exemplars in OpenMetrics syntax and the body ends with
+        ``# EOF`` (served behind content negotiation — the default
+        scrape format stays byte-identical to before)."""
         self.collect()
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         lines = []
         for metric in metrics:
-            lines.append(f"# HELP {metric.name} "
+            family = metric.om_family() \
+                if openmetrics and isinstance(metric, Counter) \
+                else metric.name
+            lines.append(f"# HELP {family} "
                          f"{_escape_help(metric.help or metric.name)}")
-            lines.append(f"# TYPE {metric.name} {metric.type_name}")
-            lines.extend(metric.render())
+            lines.append(f"# TYPE {family} {metric.type_name}")
+            lines.extend(metric.render(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def reset(self):
@@ -383,3 +471,14 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# negotiated via the Accept header on the /metrics endpoints — the only
+# format whose bucket lines carry exemplars
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+def wants_openmetrics(accept: str | None) -> bool:
+    """Content negotiation for the /metrics endpoints: OpenMetrics only
+    when the client asks for it by name (Prometheus text 0.0.4 stays
+    the default for every other Accept value)."""
+    return bool(accept) and "application/openmetrics-text" in accept

@@ -52,9 +52,27 @@ config and the service alert machinery).
 from __future__ import annotations
 
 import threading
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
+from .flight import record as flight_record
 from .metrics import REGISTRY
+
+
+def registry_exemplars(family: str, labels: Optional[dict],
+                       threshold: float, k: int) -> list[dict]:
+    """Default breach-forensics lookup: the top-``k`` exemplars by value
+    from the in-process registry histogram's offending buckets (value
+    over ``threshold``, label-subset filtered) — the trace ids an SLO
+    breach names (docs/observability.md "Request attribution, exemplars
+    & trace assembly"). A federated evaluator passes
+    ``MetricsAggregator.exemplars``-backed lookup instead."""
+    metric = REGISTRY.get(family)
+    exemplar_read = getattr(metric, "exemplars", None)
+    if exemplar_read is None:
+        return []
+    found = exemplar_read(match=labels)
+    over = [e for e in found if e["value"] > threshold]
+    return sorted(over, key=lambda e: -e["value"])[:max(0, int(k))]
 
 SLO_BURN_RATE = REGISTRY.gauge(
     "mlt_slo_burn_rate",
@@ -292,7 +310,9 @@ class SLOEvaluator:
     def __init__(self, store, slos: Iterable[SLO] = (),
                  fast_window: float = 60.0, slow_window: float = 300.0,
                  fast_burn: float = 14.4, slow_burn: float = 6.0,
-                 refire_after: float = 0.0, project: str = ""):
+                 refire_after: float = 0.0, project: str = "",
+                 exemplar_lookup: Optional[Callable] = None,
+                 exemplar_k: int = 3):
         if fast_window <= 0 or slow_window <= fast_window:
             raise ValueError("need 0 < fast_window < slow_window")
         self.store = store
@@ -303,13 +323,19 @@ class SLOEvaluator:
         self.slow_burn = float(slow_burn)
         self.refire_after = float(refire_after)
         self.project = project
+        # (family, labels, threshold, k) -> worst-offender exemplars; a
+        # confirmed breach attaches these so the alert names trace ids
+        self.exemplar_lookup = exemplar_lookup or registry_exemplars
+        self.exemplar_k = int(exemplar_k)
         self._lock = threading.Lock()
         self._last: list[SLOStatus] = []
         self._fired_at: dict[str, float] = {}  # slo name -> last fire t
 
     @classmethod
     def from_mlconf(cls, store, slos: Iterable[SLO] = None,
-                    project: str = "") -> "SLOEvaluator":
+                    project: str = "",
+                    exemplar_lookup: Optional[Callable] = None
+                    ) -> "SLOEvaluator":
         from ..config import mlconf
 
         conf = mlconf.observability.slo
@@ -322,7 +348,8 @@ class SLOEvaluator:
                    fast_burn=float(conf.fast_burn),
                    slow_burn=float(conf.slow_burn),
                    refire_after=float(conf.refire_after_s),
-                   project=project)
+                   project=project,
+                   exemplar_lookup=exemplar_lookup)
 
     def evaluate(self, at: float) -> list[SLOStatus]:
         """Burn rates for every objective at ``at``. Breach = fast AND
@@ -382,6 +409,7 @@ class SLOEvaluator:
         from ..service.alerts import process_event
 
         project = self.project if project is None else project
+        slos_by_name = {slo.name: slo for slo in self.slos}
         fired = []
         for status in self.evaluate(at):
             if not status.breaching:
@@ -399,6 +427,31 @@ class SLOEvaluator:
                      "burn_fast": status.burn_fast,
                      "burn_slow": status.burn_slow,
                      "target": status["target"]}
+            exemplar_ids: list[str] = []
+            slo = slos_by_name.get(status["name"])
+            if slo is not None and slo.kind == "latency":
+                # the breach window's worst offenders, lifted off the
+                # offending histogram buckets: the alert payload and the
+                # flight-recorder entry now NAME trace ids a
+                # `/debug/trace/<id>` fetch turns into a waterfall
+                try:
+                    worst = self.exemplar_lookup(
+                        slo.family, slo.labels or None, slo.target,
+                        self.exemplar_k)
+                except Exception:  # noqa: BLE001 - forensics must not
+                    worst = []     # block the alert itself
+                if worst:
+                    event["exemplars"] = [
+                        {"value": e["value"], **e["labels"]}
+                        for e in worst]
+                    exemplar_ids = [e["labels"].get("trace_id")
+                                    for e in worst
+                                    if e["labels"].get("trace_id")]
+            flight_record("slo.breach", slo=status["name"],
+                          slo_kind=status["kind"],
+                          burn_fast=status.burn_fast,
+                          burn_slow=status.burn_slow,
+                          exemplar_trace_ids=exemplar_ids)
             db.emit_event(SLO_EVENT_KIND, event, project)
             fired.extend(process_event(db, project, SLO_EVENT_KIND, event))
         return fired

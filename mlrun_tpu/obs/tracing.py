@@ -65,16 +65,29 @@ def trace_id_for(seed: str) -> str:
     return hashlib.md5(str(seed).encode()).hexdigest()  # noqa: S324
 
 
+def _header_text(value) -> str:
+    """Header keys/values may arrive as bytes from raw ASGI/WSGI layers;
+    decode rather than str() (which would mangle b"x-mlt-trace" into
+    "b'x-mlt-trace'" and silently drop the caller's trace)."""
+    if isinstance(value, (bytes, bytearray)):
+        return bytes(value).decode("latin-1", "replace")
+    return str(value)
+
+
 def parse_trace_header(headers: dict | None
                        ) -> tuple[Optional[str], Optional[str]]:
     """(trace_id, parent_span_id) from request headers; (None, None) when
-    absent or malformed — a garbage header must never fail a request."""
+    absent or malformed — a garbage header must never fail a request.
+    The contract is load-bearing for cross-replica trace assembly
+    (docs/observability.md), so malformed shapes (mixed-case names, bare
+    trace ids, overlong/non-hex/empty span parts, bytes values) are
+    pinned by tests."""
     if not headers:
         return None, None
     value = None
     for key, candidate in headers.items():
-        if str(key).lower() == TRACE_HEADER:
-            value = str(candidate)
+        if _header_text(key).lower() == TRACE_HEADER:
+            value = _header_text(candidate)
             break
     if not value:
         return None, None
@@ -121,20 +134,33 @@ class Tracer:
     (:func:`get_tracer`); tests may build isolated instances (e.g. one
     per GraphServer) to assert on each side of an HTTP hop."""
 
-    def __init__(self, ring: int = 2048, path: str | None = None):
+    # JSONL rotation default: one predecessor kept, so the on-disk span
+    # footprint of a long-running replica is bounded at ~2x this
+    DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+    def __init__(self, ring: int = 2048, path: str | None = None,
+                 max_bytes: int | None = None):
         self._ring: deque[Span] = deque(maxlen=max(1, int(ring)))
         self._path = path or None
+        self._max_bytes = int(max_bytes if max_bytes is not None
+                              else self.DEFAULT_MAX_BYTES)
+        self._size: Optional[int] = None  # bytes in the active file
         self._file_lock = threading.Lock()
         self._local = threading.local()
         self._lock = threading.Lock()
 
     # -- configuration -------------------------------------------------------
-    def configure(self, path: str | None = None, ring: int | None = None):
+    def configure(self, path: str | None = None, ring: int | None = None,
+                  max_bytes: int | None = None):
         if ring is not None:
             with self._lock:
                 self._ring = deque(self._ring, maxlen=max(1, int(ring)))
         if path is not None:
-            self._path = path or None
+            with self._file_lock:
+                self._path = path or None
+                self._size = None  # re-measured on the next export
+        if max_bytes is not None:
+            self._max_bytes = int(max_bytes)
         return self
 
     @property
@@ -232,13 +258,28 @@ class Tracer:
         path = self._path
         if path:
             try:
-                line = json.dumps(span.to_dict(), default=str)
+                line = json.dumps(span.to_dict(), default=str) + "\n"
                 with self._file_lock:
                     directory = os.path.dirname(path)
                     if directory:
                         os.makedirs(directory, exist_ok=True)
+                    if self._size is None:
+                        try:
+                            self._size = os.path.getsize(path)
+                        except OSError:
+                            self._size = 0
+                    # size-capped rotation (mlconf.observability.
+                    # trace_max_bytes): rotate BEFORE the write that
+                    # would cross the cap, keeping exactly one `.1`
+                    # predecessor — a long-running emit loop never holds
+                    # more than 2x the cap on disk
+                    if self._max_bytes > 0 and self._size \
+                            and self._size + len(line) > self._max_bytes:
+                        os.replace(path, path + ".1")
+                        self._size = 0
                     with open(path, "a") as fp:
-                        fp.write(line + "\n")
+                        fp.write(line)
+                    self._size += len(line)
             except OSError:
                 # span export must never fail the traced operation
                 pass
@@ -277,7 +318,10 @@ def configure_from_mlconf():
             return tracer
         path = str(obs_conf.get("trace_path") or "") or None
         ring = obs_conf.get("trace_ring")
-        tracer.configure(path=path, ring=int(ring) if ring else None)
+        max_bytes = obs_conf.get("trace_max_bytes")
+        tracer.configure(path=path, ring=int(ring) if ring else None,
+                         max_bytes=(int(max_bytes)
+                                    if max_bytes is not None else None))
     except Exception:  # noqa: BLE001 - observability must not block startup
         pass
     return tracer

@@ -26,15 +26,26 @@ def register(r: web.RouteTableDef, state):
         by failure class, stall aborts), chaos fire counts, and — when
         this process also serves — the serving/engine series. Root path
         (not under the API base) per scraper convention; left open by the
-        auth middleware like healthz."""
-        from ...obs import CONTENT_TYPE, PROBE_REQUESTS, REGISTRY
+        auth middleware like healthz. Accept:
+        application/openmetrics-text negotiates exemplar-carrying
+        OpenMetrics output (default stays Prometheus text 0.0.4)."""
+        from ...obs import (
+            CONTENT_TYPE,
+            OPENMETRICS_CONTENT_TYPE,
+            PROBE_REQUESTS,
+            REGISTRY,
+            wants_openmetrics,
+        )
 
         PROBE_REQUESTS.inc(path="/metrics")
         if not bool(mlconf.observability.metrics_enabled):
             return web.Response(status=404, text="metrics exposition is "
                                 "disabled (mlconf.observability)")
-        return web.Response(body=REGISTRY.render().encode(),
-                            headers={"Content-Type": CONTENT_TYPE})
+        om = wants_openmetrics(request.headers.get("Accept"))
+        return web.Response(
+            body=REGISTRY.render(openmetrics=om).encode(),
+            headers={"Content-Type": (OPENMETRICS_CONTENT_TYPE if om
+                                      else CONTENT_TYPE)})
 
     # -- debug endpoints (docs/observability.md "Flight recorder & debug
     # endpoints"); root paths like /metrics, but NOT middleware-open —
@@ -53,6 +64,29 @@ def register(r: web.RouteTableDef, state):
         try:
             payload = flight_snapshot(request.query.get("kind", ""),
                                       request.query.get("limit", 0))
+        except ValueError as exc:
+            return error_response(str(exc), 400)
+        return web.json_response(
+            payload, dumps=lambda d: _json.dumps(d, default=str))
+
+    @r.get("/debug/trace/{trace_id}")
+    async def debug_trace(request):
+        """Assembled cross-replica waterfall + blocking critical path
+        for one trace id (docs/observability.md "Request attribution,
+        exemplars & trace assembly"). Handler core shared with the
+        serving gateway (obs/debug.py); like the other /debug routes it
+        stays behind the service auth token."""
+        import asyncio as _asyncio
+        import json as _json
+
+        from ...obs.debug import trace_snapshot
+
+        local_only = request.query.get("local", "") in ("1", "true")
+        loop = _asyncio.get_event_loop()
+        try:
+            payload = await loop.run_in_executor(None, lambda: (
+                trace_snapshot(request.match_info["trace_id"],
+                               local_only=local_only)))
         except ValueError as exc:
             return error_response(str(exc), 400)
         return web.json_response(

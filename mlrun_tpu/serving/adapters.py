@@ -291,19 +291,29 @@ class AdapterRegistry:
         no in-flight request pins it. A still-pinned resident keeps
         serving its in-flight requests and becomes LRU-evictable once
         the pins drain — retire never fails live traffic."""
+        from ..obs import retire_adapter_phases
+
         with self._lock:
             if not keep_source:
                 self.sources.pop(name, None)
             self._host_cache.pop(name, None)
             resident = self._residents.get(name)
-            if resident is None or resident.refcount > 0:
-                return
-            del self._residents[name]
-            slot = resident.slot
-            self._free_slots.append(slot)
-            self.stats["adapter_evictions"] += 1
-        fire(FaultPoints.llm_adapter_load, op="evict", adapter=name,
-             slot=slot)
+            retired_resident = resident is not None \
+                and resident.refcount == 0
+            if retired_resident:
+                del self._residents[name]
+                slot = resident.slot
+                self._free_slots.append(slot)
+                self.stats["adapter_evictions"] += 1
+        if not keep_source:
+            # a fully-retired identity (canary rollback, promotion's
+            # displaced version) releases its per-phase histogram
+            # series too — version churn must not exhaust the
+            # mlt_request_phase_seconds label-set cap (obs/reqledger.py)
+            retire_adapter_phases(name)
+        if retired_resident:
+            fire(FaultPoints.llm_adapter_load, op="evict", adapter=name,
+                 slot=slot)
 
     # -- host-side loading ---------------------------------------------------
     def known(self, name: str) -> bool:

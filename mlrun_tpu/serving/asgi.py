@@ -19,7 +19,14 @@ from typing import Optional
 from aiohttp import web
 
 from ..config import mlconf
-from ..obs import CONTENT_TYPE, PROBE_REQUESTS, REGISTRY, configure_from_mlconf
+from ..obs import (
+    CONTENT_TYPE,
+    OPENMETRICS_CONTENT_TYPE,
+    PROBE_REQUESTS,
+    REGISTRY,
+    configure_from_mlconf,
+    wants_openmetrics,
+)
 from ..utils import logger
 from .server import GraphContext, GraphServer, MockEvent, Response
 
@@ -110,13 +117,18 @@ def build_serving_app(server: GraphServer) -> web.Application:
     async def metrics(request):
         # Prometheus text exposition of the process-wide registry
         # (docs/observability.md) — engine, resilience, step-latency and
-        # request series for this replica
+        # request series for this replica. An Accept header naming
+        # application/openmetrics-text negotiates the OpenMetrics
+        # variant, whose histogram buckets carry trace-id exemplars
         _probe("/metrics")
         if not bool(mlconf.observability.metrics_enabled):
             return web.Response(status=404, text="metrics exposition is "
                                 "disabled (mlconf.observability)")
-        return web.Response(body=REGISTRY.render().encode(),
-                            headers={"Content-Type": CONTENT_TYPE})
+        om = wants_openmetrics(request.headers.get("Accept"))
+        return web.Response(
+            body=REGISTRY.render(openmetrics=om).encode(),
+            headers={"Content-Type": (OPENMETRICS_CONTENT_TYPE if om
+                                      else CONTENT_TYPE)})
 
     # -- debug endpoints (docs/observability.md "Flight recorder & debug
     # endpoints") — live reads of the black-box ring and on-demand
@@ -130,6 +142,29 @@ def build_serving_app(server: GraphServer) -> web.Application:
         try:
             payload = flight_snapshot(request.query.get("kind", ""),
                                       request.query.get("limit", 0))
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response(
+            payload, dumps=lambda d: json.dumps(d, default=str))
+
+    async def debug_trace(request):
+        # alert → culprit request → phase breakdown in one hop: an
+        # exemplar's trace id resolves here into one waterfall with the
+        # blocking critical path (docs/observability.md "Request
+        # attribution, exemplars & trace assembly"). Fan-out to peer
+        # replicas happens in the shared core with per-replica timeouts
+        # (a dead replica degrades the waterfall, never 504s it);
+        # ?local=1 answers from this process's ring only (the leaf read
+        # peers serve each other).
+        from ..obs.debug import trace_snapshot
+
+        _probe("/debug/trace")
+        local_only = request.query.get("local", "") in ("1", "true")
+        loop = asyncio.get_event_loop()
+        try:
+            payload = await loop.run_in_executor(None, lambda: (
+                trace_snapshot(request.match_info["trace_id"],
+                               local_only=local_only)))
         except ValueError as exc:
             return web.json_response({"error": str(exc)}, status=400)
         return web.json_response(
@@ -165,6 +200,7 @@ def build_serving_app(server: GraphServer) -> web.Application:
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_get("/debug/trace/{trace_id}", debug_trace)
     app.router.add_get("/debug/profile", debug_profile_get)
     app.router.add_post("/debug/profile", debug_profile_post)
     app.router.add_post("/__drain__", drain)

@@ -503,14 +503,53 @@ class EngineFleet:
         if not out.done():
             out.set_exception(exc)
 
+    @staticmethod
+    def _merge_timing(state: dict, stats: dict):
+        """Fold the fleet's own time attribution into the engine-side
+        phase ledger so the end-to-end timing still sums to the
+        CLIENT-OBSERVED wall by construction (docs/observability.md
+        "Request attribution"): the prefill replica's ledger (riding
+        the KV handoff) and the decode replica's ledger add phase-wise,
+        the re-dispatch backoff timers land on ``redispatch_backoff``,
+        and whatever the engines could not see — dispatch callbacks,
+        handoff transfer, a failed attempt's discarded work — is the
+        ``network`` remainder (the hop wall minus the server-side
+        attributed time, exactly the RemoteStep definition)."""
+        from ..obs import merge_timing
+
+        timing = stats.get("timing")
+        if not isinstance(timing, dict):
+            return
+        timing = dict(timing)
+        timing["phases"] = dict(timing.get("phases") or {})
+        handoff = state.get("handoff")
+        if handoff is not None and getattr(handoff, "timing", None):
+            merge_timing(timing, handoff.timing)
+        phases = timing["phases"]
+        backoff = state.get("backoff_s", 0.0)
+        if backoff > 0:
+            phases["redispatch_backoff"] = \
+                phases.get("redispatch_backoff", 0.0) + backoff
+        wall = time.perf_counter() - state["t0"]
+        attributed = sum(phases.values())
+        gap = wall - attributed
+        if gap > 0:
+            phases["network"] = phases.get("network", 0.0) + gap
+        timing["wall_s"] = max(wall, attributed)
+        timing["attribution_closed"] = True
+        stats["timing"] = timing
+
     def _retry_later(self, out: Future, state: dict, redo: Callable):
         """Deterministic-jitter backoff off-thread: the done-callback
-        runs on a replica's scheduler thread, which must never sleep."""
+        runs on a replica's scheduler thread, which must never sleep.
+        The delay is remembered so the final timing attributes it to
+        the ``redispatch_backoff`` phase (obs/reqledger.py)."""
         with self._lock:
             self._stats["redispatches"] += 1
         delay = compute_backoff(
             state["attempts"] - 1, self._retry_policy,
             seed=f"fleet:{state['key']}")
+        state["backoff_s"] = state.get("backoff_s", 0.0) + delay
         timer = threading.Timer(delay, redo)
         timer.daemon = True
         timer.start()
@@ -674,6 +713,7 @@ class EngineFleet:
         stats["dispatch_attempts"] = state["attempts"] + 1
         if state.get("adapter"):
             stats["adapter"] = state["adapter"]
+        self._merge_timing(state, stats)
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="ok")
         with self._lock:
             self._stats["dispatches"] += 1

@@ -664,7 +664,8 @@ class LLMModelServer:
                          adapters: dict | None = None,
                          max_live_adapters: int | None = None,
                          adapter_rate: float | None = None,
-                         adapter_burst: float | None = None, **kw):
+                         adapter_burst: float | None = None,
+                         request_ledger: bool | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -708,8 +709,19 @@ class LLMModelServer:
                 self.max_live_adapters = max_live_adapters
                 self.adapter_rate = adapter_rate
                 self.adapter_burst = adapter_burst
+                # per-request phase ledger (docs/observability.md
+                # "Request attribution"); None = mlconf default (on)
+                self.request_ledger = request_ledger
                 self._tokenizer = None
                 self.engine = None
+                # predict→postprocess handover for the opt-in "timing"
+                # field: thread-local, because concurrent requests share
+                # this server instance and do_event runs the whole
+                # pre/predict/post chain on one thread — an instance
+                # attribute would hand one request's timing to another
+                import threading as _threading
+
+                self._timing_out = _threading.local()
 
             def load(self):
                 from ..frameworks.jax.auto_trainer import MODEL_PRESETS
@@ -753,7 +765,8 @@ class LLMModelServer:
                                 adapters=self.adapters,
                                 max_live_adapters=self.max_live_adapters,
                                 adapter_rate=self.adapter_rate,
-                                adapter_burst=self.adapter_burst)
+                                adapter_burst=self.adapter_burst,
+                                request_ledger=self.request_ledger)
                         from .llm_batch import ContinuousBatchingEngine
 
                         return ContinuousBatchingEngine(
@@ -767,7 +780,8 @@ class LLMModelServer:
                             adapters=self.adapters,
                             max_live_adapters=self.max_live_adapters,
                             adapter_rate=self.adapter_rate,
-                            adapter_burst=self.adapter_burst)
+                            adapter_burst=self.adapter_burst,
+                            request_ledger=self.request_ledger)
 
                     if self.replicas >= 2 or self.prefill_replicas:
                         # replica fleet: prefix-affinity routing across
@@ -814,6 +828,16 @@ class LLMModelServer:
                 # tokens decide deterministically.
                 adapter = request.get("adapter", "") or ""
                 request_key = request.get("request_key") or None
+                # opt-in per-request forensics: {"timing": true} in the
+                # v2 body returns each input's phase-ledger breakdown
+                # (obs/reqledger.py) in the response envelope — the
+                # debug field behind "where did this request's time go".
+                # Clear the handover slot up front: a predict() that
+                # raised after filling it must not leak one request's
+                # timing (trace ids included) onto this thread's next
+                # request.
+                self._timing_out.value = None
+                want_timing = bool(request.get("timing"))
                 id_lists = []
                 for item in inputs:
                     if isinstance(item, str):
@@ -851,6 +875,9 @@ class LLMModelServer:
                                 "prefill_chunks"):
                         if key in engine_stats:
                             self.set_metric(key, engine_stats[key])
+                    if want_timing:
+                        self._timing_out.value = [s.get("timing")
+                                                  for _, s in results]
                     out_tokens = [tokens for tokens, _ in results]
                 else:
                     out_tokens = []
@@ -870,5 +897,16 @@ class LLMModelServer:
                     else:
                         outputs.append(tokens)
                 return outputs
+
+            def postprocess(self, response):
+                # the opt-in "timing" debug field rides the v2 envelope
+                # next to "outputs" (one entry per input, aligned):
+                # phase-attributed wall + trace id, straight from the
+                # engine's request ledger
+                timings = getattr(self._timing_out, "value", None)
+                self._timing_out.value = None
+                if timings and any(t is not None for t in timings):
+                    response["timing"] = timings
+                return response
 
         return _Server(*args, **kwargs)

@@ -44,6 +44,8 @@ from ..obs import (
     LLM_QUEUE_DEPTH,
     LLM_TTFT,
     REGISTRY,
+    RequestLedger,
+    export_phases,
     flight_record,
     get_flight_recorder,
     get_tracer,
@@ -203,6 +205,9 @@ class KVHandoff:
     #                              the decode replica MUST decode with the
     #                              same adapter (docs/serving.md
     #                              "Multi-tenant LoRA")
+    timing: Optional[dict] = None  # prefill-side phase-ledger summary
+    #                              (obs/reqledger.py) the fleet merges
+    #                              into the request's end-to-end timing
 
     def nbytes(self) -> int:
         return int(sum(arr.nbytes for arr in self.kv.values()))
@@ -253,6 +258,9 @@ class _Admission:
     # monitoring tap (serving/samples.py): first-token top1-top2 logit
     # gap, captured at prefill only while a sample observer is armed
     logit_margin: float = float("nan")
+    # per-request phase ledger (obs/reqledger.py): phase transitions
+    # sum to the request wall by construction; None when disabled
+    ledger: Optional[RequestLedger] = None
 
 
 @dataclass
@@ -278,6 +286,9 @@ class _Slot:
     adapter_slot: int = 0
     # monitoring tap: threaded from the admission for the finish sample
     logit_margin: float = float("nan")
+    # per-request phase ledger, handed over from the admission; the
+    # decode loop flips it decode_active/decode_stall around every tick
+    ledger: Optional[RequestLedger] = None
 
     @property
     def active(self) -> bool:
@@ -303,7 +314,8 @@ class ContinuousBatchingEngine:
                  attention_impl: str | None = None,
                  adapters=None, max_live_adapters: int | None = None,
                  adapter_rate: float | None = None,
-                 adapter_burst: float | None = None):
+                 adapter_burst: float | None = None,
+                 request_ledger: bool | None = None):
         from ..ops.attention import resolve_prefill_impl
         from .adapters import AdapterRegistry, TenantRateLimiter
 
@@ -390,6 +402,17 @@ class ContinuousBatchingEngine:
         # adapter label values this engine has emitted series for —
         # removed with the rest of its series on stop()
         self._adapter_labels_seen: set = set()
+        # per-request phase ledger (obs/reqledger.py,
+        # docs/observability.md "Request attribution"): off = one None
+        # check per instrumented site, nothing allocated
+        if request_ledger is None:
+            from ..obs import ledger_enabled
+
+            request_ledger = ledger_enabled()
+        self.request_ledger = bool(request_ledger)
+        # injectable for deterministic fake-clock closure tests; every
+        # ledger transition reads THIS clock exactly once
+        self._ledger_clock = time.perf_counter
         # the admission being prefilled right now (chunked mode resumes it
         # across ticks; only ever touched by the scheduler thread)
         self._admission: Optional[_Admission] = None
@@ -827,6 +850,11 @@ class ContinuousBatchingEngine:
                 f"{max_new_tokens} exceeds max_len {self.max_len}"))
             return future
         adapter = adapter or ""
+        # phase ledger from here on: everything submit-side (canary
+        # resolution, 404 lookup, the pin) is "admission" time; the
+        # limiter check is split out as "rate_limit_wait"
+        ledger = RequestLedger(clock=self._ledger_clock) \
+            if self.request_ledger else None
         split_tenant = split_side = ""
         if adapter and not isinstance(_extra, KVHandoff):
             # canary/version resolution (serving/canary.py): a tenant id
@@ -867,16 +895,21 @@ class ContinuousBatchingEngine:
         # charging again would 429 a request whose prefill compute and
         # handoff bytes are already spent.
         if self._tenant_limiter is not None \
-                and not isinstance(_extra, KVHandoff) \
-                and not self._tenant_limiter.try_acquire(adapter):
-            from .adapters import AdapterRateLimitError
+                and not isinstance(_extra, KVHandoff):
+            if ledger is not None:
+                ledger.enter("rate_limit_wait")
+            acquired = self._tenant_limiter.try_acquire(adapter)
+            if ledger is not None:
+                ledger.enter("admission")
+            if not acquired:
+                from .adapters import AdapterRateLimitError
 
-            with self._lock:
-                self._stats["adapter_rate_limited"] += 1
-            future.set_exception(AdapterRateLimitError(
-                f"tenant '{adapter or '<base>'}' is over its admission "
-                f"rate — shed to protect the shared queue"))
-            return future
+                with self._lock:
+                    self._stats["adapter_rate_limited"] += 1
+                future.set_exception(AdapterRateLimitError(
+                    f"tenant '{adapter or '<base>'}' is over its "
+                    f"admission rate — shed to protect the shared queue"))
+                return future
         # the chaos point fires BEFORE the pin: an armed error here must
         # not strand a refcount (the future below is the pin's lifetime
         # authority, and it does not exist as a completion path yet)
@@ -897,7 +930,7 @@ class ContinuousBatchingEngine:
                 self._enqueue(future, prompt_tokens,
                               max_new_tokens, eos_id, temperature,
                               top_k, top_p, max_wait, adapter,
-                              _extra, _trace)
+                              _extra, _trace, ledger)
             except Exception as exc:  # noqa: BLE001 - an exception past
                 # the pin must complete the future (that runs the unpin
                 # callback) instead of leaking a refcount forever
@@ -908,7 +941,7 @@ class ContinuousBatchingEngine:
             return future
         self._enqueue(future, prompt_tokens, max_new_tokens,
                       eos_id, temperature, top_k, top_p, max_wait,
-                      adapter, _extra, _trace)
+                      adapter, _extra, _trace, ledger)
         self._meter_split(split_tenant, split_side, future)
         return future
 
@@ -925,7 +958,7 @@ class ContinuousBatchingEngine:
 
     def _enqueue(self, future: Future, prompt_tokens, max_new_tokens,
                  eos_id, temperature, top_k, top_p, max_wait, adapter,
-                 _extra, _trace) -> Future:
+                 _extra, _trace, ledger=None) -> Future:
         """Pressure/degradation checks + the actual queue put (the tail
         of :meth:`submit`, split out so the adapter-pinned path can
         armor it)."""
@@ -964,6 +997,11 @@ class ContinuousBatchingEngine:
             current_span = get_tracer().current()
             _trace = ((current_span.trace_id, current_span.span_id)
                       if current_span is not None else None)
+        if ledger is not None:
+            if _trace is not None:
+                ledger.trace_id = _trace[0]
+            # submit-side work done; the clock now charges the queue
+            ledger.enter("queue_wait")
         # enqueue under the lock: the expiry sweep drains and re-puts the
         # queue atomically, so a racing put must not land mid-sweep and
         # jump ahead of older requests
@@ -977,7 +1015,7 @@ class ContinuousBatchingEngine:
                              max_new_tokens, eos_id, future,
                              time.perf_counter(),
                              (float(temperature), int(top_k), float(top_p)),
-                             expires, _trace, _extra, adapter))
+                             expires, _trace, _extra, adapter, ledger))
         if not self._running:
             self.start()
         return future
@@ -1054,17 +1092,38 @@ class ContinuousBatchingEngine:
         a decode slot. The paged engine's `_complete_storage` already
         registered the prompt blocks, so the prefix stays cache-resident
         here for the next request sharing it."""
+        if adm.ledger is not None:
+            # the slot-cache trim/serialize below is the prefill-side
+            # handoff cost; the ledger closes here and rides the payload
+            adm.ledger.enter("handoff")
         rows = len(adm.prompt)
         kv = {}
         for name in ("k", "v", "k_scale", "v_scale"):
             if name in adm.small:
                 kv[name] = np.asarray(adm.small[name][:, 0, :rows])
         prefill_s = time.perf_counter() - adm.submitted
+        timing = None
+        if adm.ledger is not None:
+            timing = adm.ledger.close("handoff")
+            export_phases(timing, adapter=adm.adapter)
+        if adm.trace is not None:
+            # the export admission's llm.prefill span is emitted HERE
+            # (not in _finish_admission) so it can carry the closed
+            # prefill-hop ledger — the assembled waterfall's ledger view
+            # then spans both hops of a disaggregated request
+            attrs = {"slot": adm.slot, "prompt_len": len(adm.prompt),
+                     "chunks": adm.chunks, "cached_prefix": adm.base,
+                     "imported": False, "exported": True,
+                     "adapter": adm.adapter, "replica": self.replica}
+            if timing is not None:
+                attrs["timing"] = timing
+            get_tracer().emit("llm.prefill", adm.trace[0], adm.trace[1],
+                              start=adm.claimed, attrs=attrs)
         handoff = KVHandoff(
             prompt=list(adm.prompt), first_token=adm.first_token, kv=kv,
             prompt_len=len(adm.prompt), cached_prefix=adm.base,
             sampling=adm.sampling, prefill_s=prefill_s,
-            replica=self.replica, adapter=adm.adapter)
+            replica=self.replica, adapter=adm.adapter, timing=timing)
         self._release_slot_storage(adm.slot)
         with self._lock:
             self._stats["handoffs_out"] += 1
@@ -1074,8 +1133,9 @@ class ContinuousBatchingEngine:
             self._ttft_ring.append(prefill_s)
             if adm.adapter:
                 self._adapter_labels_seen.add(adm.adapter)
-        LLM_TTFT.observe(prefill_s, replica=self.replica,
-                         adapter=adm.adapter)
+        LLM_TTFT.observe(prefill_s,
+                         exemplar=(adm.trace[0] if adm.trace else None),
+                         replica=self.replica, adapter=adm.adapter)
         if not adm.future.done():
             adm.future.set_result(handoff)
 
@@ -1166,6 +1226,12 @@ class ContinuousBatchingEngine:
         prefix-cache hit the cached prefix KV is already in ``adm.small``
         and only the suffix runs. Returns True once the prompt is fully
         prefilled and the first token is sampled."""
+        if adm.ledger is not None and \
+                adm.ledger.current_phase != "prefill":
+            # first chunk dispatch: the request is in prefill from here
+            # to the first token — decode ticks interleaved between
+            # chunks included, that IS this request's prefill latency
+            adm.ledger.enter("prefill")
         fire(FaultPoints.llm_prefill, request_id=adm.request_id,
              slot=adm.slot, offset=adm.offset, chunks=adm.chunks)
         prompt = adm.prompt
@@ -1223,7 +1289,8 @@ class ContinuousBatchingEngine:
                        prompt_len: int, sampling: tuple,
                        trace: tuple | None = None, adapter: str = "",
                        adapter_slot: int = 0,
-                       logit_margin: float = float("nan")):
+                       logit_margin: float = float("nan"),
+                       ledger: RequestLedger | None = None):
         """Fill slot bookkeeping after a successful prefill (shared by the
         dense and paged admission paths)."""
         temperature, top_k, top_p = sampling
@@ -1243,12 +1310,19 @@ class ContinuousBatchingEngine:
         slot.adapter = adapter
         slot.adapter_slot = adapter_slot
         slot.logit_margin = logit_margin
+        slot.ledger = ledger
         slot.decode_started = time.time()
+        if ledger is not None:
+            # the row now waits for its first decode dispatch; every
+            # tick flips decode_active around the device step
+            ledger.enter("decode_stall")
         with self._lock:
             self._ttft_ring.append(slot.ttft)
             if adapter:
                 self._adapter_labels_seen.add(adapter)
-        LLM_TTFT.observe(slot.ttft, replica=self.replica, adapter=adapter)
+        LLM_TTFT.observe(slot.ttft,
+                         exemplar=(trace[0] if trace else None),
+                         replica=self.replica, adapter=adapter)
         if (eos_id is not None and first_token == eos_id) or \
                 slot.remaining <= 0:
             self._finish(free)
@@ -1289,7 +1363,14 @@ class ContinuousBatchingEngine:
              sampling, expires) = item[:8]
             extra = item[9] if len(item) > 9 else None
             adapter = item[10] if len(item) > 10 else ""
+            ledger = item[11] if len(item) > 11 else None
+            if ledger is not None:
+                # claimed off the queue: queue_wait closes here
+                ledger.enter("adapter_load_wait" if adapter
+                             else "admission")
             adapter_slot = self._resolve_adapter(adapter, future)
+            if ledger is not None and adapter:
+                ledger.enter("admission")
             if adapter_slot is None:
                 continue  # adapter load failed — request failed typed
             try:
@@ -1298,7 +1379,8 @@ class ContinuousBatchingEngine:
                     max_new=max_new, eos_id=eos_id, future=future,
                     submitted=submitted, sampling=sampling,
                     expires=expires, trace=item[8], claimed=time.time(),
-                    adapter=adapter, adapter_slot=adapter_slot)
+                    adapter=adapter, adapter_slot=adapter_slot,
+                    ledger=ledger)
                 self._apply_directive(adm, extra)
                 if adm.small is None:
                     adm.small = init_kv_cache(self.config, 1, self.max_len,
@@ -1337,6 +1419,11 @@ class ContinuousBatchingEngine:
         if extra == "export":
             adm.export = True
         elif isinstance(extra, KVHandoff):
+            if adm.ledger is not None:
+                # deserialize + storage completion are the decode-side
+                # handoff cost (the prefill side closed its own ledger
+                # into "handoff" at export)
+                adm.ledger.enter("handoff")
             adm.small = self._import_small(extra)
             adm.offset = len(adm.prompt)
             adm.first_token = extra.first_token
@@ -1353,17 +1440,25 @@ class ContinuousBatchingEngine:
 
     def _finish_admission(self, adm: _Admission):
         self._complete_storage(adm)
-        if adm.trace is not None:
+        if adm.ledger is not None:
+            adm.ledger.note("prefill_chunks", adm.chunks)
+            if adm.base:
+                adm.ledger.note("cached_prefix", adm.base)
+        if adm.trace is not None and not adm.export:
             # the prefill scheduler phase as a span under the submitting
-            # step — chunk count and cached-prefix length ride as attrs
-            # (imported=True marks a KV-handoff import: no prefill ran)
+            # step — chunk count, cached-prefix length and the serving
+            # replica ride as attrs (imported=True marks a KV-handoff
+            # import: no prefill ran); the replica attr is what lets a
+            # /debug/trace waterfall tell the fleet hops apart. Export
+            # admissions emit theirs in _export_admission instead, so
+            # the span can carry the closed prefill-hop ledger.
             get_tracer().emit(
                 "llm.prefill", adm.trace[0], adm.trace[1],
                 start=adm.claimed, attrs={
                     "slot": adm.slot, "prompt_len": len(adm.prompt),
                     "chunks": adm.chunks, "cached_prefix": adm.base,
-                    "imported": adm.prefilled, "exported": adm.export,
-                    "adapter": adm.adapter})
+                    "imported": adm.prefilled, "exported": False,
+                    "adapter": adm.adapter, "replica": self.replica})
         # scheduler decision on the flight ring: one admission completed
         # (prompt length, reused prefix, chunking — the inputs to every
         # later latency question a post-mortem asks)
@@ -1380,7 +1475,8 @@ class ContinuousBatchingEngine:
                             adm.submitted, len(adm.prompt), adm.sampling,
                             trace=adm.trace, adapter=adm.adapter,
                             adapter_slot=adm.adapter_slot,
-                            logit_margin=adm.logit_margin)
+                            logit_margin=adm.logit_margin,
+                            ledger=adm.ledger)
 
     def _abort_admission(self, adm: _Admission):
         """Release admission-held storage (expiry mid-prefill, stop). The
@@ -1428,6 +1524,15 @@ class ContinuousBatchingEngine:
             self._finish_admission(adm)
             self._admission = None
 
+    def _ledger_mark(self, active: list, phase: str):
+        """Flip every active slot's ledger into ``phase`` (the
+        decode_active/decode_stall split around each device dispatch —
+        transition-based, so the split still sums to wall exactly)."""
+        for i in active:
+            ledger = self._slot_state[i].ledger
+            if ledger is not None:
+                ledger.enter(phase)
+
     def _finish(self, index: int):
         slot = self._slot_state[index]
         stats = {
@@ -1436,15 +1541,26 @@ class ContinuousBatchingEngine:
             "prompt_len": slot.prompt_len,
             "total_s": time.perf_counter() - slot.started,
         }
+        timing = None
+        if slot.ledger is not None:
+            timing = slot.ledger.close()
+            stats["timing"] = timing
+            export_phases(timing, adapter=slot.adapter)
         with self._lock:
             self._stats["completed"] += 1
             self._stats["ttft_sum"] += slot.ttft
             self._stats["tokens_out"] += len(slot.tokens)
         if slot.trace is not None:
+            # the ledger rides the decode span so an assembled
+            # /debug/trace waterfall can reconcile its critical path
+            # against the request's own attribution (obs/traceview.py)
+            attrs = {"slot": index, "generated": len(slot.tokens),
+                     "replica": self.replica}
+            if timing is not None:
+                attrs["timing"] = timing
             get_tracer().emit(
                 "llm.decode", slot.trace[0], slot.trace[1],
-                start=slot.decode_started,
-                attrs={"slot": index, "generated": len(slot.tokens)})
+                start=slot.decode_started, attrs=attrs)
         if sampling_enabled():
             # monitoring tap (docs/continuous_tuning.md): one bounded
             # per-completion sample for the drift analyzer — output
@@ -1475,6 +1591,7 @@ class ContinuousBatchingEngine:
             last[i, 0] = self._slot_state[i].tokens[-1]
         lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
             if self._adapters is not None else {}
+        self._ledger_mark(active, "decode_active")
         if any(self._slot_state[i].temperature > 0 for i in active):
             temp = np.zeros((self.slots,), np.float32)
             top_k = np.zeros((self.slots,), np.int32)
@@ -1493,6 +1610,7 @@ class ContinuousBatchingEngine:
             next_token, self._cache = self._decode(
                 self.params, jnp.asarray(last), self._cache, **lora_kw)
         tokens_host = np.asarray(next_token)
+        self._ledger_mark(active, "decode_stall")
         for i in active:
             slot = self._slot_state[i]
             token = int(tokens_host[i])

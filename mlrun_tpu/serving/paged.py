@@ -329,7 +329,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  attention_impl: str | None = None,
                  adapters=None, max_live_adapters: int | None = None,
                  adapter_rate: float | None = None,
-                 adapter_burst: float | None = None):
+                 adapter_burst: float | None = None,
+                 request_ledger: bool | None = None):
         from ..ops.paged_attention import resolve_paged_impl
 
         if max_len % page_size:
@@ -358,7 +359,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          adapters=adapters,
                          max_live_adapters=max_live_adapters,
                          adapter_rate=adapter_rate,
-                         adapter_burst=adapter_burst)
+                         adapter_burst=adapter_burst,
+                         request_ledger=request_ledger)
         # decode path: pallas paged kernel (page-table indexed) or the
         # gather+dense reference — resolved once, from the same knob the
         # base class resolved the prefill path from
@@ -508,6 +510,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
              sampling, expires) = item[:8]
             extra = item[9] if len(item) > 9 else None
             adapter = item[10] if len(item) > 10 else ""
+            ledger = item[11] if len(item) > 11 else None
             prompt_len = len(prompt)
             needed = -(-(prompt_len + max_new) // self.page_size)
             if needed > self.n_pages:
@@ -537,10 +540,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 available += self._prefix.evictable_pages()
             if available < fresh_needed:
                 # head-of-line waits for pages (in order); drop the match
-                # holds so the cached prefix stays evictable meanwhile
+                # holds so the cached prefix stays evictable meanwhile —
+                # the parked time keeps charging queue_wait on the
+                # ledger (the request is still waiting, not being served)
                 if self._prefix is not None:
                     self._prefix.release(matched_nodes)
                 return None
+            if ledger is not None and adapter:
+                ledger.enter("adapter_load_wait")
             adapter_slot = self._resolve_adapter(adapter, future)
             if adapter_slot is None:
                 # adapter load failed — request failed typed; release
@@ -549,6 +556,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     self._prefix.release(matched_nodes)
                 self._pending.popleft()
                 continue
+            if ledger is not None:
+                # claimed for good: page reservation + prefix gather
+                # below are admission work
+                ledger.enter("admission")
             self._pending.popleft()
             fresh: list = []
             try:
@@ -570,7 +581,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     submitted=submitted, sampling=sampling,
                     expires=expires, trace=item[8], claimed=time.time(),
                     base=k * self.page_size, offset=k * self.page_size,
-                    adapter=adapter, adapter_slot=adapter_slot)
+                    adapter=adapter, adapter_slot=adapter_slot,
+                    ledger=ledger)
                 adm.page_ids = ids
                 adm.pages = fresh
                 adm.prefix_nodes = matched_nodes
@@ -684,6 +696,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         pos = jnp.asarray(self._pos)
         lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
             if self._adapters is not None else {}
+        self._ledger_mark(active, "decode_active")
         if any(self._slot_state[i].temperature > 0 for i in active):
             temp = np.zeros((self.slots,), np.float32)
             top_k = np.zeros((self.slots,), np.int32)
@@ -703,6 +716,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self.params, jnp.asarray(last), self._pool, table, pos,
                 **lora_kw)
         tokens_host = np.asarray(next_token)
+        self._ledger_mark(active, "decode_stall")
         with self._lock:
             # the microbench/acceptance stat: on the kernel path the tick
             # never gathers a dense view (attn_gather_ticks stays 0) and

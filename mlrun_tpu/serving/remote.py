@@ -35,6 +35,31 @@ from .resilience import DeadlineExceeded, deadline_remaining
 _sleep = time.sleep
 
 
+def _attribute_network(body, hop_s: float):
+    """Fold the HTTP hop into a v2 response's phase-ledger timing
+    (docs/observability.md "Request attribution"): every item's
+    caller-visible wall IS the hop wall (the batch returns together),
+    so each item's ``network`` gap is the hop wall minus THAT item's
+    server-side attributed wall — transfer, retries, and queueing
+    behind the batch's slowest sibling — keeping each item's timing
+    summing to the caller-visible wall. No-op for bodies without an
+    opt-in ``timing`` field."""
+    if not isinstance(body, dict):
+        return
+    timings = body.get("timing")
+    if not isinstance(timings, list):
+        return
+    for timing in timings:
+        if not isinstance(timing, dict):
+            continue
+        gap = hop_s - timing.get("wall_s", 0.0)
+        if gap <= 0:
+            continue
+        phases = timing.setdefault("phases", {})
+        phases["network"] = phases.get("network", 0.0) + gap
+        timing["wall_s"] = timing.get("wall_s", 0.0) + gap
+
+
 class RemoteCallError(RuntimeError):
     """A remote step exhausted its retries (or hit a permanent failure).
     ``status_code`` is the last HTTP status (None for transport errors);
@@ -190,12 +215,15 @@ class RemoteStep:
             resp.raise_for_status()
             return resp.json() if self.return_json else resp.content
 
+        hop_started = time.perf_counter()
         try:
             event.body = self._call_with_retries(call, event)
         except Exception:
             self._finish_span(span, "error")
             raise
         self._finish_span(span)
+        _attribute_network(event.body,
+                           time.perf_counter() - hop_started)
         return event
 
 

@@ -313,7 +313,12 @@ class GraphServer(ModelObj):
             with self._state_lock:
                 self._inflight -= 1
             SERVER_INFLIGHT.dec()
-            REQUEST_LATENCY.observe(time.perf_counter() - started)
+            # the request's trace id rides the latency histogram as its
+            # bucket exemplar — a latency SLO breach names it, and
+            # GET /debug/trace/<id> turns it into a waterfall
+            REQUEST_LATENCY.observe(
+                time.perf_counter() - started,
+                exemplar=span.trace_id if span is not None else None)
             if span is not None:
                 tracer.end_span(span, status=span_status)
         if isinstance(response, MockEvent):

@@ -6,7 +6,11 @@ drive requests through it, scrape both replicas' series over HTTP,
 federate the scrapes through ``obs.MetricsAggregator`` into a
 ``TimeSeriesStore``, read an SLO status off the windowed view, and
 assert the federation cardinality budget holds (re-scraping must not
-multiply series). Then the multi-tenant leg: a 2-tenant adapter
+multiply series). Then the forensics leg: a disaggregated fleet with
+one chaos-slowed request — its trace id must appear as an OpenMetrics
+exemplar and resolve through ``GET /debug/trace/<id>`` into a
+two-replica waterfall whose critical path blames ``prefill``. Then
+the multi-tenant leg: a 2-tenant adapter
 engine, asserting the bounded ``adapter`` label cardinality holds
 across re-scrapes. Then the canary leg: the continuous-tuning closed
 loop (drift injected via ``monitor.drift``) driven to an automatic
@@ -139,6 +143,122 @@ def _fleet_leg(base: str):
             "fleet_replicas": sorted(replica_ids),
             "merged_series": count,
             "slo_burn_fast": status.burn_fast,
+        }
+    finally:
+        fleet.stop()
+
+
+def _forensics_leg(base: str):
+    """Tail-latency forensics smoke (docs/observability.md "Request
+    attribution, exemplars & trace assembly"): a disaggregated
+    2-replica fleet serves traffic with ONE chaos-injected slow request
+    (``llm.prefill`` delay); the OpenMetrics scrape must carry that
+    request's trace id as a TTFT exemplar (and the federation parser
+    must carry it through ``MetricsAggregator``), and
+    ``GET /debug/trace/<id>`` must assemble a waterfall whose spans
+    cover both replicas and whose critical path blames ``prefill``."""
+    import jax
+    import requests
+
+    from mlrun_tpu.chaos import chaos, fail_first
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs import MetricsAggregator, get_tracer
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    def factory(role):
+        return PagedContinuousBatchingEngine(
+            config, params, max_len=64, slots=2, page_size=16,
+            prefill_buckets=(64,))
+
+    # prefill_replicas=1 + one decode worker: every request's waterfall
+    # genuinely spans two replicas (prefill hop → KV handoff → decode)
+    fleet = EngineFleet(factory, replicas=1, prefill_replicas=1)
+    fleet.start()
+    tracer = get_tracer()
+    slow_trace = None
+    try:
+        def one_request():
+            with tracer.span("forensics.request") as span:
+                _, stats = fleet.generate([7, 11, 13, 17],
+                                          max_new_tokens=4)
+            return span.trace_id, stats
+
+        # warm the compiles so the chaos delay dominates the slow
+        # request's prefill instead of drowning in first-compile noise
+        for _ in range(3):
+            one_request()
+        with chaos.inject("llm.prefill", fail_first(1), delay=0.5):
+            slow_trace, slow_stats = one_request()
+        one_request()  # a fast request after, so slow stands out
+
+        timing = slow_stats.get("timing") or {}
+        if not timing.get("attribution_closed"):
+            _fail(f"slow request's ledger did not close: {timing}")
+        if timing.get("phases", {}).get("prefill", 0.0) < 0.5:
+            _fail(f"injected prefill delay not attributed to the "
+                  f"prefill phase: {timing.get('phases')}")
+
+        scrape = requests.get(
+            base + "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+            timeout=10)
+        if scrape.status_code != 200:
+            _fail(f"OpenMetrics scrape returned {scrape.status_code}")
+        if "application/openmetrics-text" not in \
+                scrape.headers.get("Content-Type", ""):
+            _fail("Accept negotiation did not switch to OpenMetrics")
+        if f'trace_id="{slow_trace}"' not in scrape.text:
+            _fail("slow request's trace id missing from the "
+                  "OpenMetrics exemplars")
+        # the federation parser carries the exemplar through the
+        # aggregator without burning cardinality budget on it
+        aggregator = MetricsAggregator.from_mlconf()
+        before = aggregator.dropped_series
+        aggregator.ingest_text("gateway", scrape.text, at=100.0)
+        carried = {e["labels"].get("trace_id")
+                   for e in aggregator.exemplars(
+                       "mlt_llm_ttft_seconds", 100.0)}
+        if slow_trace not in carried:
+            _fail("exemplar did not survive federation ingest")
+        if aggregator.dropped_series != before:
+            _fail("exemplar ingest consumed federation cardinality")
+        # the federated breach-forensics lookup (the one a central
+        # evaluator wires in as exemplar_lookup=) surfaces the slow
+        # request as a worst offender
+        worst = aggregator.breach_exemplars(
+            "mlt_llm_ttft_seconds", None, 0.4, 3, now=100.0)
+        if slow_trace not in {e["labels"].get("trace_id")
+                              for e in worst}:
+            _fail(f"breach_exemplars did not surface the slow trace: "
+                  f"{worst}")
+
+        # alert → trace: the waterfall names both replicas and its
+        # critical path blames prefill
+        resp = requests.get(base + f"/debug/trace/{slow_trace}",
+                            timeout=10)
+        if resp.status_code != 200:
+            _fail(f"/debug/trace returned {resp.status_code}")
+        waterfall = resp.json()
+        replicas = waterfall.get("replicas") or []
+        if len(replicas) < 2:
+            _fail(f"waterfall does not span both replicas: {replicas}")
+        totals = waterfall.get("phase_totals") or {}
+        if not totals or max(totals, key=totals.get) != "prefill":
+            _fail(f"critical path does not blame prefill: {totals}")
+        recon = waterfall.get("reconciliation") or {}
+        ledger_wall = recon.get("ledger_wall_s") or 0.0
+        if ledger_wall <= 0 or abs(recon.get("delta_s", 1.0)) > \
+                0.25 * max(ledger_wall, 0.5):
+            _fail(f"critical path does not reconcile with the "
+                  f"request ledger: {recon}")
+        return {
+            "forensics_trace": slow_trace,
+            "forensics_blamed_phase": max(totals, key=totals.get),
+            "forensics_replicas": replicas,
         }
     finally:
         fleet.stop()
@@ -490,6 +610,7 @@ def main() -> int:
             _fail("request latency histogram did not count the request")
 
         fleet_summary = _fleet_leg(base)
+        fleet_summary.update(_forensics_leg(base))
         fleet_summary.update(_adapter_leg(base))
         fleet_summary.update(_canary_leg(base))
         fleet_summary.update(_training_leg(base))

@@ -39,11 +39,21 @@ def test_parser_rejects_malformed_exposition():
     with pytest.raises(PromParseError, match="malformed sample"):
         parse_prometheus("# HELP x x\n# TYPE x counter\nx{oops 1")
     with pytest.raises(PromParseError, match="unknown comment"):
-        parse_prometheus("# EOF")
+        parse_prometheus("# NOPE not a directive")
     with pytest.raises(PromParseError, match="missing HELP"):
         parse_prometheus("# TYPE x counter\nx 1")
     with pytest.raises(PromParseError, match="unknown metric type"):
         parse_prometheus("# HELP x x\n# TYPE x summary\nx 1")
+    # "# EOF" is the OpenMetrics trailer our own renderer emits behind
+    # content negotiation — accepted, not an unknown comment
+    assert parse_prometheus("# EOF") == ({}, {})
+    # a malformed exemplar clause is still a hard parse error
+    with pytest.raises(PromParseError, match="malformed exemplar"):
+        parse_prometheus("# HELP x x\n# TYPE x histogram\n"
+                         'x_bucket{le="1"} 1 # {oops')
+    with pytest.raises(PromParseError, match="malformed sample"):
+        parse_prometheus("# HELP x x\n# TYPE x histogram\n"
+                         'x_bucket{le="1"} 1 # not-an-exemplar')
 
 
 # -- registry unit behavior --------------------------------------------------
