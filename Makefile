@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace obs-smoke dryrun clean
+.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic obs-smoke dryrun clean
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -61,6 +61,12 @@ bench-goodput:   ## goodput/badput attribution of the train A-B (docs/observabil
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train --goodput > BENCH_r10.tmp \
 		&& tail -n 1 BENCH_r10.tmp > BENCH_r10.json \
 		&& rm BENCH_r10.tmp && cat BENCH_r10.json
+
+bench-elastic:   ## elastic vs full-resubmit A-B under the same injected slice kill (docs/fault_tolerance.md "Elastic training"); rewrites BENCH_r13.json
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PYTHON) bench.py --elastic > BENCH_r13.tmp \
+		&& tail -n 1 BENCH_r13.tmp > BENCH_r13.json \
+		&& rm BENCH_r13.tmp && cat BENCH_r13.json
 
 bench-attn:      ## attention kernels vs reference (flash v1/v2 + paged decode), CPU interpret mode; rewrites BENCH_ATTN_CPU.json
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_attention_cpu.py

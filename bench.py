@@ -295,6 +295,212 @@ def run_goodput(**kwargs) -> dict:
     }
 
 
+# -- elastic vs full-resubmit A-B (make bench-elastic) -----------------------
+
+def run_elastic(steps: int = 16, batch: int = 8, seq: int = 128,
+                fail_at: int = 6, rejoin_at: int = 11,
+                checkpoint_every: int = 2, downtime_s: float = 5.0,
+                cache_dir: str | None = None) -> dict:
+    """``bench.py --elastic`` (``make bench-elastic`` → BENCH_r13.json):
+    the same injected slice-kill schedule run two ways —
+
+    - **full resubmit** (the pre-elastic behavior): the kill step ends
+      the whole run via the preemption path (final checkpoint), the
+      eviction→replacement gap is attributed out-of-band as
+      ``preemption_downtime`` (``downtime_s``, the service's default
+      first-retry backoff — exactly how the monitor prices it in
+      production), and a fresh trainer resumes from the checkpoint and
+      finishes the remaining steps (its warm restart rides the
+      persistent compile cache, generous to the baseline);
+    - **elastic**: an :class:`ElasticGuard` + ``train.slice_fail`` chaos
+      injection kill one of two virtual slices mid-fit, the run
+      reshards onto the survivors (checkpoint restore at the shrunk
+      world), pays the ``degraded`` capacity tax until the replacement
+      joins at ``rejoin_at``, and grows back — one fit, no downtime.
+
+    All three mesh programs are prewarmed into the shared persistent
+    compile cache first so the A-B prices the *elasticity mechanics*
+    (downtime + redone steps vs reshard + degraded capacity), not
+    compile-order luck. Attribution sums to wall by construction in
+    both arms; the headline is the elastic arm's goodput fraction and
+    ``vs_baseline`` its ratio over the resubmit arm's. Both arms are
+    judged against the same ``SLO(kind="goodput")`` objective.
+    """
+    import tempfile
+
+    import jax
+
+    from mlrun_tpu.chaos import chaos, fail_nth
+    from mlrun_tpu.config import mlconf
+    from mlrun_tpu.models import tiny_llama
+    from mlrun_tpu.obs.slo import SLO
+    from mlrun_tpu.parallel.mesh import make_mesh
+    from mlrun_tpu.training import (
+        CheckpointManager,
+        ElasticGuard,
+        PreemptionGuard,
+        TrainConfig,
+        Trainer,
+        synthetic_token_stream,
+    )
+    from mlrun_tpu.utils import compile_cache
+
+    n = jax.device_count()
+    if n < 2 or n % 2:
+        raise SystemExit(f"bench --elastic needs an even device count "
+                         f"(got {n}) — run with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    full_shape = {"data": 2, "fsdp": n // 2}
+    shrunk_shape = {"data": 1, "fsdp": n // 2}
+    config = tiny_llama(attention_impl="reference", remat=False)
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="mlt-compile-cache-")
+    previous_cache = str(mlconf.training.get("compile_cache_dir", "") or "")
+    mlconf.training.compile_cache_dir = cache_dir
+
+    def _trainer(shape, devices=None):
+        trainer = Trainer(config, TrainConfig(total_steps=steps + 4),
+                          mesh=make_mesh(shape, devices=devices))
+        trainer.init(0)
+        return trainer
+
+    def _ckpt_cb(manager):
+        def cb(step, metrics, trainer):
+            s = int(trainer.state.step)
+            if s and s % checkpoint_every == 0:
+                manager.save(s, trainer.state, force=True)
+                manager.wait()
+        return cb
+
+    try:
+        # prewarm every mesh program into the persistent cache so
+        # neither arm pays compile-order luck
+        for shape, devs in ((full_shape, None),
+                            (shrunk_shape, list(jax.devices())[: n // 2])):
+            _trainer(shape, devs).warmup(batch, seq)
+
+        # -- arm A: full resubmit (pre-elastic behavior) -------------------
+        ckdir_a = tempfile.mkdtemp(prefix="mlt-elastic-a-")
+        manager_a = CheckpointManager(ckdir_a)
+        guard_a = PreemptionGuard()
+        counted = iter(range(1 << 20))
+
+        def killing(base):
+            for item in base:
+                if next(counted) == fail_at:
+                    guard_a.request()  # the slice eviction kills the JOB
+                yield item
+
+        trainer_a = _trainer(full_shape)
+        trainer_a.warmup(batch, seq)
+        out_a = trainer_a.fit(
+            killing(synthetic_token_stream(batch, seq, config.vocab_size)),
+            steps=steps, log_every=1, callbacks=[_ckpt_cb(manager_a)],
+            checkpoint_manager=manager_a, preemption_guard=guard_a,
+            prefetch=0)
+        summary_a1 = trainer_a.goodput.summary()
+        resumed_step = int(out_a.get("step", 0))
+        trainer_a2 = _trainer(full_shape)
+        trainer_a2.warmup(batch, seq)  # warm restart via the cache
+        trainer_a2.state = manager_a.restore(trainer_a2.state)
+        stream_a2 = synthetic_token_stream(batch, seq, config.vocab_size)
+        for _ in range(resumed_step):
+            next(stream_a2)
+        out_a2 = trainer_a2.fit(stream_a2, steps=steps - resumed_step,
+                                log_every=1, prefetch=0)
+        summary_a2 = trainer_a2.goodput.summary()
+        manager_a.close()
+        badput_a: dict = {"preemption_downtime": downtime_s}
+        for part in (summary_a1, summary_a2):
+            for bucket, seconds in part["badput"].items():
+                badput_a[bucket] = badput_a.get(bucket, 0.0) + seconds
+        goodput_a = summary_a1["goodput_s"] + summary_a2["goodput_s"]
+        wall_a = summary_a1["wall_s"] + downtime_s + summary_a2["wall_s"]
+        fraction_a = goodput_a / wall_a if wall_a else 0.0
+
+        # -- arm B: elastic -----------------------------------------------
+        ckdir_b = tempfile.mkdtemp(prefix="mlt-elastic-b-")
+        manager_b = CheckpointManager(ckdir_b)
+        trainer_b = _trainer(full_shape)
+        trainer_b.warmup(batch, seq)
+        elastic_guard = ElasticGuard(num_slices=2)
+        with chaos.inject(
+                "train.slice_fail", fail_nth(fail_at + 1),
+                action=lambda p, ctx: ctx["box"].__setitem__("fail", 1)), \
+             chaos.inject(
+                "train.slice_fail", fail_nth(rejoin_at + 1),
+                action=lambda p, ctx: ctx["box"].__setitem__("join", 1)):
+            out_b = trainer_b.fit(
+                synthetic_token_stream(batch, seq, config.vocab_size),
+                steps=steps, log_every=1,
+                callbacks=[_ckpt_cb(manager_b)],
+                checkpoint_manager=manager_b,
+                elastic_guard=elastic_guard, prefetch=0)
+        summary_b = trainer_b.goodput.summary()
+        manager_b.close()
+        fraction_b = summary_b["goodput_fraction"]
+    finally:
+        mlconf.training.compile_cache_dir = previous_cache
+        if previous_cache:
+            compile_cache.configure(previous_cache)
+        else:
+            compile_cache.disable()
+
+    # both arms judged against the same goodput objective: burn is the
+    # badput fraction over the error budget (1 - target), the burn-rate
+    # definition SLO(kind="goodput") evaluates over federated windows
+    slo = SLO("train-goodput", "goodput", target=0.5, run="bench-elastic")
+    burn_a = (1.0 - fraction_a) / slo.budget if slo.budget else 0.0
+    burn_b = (1.0 - fraction_b) / slo.budget if slo.budget else 0.0
+
+    def _closed(goodput, badput, wall):
+        return abs(goodput + sum(badput.values()) - wall) < 0.05
+
+    return {
+        "metric": "train_elastic_goodput_fraction",
+        "value": round(fraction_b, 4),
+        "unit": "fraction",
+        # >1.0 = elastic beats full resubmit under the same kill schedule
+        "vs_baseline": round(fraction_b / fraction_a, 4) if fraction_a
+        else 0.0,
+        "detail": {
+            "full_resubmit": {
+                "goodput_fraction": round(fraction_a, 4),
+                "goodput_s": round(goodput_a, 4),
+                "wall_s": round(wall_a, 4),
+                "badput_s": {k: round(v, 4)
+                             for k, v in sorted(badput_a.items())},
+                "final_step": int(out_a2.get("step", 0)),
+                "downtime_s": downtime_s,
+            },
+            "elastic": {
+                "goodput_fraction": round(fraction_b, 4),
+                "goodput_s": round(summary_b["goodput_s"], 4),
+                "wall_s": round(summary_b["wall_s"], 4),
+                "badput_s": {k: round(v, 4)
+                             for k, v in
+                             sorted(summary_b["badput"].items())},
+                "final_step": int(out_b.get("step", 0)),
+                "world_sizes": [h.get("world_size")
+                                for h in trainer_b.metrics_history],
+            },
+            "slo": {"kind": "goodput", "target": slo.target,
+                    "budget": round(slo.budget, 4),
+                    "full_resubmit_burn": round(burn_a, 4),
+                    "elastic_burn": round(burn_b, 4),
+                    "full_resubmit_meets": burn_a <= 1.0,
+                    "elastic_meets": burn_b <= 1.0},
+            "attribution_closed": (
+                _closed(goodput_a, badput_a, wall_a)
+                and _closed(summary_b["goodput_s"], summary_b["badput"],
+                            summary_b["wall_s"])),
+            "steps": steps, "batch": batch, "seq": seq,
+            "fail_at": fail_at, "rejoin_at": rejoin_at,
+            "checkpoint_every": checkpoint_every,
+            "cache_dir": cache_dir,
+        },
+    }
+
+
 def _train_main():
     import argparse
 
@@ -313,6 +519,29 @@ def _train_main():
     out = runner(steps=args.steps, batch=args.batch, seq=args.seq,
                  depth=args.depth,
                  input_delay_s=args.input_delay_ms / 1000.0)
+    print(json.dumps(out))
+
+
+def _elastic_main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--elastic", action="store_true")
+    parser.add_argument("--steps", type=int, default=16)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--fail-at", type=int, default=6)
+    parser.add_argument("--rejoin-at", type=int, default=11)
+    parser.add_argument("--checkpoint-every", type=int, default=2)
+    parser.add_argument("--downtime-s", type=float, default=5.0,
+                        help="eviction->replacement gap charged to the "
+                        "full-resubmit arm (the service's default "
+                        "first-retry backoff)")
+    args = parser.parse_args()
+    out = run_elastic(steps=args.steps, batch=args.batch, seq=args.seq,
+                      fail_at=args.fail_at, rejoin_at=args.rejoin_at,
+                      checkpoint_every=args.checkpoint_every,
+                      downtime_s=args.downtime_s)
     print(json.dumps(out))
 
 
@@ -388,6 +617,8 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--one":
         _subprocess_main()
+    elif "--elastic" in sys.argv:
+        _elastic_main()
     elif "--train" in sys.argv:
         _train_main()
     else:

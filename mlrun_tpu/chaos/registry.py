@@ -101,6 +101,14 @@ class FaultPoints:
     # pipeline (input-boundness on demand), an error models a poisoned
     # batch reaching the consumer at its exact position
     train_prefetch = "train.prefetch"
+    # one elastic-guard health poll per train step (training/elastic.py
+    # ElasticGuard.poll) — fires with a mutable ``box``; an action()
+    # setting box["fail"]=<slice> kills that slice under the running fit
+    # (deterministic mid-run slice preemption), box["join"]=<slice>
+    # models the replacement slice joining (grow-back). The injection IS
+    # the failure: no real devices die, the trainer reshards exactly as
+    # it would on hardware (docs/fault_tolerance.md "Elastic training")
+    train_slice_fail = "train.slice_fail"
 
     @staticmethod
     def all() -> list[str]:
@@ -115,7 +123,7 @@ class FaultPoints:
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
             FaultPoints.llm_adapter_load,
             FaultPoints.obs_autoscale, FaultPoints.monitor_drift,
-            FaultPoints.train_prefetch,
+            FaultPoints.train_prefetch, FaultPoints.train_slice_fail,
         ]
 
 

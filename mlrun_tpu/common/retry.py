@@ -23,6 +23,12 @@ class FailureClass:
 
     # retryable infra faults
     preemption = "preemption"                  # spot/preemptible eviction
+    # ONE pod-slice of a multi-slice job evicted while the job itself is
+    # alive — the elastic case: survivors reshard and keep training, the
+    # monitor submits only a replacement slice (not a full resubmit).
+    # Distinguished from ``preemption`` (whole job dead) by the provider's
+    # slice_status probe / slice-scoped failure text.
+    slice_preempted = "slice_preempted"
     image_pull_backoff = "image_pull_backoff"  # registry flake
     node_drain = "node_drain"                  # node shutdown / drain
     http_5xx = "http_5xx"                      # control-plane 5xx
@@ -35,7 +41,8 @@ class FailureClass:
     @staticmethod
     def retryable() -> list[str]:
         return [
-            FailureClass.preemption, FailureClass.image_pull_backoff,
+            FailureClass.preemption, FailureClass.slice_preempted,
+            FailureClass.image_pull_backoff,
             FailureClass.node_drain, FailureClass.http_5xx,
             FailureClass.resource_vanished, FailureClass.infra,
             FailureClass.stalled,
@@ -46,6 +53,10 @@ class FailureClass:
 # reasons (Evicted/Preempted/NodeShutdown), kubelet waiting reasons
 # (ImagePullBackOff/ErrImagePull), and control-plane error text.
 _PATTERNS: list[tuple[str, str]] = [
+    # slice-scoped text must outrank the generic preemption pattern
+    # ("slice 1 preempted" contains "preempt") — first hit wins
+    (r"slice[\s_-]*\d*[\s_-]*(preempt|fail|evict)|slicefailed|failedslice",
+     FailureClass.slice_preempted),
     (r"preempt|evict|spot|gke-spot", FailureClass.preemption),
     (r"imagepullbackoff|errimagepull|image\s*pull", FailureClass.image_pull_backoff),
     (r"node\s*drain|nodeshutdown|node\s*shutdown|unschedulable|"

@@ -1,1 +1,5 @@
-from .jobset import build_jobset, parse_topology  # noqa: F401
+from .jobset import (  # noqa: F401
+    TopologyError,
+    build_jobset,
+    parse_topology,
+)

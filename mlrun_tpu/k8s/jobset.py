@@ -20,14 +20,27 @@ from ..config import mlconf
 JOBSET_API_VERSION = "jobset.x-k8s.io/v1alpha2"
 
 
+class TopologyError(ValueError):
+    """Invalid TPU topology / host-geometry declaration (zero, negative
+    or non-integer dims; non-positive chips_per_host). Typed so callers
+    can catch the *declaration* error specifically — and raised at build
+    time instead of letting a bad geometry silently produce a 0-host
+    JobSet the cluster would park forever."""
+
+
 def parse_topology(topology: str) -> tuple[int, ...]:
-    """'2x4' -> (2, 4); '4x4x4' -> (4, 4, 4)."""
+    """'2x4' -> (2, 4); '4x4x4' -> (4, 4, 4).
+
+    Rejects empty, non-integer ('2.5x4', '2x', 'ax4') and
+    zero/negative dims with a :class:`TopologyError`."""
     try:
-        dims = tuple(int(d) for d in topology.lower().split("x"))
-    except ValueError as exc:
-        raise ValueError(f"bad TPU topology '{topology}'") from exc
+        dims = tuple(int(d) for d in str(topology).lower().split("x"))
+    except (ValueError, AttributeError) as exc:
+        raise TopologyError(f"bad TPU topology '{topology}'") from exc
     if not dims or any(d <= 0 for d in dims):
-        raise ValueError(f"bad TPU topology '{topology}'")
+        raise TopologyError(
+            f"bad TPU topology '{topology}': dims must be positive "
+            "integers")
     return dims
 
 
@@ -39,7 +52,18 @@ def chips_in_topology(topology: str) -> int:
 
 
 def hosts_for_topology(topology: str, chips_per_host: int | None = None) -> int:
-    chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+    # None means "use the config default"; an explicit 0 must NOT fall
+    # back silently — it is exactly the bad declaration this validates
+    if chips_per_host is None:
+        chips_per_host = mlconf.tpu.chips_per_host
+    try:
+        chips_per_host = int(chips_per_host)
+    except (TypeError, ValueError) as exc:
+        raise TopologyError(
+            f"bad chips_per_host '{chips_per_host}'") from exc
+    if chips_per_host <= 0:
+        raise TopologyError(
+            f"chips_per_host must be positive, got {chips_per_host}")
     return max(1, math.ceil(chips_in_topology(topology) / chips_per_host))
 
 
@@ -47,7 +71,7 @@ def build_jobset(name: str, namespace: str, pod_spec: dict, *,
                  accelerator: str, topology: str, num_slices: int = 1,
                  chips_per_host: int | None = None, max_restarts: int = 0,
                  labels: dict | None = None, annotations: dict | None = None,
-                 suspend: bool = False) -> dict:
+                 suspend: bool = False, elastic: bool = False) -> dict:
     """Build the JobSet dict for a TPU run.
 
     One replicated Job named 'slice' with ``num_slices`` replicas; each Job is
@@ -55,11 +79,26 @@ def build_jobset(name: str, namespace: str, pod_spec: dict, *,
     ``chips_per_host`` TPU chips and carries the GKE TPU node selectors. For
     multi-slice (num_slices>1) the MEGASCALE coordinator env is injected so
     XLA runs DCN collectives across slices.
+
+    ``elastic`` marks a multi-slice run that survives losing a slice
+    (docs/fault_tolerance.md "Elastic training"): the
+    ``mlrun-tpu/elastic`` annotation declares the intent, and the
+    failurePolicy restart budget is floored at ``num_slices`` so a
+    single child-Job failure cannot fail the whole JobSet before the
+    service's slice-replacement path (``TpuJobHandler._check_slices``)
+    reacts.
     """
-    chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+    # None = config default; an explicit 0 must reach the validation in
+    # hosts_for_topology instead of silently becoming the default
+    if chips_per_host is None:
+        chips_per_host = mlconf.tpu.chips_per_host
     hosts = hosts_for_topology(topology, chips_per_host)
     labels = dict(labels or {})
     labels.setdefault("app.kubernetes.io/managed-by", "mlrun-tpu")
+    annotations = dict(annotations or {})
+    if elastic:
+        annotations["mlrun-tpu/elastic"] = "true"
+        max_restarts = max(int(max_restarts), int(num_slices))
 
     pod_spec = dict(pod_spec)
     pod_spec["subdomain"] = name  # headless service for host discovery
@@ -116,7 +155,7 @@ def build_jobset(name: str, namespace: str, pod_spec: dict, *,
             "name": name,
             "namespace": namespace,
             "labels": labels,
-            "annotations": annotations or {},
+            "annotations": annotations,
         },
         "spec": {
             "suspend": suspend,

@@ -58,11 +58,19 @@ GOODPUT_PHASE = "step"
 #   preemption_downtime  eviction -> replacement-resource gap (monitor)
 #   resubmit_gap         retry backoff before a non-preemption resubmit
 #   stall                heartbeat-silent window before a stall abort
+#   reshard              elastic slice-loss recovery: survivor mesh
+#                        rebuild + checkpoint reshard-restore + the
+#                        post-reshard recompile (and the grow-back put)
+#   degraded             capacity lost while running at reduced world
+#                        size: of every step-second at W' of W devices,
+#                        the (1 - W'/W) share is attributed here — the
+#                        price elasticity pays INSTEAD of
+#                        preemption_downtime + re_warm full stops
 #   init                 loop entry before the first phase transition
 #   other                attributable to no instrumented phase
 BADPUT_BUCKETS = ("compile", "re_warm", "data_wait", "h2d", "metric_flush",
                   "checkpoint", "preemption_downtime", "resubmit_gap",
-                  "stall", "init", "other")
+                  "stall", "reshard", "degraded", "init", "other")
 
 # one run-admission gate bounds the ``run`` label across ALL four
 # families (below): per-family overflow="drop" alone would desync them
@@ -80,7 +88,8 @@ BADPUT_SECONDS = REGISTRY.counter(
     "mlt_badput_seconds_total",
     "Unproductive wall seconds per run by typed bucket (compile, "
     "re_warm, data_wait, h2d, metric_flush, checkpoint, "
-    "preemption_downtime, resubmit_gap, stall, init, other)",
+    "preemption_downtime, resubmit_gap, stall, reshard, degraded, "
+    "init, other)",
     labels=("run", "bucket"), max_label_sets=8192, overflow="drop")
 WALL_SECONDS = REGISTRY.counter(
     "mlt_goodput_wall_seconds_total",

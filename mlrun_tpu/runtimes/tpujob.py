@@ -22,21 +22,29 @@ from .pod import KubeResource, KubeResourceSpec
 class TpuJobSpec(KubeResourceSpec):
     _dict_fields = KubeResourceSpec._dict_fields + [
         "accelerator_type", "topology", "num_slices", "chips_per_host",
-        "max_restarts", "mesh_shape", "mesh_axes",
+        "max_restarts", "mesh_shape", "mesh_axes", "elastic",
     ]
 
     def __init__(self, accelerator_type=None, topology=None, num_slices=None,
                  chips_per_host=None, max_restarts=None, mesh_shape=None,
-                 mesh_axes=None, **kwargs):
+                 mesh_axes=None, elastic=None, **kwargs):
         super().__init__(**kwargs)
         self.accelerator_type = accelerator_type or mlconf.tpu.default_accelerator
         self.topology = topology or mlconf.tpu.default_topology
         self.num_slices = num_slices or 1
-        self.chips_per_host = chips_per_host or mlconf.tpu.chips_per_host
+        # None = config default; an explicit 0 is kept so the typed
+        # TopologyError fires at JobSet build instead of the bad value
+        # silently becoming the default host geometry
+        self.chips_per_host = chips_per_host if chips_per_host is not None \
+            else mlconf.tpu.chips_per_host
         # restart the whole JobSet on preemption; checkpoint-resume picks up
         self.max_restarts = max_restarts if max_restarts is not None else 3
         self.mesh_shape = mesh_shape
         self.mesh_axes = mesh_axes
+        # elastic multi-slice: survive one slice's preemption by
+        # resharding onto the survivors instead of a full JobSet restart
+        # (docs/fault_tolerance.md "Elastic training")
+        self.elastic = bool(elastic)
 
 
 class TpuJobRuntime(KubeResource):
@@ -72,6 +80,17 @@ class TpuJobRuntime(KubeResource):
             self.spec.mesh_shape = dict(shape)
         if axes:
             self.spec.mesh_axes = list(axes)
+        return self
+
+    def with_elastic(self, elastic: bool = True):
+        """Opt the run into elastic multi-slice training: on a slice
+        preemption the service submits only a replacement slice while
+        the survivors reshard and keep training
+        (docs/fault_tolerance.md "Elastic training"). The run's handler
+        should pass an :class:`~mlrun_tpu.training.ElasticGuard` to
+        ``Trainer.fit`` and a retry policy with ``max_retries`` > 0 (the
+        slice-replacement budget)."""
+        self.spec.elastic = bool(elastic)
         return self
 
     def with_preemptible(self, spot: bool = True):
@@ -126,6 +145,7 @@ class TpuJobRuntime(KubeResource):
             num_slices=self.spec.num_slices,
             chips_per_host=self.spec.chips_per_host,
             max_restarts=self.spec.max_restarts,
+            elastic=bool(getattr(self.spec, "elastic", False)),
             labels={
                 "mlrun-tpu/project": runobj.metadata.project,
                 "mlrun-tpu/uid": runobj.metadata.uid,

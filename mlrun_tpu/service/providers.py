@@ -324,6 +324,77 @@ class KubernetesProvider(Provider):
         else:
             self._core.delete_namespaced_pod(name, self.namespace)
 
+    # -- slice elasticity (docs/fault_tolerance.md "Elastic training") ------
+    def slice_status(self, resource_id: str) -> dict:
+        """Per-slice health of a multi-slice JobSet: ``{"failed_slices":
+        [indices], "replicas": N}`` (empty dict for non-JobSet
+        resources). The contract field is ``status.failedSlices`` — the
+        fake cluster maintains it directly; a production deployment
+        derives it from the JobSet controller's child-Job states (the
+        stock ``replicatedJobsStatus`` carries counts, not indices, so a
+        real watcher enumerates child Jobs ``<name>-slice-<i>``). This is
+        what lets ``monitor_runs`` tell "one slice gone, job alive"
+        (elastic replacement) from "job dead" (full resubmit)."""
+        kind, _, name = resource_id.partition("/")
+        if kind != "jobset":
+            return {}
+        group, version, plural = _CRD_BY_LOWER["jobset"]
+        obj = self._custom.get_namespaced_custom_object(
+            group, version, self.namespace, plural, name)
+        status = obj.get("status", {}) or {}
+        failed = status.get("failedSlices") or []
+        jobs = obj.get("spec", {}).get("replicatedJobs") or [{}]
+        replicas = int(jobs[0].get("replicas", 1) or 1)
+        annotations = obj.get("metadata", {}).get("annotations") or {}
+        return {"failed_slices": sorted(int(s) for s in failed),
+                "replicas": replicas,
+                # the with_elastic() opt-in, carried on the resource so
+                # a restarted service still honors it
+                "elastic": annotations.get("mlrun-tpu/elastic") == "true"}
+
+    def replace_slice(self, resource_id: str, slice_index: int,
+                      extra_env: dict | None = None) -> str:
+        """Submit a replacement for ONE preempted slice of a live JobSet
+        — the survivors keep running. ``extra_env`` (checkpoint-resume +
+        compile-cache env) is upserted into the JobSet's pod template
+        first, so the replacement pod joins warm; then the failed child
+        Job is deleted and the JobSet controller recreates it from the
+        updated template. Returns the child-Job name."""
+        chaos_fire("provider.replace_slice", kind=self.kind,
+                   resource_id=resource_id, slice_index=slice_index)
+        kind, _, name = resource_id.partition("/")
+        if kind != "jobset":
+            raise ValueError(
+                f"slice replacement only applies to JobSets, not "
+                f"'{resource_id}'")
+        group, version, plural = _CRD_BY_LOWER["jobset"]
+        if extra_env:
+            obj = self._custom.get_namespaced_custom_object(
+                group, version, self.namespace, plural, name)
+            jobs = obj.get("spec", {}).get("replicatedJobs") or []
+            for job in jobs:
+                pod_spec = (job.get("template", {}).get("spec", {})
+                            .get("template", {}).get("spec", {}))
+                for container in pod_spec.get("containers", []):
+                    env = container.setdefault("env", [])
+                    for key, value in extra_env.items():
+                        for existing in env:
+                            if existing.get("name") == key:
+                                existing["value"] = str(value)
+                                break
+                        else:
+                            env.append({"name": key, "value": str(value)})
+            self._custom.patch_namespaced_custom_object(
+                group, version, self.namespace, plural, name,
+                {"spec": {"replicatedJobs": jobs}})
+        import kubernetes
+
+        child = f"{name}-slice-{int(slice_index)}"
+        kubernetes.client.BatchV1Api(
+            self._core.api_client).delete_namespaced_job(
+            child, self.namespace)
+        return child
+
     def ensure_project_secret(self, project: str, secrets: dict) -> str:
         """Create/replace the project's k8s Secret and return its name."""
         import base64

@@ -342,6 +342,11 @@ class BaseRuntimeHandler:
                 # undo the blip's pending-for-retry parking
                 self.db.update_run({"status.state": RunStates.running},
                                    uid, project, iter=iteration)
+            # elastic multi-slice path: ONE slice gone while the job
+            # stays alive is not a failure — submit only a replacement
+            # slice; the in-run trainer reshards onto the survivors
+            if self._check_slices(key, resource_id, project, run):
+                return
             # heartbeat watchdog: a resource that still reports running
             # but whose run went silent is stalled (hung collective,
             # wedged host)
@@ -528,6 +533,14 @@ class BaseRuntimeHandler:
                                   attempt: int):
         """Handler hook: adjust the renamed manifest before resubmission
         (TpuJobHandler wires checkpoint-resume env here)."""
+
+    def _check_slices(self, key: str, resource_id: str, project: str,
+                      run: dict) -> bool:
+        """Handler hook: per-slice health of a still-running resource.
+        True → a slice-level event was handled this tick (the monitor
+        skips the stall check — a just-degraded run is mid-reshard, not
+        stalled). Base handlers have no slice structure."""
+        return False
 
     # -- stall watchdog ------------------------------------------------------
     def _check_stalled(self, key: str, resource_id: str, project: str,
@@ -791,6 +804,125 @@ class TpuJobHandler(BaseRuntimeHandler):
         if training is None:
             return ""
         return str(training.get("compile_cache_dir", "") or "")
+
+    def _check_slices(self, key: str, resource_id: str, project: str,
+                      run: dict) -> bool:
+        """Elastic multi-slice handling (docs/fault_tolerance.md
+        "Elastic training"): a failed slice of a LIVE JobSet gets only a
+        replacement slice Job — the survivors keep training at reduced
+        world size (the in-run trainer reshards; ``ElasticGuard``) —
+        instead of the whole run being resubmitted. Re-entry is warm:
+        the replacement's template is refreshed with the latest
+        ``status.checkpoint`` resume env and the persistent compile
+        cache before the child Job is recreated. Budgeted like retries
+        (``status.slice_replacements`` against ``max_retries``), gated
+        on ``slice_preempted`` being a retried class."""
+        slice_status = getattr(self.provider, "slice_status", None)
+        if slice_status is None:
+            return False
+        try:
+            status = slice_status(resource_id) or {}
+        except Exception:  # noqa: BLE001 - a probe blip never escalates
+            return False   # here; the state probe owns liveness
+        if not status.get("elastic"):
+            # elasticity is an OPT-IN (with_elastic(), the
+            # mlrun-tpu/elastic annotation): a non-elastic run's failed
+            # slice means its survivors are wedged in dead DCN
+            # collectives with no reshard machinery — the job-level
+            # failure/full-resubmit path is the right medicine there
+            return False
+        failed = sorted(int(s) for s in status.get("failed_slices") or [])
+        uid, iteration = self._split_key(key)
+        degraded = [int(s) for s in
+                    get_in(run, "status.degraded_slices", []) or []]
+        if not failed:
+            if degraded:
+                # the replacement came up: the run is whole again —
+                # grow-back is the trainer's job, this is bookkeeping
+                self.db.update_run(
+                    {"status.degraded_slices": [],
+                     "status.status_text":
+                     "replacement slice joined — full world size "
+                     "restored"},
+                    uid, project, iter=iteration)
+                flight_record("run.slice_rejoined", uid=uid,
+                              slices=degraded)
+                logger.info("slice replacement joined", uid=uid,
+                            slices=degraded)
+            return False
+        replicas = int(status.get("replicas") or 0)
+        if replicas and len(failed) >= replicas:
+            # EVERY slice is gone: that is a dead job, not a degraded
+            # one — fall through to the state probe / full-resubmit path
+            return False
+        policy = resolve_retry_policy(get_in(run, "spec.retry_policy"))
+        replaced = int(get_in(run, "status.slice_replacements", 0) or 0)
+        fresh = [s for s in failed if s not in degraded]
+        if FailureClass.slice_preempted not in policy.retry_on:
+            return False
+        if not fresh:
+            # replacements pending — survivors keep running, and the
+            # stall watchdog must KEEP watching them (a wedged survivor
+            # set during a capacity shortage still needs the escalation
+            # path), so this is deliberately not "handled"
+            return False
+        if not policy.retries_left(replaced):
+            logger.warning("slice replacement budget exhausted", uid=uid,
+                           slices=fresh, budget=policy.max_retries)
+            return False
+        checkpoint = get_in(run, "status.checkpoint", {}) or {}
+        resume_env = {}
+        if checkpoint.get("path"):
+            resume_env[RESUME_CHECKPOINT_ENV] = str(checkpoint["path"])
+            if checkpoint.get("step") is not None:
+                resume_env[RESUME_STEP_ENV] = str(checkpoint["step"])
+        cache_dir = self._compile_cache_dir()
+        if cache_dir:
+            resume_env[COMPILE_CACHE_ENV] = cache_dir
+        flight_record("run.slice_preempted", uid=uid, slices=fresh,
+                      survivors=(replicas - len(failed)) if replicas
+                      else None)
+        submitted = []
+        for slice_index in fresh:
+            if not policy.retries_left(replaced):
+                # re-checked per slice: several slices failing in one
+                # tick must not overrun the budget together
+                logger.warning("slice replacement budget exhausted",
+                               uid=uid, slice=slice_index,
+                               budget=policy.max_retries)
+                break
+            try:
+                child = self.provider.replace_slice(
+                    resource_id, slice_index, extra_env=resume_env)
+            except Exception as exc:  # noqa: BLE001 - a failed slice
+                # replacement degrades to the full-resubmit safety net
+                # on a later tick (the slice stays listed as failed)
+                logger.warning("slice replacement failed", uid=uid,
+                               slice=slice_index, error=str(exc))
+                continue
+            submitted.append(slice_index)
+            replaced += 1
+            RUN_RETRIES.inc(failure_class=FailureClass.slice_preempted)
+            flight_record("run.slice_replacement", uid=uid,
+                          slice=slice_index, resource=child,
+                          attempt=replaced)
+        if not submitted:
+            return False
+        self.db.update_run(
+            {"status.degraded_slices": sorted(set(degraded)
+                                              | set(submitted)),
+             "status.slice_replacements": replaced,
+             "status.status_text":
+             f"slice(s) {submitted} preempted — replacement submitted, "
+             "survivors continue resharded"},
+            uid, project, iter=iteration)
+        get_tracer().emit(
+            "run.slice_replacement", trace_id_for(uid),
+            attrs={"uid": uid, "slices": submitted,
+                   "resource": resource_id})
+        logger.info("submitted slice replacement", uid=uid,
+                    slices=submitted, resource=resource_id)
+        return True
 
     def _customize_retry_manifest(self, manifest: dict, run: dict,
                                   attempt: int):

@@ -8,6 +8,7 @@ from .data import (  # noqa: F401
     synthetic_token_stream,
     text_file_stream,
 )
+from .elastic import ElasticGuard, SliceEvent  # noqa: F401
 from .mfu import ThroughputTracker, chip_peak_flops, mfu  # noqa: F401
 from .preemption import PreemptionGuard  # noqa: F401
 from .train import (  # noqa: F401

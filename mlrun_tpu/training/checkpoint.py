@@ -46,14 +46,41 @@ class CheckpointManager:
         return bool(saved)
 
     def restore(self, state_like: Any, step: int | None = None) -> Any:
+        """Restore ``step`` (default: latest) into the shape of
+        ``state_like``.
+
+        The restore is **sharding-agnostic**: every device-array leaf of
+        the target is reduced to its abstract (shape, dtype, sharding)
+        before orbax sees it, so a checkpoint written at N slices
+        restores at N−1 (or N+1) — the arrays materialize directly under
+        the TARGET's shardings, whatever layout the writer had. This is
+        the load-bearing invariant of elastic training: the survivors'
+        trainer hands in a state skeleton sharded over the SHRUNK mesh
+        and gets the old checkpoint's values back resharded onto it.
+        ``state_like`` leaves may already be abstract
+        (``jax.ShapeDtypeStruct`` carrying a sharding) — the elastic
+        reshard path builds exactly that, with no concrete donor state.
+        """
         import orbax.checkpoint as ocp
 
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        target = _to_pytree(state_like)
+        target = _abstract_leaves(_to_pytree(state_like))
         restored = self._manager.restore(
             step, args=ocp.args.StandardRestore(target))
+        # Donation safety: orbax hands back arrays whose buffers the
+        # restore machinery may still co-own. The trainer donates the
+        # state into the step executable (donate_argnums=0), and
+        # donating a co-owned buffer corrupts it — observed as garbage
+        # step values / segfaults once the executable came
+        # deserialized from the persistent compile cache. One XLA copy
+        # per restore makes every leaf exclusively ours.
+        import jax.numpy as jnp
+
+        restored = jax.tree_util.tree_map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a,
+            restored)
         return _from_pytree(state_like, restored)
 
     def latest_step(self) -> Optional[int]:
@@ -76,6 +103,22 @@ def _to_pytree(state):
             tree["lora"] = state.lora
         return tree
     return state
+
+
+def _abstract_leaves(tree):
+    """Replace device-array leaves with (shape, dtype, sharding)
+    abstractions. Host leaves (numpy scalars etc.) pass through concrete;
+    already-abstract leaves pass through unchanged."""
+
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        sharding = getattr(x, "sharding", None)
+        if isinstance(x, jax.Array) and sharding is not None:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def _from_pytree(state_like, restored):

@@ -1,0 +1,167 @@
+"""Elastic multi-slice training: the slice-aware fault model.
+
+A multi-slice TPU job's failure domain is the pod-slice ("Exploring the
+limits of Concurrency in ML Training on Google TPUs", PAPERS.md): one
+slice preempted used to mean the whole JobSet restarts. The elastic path
+instead reshards the run onto the survivors and keeps training at
+reduced world size until the replacement slice joins
+(docs/fault_tolerance.md "Elastic training").
+
+This module is the detection half: :class:`ElasticGuard` partitions the
+device set into slices and tracks which are alive. ``Trainer.fit`` polls
+it once per step (the ``preemption_guard`` pattern) and reacts to the
+events it emits:
+
+- ``fail``: a slice died — reshard onto the survivors
+  (``Trainer.reshard``, restoring from the last checkpoint: on real
+  hardware the dead slice's shards are gone).
+- ``join``: the replacement slice is back — grow back to full world
+  size (the survivors hold the full state, so this is an in-memory
+  reshard, no step rewind).
+
+Detection sources, in order:
+
+- programmatic ``fail_slice``/``join_slice`` (tests, an external watcher
+  wired to the JobSet controller's child-job events);
+- the ``train.slice_fail`` chaos point, fired with a mutable ``box`` on
+  every poll — an armed injection setting ``box["fail"]``/``box["join"]``
+  kills/revives a slice mid-fit deterministically. The injection IS the
+  failure: no devices actually die, so the same reshard machinery that
+  would run on hardware is exercised end-to-end on the CPU backend.
+
+On real multi-slice TPU, slice membership comes from the devices'
+``slice_index``; on CPU/virtual backends devices are split into
+``num_slices`` contiguous blocks (``MLT_NUM_SLICES`` /
+``parallel.mesh._detect_num_slices``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+from ..chaos import chaos
+from ..chaos import fire as chaos_fire
+from ..utils import logger
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceEvent:
+    """One slice-membership change observed by a poll."""
+
+    kind: str           # "fail" | "join"
+    slice_index: int
+    devices: tuple      # the ACTIVE device set after this event
+
+
+class ElasticGuard:
+    """Tracks slice liveness over a device set (single consumer: the
+    training loop; programmatic mutations may come from other threads —
+    the event queue is the only shared state and ``deque`` append/pop
+    are atomic)."""
+
+    def __init__(self, devices=None, num_slices: int | None = None):
+        import jax
+
+        from ..parallel.mesh import _detect_num_slices
+
+        devices = list(devices if devices is not None else jax.devices())
+        if not devices:
+            raise ValueError("elastic guard needs at least one device")
+        num_slices = int(num_slices or _detect_num_slices(devices))
+        if num_slices < 1 or len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not split into "
+                f"{num_slices} equal slices")
+        # group by the hardware slice_index when the backend has one;
+        # contiguous equal blocks otherwise (virtual slices on CPU)
+        by_slice: dict[int, list] = {}
+        ids = {getattr(d, "slice_index", None) for d in devices}
+        if None not in ids and len(ids) == num_slices:
+            for d in devices:
+                by_slice.setdefault(int(d.slice_index), []).append(d)
+            self._slices = [by_slice[k] for k in sorted(by_slice)]
+        else:
+            per = len(devices) // num_slices
+            self._slices = [devices[i * per:(i + 1) * per]
+                            for i in range(num_slices)]
+        self._failed: set[int] = set()
+        self._events: deque = deque()
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def num_slices(self) -> int:
+        return len(self._slices)
+
+    @property
+    def failed_slices(self) -> list[int]:
+        return sorted(self._failed)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self._failed)
+
+    @property
+    def devices(self) -> list:
+        """The ACTIVE device set (every device of every live slice)."""
+        return [d for i, group in enumerate(self._slices)
+                if i not in self._failed for d in group]
+
+    def lost_fraction(self) -> float:
+        """Capacity fraction currently lost to failed slices — the
+        ``degraded`` goodput-bucket tax rate."""
+        return len(self._failed) / len(self._slices)
+
+    # -- mutations -----------------------------------------------------------
+    def fail_slice(self, slice_index: int):
+        """Mark a slice preempted. Failing the LAST live slice is a job
+        failure, not elasticity — rejected loudly so a bad injection
+        can't make the trainer 'reshard' onto nothing."""
+        slice_index = self._validate(slice_index)
+        if slice_index in self._failed:
+            return
+        if len(self._failed) + 1 >= len(self._slices):
+            raise ValueError(
+                f"slice {slice_index} is the last survivor — no elastic "
+                "recovery exists for losing every slice")
+        self._failed.add(slice_index)
+        logger.warning("slice preempted", slice=slice_index,
+                       survivors=len(self.devices))
+        self._events.append(SliceEvent("fail", slice_index,
+                                       tuple(self.devices)))
+
+    def join_slice(self, slice_index: int):
+        """A replacement for a failed slice joined (grow-back)."""
+        slice_index = self._validate(slice_index)
+        if slice_index not in self._failed:
+            return
+        self._failed.discard(slice_index)
+        logger.info("slice rejoined", slice=slice_index,
+                    world=len(self.devices))
+        self._events.append(SliceEvent("join", slice_index,
+                                       tuple(self.devices)))
+
+    def _validate(self, slice_index: int) -> int:
+        slice_index = int(slice_index)
+        if not 0 <= slice_index < len(self._slices):
+            raise ValueError(
+                f"slice {slice_index} out of range "
+                f"(num_slices={len(self._slices)})")
+        return slice_index
+
+    # -- polling -------------------------------------------------------------
+    def poll(self) -> Optional[SliceEvent]:
+        """One health check, called once per train step. Fires the
+        ``train.slice_fail`` chaos point (dark: one attribute read) and
+        returns the oldest pending membership change, or None."""
+        if chaos.enabled:
+            box: dict = {"fail": None, "join": None}
+            chaos_fire("train.slice_fail", box=box,
+                       failed=self.failed_slices,
+                       num_slices=len(self._slices))
+            if box["fail"] is not None:
+                self.fail_slice(box["fail"])
+            if box["join"] is not None:
+                self.join_slice(box["join"])
+        return self._events.popleft() if self._events else None

@@ -693,9 +693,81 @@ class Trainer:
             context.log_result("resumed_from_step", int(self.state.step))
         return True
 
+    def reshard(self, devices, checkpoint_manager=None,
+                num_slices: int | None = None) -> dict:
+        """Rebuild the mesh + step function over ``devices`` and move the
+        train state onto it — the elastic slice-loss/grow-back core
+        (docs/fault_tolerance.md "Elastic training"). The logical mesh
+        shape is refit by rescaling one axis (``parallel.mesh.refit_shape``
+        — conventionally the DCN/data axis that spanned the lost slice).
+
+        State transfer has two modes: with a checkpoint available the
+        state is RESTORED from it under the new shardings — the only
+        honest source after a slice death, since on real hardware the
+        dead slice's shards are gone (``CheckpointManager.restore`` is
+        sharding-agnostic, so the cross-world-size restore is exact).
+        Without one (grow-back, where the survivors hold everything; or
+        a simulated shrink that never checkpointed) the LIVE state is
+        resharded in place via ``device_put`` — no step rewind. Returns
+        the decision record the flight-recorder chain carries."""
+        from ..parallel.mesh import _detect_num_slices, make_mesh, refit_shape
+
+        assert self.state is not None, "call init() first"
+        devices = list(devices)
+        old_world = int(self.mesh.devices.size)
+        new_shape = refit_shape(dict(self.mesh.shape), len(devices))
+        # slice count for the NEW mesh: the caller (ElasticGuard via fit)
+        # knows how many slices survive; detection — and especially the
+        # global MLT_NUM_SLICES override — describes the FULL device set
+        # and must not be trusted for a survivor subset (it would fail
+        # the refit shape's DCN divisibility check mid-recovery)
+        num_slices = int(num_slices or _detect_num_slices(devices))
+        if next(iter(new_shape.values())) % max(1, num_slices):
+            num_slices = 1
+        started = time.perf_counter()
+        mesh = make_mesh(new_shape, devices=devices, num_slices=num_slices)
+        step_fn = make_train_step(self.model_config, self.train_config,
+                                  self.optimizer, mesh, self.rules)
+        shardings = getattr(step_fn, "_state_shardings", None)
+        if shardings is None:
+            raise ValueError(
+                "elastic resharding needs a step function that exposes "
+                "its state shardings (the context-parallel wrapper does "
+                "not)")
+        latest = checkpoint_manager.latest_step() \
+            if checkpoint_manager is not None else None
+        if latest is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                  sharding=s),
+                self.state, shardings)
+            state = checkpoint_manager.restore(abstract, step=latest)
+            decision = "restore_checkpoint"
+        else:
+            state = jax.device_put(self.state, shardings)
+            decision = "carry_live_state"
+        # swap atomically only once the transfer succeeded — a failed
+        # restore leaves the trainer on its old (still valid) world
+        self.mesh = mesh
+        self.step_fn = step_fn
+        self.state = state
+        self._compiled = None        # the AOT executable binds the OLD mesh
+        self._warmed_shape = None
+        elapsed = time.perf_counter() - started
+        info = {"world_from": old_world,
+                "world_to": int(mesh.devices.size),
+                "decision": decision,
+                "restored_step": int(self.state.step),
+                "reshard_s": elapsed}
+        logger.info("resharded train state", **{
+            k: round(v, 3) if isinstance(v, float) else v
+            for k, v in info.items()})
+        return info
+
     def fit(self, data_iter, steps: int, context=None,
             log_every: int = 10, callbacks: list | None = None,
             checkpoint_manager=None, preemption_guard=None,
+            elastic_guard=None,
             epoch_steps: int = 0, prefetch: int | None = None,
             defer_metrics: bool | None = None) -> dict:
         """Run the training loop; logs metrics to the run context
@@ -703,6 +775,16 @@ class Trainer:
         SIGTERM (TPU slice eviction) triggers one final synchronous
         checkpoint and a clean early return with ``preempted: True`` — the
         JobSet restart then resumes from that step (training/preemption.py).
+
+        With ``elastic_guard`` (:class:`~.elastic.ElasticGuard`), a
+        multi-slice run survives losing a slice mid-fit: the guard is
+        polled once per step, a ``fail`` event reshards the run onto the
+        survivors (:meth:`reshard` — mesh refit, sharding-agnostic
+        checkpoint restore, step-fn rebuild) and training continues at
+        reduced world size, taxed as ``degraded`` badput until a
+        ``join`` event grows it back. The full
+        detect→reshard→continue→grow chain lands in the flight recorder
+        (docs/fault_tolerance.md "Elastic training").
 
         The hot loop is pipelined (docs/training_performance.md):
         ``prefetch`` (default ``mlconf.training.prefetch``) wraps
@@ -834,6 +916,27 @@ class Trainer:
             view["step"] = int(step_arr)
             return _log_view(view)
 
+        # elastic degraded-capacity accounting: while the run is at W' of
+        # W devices, the (1 - W'/W) share of every step-second is moved
+        # from goodput into the 'degraded' bucket — attribution still
+        # sums to wall because transfer() only reclassifies, and the tax
+        # lands BEFORE each export so the counters stay monotone
+        degraded_lost = 0.0   # capacity fraction currently lost
+        degraded_mark = 0.0   # goodput seconds already taxed
+        reshard_pending = False  # next dispatch recompiles → 'reshard'
+
+        def _degraded_tax():
+            nonlocal degraded_mark
+            good = ledger.goodput_seconds()
+            if degraded_lost <= 0.0:
+                degraded_mark = good
+                return
+            delta = good - degraded_mark
+            if delta > 0:
+                moved = delta * degraded_lost
+                ledger.transfer("step", "degraded", moved)
+                degraded_mark = good - moved
+
         hooks.on_train_begin()
         seq_len = None
         last = {}
@@ -888,6 +991,73 @@ class Trainer:
                     # the artifacts are what survives the eviction
                     hooks.on_train_end(last)
                     return last
+                if elastic_guard is not None:
+                    event = elastic_guard.poll()
+                    if event is not None:
+                        # a staged log point must land before the world
+                        # changes — its device arrays live on the OLD mesh
+                        if pending is not None:
+                            with ledger.phase("metric_flush"):
+                                last = _drain(pending)
+                            pending = None
+                        _degraded_tax()  # settle the tax at the OLD rate
+                        if event.kind == "fail":
+                            flight_record(
+                                "train.slice_fail", run=run_uid,
+                                step=int(self.state.step),
+                                slice=event.slice_index,
+                                survivors=len(event.devices),
+                                survivor_devices=[str(d)
+                                                  for d in event.devices])
+                        else:
+                            flight_record(
+                                "train.slice_join", run=run_uid,
+                                step=int(self.state.step),
+                                slice=event.slice_index,
+                                world=len(event.devices))
+                        with ledger.phase("reshard"):
+                            # shrink restores from the last checkpoint
+                            # (the dead slice's shards are gone on real
+                            # hardware); grow carries the live state —
+                            # the survivors hold everything
+                            info = self.reshard(
+                                event.devices,
+                                checkpoint_manager
+                                if event.kind == "fail" else None,
+                                num_slices=elastic_guard.num_slices
+                                - len(elastic_guard.failed_slices))
+                        reshard_pending = True
+                        degraded_lost = elastic_guard.lost_fraction()
+                        degraded_mark = ledger.goodput_seconds()
+                        info_flat = {
+                            k: (round(v, 3) if isinstance(v, float) else v)
+                            for k, v in info.items()}
+                        if event.kind == "fail":
+                            # black-box artifact: survivor set + reshard
+                            # decision, dumped BEFORE training resumes
+                            # (the PR 10 post-mortem path)
+                            get_flight_recorder().dump(
+                                "slice-preemption",
+                                extra={"run": run_uid,
+                                       "slice": event.slice_index,
+                                       "survivors": [str(d) for d
+                                                     in event.devices],
+                                       **info_flat})
+                            flight_record("train.reshard", run=run_uid,
+                                          **info_flat)
+                        else:
+                            flight_record("train.grow", run=run_uid,
+                                          **info_flat)
+                        if context is not None and \
+                                hasattr(context, "log_result"):
+                            context.log_result("world_size",
+                                               info["world_to"])
+                        if prefetcher is not None:
+                            # already-staged batches re-place through
+                            # shard_batch; future ones stage straight
+                            # onto the new mesh
+                            prefetcher._sharding = getattr(
+                                self.step_fn, "_data_sharding", None)
                 ledger.enter("data_wait")
                 t_input = time.perf_counter()
                 tokens, targets = next(data_iter)
@@ -915,6 +1085,19 @@ class Trainer:
                     ledger.transfer(
                         "step", "re_warm" if resumed else "compile",
                         self.compile_seconds)
+                elif reshard_pending:
+                    # the first dispatch after a reshard re-traces +
+                    # compiles for the new mesh (warm when the persistent
+                    # compile cache holds the program) — reshard-class
+                    # time, not goodput
+                    reshard_pending = False
+                    recompile = time.perf_counter() - t_dispatch
+                    ledger.enter("step")
+                    ledger.transfer("step", "reshard", recompile)
+                    degraded_mark = ledger.goodput_seconds()
+                    flight_record("train.reshard_warm", run=run_uid,
+                                  loop_step=step,
+                                  compile_s=round(recompile, 3))
                 # on-demand profiling: claims/advances an armed
                 # POST /debug/profile capture; one global check when dark
                 profiler_mod.tick(self._profiler_source, context)
@@ -925,6 +1108,7 @@ class Trainer:
                 # device; a callback that reads a value pays its own sync
                 step_metrics: dict = dict(metrics)
                 if log_point:
+                    _degraded_tax()
                     tps = tracker.tokens_per_sec()
                     extras = {
                         "tokens_per_sec": tps,
@@ -935,6 +1119,8 @@ class Trainer:
                     }
                     if self.compile_seconds is not None:
                         extras["compile_seconds"] = self.compile_seconds
+                    if elastic_guard is not None:
+                        extras["world_size"] = int(self.mesh.devices.size)
                     extras["goodput_fraction"] = ledger.goodput_fraction()
                     if tps > 0:
                         TRAIN_STEP_TIME.set(
@@ -1041,9 +1227,11 @@ class Trainer:
                     pass           # exception must win the unwind
             _flush_obs()
             try:
+                # settle any trailing degraded-capacity tax, then close:
                 # trailing open interval -> its current phase; final
                 # counter flush + fraction gauge. summary() stays
                 # readable on self.goodput
+                _degraded_tax()
                 ledger.close()
             except Exception:  # noqa: BLE001 - accounting must not
                 pass           # replace the loop's own outcome

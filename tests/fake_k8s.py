@@ -40,6 +40,13 @@ class FakeCluster:
         # for the existing jobset tests
         self.customs: dict[str, dict[str, dict]] = {"jobsets": {}}
         self.jobset_conditions: dict[str, list] = {}
+        # per-JobSet failed pod-slice indices (elastic training): the
+        # JobSet itself stays alive — status.failedSlices is the
+        # provider's slice_status contract
+        self.jobset_slice_failures: dict[str, set] = {}
+        # JobSet names whose deleted child Jobs are NOT recreated by the
+        # (fake) controller — models a replacement slice stuck pending
+        self.stuck_slice_jobs: set = set()
         self.custom_status: dict[tuple, dict] = {}  # (plural,name)->status
         self.secrets: dict[str, dict] = {}
         self.events: list[tuple[str, str, str]] = []  # (verb, kind, name)
@@ -74,7 +81,22 @@ class FakeCluster:
         out-of-band (node drain / GC), so the next state probe 404s."""
         self.customs["jobsets"].pop(name, None)
         self.jobset_conditions.pop(name, None)
+        self.jobset_slice_failures.pop(name, None)
         self.events.append(("kill", "jobset", name))
+
+    def fail_slice(self, name: str, slice_index: int):
+        """Simulate ONE pod-slice of a multi-slice JobSet being
+        preempted while the JobSet stays alive — the elastic failure
+        mode. Shows up as ``status.failedSlices`` on reads."""
+        if name not in self.customs["jobsets"]:
+            raise ApiException(404, f"jobsets/{name}")
+        self.jobset_slice_failures.setdefault(name, set()).add(
+            int(slice_index))
+        self.events.append(("fail_slice", "jobset", name))
+
+    def restore_slice(self, name: str, slice_index: int):
+        self.jobset_slice_failures.get(name, set()).discard(
+            int(slice_index))
 
     def kill_pod(self, name: str):
         """Simulate an out-of-band pod kill (preemption)."""
@@ -247,11 +269,29 @@ def make_fake_kubernetes(cluster: FakeCluster):
             obj = dict(bucket[name])
             if plural == "jobsets":
                 obj["status"] = {
-                    "conditions": cluster.jobset_conditions.get(name, [])}
+                    "conditions": cluster.jobset_conditions.get(name, []),
+                    "failedSlices": sorted(
+                        cluster.jobset_slice_failures.get(name, set())),
+                }
             else:
                 obj["status"] = cluster.custom_status.get(
                     (plural, name), {})
             return obj
+
+        def patch_namespaced_custom_object(self, group, version, ns,
+                                           plural, name, body):
+            bucket = self._bucket(plural)
+            chaos_fire("k8s.patch", kind=plural[:-1], name=name)
+            if name not in bucket:
+                raise ApiException(404, f"{plural}/{name}")
+            # strategic-merge-lite: top-level spec keys replace in place
+            for key, value in (body or {}).items():
+                if key == "spec" and isinstance(value, dict):
+                    bucket[name].setdefault("spec", {}).update(value)
+                else:
+                    bucket[name][key] = value
+            cluster.events.append(("patch", plural[:-1], name))
+            return bucket[name]
 
         def delete_namespaced_custom_object(self, group, version, ns,
                                             plural, name):
@@ -271,6 +311,26 @@ def make_fake_kubernetes(cluster: FakeCluster):
                          key) == value]
             return {"items": items, "metadata": {}}
 
+    class BatchV1Api:
+        """Child-Job surface for slice replacement: deleting a JobSet's
+        failed child Job (``<jobset>-slice-<i>``) makes the (fake)
+        controller recreate it from the template — modeled as the slice
+        failure clearing, i.e. the replacement slice joining. JobSets in
+        ``cluster.stuck_slice_jobs`` accept the delete but never bring
+        the replacement up (capacity shortage)."""
+
+        def __init__(self, api_client=None):
+            self.api_client = api_client or object()
+
+        def delete_namespaced_job(self, name, ns):
+            chaos_fire("k8s.delete", kind="job", name=name)
+            jobset, sep, index = name.rpartition("-slice-")
+            if not sep or jobset not in cluster.customs["jobsets"]:
+                raise ApiException(404, f"jobs/{name}")
+            cluster.events.append(("delete", "job", name))
+            if jobset not in cluster.stuck_slice_jobs:
+                cluster.restore_slice(jobset, int(index))
+
     class V1ObjectMeta:
         def __init__(self, name="", labels=None):
             self.name = name
@@ -286,7 +346,7 @@ def make_fake_kubernetes(cluster: FakeCluster):
         load_incluster_config=lambda: None,
         load_kube_config=lambda: None)
     module.client = types.SimpleNamespace(
-        CoreV1Api=CoreV1Api, AppsV1Api=AppsV1Api,
+        CoreV1Api=CoreV1Api, AppsV1Api=AppsV1Api, BatchV1Api=BatchV1Api,
         CustomObjectsApi=CustomObjectsApi, V1Secret=V1Secret,
         V1ObjectMeta=V1ObjectMeta,
         exceptions=types.SimpleNamespace(ApiException=ApiException))
