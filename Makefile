@@ -2,7 +2,11 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast chaos native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic obs-smoke dryrun clean
+
+help:            ## list targets with their one-line descriptions
+	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
+	  awk -F':.*## ' '{printf "  %-16s %s\n", $$1, $$2}'
 
 test:            ## full suite on the virtual 8-device CPU mesh
 	$(PYTHON) -m pytest tests/ -q
@@ -18,6 +22,9 @@ test-fast:       ## skip the slow jax-compile-heavy suites
 
 chaos:           ## fault-injection subset: runs + serving resilience (docs/fault_tolerance.md, docs/serving_resilience.md)
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
+
+lint-invariants: ## mlt-lint: AST invariant checker over the package (docs/static_analysis.md); JSON report at /tmp/mlt_lint.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m mlrun_tpu.analysis mlrun_tpu/ --json /tmp/mlt_lint.json
 
 native:          ## build the C++ log collector (mlt-logd)
 	$(MAKE) -C native

@@ -1,0 +1,47 @@
+"""mlt-lint: AST invariant checker for the framework's cross-cutting
+contracts (docs/static_analysis.md).
+
+Stdlib-only (ast + tokenize). Run it:
+
+    python -m mlrun_tpu.analysis mlrun_tpu/          # human output
+    make lint-invariants                             # + JSON artifact
+
+Codes:
+
+- MLT000 malformed/unreasoned suppression comment
+- MLT001 chaos coherence (fire()/FaultPoints/docs agreement)
+- MLT002 metrics discipline (one ctor site, label keys, retire, docs)
+- MLT003 explicit-now in control loops (fake-clock testability)
+- MLT004 blocking call under an engine lock
+- MLT005 typed errors on the serving request path
+- MLT006 mlconf key chains resolve against config.py defaults
+
+Suppress one finding inline with ``# mlt: ignore[MLT0xx]: reason`` —
+the reason is required. Structural exceptions go in each checker's
+ALLOWLIST table with a one-line rationale.
+"""
+
+from .core import (  # noqa: F401
+    Checker,
+    Finding,
+    SUPPRESSION_CODE,
+    parse_suppressions,
+)
+from .engine import (  # noqa: F401
+    AnalysisResult,
+    default_checkers,
+    iter_py_files,
+    render_human,
+    render_json,
+    run_analysis,
+)
+
+CODES = {
+    "MLT000": "malformed or unreasoned suppression comment",
+    "MLT001": "chaos coherence: fire()/FaultPoints/docs agreement",
+    "MLT002": "metrics discipline: ctor sites, label keys, retire, docs",
+    "MLT003": "explicit-now: no wall clock in control-loop modules",
+    "MLT004": "blocking call under an engine lock",
+    "MLT005": "typed errors on the serving request path",
+    "MLT006": "mlconf key chains resolve against config.py defaults",
+}

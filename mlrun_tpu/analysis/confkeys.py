@@ -1,0 +1,201 @@
+"""MLT006 — config-key resolution against the defaults tree.
+
+``mlconf`` is attribute-style access over the nested ``default_config``
+dict in config.py. A typo'd chain (``mlconf.serving.llm.prefil_chunk``)
+is not a syntax error and not an import error — it raises (or, through
+``.get(...)``, silently reads the fallback default) only when that
+exact code path runs, which for cold paths is production. This checker
+resolves every literal ``mlconf.a.b.c`` chain and every literal
+``<chain>.get("key")`` against the defaults tree parsed straight out
+of config.py's AST — no import, no env resolution.
+
+Chain walking stops at (a) a leaf value — further attributes are on
+the VALUE (``mlconf.api_base_path.rstrip``), (b) a Config-object
+method/property (``get``, ``update``, ``resolve_artifact_path``, …),
+or (c) anything dynamic. Store context (``mlconf.x = ...``) is not
+validated — tests and client_spec pushes create keys legitimately.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Checker, Finding
+
+CODE = "MLT006"
+
+#: (module, chain) -> rationale for a chain the defaults tree cannot
+#: see (e.g. keys created at runtime by a client_spec push)
+ALLOWLIST: dict[tuple[str, str], str] = {
+}
+
+_LEAF = object()
+
+
+def _key_tree(config_path: str) -> tuple[dict | None, set[str]]:
+    """(nested key tree from default_config, Config method/property
+    names). Values are sub-dicts or _LEAF — we only need key shape,
+    so non-literal values (BinOps, calls) are fine."""
+    try:
+        with open(config_path, encoding="utf-8") as fp:
+            tree = ast.parse(fp.read())
+    except (OSError, SyntaxError):
+        return None, set()
+
+    def build(node):
+        if not isinstance(node, ast.Dict):
+            return _LEAF
+        out = {}
+        for key, value in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value,
+                                                            str):
+                out[key.value] = build(value)
+        return out
+
+    keys = None
+    methods: set[str] = set()
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.AnnAssign):
+            target = node.target
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        if isinstance(target, ast.Name) \
+                and target.id == "default_config" \
+                and node.value is not None:
+            keys = build(node.value)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    methods.add(stmt.name)
+    return keys if isinstance(keys, dict) else None, methods
+
+
+class ConfigKeyChecker(Checker):
+    code = CODE
+    name = "config-keys"
+
+    def begin(self, root: str) -> None:
+        self._root = root
+        self._tree, self._methods = _key_tree(
+            os.path.join(root, "mlrun_tpu", "config.py"))
+
+    def visit(self, tree, source: str, path: str) -> list[Finding]:
+        if self._tree is None:
+            return []
+        rel = os.path.relpath(path, self._root).replace(os.sep, "/")
+        if rel.startswith("tests/") or rel.endswith("config.py"):
+            return []
+        # only modules that import mlconf from this package
+        if not self._imports_mlconf(tree):
+            return []
+        findings: list[Finding] = []
+        seen: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute) \
+                    or id(node) in seen:
+                continue
+            chain = self._mlconf_chain(node)
+            if chain is None:
+                continue
+            # mark sub-attributes handled so a.b.c doesn't re-report
+            # at a.b
+            sub = node
+            while isinstance(sub, ast.Attribute):
+                seen.add(id(sub))
+                sub = sub.value
+            findings.extend(self._check_chain(chain, node, path, rel))
+        # literal .get("key") off a chain (incl. bare mlconf.get("k"))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            base_chain = self._base_parts(node.func.value)
+            if base_chain is None:
+                continue
+            at = self._resolve(base_chain[1:])
+            if isinstance(at, dict):
+                key = node.args[0].value
+                full = ".".join(base_chain[1:] + [key])
+                if key not in at and (rel, full) not in ALLOWLIST:
+                    findings.append(Finding(
+                        CODE, path, node.lineno,
+                        f"mlconf.{full} (via .get) does not resolve "
+                        f"against the config.py defaults tree",
+                        "fix the key or add it to default_config — "
+                        "a typo'd get() silently reads the fallback"))
+        return findings
+
+    @staticmethod
+    def _imports_mlconf(tree) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.split(".")[-1] == "config":
+                if any(alias.name == "mlconf" for alias in node.names):
+                    return True
+        return False
+
+    def _mlconf_chain(self, node: ast.Attribute) -> list[str] | None:
+        """Longest literal attribute chain rooted at Name('mlconf'),
+        in Load context."""
+        if not isinstance(node.ctx, ast.Load):
+            return None
+        parts = self._base_parts(node)
+        if parts is None:
+            return None
+        return parts[1:]
+
+    @staticmethod
+    def _base_parts(node) -> list[str] | None:
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name) and node.id == "mlconf":
+            parts.append("mlconf")
+            parts.reverse()
+            return parts
+        return None
+
+    def _resolve(self, chain: list[str]):
+        """Walk the key tree; returns the node reached, or None when
+        the walk fell off the tree (the caller decides if that is a
+        finding), or _LEAF."""
+        at = self._tree
+        for part in chain:
+            if not isinstance(at, dict):
+                return at  # attribute on a leaf VALUE — out of scope
+            if part in self._methods or part == "get":
+                return _LEAF  # Config method/property terminates
+            if part not in at:
+                return None
+            at = at[part]
+        return at
+
+    def _check_chain(self, chain: list[str], node, path: str,
+                     rel: str) -> list[Finding]:
+        at = self._tree
+        for idx, part in enumerate(chain):
+            if not isinstance(at, dict):
+                return []  # leaf value reached — rest is on the value
+            if part in self._methods:
+                return []  # Config method/property
+            if part not in at:
+                full = ".".join(chain[:idx + 1])
+                if (rel, full) in ALLOWLIST:
+                    return []
+                return [Finding(
+                    CODE, path, node.lineno,
+                    f"mlconf.{full} does not resolve against the "
+                    f"config.py defaults tree",
+                    "fix the key or add it to default_config so the "
+                    "chain has a declared default")]
+            at = at[part]
+        return []

@@ -44,10 +44,17 @@ class FaultPoints:
     k8s_create = "k8s.create"
     k8s_read = "k8s.read"
     k8s_delete = "k8s.delete"
+    # custom-object patch (JobSet suspend/resume, slice replacement) —
+    # fired by the fake cluster's patch verb like the verbs above
+    k8s_patch = "k8s.patch"
     # execution-resource providers (service/providers.py)
     provider_create = "provider.create"
     provider_state = "provider.state"
     provider_delete = "provider.delete"
+    # one child-Job slice replacement during elastic recovery
+    # (service/providers.py replace_slice) — an error here models a
+    # replacement submission that itself fails
+    provider_replace_slice = "provider.replace_slice"
     # datastore reads/writes (datastore/base.py DataItem/DataStore)
     datastore_read = "datastore.read"
     datastore_write = "datastore.write"
@@ -114,8 +121,10 @@ class FaultPoints:
     def all() -> list[str]:
         return [
             FaultPoints.k8s_create, FaultPoints.k8s_read,
-            FaultPoints.k8s_delete, FaultPoints.provider_create,
+            FaultPoints.k8s_delete, FaultPoints.k8s_patch,
+            FaultPoints.provider_create,
             FaultPoints.provider_state, FaultPoints.provider_delete,
+            FaultPoints.provider_replace_slice,
             FaultPoints.datastore_read, FaultPoints.datastore_write,
             FaultPoints.httpdb_request, FaultPoints.execution_commit,
             FaultPoints.serving_step, FaultPoints.serving_remote,

@@ -104,6 +104,9 @@ default_config: dict[str, Any] = {
     "function": {
         "default_image": "mlrun-tpu/base:latest",
         "tpu_image": "mlrun-tpu/tpu:latest",
+        # dask scheduler/worker pods need a dask-capable image, not the
+        # generic base image
+        "dask_image": "daskdev/dask:latest",
         # deploy_function blocks up to this long for the gateway to answer
         # its readiness probe (reference: nuclio deploy polls build/rollout
         # state the same way)

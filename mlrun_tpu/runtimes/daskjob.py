@@ -103,8 +103,7 @@ class DaskRuntime(KubeResource):
         manifests (pure builders — unit-testable without a cluster)."""
         namespace = namespace or mlconf.namespace
         name = self._cluster_name()
-        image = self.spec.image or mlconf.get("default_image",
-                                              "daskdev/dask:latest")
+        image = self.spec.image or mlconf.function.dask_image
         labels = {"mlrun-tpu/class": "dask", "mlrun-tpu/cluster": name}
 
         def deployment(component: str, command: list, replicas: int,

@@ -73,6 +73,14 @@ class PromptTooLongError(ResilienceError, ValueError):
     status_code = 400
 
 
+class ModelNotReadyError(ResilienceError):
+    """The model behind a serving step failed to load or has not
+    finished loading — the request can be retried on another replica
+    (503-class), unlike a user-payload error."""
+
+    status_code = 503
+
+
 class DeadlineExceeded(ResilienceError):
     """The event's deadline expired before/while executing a step."""
 

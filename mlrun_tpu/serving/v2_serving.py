@@ -16,6 +16,7 @@ import uuid
 from typing import Any, Dict, Optional, Union
 
 from ..utils import logger, now_iso
+from .resilience import ModelNotReadyError
 
 
 class V2ModelServer:
@@ -113,7 +114,7 @@ class V2ModelServer:
 
         if op == "ready":
             if not self.ready:
-                raise RuntimeError(
+                raise ModelNotReadyError(
                     f"model {self.name} is not ready: {self.error}")
             event.body = {"name": self.name, "ready": True}
             return event
@@ -128,8 +129,9 @@ class V2ModelServer:
                     if not self.ready:
                         self.post_init()
                 if not self.ready:
-                    raise RuntimeError(
-                        f"model {self.name} failed to load: {self.error}")
+                    raise ModelNotReadyError(
+                        f"model {self.name} failed to load: "
+                        f"{self.error}")
             start = time.monotonic()
             try:
                 request = self.preprocess(request, op)

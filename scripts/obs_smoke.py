@@ -531,7 +531,37 @@ def _training_leg(base: str):
     }
 
 
+def _lint_preamble():
+    """Fail the smoke gate fast on invariant drift, before any engine
+    boots: the analyzer over mlrun_tpu/ must be clean (the same
+    contract `make lint-invariants` and the tier-1 analysis test
+    enforce — docs/static_analysis.md)."""
+    from mlrun_tpu.analysis import run_analysis
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = run_analysis([os.path.join(repo, "mlrun_tpu")], root=repo)
+    report = os.path.join(tempfile.gettempdir(), "mlt_lint.json")
+    try:
+        from mlrun_tpu.analysis import render_json
+
+        with open(report, "w", encoding="utf-8") as fp:
+            fp.write(render_json(result) + "\n")
+    except OSError:
+        pass
+    if not result.ok:
+        for err in result.parse_errors:
+            print(f"{err['path']}: PARSE ERROR {err['error']}")
+        for finding in result.findings[:20]:
+            print(finding.render())
+        _fail(f"{len(result.findings)} unsuppressed mlt-lint "
+              f"finding(s), {len(result.parse_errors)} parse error(s) "
+              f"(full report: {report})")
+    print(f"lint-invariants OK ({result.files_checked} files, "
+          f"{len(result.suppressed)} suppressed)")
+
+
 def main() -> int:
+    _lint_preamble()
     spans_path = os.path.join(tempfile.mkdtemp(prefix="obs-smoke-"),
                               "spans.jsonl")
     os.environ.setdefault("MLT_OBSERVABILITY__TRACE_PATH", spans_path)
