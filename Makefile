@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -60,6 +60,11 @@ bench-reqtrace:  ## request-forensics A/B: phase ledger + exemplars on vs off on
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --reqtrace > BENCH_r12.tmp \
 		&& tail -n 1 BENCH_r12.tmp > BENCH_r12.json \
 		&& rm BENCH_r12.tmp && cat BENCH_r12.json
+
+bench-prefill:   ## paged prefill kernel + int8 KV pages A/B: prefix-hit TTFT kernel vs gather + hit-rate at fixed pool bytes int8 on/off (docs/serving.md "Attention kernels"); rewrites BENCH_r15.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --prefill-kernel > BENCH_r15.tmp \
+		&& tail -n 1 BENCH_r15.tmp > BENCH_r15.json \
+		&& rm BENCH_r15.tmp && cat BENCH_r15.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

@@ -144,7 +144,7 @@ except Exception:  # noqa: BLE001
     _PALLAS_OK = False
 
 
-def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _flash_v2_body(q_off, k_lo, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, *,
                    num_kb: int, kv_len: int, scale: float, causal: bool):
     """Grid-pipelined flash forward body: grid (bh, q_blocks, k_blocks).
@@ -159,11 +159,18 @@ def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
     python int — the training/self-attention form) or a traced scalar
     (the cached-prefill form, where q rows sit at ``start + i`` against a
     KV cache whose rows start at position 0).
+
+    ``k_lo`` masks kv positions BELOW a lower bound: 0 (static — the
+    plain forms) or a traced scalar (the paged-prefill-merge form, where
+    cache rows < k_lo belong to shared prefix pages attended separately
+    by the paged prefill kernel and LSE-merged afterwards —
+    ops/paged_attention.py).
     """
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
+    bounded = not (isinstance(k_lo, int) and k_lo == 0)
 
     @pl.when(kb == 0)
     def _init():
@@ -177,6 +184,9 @@ def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # (python bool when q_off is the static 0, a traced predicate when it
     # is the dynamic cached-prefill offset — pl.when takes both)
     live = (not causal) or (k_start <= q_off + q_start + block_q - 1)
+    if bounded:
+        # tiles wholly below the lower bound contribute nothing
+        live = live & (k_start + block_k - 1 >= k_lo)
 
     @pl.when(live)
     def _compute():
@@ -190,6 +200,8 @@ def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q_pos = q_off + q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if bounded:
+            s = jnp.where(k_pos >= k_lo, s, NEG_INF)
         s = jnp.where(k_pos < kv_len, s, NEG_INF)
         m_prev = m_scr[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -211,7 +223,7 @@ def _flash_v2_body(q_off, q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _flash_fwd_kernel_v2(q_ref, k_ref, v_ref, o_ref, lse_ref,
                          m_scr, l_scr, acc_scr, **kw):
     """Self-attention form: q positions aligned with kv position 0."""
-    _flash_v2_body(0, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    _flash_v2_body(0, 0, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, **kw)
 
 
@@ -220,17 +232,31 @@ def _flash_fwd_kernel_v2_cached(q_off_ref, q_ref, k_ref, v_ref, o_ref,
     """Cached-prefill form: q rows live at absolute positions
     ``q_off + i`` against a KV cache indexed from 0 (serving engines'
     chunked/suffix prefill — ops/attention.flash_attention_cached)."""
-    _flash_v2_body(q_off_ref[0], q_ref, k_ref, v_ref, o_ref, lse_ref,
+    _flash_v2_body(q_off_ref[0], 0, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, **kw)
 
 
-def _flash_v2_call(q, k, v, causal, block_q, block_k, interpret, q_offset):
+def _flash_fwd_kernel_v2_bounded(q_off_ref, k_lo_ref, q_ref, k_ref, v_ref,
+                                 o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                                 **kw):
+    """Bounded cached form: like the cached form, but kv rows below
+    ``k_lo`` are masked out — they hold zeros where a shared prefix
+    lives in pool pages instead, attended by the paged prefill kernel
+    and LSE-merged with this kernel's partial state
+    (ops/paged_attention.paged_prefill_attention)."""
+    _flash_v2_body(q_off_ref[0], k_lo_ref[0], q_ref, k_ref, v_ref, o_ref,
+                   lse_ref, m_scr, l_scr, acc_scr, **kw)
+
+
+def _flash_v2_call(q, k, v, causal, block_q, block_k, interpret, q_offset,
+                   k_lo=None):
     """Shared v2 plumbing (block fit, padding, fold batch*heads, grid,
     scratch) for the self-attention and cached-prefill forms — one body,
-    so the two can never diverge (the cold-vs-hit bit-equality guarantee
-    rides on identical block/padding choices). ``q_offset=None`` selects
+    so the two can never diverge (the cold-vs-hit parity contract rides
+    on identical block/padding choices). ``q_offset=None`` selects
     the static-zero kernel; otherwise the offset rides a (1,) SMEM
-    operand."""
+    operand. ``k_lo`` (requires ``q_offset``) additionally masks kv
+    rows below a traced lower bound — the paged-prefill-merge form."""
     if interpret is None:
         interpret = not _on_tpu()
     b, sq, h, d = q.shape
@@ -257,11 +283,17 @@ def _flash_v2_call(q, k, v, causal, block_q, block_k, interpret, q_offset):
     if q_offset is None:
         kernel = functools.partial(_flash_fwd_kernel_v2, **static)
         off_specs, off_args = [], ()
-    else:
+    elif k_lo is None:
         kernel = functools.partial(_flash_fwd_kernel_v2_cached, **static)
         off_specs = [pl.BlockSpec((1,), lambda bh, i, j: (0,),
                                   memory_space=pltpu.SMEM)]
         off_args = (jnp.asarray(q_offset, jnp.int32).reshape(1),)
+    else:
+        kernel = functools.partial(_flash_fwd_kernel_v2_bounded, **static)
+        off_specs = [pl.BlockSpec((1,), lambda bh, i, j: (0,),
+                                  memory_space=pltpu.SMEM)] * 2
+        off_args = (jnp.asarray(q_offset, jnp.int32).reshape(1),
+                    jnp.asarray(k_lo, jnp.int32).reshape(1))
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -317,10 +349,26 @@ def _flash_fwd_v2_cached(q, k, v, q_offset, block_q=512, block_k=512,
     already written at ``q_offset``..). kv pre-repeated to q heads.
     Returns (o, lse). The k-block accumulation order for a given q row is
     identical whatever ``q_offset``/``block_q`` split the prompt arrived
-    under, which is what keeps engine-cold and prefix-hit greedy decoding
-    bit-identical (docs/serving.md "Attention kernels")."""
+    under — chunked and unchunked prefills of the same gathered cache
+    stay bit-identical; the paged prefix-hit path merges a SEPARATE
+    prefix state instead and carries a tolerance contract
+    (docs/serving.md "Attention kernels")."""
     return _flash_v2_call(q, k, v, True, block_q, block_k, interpret,
                           q_offset)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k",
+                                             "interpret"))
+def _flash_fwd_v2_cached_bounded(q, k, v, q_offset, k_lo, block_q=512,
+                                 block_k=512, interpret=None):
+    """Causal cached flash with a kv lower bound: rows < ``k_lo`` are
+    masked out (the serving engines' suffix-prefill form on a paged
+    prefix-cache hit — those positions live in shared pool pages, not
+    the local cache, and are attended by the paged prefill kernel).
+    Returns (o, lse) so the caller can LSE-merge the two partial
+    softmax states (ops/paged_attention.merge_softmax_states)."""
+    return _flash_v2_call(q, k, v, True, block_q, block_k, interpret,
+                          q_offset, k_lo=k_lo)
 
 
 def flash_attention_cached(q, k, v, q_start) -> jax.Array:
@@ -524,11 +572,21 @@ def resolve_prefill_impl(impl: str = "auto") -> str:
     """Resolve a serving ``attention_impl`` knob to the engines' prefill
     attention path: ``flash`` (flash_attention_cached — interpret mode
     off-TPU) or ``dense`` (the masked-softmax `_cached_attention`).
-    ``kernel`` opts the paged DECODE kernel in while keeping prefill
-    dense (decode-path isolation for parity tests)."""
-    if impl == "flash":
+    ``kernel`` is the full kernel stack — paged decode kernel AND flash/
+    paged prefill (a prefix-hit admission must never fall back to the
+    dense gather; docs/serving.md "Attention kernels"). Explicit kernel
+    requests that cannot be honored (pallas unavailable) raise typed
+    (ops/paged_attention.KernelUnavailableError)."""
+    if impl in ("flash", "kernel"):
+        if not _PALLAS_OK:
+            from .paged_attention import KernelUnavailableError
+
+            raise KernelUnavailableError(
+                f"attention_impl='{impl}' requested but Pallas is "
+                "unavailable in this jax build — use 'auto' (falls back "
+                "to the dense reference) or 'reference'")
         return "flash"
-    if impl in ("reference", "dense", "kernel"):
+    if impl in ("reference", "dense"):
         return "dense"
     if impl != "auto":
         raise ValueError(

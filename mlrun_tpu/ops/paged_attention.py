@@ -31,10 +31,28 @@ round-off. The page table and positions ride scalar prefetch
 (``PrefetchScalarGridSpec``) because the k/v BlockSpec index maps need
 them to translate (slot, page-slot) -> physical page id before the DMA.
 
+Beyond decode, this module carries the other two KV-heavy moments of the
+serving path (docs/serving.md "Attention kernels"):
+
+- **multi-row paged prefill** (``_paged_prefill_call`` /
+  ``paged_prefill_attention``): on a prefix-cache hit, a chunk of query
+  tokens attends the ``base`` cached prefix tokens IN PLACE through the
+  same page-table-indexed BlockSpec design (page ids + base on scalar
+  prefetch, grid (kv_head, q_block, page)), emitting a partial softmax
+  state ``(o, lse)`` that ``merge_softmax_states`` LSE-merges with the
+  local causal flash over the suffix — the admission-time dense
+  ``gather_prefix_pages`` copy becomes the CPU/reference fallback only.
+- **int8 KV pages**: both kernels take optional per-vector f32 dequant
+  scales riding the same page-table-indexed operands as the pages, so a
+  ``kv_dtype="int8"`` pool (double the resident pages per HBM byte)
+  runs the kernel path instead of downgrading to the reference.
+
 Dispatch mirrors ``ops.attention.attention``: ``resolve_paged_impl``
 picks the kernel on TPU, the gather+dense reference on CPU — unless
 interpret mode is forced (``MLT_ATTN_INTERPRET=1``), which runs the real
 kernel code path under the Pallas interpreter so tier-1 exercises it.
+An EXPLICIT kernel request that cannot be honored raises the typed
+:class:`KernelUnavailableError` instead of silently downgrading.
 """
 
 from __future__ import annotations
@@ -46,6 +64,8 @@ import jax.numpy as jnp
 
 from .attention import (
     NEG_INF,
+    _fit_block,
+    _flash_fwd_v2_cached_bounded,
     _on_tpu,
     _PALLAS_OK,
     _repeat_kv,
@@ -57,12 +77,33 @@ if _PALLAS_OK:
     from jax.experimental.pallas import tpu as pltpu
 
 
+class KernelUnavailableError(ValueError):
+    """An EXPLICIT ``attention_impl="kernel"``/``"flash"`` request cannot
+    be honored (Pallas missing from the jax build). Raised at engine
+    construction — a silent downgrade would quietly serve on the
+    reference path while the operator believes the kernel is live.
+    ``auto`` may still fall back (warned once)."""
+
+
+_warned_auto_fallback = False
+
+
 def resolve_paged_impl(impl: str = "auto") -> str:
     """Resolve a serving ``attention_impl`` knob to the paged-decode path:
     ``kernel`` (Pallas, page-table indexed) or ``reference``
     (gather+dense). ``flash`` counts as an explicit kernel opt-in;
-    ``dense`` as an explicit reference opt-in."""
+    ``dense`` as an explicit reference opt-in. Explicit kernel requests
+    that cannot be honored raise :class:`KernelUnavailableError` —
+    ``auto`` falls back to the reference (warned once when the fallback
+    is a missing Pallas rather than the normal CPU default)."""
+    global _warned_auto_fallback
+
     if impl in ("kernel", "flash"):
+        if not _PALLAS_OK:
+            raise KernelUnavailableError(
+                f"attention_impl='{impl}' requested but Pallas is "
+                "unavailable in this jax build — use 'auto' (falls back "
+                "to the gather+dense reference) or 'reference'")
         return "kernel"
     if impl in ("reference", "dense"):
         return "reference"
@@ -70,7 +111,16 @@ def resolve_paged_impl(impl: str = "auto") -> str:
         raise ValueError(
             f"unknown paged attention impl '{impl}' "
             "(auto | flash | kernel | reference | dense)")
-    if _PALLAS_OK and (_on_tpu() or interpret_forced()):
+    if not _PALLAS_OK:
+        if not _warned_auto_fallback:
+            _warned_auto_fallback = True
+            from ..utils import logger
+
+            logger.warning(
+                "paged attention: Pallas unavailable — attention_impl "
+                "'auto' resolves to the gather+dense reference path")
+        return "reference"
+    if _on_tpu() or interpret_forced():
         return "kernel"
     return "reference"
 
@@ -79,13 +129,46 @@ def resolve_paged_impl(impl: str = "auto") -> str:
 # pallas kernel
 # ---------------------------------------------------------------------------
 
-def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, page_size: int,
-                         pages_per_slot: int, scale: float):
+def _decode_page_update(q_ref, k, v, m_scr, l_scr, acc_scr, *,
+                        p, pos, page_size: int, scale: float):
+    """Shared online-softmax update over one (already dequantized) page
+    tile — the native and int8 decode kernels differ only in how k/v
+    reach f32."""
+    n_rep = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale              # [n_rep, d]
+    logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    k_pos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (n_rep, page_size), 1)
+    logits = jnp.where(k_pos <= pos, logits, NEG_INF)
+    m_prev = m_scr[:]
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    weight = jnp.exp(logits - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(weight, axis=-1,
+                                          keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+        weight, v, preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *refs,
+                         page_size: int, pages_per_slot: int,
+                         scale: float, quantized: bool):
     """Grid (slot, kv_head, page-slot); refs:
     q [1, n_rep, d] (this kv head's GQA query group), k/v [1, page_size,
     1, d] (the physical page the index map resolved via the page table).
-    Scratch carries the online softmax across the page-slot grid dim."""
+    Scratch carries the online softmax across the page-slot grid dim.
+
+    ``quantized`` (static) inserts two extra refs after v: the int8
+    pool's per-vector f32 dequant scales (ks/vs [1, page_size, 1]),
+    riding the SAME page-table-indexed BlockSpecs as the pages —
+    dequantization happens in-register, everything else is one code
+    path."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     s = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -96,7 +179,6 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     pos = pos_ref[s]
-    n_rep = q_ref.shape[1]
     # pages wholly past the current position contribute nothing — skip the
     # flops (the DMA already happened; it fetched the scratch page or a
     # masked page, both harmless)
@@ -104,23 +186,14 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [n_rep, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)          # [page_size, d]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
-        logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        k_pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (n_rep, page_size), 1)
-        logits = jnp.where(k_pos <= pos, logits, NEG_INF)
-        m_prev = m_scr[:]
-        m_cur = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        weight = jnp.exp(logits - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(weight, axis=-1,
-                                              keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-            weight, v, preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
+        if quantized:
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        _decode_page_update(q_ref, k, v, m_scr, l_scr, acc_scr,
+                            p=p, pos=pos, page_size=page_size,
+                            scale=scale)
 
     @pl.when(p == pages_per_slot - 1)
     def _finalize():
@@ -130,9 +203,12 @@ def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
-                       page_size: int, interpret=None):
+                       page_size: int, k_scale=None, v_scale=None,
+                       interpret=None):
     """q [slots, H, D] x pool pages [P+1, page_size, Hkv, D] -> [slots,
-    H, D]. ``page_table`` may contain -1 (routed to the scratch page)."""
+    H, D]. ``page_table`` may contain -1 (routed to the scratch page).
+    ``k_scale``/``v_scale`` ([P+1, page_size, Hkv] f32) select the int8
+    kernel: pages are dequantized per vector inside the kernel."""
     if interpret is None:
         interpret = not _on_tpu()
     slots, h, d = q.shape
@@ -144,10 +220,11 @@ def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
     safe_table = jnp.where(page_table >= 0, page_table,
                            scratch_page).astype(jnp.int32)
     pos = pos.astype(jnp.int32)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
         _paged_decode_kernel, page_size=page_size,
-        pages_per_slot=pages_per_slot, scale=scale)
+        pages_per_slot=pages_per_slot, scale=scale, quantized=quantized)
 
     def q_map(s, h_, p, pt, ps):
         return (s, h_, 0)
@@ -155,14 +232,23 @@ def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
     def kv_map(s, h_, p, pt, ps):
         return (pt[s, p], 0, h_, 0)
 
+    def sc_map(s, h_, p, pt, ps):
+        return (pt[s, p], 0, h_)
+
+    in_specs = [
+        pl.BlockSpec((1, n_rep, d), q_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+    ]
+    operands = [safe_table, pos, q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, 1), sc_map),
+                     pl.BlockSpec((1, page_size, 1), sc_map)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(slots, hkv, pages_per_slot),
-        in_specs=[
-            pl.BlockSpec((1, n_rep, d), q_map),
-            pl.BlockSpec((1, page_size, 1, d), kv_map),
-            pl.BlockSpec((1, page_size, 1, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_rep, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((n_rep, 1), jnp.float32),   # running max
@@ -177,7 +263,220 @@ def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((slots, h, d), q.dtype),
         interpret=interpret,
-    )(safe_table, pos, q, k_pages, v_pages)
+    )(*operands)
+
+
+# ---------------------------------------------------------------------------
+# multi-row paged prefill: a prompt chunk over shared prefix pages in place
+# ---------------------------------------------------------------------------
+
+def _prefill_page_update(q_ref, k, v, m_scr, l_scr, acc_scr, *,
+                         p, base, page_size: int, scale: float):
+    """Shared prefill online-softmax update over one (already
+    dequantized) prefix page tile — positions at or past ``base`` are
+    masked; no causal mask (every prefix position precedes every query
+    row)."""
+    block_rows = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale          # [block_rows, d]
+    logits = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    k_pos = p * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (block_rows, page_size), 1)
+    logits = jnp.where(k_pos < base, logits, NEG_INF)
+    m_prev = m_scr[:]
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    weight = jnp.exp(logits - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(weight, axis=-1,
+                                          keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+        weight, v, preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+
+def _paged_prefill_kernel(ids_ref, base_ref, q_ref, k_ref, v_ref, *refs,
+                          page_size: int, pages_per_slot: int,
+                          scale: float, quantized: bool):
+    """Grid (kv_head, q_block, page-slot); refs:
+    q [1, block_rows, d] (this kv head's GQA query rows, rows = token x
+    n_rep), k/v [1, page_size, 1, d] — the physical page the index map
+    resolved through the slot's page ids. Every prefix position
+    (0..base-1) precedes every query row, so no causal mask is needed;
+    pages at or past ``base`` (and -1 entries, routed to the scratch
+    page) are masked out wholesale. Scratch carries the online softmax
+    across the page-slot grid dim; the finalize step emits (o, lse) so
+    the caller can LSE-merge with the local causal flash over the
+    suffix chunk.
+
+    ``quantized`` (static) inserts two extra refs after v: the int8
+    pool's per-vector f32 dequant scales (ks/vs [1, page_size, 1]) on
+    the same page-table-indexed BlockSpecs, dequantized in-register —
+    one code path for both pool dtypes."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    base = base_ref[0]
+    block_rows = q_ref.shape[1]
+    live = p * page_size < base
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page_size, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        _prefill_page_update(q_ref, k, v, m_scr, l_scr, acc_scr,
+                             p=p, base=base, page_size=page_size,
+                             scale=scale)
+
+    @pl.when(p == pages_per_slot - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = acc_scr[:] / l
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
+                                      (block_rows, 8))
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def _paged_prefill_call(q, k_pages, v_pages, page_ids, base,
+                        page_size: int, k_scale=None, v_scale=None,
+                        interpret=None):
+    """q [S, H, D] (one admission's prompt chunk, batch=1) attends over
+    the ``base`` prefix tokens stored in pool pages ``page_ids``
+    ([pages_per_slot] int32, -1 past the prefix → scratch page) —
+    in place, through the page table, never gathered. Returns
+    (o [S, H, D] f32, lse [S, H] f32) — one partial softmax state per
+    query row, LSE-merged by the caller with the local causal flash over
+    the suffix (``merge_softmax_states``)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    s, h, d = q.shape
+    hkv = k_pages.shape[2]
+    n_rep = h // hkv
+    pages_per_slot = page_ids.shape[0]
+    scale = d ** -0.5
+    scratch_page = k_pages.shape[0] - 1
+    safe_ids = jnp.where(page_ids >= 0, page_ids,
+                         scratch_page).astype(jnp.int32)
+    base = jnp.asarray(base, jnp.int32).reshape(1)
+    quantized = k_scale is not None
+
+    # rows grouped per kv head (head h*n_rep+r is kv head h's GQA group,
+    # matching _repeat_kv order): [S, H, D] -> [Hkv, S*n_rep, D]
+    rows = s * n_rep
+    qg = q.reshape(s, hkv, n_rep, d).transpose(1, 0, 2, 3).reshape(
+        hkv, rows, d)
+    block_rows = _fit_block(rows, 256)
+    pad_rows = (-rows) % block_rows
+    if pad_rows:
+        qg = jnp.pad(qg, ((0, 0), (0, pad_rows), (0, 0)))
+    padded_rows = rows + pad_rows
+
+    kernel = functools.partial(
+        _paged_prefill_kernel, page_size=page_size,
+        pages_per_slot=pages_per_slot, scale=scale, quantized=quantized)
+
+    def q_map(h_, qb, p, ids, b):
+        return (h_, qb, 0)
+
+    def kv_map(h_, qb, p, ids, b):
+        return (ids[p], 0, h_, 0)
+
+    def sc_map(h_, qb, p, ids, b):
+        return (ids[p], 0, h_)
+
+    in_specs = [
+        pl.BlockSpec((1, block_rows, d), q_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+    ]
+    operands = [safe_ids, base, qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, 1), sc_map),
+                     pl.BlockSpec((1, page_size, 1), sc_map)]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(hkv, padded_rows // block_rows, pages_per_slot),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_rows, d), q_map),
+            pl.BlockSpec((1, block_rows, 8), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_rows, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_rows, 1), jnp.float32),   # running denom
+            pltpu.VMEM((block_rows, d), jnp.float32),   # accumulator
+        ],
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((hkv, padded_rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((hkv, padded_rows, 8), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    o = o[:, :rows].reshape(hkv, s, n_rep, d).transpose(1, 0, 2, 3)
+    lse = lse[:, :rows, 0].reshape(hkv, s, n_rep).transpose(1, 0, 2)
+    return o.reshape(s, h, d), lse.reshape(s, h)
+
+
+def merge_softmax_states(o_a, lse_a, o_b, lse_b):
+    """LSE-merge two partial attention states over disjoint kv sets:
+    ``o_*`` [B, S, H, D] (any float dtype), ``lse_*`` [B, H, S] f32
+    (the flash kernels' lse layout). Returns the combined f32 output —
+    exactly softmax over the union, up to accumulation-order round-off
+    (the documented cold-vs-hit tolerance contract, docs/serving.md
+    "Attention kernels")."""
+    la = lse_a.transpose(0, 2, 1)[..., None]       # [B, S, H, 1]
+    lb = lse_b.transpose(0, 2, 1)[..., None]
+    m = jnp.maximum(la, lb)
+    wa = jnp.exp(la - m)
+    wb = jnp.exp(lb - m)
+    return (o_a.astype(jnp.float32) * wa
+            + o_b.astype(jnp.float32) * wb) / (wa + wb)
+
+
+def paged_prefix_part(q, k_pages, v_pages, page_ids, base, *,
+                      page_size: int, k_scale=None, v_scale=None,
+                      interpret=None):
+    """Batch-1 convenience over :func:`_paged_prefill_call`: q
+    [1, S, H, D] -> (o [1, S, H, D] f32, lse [1, H, S] f32) in the flash
+    lse layout, ready for :func:`merge_softmax_states`."""
+    o, lse = _paged_prefill_call(q[0], k_pages, v_pages, page_ids, base,
+                                 page_size, k_scale=k_scale,
+                                 v_scale=v_scale, interpret=interpret)
+    return o[None], lse.T[None]
+
+
+def paged_prefill_attention(q, k_cache, v_cache, q_start, k_pages,
+                            v_pages, page_ids, base, *, page_size: int,
+                            k_scale=None, v_scale=None, interpret=None):
+    """Merged suffix-prefill attention on a prefix-cache hit: q
+    [1, S, H, D] rows at absolute positions ``q_start + i``; local cache
+    k_cache/v_cache [1, M, H, D] (kv repeated to q heads, rows valid
+    from ``base``); prefix tokens 0..base-1 live in pool pages and are
+    attended IN PLACE through ``page_ids``. Returns the merged [1, S, H,
+    D] f32 output — the hit-path analog of flash_attention_cached over a
+    densely gathered cache, without the gather."""
+    o_loc, lse_loc = _flash_fwd_v2_cached_bounded(
+        q, k_cache, v_cache, q_start, base, interpret=interpret)
+    o_pre, lse_pre = paged_prefix_part(
+        q, k_pages, v_pages, page_ids, base, page_size=page_size,
+        k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+    return merge_softmax_states(o_pre, lse_pre, o_loc, lse_loc)
 
 
 # ---------------------------------------------------------------------------
@@ -185,10 +484,12 @@ def _paged_decode_call(q, k_pages, v_pages, page_table, pos,
 # ---------------------------------------------------------------------------
 
 def paged_decode_reference(q, k_pages, v_pages, page_table, pos,
-                           page_size: int):
+                           page_size: int, k_scale=None, v_scale=None):
     """Dense-view reference: gather every slot's pages into
     [slots, max_len] (the materialization the kernel exists to avoid) and
-    run masked attention. Used for parity tests and as the CPU path."""
+    run masked attention. Used for parity tests and as the CPU path.
+    int8 pools pass per-vector ``k_scale``/``v_scale`` ([P+1, page_size,
+    Hkv] f32) and dequantize after the gather."""
     slots, h, d = q.shape
     hkv = k_pages.shape[2]
     n_rep = h // hkv
@@ -196,26 +497,34 @@ def paged_decode_reference(q, k_pages, v_pages, page_table, pos,
     kd = jnp.take(k_pages, safe, axis=0)     # [slots, pps, ps, hkv, d]
     vd = jnp.take(v_pages, safe, axis=0)
     s_, p_, ps_, hh, dd = kd.shape
-    kd = _repeat_kv(kd.reshape(s_, p_ * ps_, hh, dd), n_rep)
-    vd = _repeat_kv(vd.reshape(s_, p_ * ps_, hh, dd), n_rep)
+    kd = kd.reshape(s_, p_ * ps_, hh, dd).astype(jnp.float32)
+    vd = vd.reshape(s_, p_ * ps_, hh, dd).astype(jnp.float32)
+    if k_scale is not None:
+        ksc = jnp.take(k_scale, safe, axis=0).reshape(s_, p_ * ps_, hh)
+        vsc = jnp.take(v_scale, safe, axis=0).reshape(s_, p_ * ps_, hh)
+        kd = kd * ksc[..., None]
+        vd = vd * vsc[..., None]
+    kd = _repeat_kv(kd, n_rep)
+    vd = _repeat_kv(vd, n_rep)
     scale = d ** -0.5
-    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
-                        kd.astype(jnp.float32),
+    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kd,
                         preferred_element_type=jnp.float32) * scale
     k_pos = jnp.arange(p_ * ps_)[None, None, :]
     logits = jnp.where(k_pos <= pos[:, None, None], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhk,bkhd->bhd", weights,
-                      vd.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", weights, vd).astype(q.dtype)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, pos, *,
                     page_size: int, impl: str = "auto",
-                    interpret=None):
-    """Dispatching paged-decode attention (see module docstring)."""
+                    k_scale=None, v_scale=None, interpret=None):
+    """Dispatching paged-decode attention (see module docstring).
+    ``k_scale``/``v_scale`` select the int8 path in both impls."""
     impl = resolve_paged_impl(impl)
     if impl == "reference":
         return paged_decode_reference(q, k_pages, v_pages, page_table,
-                                      pos, page_size)
+                                      pos, page_size, k_scale=k_scale,
+                                      v_scale=v_scale)
     return _paged_decode_call(q, k_pages, v_pages, page_table, pos,
-                              page_size, interpret=interpret)
+                              page_size, k_scale=k_scale,
+                              v_scale=v_scale, interpret=interpret)

@@ -25,10 +25,12 @@ already cached. This module is the fleet layer above the engines:
   live there) and a decode pool (least-loaded). A prefill replica runs
   the (chunked) prefill and exports the slot's KV
   (``KVHandoff``, the batch=1 slot-cache serialization boundary that
-  ``gather_prefix_pages``/``insert_prompt_pages`` already define); a
-  decode replica imports it and ticks — a fleet-wide long prompt can
-  never appear between two decode ticks, generalizing chunked prefill
-  across processes.
+  ``gather_prefix_pages``/``insert_prompt_pages`` already define; int8
+  pools ship int8 pages + per-vector f32 scales as-is — the payload is
+  never densified to the native dtype, and ``KVHandoff.kv_dtype``
+  rejects mismatched pools typed); a decode replica imports it and
+  ticks — a fleet-wide long prompt can never appear between two decode
+  ticks, generalizing chunked prefill across processes.
 
 Everything here is host-side Python with no jax import at module level —
 the router must stay importable below the engines (serving/__init__.py
@@ -770,7 +772,9 @@ class EngineFleet:
                 "handoffs_in": stats.get("handoffs_in", 0),
             }
             for key in ("ttft_p50_s", "ttft_p95_s", "decode_tick_p50_s",
-                        "decode_tick_p95_s", "prefill_chunks"):
+                        "decode_tick_p95_s", "prefill_chunks",
+                        "prefill_kernel_chunks",
+                        "prefill_gather_admissions"):
                 if key in stats:
                     per[replica.id][key] = stats[key]
         out["completed"] = completed

@@ -82,6 +82,37 @@ def _cached_attention(config, q, k_cache, v_cache, q_positions, cache_len):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v_cache)
 
 
+def _cached_attention_lse(config, q, k_cache, v_cache, q_positions, k_lo):
+    """Bounded dense cached attention returning (o, lse): like
+    :func:`_cached_attention` but kv rows below ``k_lo`` are masked out
+    — on a paged prefix-cache hit those positions live in shared pool
+    pages and are attended by the paged prefill kernel; the two partial
+    softmax states are then LSE-merged
+    (ops/paged_attention.merge_softmax_states). o is [B, S, H, D] f32,
+    lse [B, H, S] f32 (the flash kernels' lse layout). This is the
+    s == 1 replay form of the hit path — a 1-row flash instance gains
+    nothing and is a shape class TPU lowering never otherwise sees."""
+    n_rep = config.n_heads // config.n_kv_heads
+    m = k_cache.shape[1]
+    if n_rep > 1:
+        k_cache = jnp.repeat(k_cache, n_rep, axis=2)
+        v_cache = jnp.repeat(v_cache, n_rep, axis=2)
+    scale = config.head_dim ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(m)[None, :]  # [1, M]
+    mask = (k_pos[None] <= q_positions[:, :, None]) \
+        & (k_pos[None] >= k_lo)     # [B, S, M]
+    logits = jnp.where(mask[:, None], logits, -2.0**30)
+    m_max = jnp.max(logits, axis=-1)                      # [B, H, S]
+    weight = jnp.exp(logits - m_max[..., None])
+    denom = jnp.maximum(jnp.sum(weight, axis=-1), 1e-30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", weight / denom[..., None],
+                   v_cache.astype(jnp.float32))
+    return o, m_max + jnp.log(denom)
+
+
 def _lora_delta(h_in, lora_target, layer, adapter_ids):
     """Per-row low-rank delta for one projection: each batch row gathers
     its OWN (A, B, scaling) from the stacked adapter bank
@@ -104,8 +135,10 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
                         tokens: jax.Array, cache: dict,
                         lora: Optional[Params] = None,
                         adapter_ids: Optional[jax.Array] = None,
+                        prefix_kv: Optional[dict] = None,
                         all_logits: bool = False,
-                        attn_impl: str = "dense"):
+                        attn_impl: str = "dense",
+                        page_size: int = 0):
     """Run tokens starting at cache['pos']; returns (logits_last, new_cache).
     ``all_logits=True`` returns [B, S, vocab] logits for every input
     position instead of just the last (speculative verification needs the
@@ -119,7 +152,17 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
     ``attn_impl="flash"`` runs the attention over the cache through the
     offset-aware flash kernel (ops.attention.flash_attention_cached,
     interpret mode off-TPU) instead of the dense masked softmax — the
-    engines' prefill hot path (docs/serving.md "Attention kernels")."""
+    engines' prefill hot path (docs/serving.md "Attention kernels").
+
+    ``prefix_kv`` is the paged engine's prefix-hit form (batch=1): a
+    dict of the pool's per-layer pages — ``{"k": [L, P+1, ps, Hkv, D],
+    "v": ..., "page_ids": [pages_per_slot] int32, "base": int32
+    scalar[, "k_scale"/"v_scale": [L, P+1, ps, Hkv] f32 on int8
+    pools]}``. Cache rows below ``base`` are zeros — the cached prefix
+    KV is attended IN PLACE through the page ids by the multi-row paged
+    prefill kernel and LSE-merged with the local attention over the
+    suffix rows, so a prefix hit never gathers the cached KV densely
+    (``page_size`` must then be the pool's static page size)."""
     b, s = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["pos"]  # [B]
@@ -173,7 +216,41 @@ def _forward_with_cache(config: LlamaConfig, params: Params,
                 (0, start[0], 0, 0))
             k_attn, v_attn = k_cache, v_cache
             scales = None
-        if attn_impl == "flash" and s > 1:
+        if prefix_kv is not None:
+            # paged prefix-hit suffix prefill: local rows (>= base) via
+            # bounded flash (s > 1) or the bounded dense form (the
+            # 1-token last-position replay), the cached prefix via the
+            # multi-row paged prefill kernel reading pool pages in
+            # place — partial softmax states LSE-merged
+            # (docs/serving.md "Attention kernels")
+            from ..ops.attention import (
+                _flash_fwd_v2_cached_bounded,
+                _repeat_kv,
+            )
+            from ..ops.paged_attention import (
+                merge_softmax_states,
+                paged_prefix_part,
+            )
+
+            n_rep = config.n_heads // config.n_kv_heads
+            base = prefix_kv["base"]
+            if attn_impl == "flash" and s > 1:
+                o_loc, lse_loc = _flash_fwd_v2_cached_bounded(
+                    q, _repeat_kv(k_attn, n_rep),
+                    _repeat_kv(v_attn, n_rep), start[0], base)
+            else:
+                o_loc, lse_loc = _cached_attention_lse(
+                    config, q, k_attn, v_attn, positions, base)
+            o_pre, lse_pre = paged_prefix_part(
+                q, prefix_kv["k"][layer], prefix_kv["v"][layer],
+                prefix_kv["page_ids"], base, page_size=page_size,
+                k_scale=(prefix_kv["k_scale"][layer]
+                         if "k_scale" in prefix_kv else None),
+                v_scale=(prefix_kv["v_scale"][layer]
+                         if "v_scale" in prefix_kv else None))
+            attn = merge_softmax_states(o_pre, lse_pre, o_loc,
+                                        lse_loc).astype(x_in.dtype)
+        elif attn_impl == "flash" and s > 1:
             from ..ops.attention import _repeat_kv, flash_attention_cached
 
             n_rep = config.n_heads // config.n_kv_heads

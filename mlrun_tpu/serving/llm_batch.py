@@ -189,12 +189,22 @@ class KVHandoff:
     boundary as plain arrays. A prefill replica produces one via
     ``submit_prefill()``; a decode replica consumes it via
     ``submit_prefilled()`` and decodes token-identically to the
-    single-engine path (greedy)."""
+    single-engine path (greedy).
+
+    Wire format: ``kv`` always carries ``k``/``v`` ``[L, prompt_len,
+    Hkv, D]``; on an int8 pool (``kv_dtype == "int8"``) they stay int8
+    and the per-vector f32 dequant scales ride alongside as
+    ``k_scale``/``v_scale`` ``[L, prompt_len, Hkv]`` — a quantized
+    handoff is never densified to the native dtype on either side
+    (half the bytes on the wire, and the decode pool imports the exact
+    int8 values the prefill pool computed)."""
 
     prompt: list
     first_token: int
     kv: dict                     # {"k","v"[, "k_scale","v_scale"]}: numpy
     prompt_len: int
+    kv_dtype: str = "native"     # "native" | "int8" — the pool dtype the
+    #                              payload was exported from
     cached_prefix: int = 0       # prompt tokens served from the prefill
     #                              replica's prefix cache
     sampling: tuple = (0.0, 0, 1.0)
@@ -245,6 +255,12 @@ class _Admission:
     page_ids: object = None
     pages: list = field(default_factory=list)
     prefix_nodes: list = field(default_factory=list)
+    # prefix-hit kernel path (docs/serving.md "Attention kernels"): the
+    # cached prefix was NOT gathered into ``small`` — prefill dispatches
+    # attend the shared pages in place through ``prefix_ids`` (full
+    # pages_per_slot length, -1 past the prefix) and LSE-merge
+    kernel_prefix: bool = False
+    prefix_ids: object = None
     # fleet disaggregation (docs/serving.md "Engine fleet"): an export
     # admission resolves its future with a KVHandoff instead of
     # activating a decode slot; a prefilled admission arrived WITH its
@@ -1051,17 +1067,32 @@ class ContinuousBatchingEngine:
         its adapter id: decode runs under the SAME adapter the KV was
         computed with."""
         expects_scales = self.kv_dtype == "int8"
-        if ("k_scale" in handoff.kv) != expects_scales:
+        wire_dtype = getattr(handoff, "kv_dtype", None) or (
+            "int8" if "k_scale" in handoff.kv else "native")
+        if wire_dtype != self.kv_dtype or \
+                ("k_scale" in handoff.kv) != expects_scales:
             raise ValueError(
                 f"KV handoff dtype mismatch: engine kv_dtype="
-                f"'{self.kv_dtype}' cannot import "
-                f"{'bf16/native' if expects_scales else 'int8'} pages")
+                f"'{self.kv_dtype}' cannot import a '{wire_dtype}' "
+                f"payload — prefill and decode pools must quantize "
+                f"alike (docs/serving.md 'Engine fleet')")
         temperature, top_k, top_p = handoff.sampling
         return self.submit(handoff.prompt, max_new_tokens=max_new_tokens,
                            eos_id=eos_id, temperature=temperature,
                            top_k=top_k, top_p=top_p, max_wait=max_wait,
                            adapter=handoff.adapter, _extra=handoff,
                            _trace=_trace)
+
+    def _handoff_kv(self, adm: _Admission, rows: int) -> dict:
+        """Serialize an export admission's prompt KV to host numpy
+        (the :class:`KVHandoff` payload — int8 pools ship int8 values +
+        f32 scales, never densified to the native dtype). Hook: the
+        paged engine's kernel-prefix path assembles the cached-prefix
+        rows straight from its pool pages, since they were never
+        gathered into the slot cache."""
+        return {name: np.asarray(adm.small[name][:, 0, :rows])
+                for name in ("k", "v", "k_scale", "v_scale")
+                if name in adm.small}
 
     def _import_small(self, handoff: KVHandoff) -> dict:
         """Deserialize a handoff into the batch=1 admission cache (the
@@ -1097,10 +1128,7 @@ class ContinuousBatchingEngine:
             # handoff cost; the ledger closes here and rides the payload
             adm.ledger.enter("handoff")
         rows = len(adm.prompt)
-        kv = {}
-        for name in ("k", "v", "k_scale", "v_scale"):
-            if name in adm.small:
-                kv[name] = np.asarray(adm.small[name][:, 0, :rows])
+        kv = self._handoff_kv(adm, rows)
         prefill_s = time.perf_counter() - adm.submitted
         timing = None
         if adm.ledger is not None:
@@ -1121,9 +1149,10 @@ class ContinuousBatchingEngine:
                               start=adm.claimed, attrs=attrs)
         handoff = KVHandoff(
             prompt=list(adm.prompt), first_token=adm.first_token, kv=kv,
-            prompt_len=len(adm.prompt), cached_prefix=adm.base,
-            sampling=adm.sampling, prefill_s=prefill_s,
-            replica=self.replica, adapter=adm.adapter, timing=timing)
+            prompt_len=len(adm.prompt), kv_dtype=self.kv_dtype,
+            cached_prefix=adm.base, sampling=adm.sampling,
+            prefill_s=prefill_s, replica=self.replica,
+            adapter=adm.adapter, timing=timing)
         self._release_slot_storage(adm.slot)
         with self._lock:
             self._stats["handoffs_out"] += 1
@@ -1253,8 +1282,8 @@ class ContinuousBatchingEngine:
         padded[0, :take] = prompt[start:start + take]
         adm.small["pos"] = jnp.full((1,), start, jnp.int32)
         lora_kw = self._lora_kwargs(adm.adapter_slot)
-        logits, adm.small = self._prefill(self.params, jnp.asarray(padded),
-                                          adm.small, **lora_kw)
+        logits, adm.small = self._prefill_dispatch(
+            adm, jnp.asarray(padded), lora_kw)
         adm.offset += take
         adm.chunks += 1
         with self._lock:
@@ -1269,9 +1298,9 @@ class ContinuousBatchingEngine:
             # padding advanced pos past the prompt; replay the last real
             # token for its logits (same trick as LLMEngine.generate)
             adm.small["pos"] = jnp.full((1,), total - 1, jnp.int32)
-            logits, adm.small = self._prefill(
-                self.params, jnp.asarray([[prompt[-1]]], dtype=jnp.int32),
-                adm.small, **lora_kw)
+            logits, adm.small = self._prefill_dispatch(
+                adm, jnp.asarray([[prompt[-1]]], dtype=jnp.int32),
+                lora_kw)
         if sampling_enabled():
             # monitoring tap: first-token top1-top2 logit gap (a cheap
             # model-confidence proxy for the drift analyzer's "logit
@@ -1283,6 +1312,13 @@ class ContinuousBatchingEngine:
                 adm.logit_margin = float(top2[1] - top2[0])
         adm.first_token = self._first_token(logits, adm.sampling)
         return True
+
+    def _prefill_dispatch(self, adm: _Admission, tokens, lora_kw):
+        """One prefill device dispatch for an admission (chunk or the
+        last-token replay). Hook: the paged engine routes prefix-hit
+        admissions through the merged paged-prefill kernel so the cached
+        prefix is attended in place instead of gathered."""
+        return self._prefill(self.params, tokens, adm.small, **lora_kw)
 
     def _activate_slot(self, free: int, request_id: int, first_token: int,
                        max_new: int, eos_id, future, submitted: float,

@@ -12,10 +12,14 @@ TPU shaping (everything static under jit):
   ids) land there, so masked-out writes can never collide with a live
   page (scatter with duplicate indices has an undefined winner).
 - page_table: [slots, pages_per_slot] int32 (page ids; -1 = unmapped)
-- attention:  gather the slot's pages into a dense [slots, max_len] view
-  per layer, then run the same masked attention as the dense engine. The
-  gather is HBM-bandwidth work of the same order as attention's cache
-  read; compute cost is unchanged.
+- attention:  the kernel path reads the pool THROUGH the page table in
+  place (decode: one token/slot; prefix-hit prefill: a suffix chunk over
+  the cached pages, LSE-merged with the local flash — both in
+  ops/paged_attention.py, int8 pools included via in-kernel dequant).
+  The reference path gathers the slot's pages into a dense
+  [slots, max_len] view per layer and runs the same masked attention as
+  the dense engine — HBM-bandwidth work of the same order as
+  attention's cache read, which is exactly what the kernels eliminate.
 - page allocation/free is host-side bookkeeping in the scheduler thread
   (a free-list), exactly where the dense engine's slot bookkeeping lives.
 
@@ -52,7 +56,7 @@ from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..models.llama import LlamaConfig
 from ..utils import logger
-from .llm import init_kv_cache
+from .llm import _forward_with_cache, init_kv_cache
 from .llm_batch import ContinuousBatchingEngine, KVHandoff, _Admission
 from .prefix import PrefixCache
 
@@ -172,11 +176,15 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
     layers, at the end), run the dense masked attention.
 
     ``attn_impl="kernel"``: per layer, scatter the token's KV into the
-    pool FIRST (one [slots] page-table-routed write), then run the pallas
-    paged-decode kernel which reads the pool THROUGH the page table — the
-    dense view is never materialized (ops/paged_attention.py). Both paths
-    store and read identical bits at identical positions, so greedy
-    decoding is token-identical between them.
+    pool FIRST (one [slots] page-table-routed write; int8 pools
+    quantize per vector on the way in), then run the pallas
+    paged-decode kernel which reads the pool THROUGH the page table —
+    the dense view is never materialized, and on int8 pools the
+    per-vector scales ride page-table-indexed operands with dequant
+    in-register (ops/paged_attention.py). Both paths store and read
+    identical bits at identical positions (int8 included — they share
+    one _quantize_kv), so greedy decoding is token-identical between
+    them.
 
     ``lora``/``adapter_ids`` add per-row multi-tenant LoRA exactly like
     the dense ``_decode_rowwise`` (docs/serving.md "Multi-tenant LoRA"):
@@ -200,9 +208,6 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
     quantized = "k_scale" in pool
     use_kernel = attn_impl == "kernel"
     if use_kernel:
-        # int8 pools resolve to "reference" at engine construction — the
-        # kernel reads raw pool pages and carries no dequant scales
-        assert not quantized, "paged kernel does not cover int8 KV"
         scratch = pool["k"].shape[1] - 1
         page_idx = pos // page_size
         offset = pos % page_size
@@ -235,14 +240,31 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
         if use_kernel:
             # token KV lands in the pool first (unmapped slots route to
             # the never-read scratch page), then the kernel attends
-            # pool-side via the page table — no dense view, no gather
-            pool["k"] = pool["k"].at[layer, pid_safe, offset].set(
-                k[:, 0].astype(pool["k"].dtype))
-            pool["v"] = pool["v"].at[layer, pid_safe, offset].set(
-                v[:, 0].astype(pool["v"].dtype))
+            # pool-side via the page table — no dense view, no gather.
+            # int8 pools quantize the token per vector on the way in and
+            # the kernel dequantizes in-register (scales ride
+            # page-table-indexed operands)
+            scales_kw = {}
+            if quantized:
+                kq_, ks_ = _quantize_kv(k[:, 0])
+                vq_, vs_ = _quantize_kv(v[:, 0])
+                pool["k"] = pool["k"].at[layer, pid_safe, offset].set(kq_)
+                pool["v"] = pool["v"].at[layer, pid_safe, offset].set(vq_)
+                pool["k_scale"] = pool["k_scale"].at[
+                    layer, pid_safe, offset].set(ks_)
+                pool["v_scale"] = pool["v_scale"].at[
+                    layer, pid_safe, offset].set(vs_)
+                scales_kw = {"k_scale": pool["k_scale"][layer],
+                             "v_scale": pool["v_scale"][layer]}
+            else:
+                pool["k"] = pool["k"].at[layer, pid_safe, offset].set(
+                    k[:, 0].astype(pool["k"].dtype))
+                pool["v"] = pool["v"].at[layer, pid_safe, offset].set(
+                    v[:, 0].astype(pool["v"].dtype))
             attn = paged_attention(
                 q[:, 0], pool["k"][layer], pool["v"][layer], page_table,
-                pos, page_size=page_size, impl="kernel")[:, None]
+                pos, page_size=page_size, impl="kernel",
+                **scales_kw)[:, None]
         else:
             # dense per-layer view of this slot's pages (dequantized)
             kp = jnp.take(pool["k"][layer], safe_table, axis=0)
@@ -363,12 +385,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          request_ledger=request_ledger)
         # decode path: pallas paged kernel (page-table indexed) or the
         # gather+dense reference — resolved once, from the same knob the
-        # base class resolved the prefill path from
+        # base class resolved the prefill path from. int8 pools run the
+        # SAME kernel (per-vector dequant scales ride page-table-indexed
+        # operands); an explicit kernel request that cannot be honored
+        # raised typed inside resolve_paged_impl.
         self.attn_impl = resolve_paged_impl(self.attention_impl)
-        if self.attn_impl == "kernel" and kv_dtype == "int8":
-            logger.info("paged attention kernel does not cover int8 KV — "
-                        "decode uses the gather+dense reference path")
-            self.attn_impl = "reference"
+        # prefix-hit suffix prefill: "kernel" attends the cached prefix
+        # pages IN PLACE (multi-row paged prefill kernel LSE-merged with
+        # the local flash over the suffix — docs/serving.md "Attention
+        # kernels"); "gather" is the dense gather_prefix_pages seed
+        # (reference/CPU fallback)
+        self.paged_prefill_impl = (
+            "kernel" if self.prefill_impl == "flash" else "gather")
         # +1 physical page: the scratch page for masked writes
         self._pool = init_paged_pool(config, self.n_pages + 1, page_size,
                                      kv_dtype)
@@ -384,7 +412,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             * int(np.prod(arr.shape[3:]))
             for name, arr in self._pool.items() if name in ("k", "v"))
         self._stats.update({"attn_kernel_ticks": 0, "attn_gather_ticks": 0,
-                            "attn_hbm_bytes_avoided": 0})
+                            "attn_hbm_bytes_avoided": 0,
+                            "prefill_kernel_chunks": 0,
+                            "prefill_gather_admissions": 0})
+        # the paged engine's prefill carries the pool page size so a
+        # prefix-hit dispatch can attend pool pages in place
+        # (prefix_kv= — see _prefill_dispatch)
+        self._prefill = jax.jit(functools.partial(
+            _forward_with_cache, config, attn_impl=self.prefill_impl,
+            page_size=page_size))
         self._decode_paged = jax.jit(
             functools.partial(_decode_rowwise_paged, config, page_size,
                               self.attn_impl),
@@ -422,13 +458,36 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                           jnp.zeros((1, self.prefill_chunk), jnp.int32),
                           small, **prefill_kw)
         if self._prefix is not None:
-            # compile the prefix-page gather (first cache hit must not
-            # pay the compile); all-(-1) ids touch no live page
-            small = init_kv_cache(self.config, 1, self.max_len,
-                                  kv_dtype=self.kv_dtype)
-            self._gather_paged(
-                self._pool, small,
-                jnp.full((self.pages_per_slot,), -1, jnp.int32))
+            if self.paged_prefill_impl == "kernel":
+                # compile the merged prefix-hit prefill programs (every
+                # bucket/chunk shape + the 1-token replay) — the first
+                # cache hit must not pay the compile. All-(-1) ids route
+                # to the never-read scratch page; outputs are discarded
+                ids = jnp.full((self.pages_per_slot,), -1, jnp.int32)
+                prefix_kv = {"k": self._pool["k"], "v": self._pool["v"],
+                             "page_ids": ids,
+                             "base": jnp.int32(self.page_size)}
+                if "k_scale" in self._pool:
+                    prefix_kv["k_scale"] = self._pool["k_scale"]
+                    prefix_kv["v_scale"] = self._pool["v_scale"]
+                shapes = set(self.prefill_buckets) | {1}
+                if self.prefill_chunk:
+                    shapes.add(self.prefill_chunk)
+                for shape in sorted(shapes):
+                    small = init_kv_cache(self.config, 1, self.max_len,
+                                          kv_dtype=self.kv_dtype)
+                    self._prefill(self.params,
+                                  jnp.zeros((1, shape), jnp.int32),
+                                  small, prefix_kv=prefix_kv,
+                                  **prefill_kw)
+            else:
+                # compile the prefix-page gather (first cache hit must
+                # not pay the compile); all-(-1) ids touch no live page
+                small = init_kv_cache(self.config, 1, self.max_len,
+                                      kv_dtype=self.kv_dtype)
+                self._gather_paged(
+                    self._pool, small,
+                    jnp.full((self.pages_per_slot,), -1, jnp.int32))
         step = jnp.zeros((self.slots, 1), jnp.int32)
         table = jnp.asarray(self._page_table)
         pos = jnp.asarray(self._pos)
@@ -591,13 +650,27 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     adm.small = init_kv_cache(self.config, 1, self.max_len,
                                               kv_dtype=self.kv_dtype)
                 if k:
-                    # seed the batch=1 cache with the shared prefix KV;
-                    # the suffix-only prefill attends over it from
-                    # pos=base
-                    gather_ids = ids.copy()
-                    gather_ids[k:] = -1
-                    adm.small = self._gather_paged(self._pool, adm.small,
-                                                   jnp.asarray(gather_ids))
+                    prefix_ids = ids.copy()
+                    prefix_ids[k:] = -1
+                    if self.paged_prefill_impl == "kernel":
+                        # the suffix prefill attends the shared prefix
+                        # pages IN PLACE through the page ids (merged
+                        # paged-prefill kernel) — the cached KV is
+                        # never materialized densely (the acceptance
+                        # stat: prefill_gather_admissions stays 0)
+                        adm.kernel_prefix = True
+                        adm.prefix_ids = prefix_ids
+                    else:
+                        # reference fallback: seed the batch=1 cache
+                        # with a dense gather of the prefix KV; the
+                        # suffix-only prefill attends over it from
+                        # pos=base
+                        with self._lock:
+                            self._stats[
+                                "prefill_gather_admissions"] += 1
+                        adm.small = self._gather_paged(
+                            self._pool, adm.small,
+                            jnp.asarray(prefix_ids))
                 return adm
             except Exception as exc:
                 # popped but not yet tracked in self._admission: fail the
@@ -610,6 +683,44 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 if not future.done():
                     future.set_exception(exc)
                 raise
+
+    def _prefill_dispatch(self, adm: _Admission, tokens, lora_kw):
+        """Prefix-hit admissions on the kernel path attend the cached
+        prefix pages in place: the pool + page ids ride the dispatch as
+        ``prefix_kv`` and every chunk (and the last-token replay)
+        LSE-merges the paged-prefill kernel's partial state with the
+        local attention over the suffix rows."""
+        if not adm.kernel_prefix:
+            return super()._prefill_dispatch(adm, tokens, lora_kw)
+        prefix_kv = {"k": self._pool["k"], "v": self._pool["v"],
+                     "page_ids": jnp.asarray(adm.prefix_ids),
+                     "base": jnp.int32(adm.base)}
+        if "k_scale" in self._pool:
+            prefix_kv["k_scale"] = self._pool["k_scale"]
+            prefix_kv["v_scale"] = self._pool["v_scale"]
+        with self._lock:
+            self._stats["prefill_kernel_chunks"] += 1
+        return self._prefill(self.params, tokens, adm.small,
+                             prefix_kv=prefix_kv, **lora_kw)
+
+    def _handoff_kv(self, adm: _Admission, rows: int) -> dict:
+        kv = super()._handoff_kv(adm, rows)
+        k = adm.base // self.page_size
+        if not adm.kernel_prefix or not k:
+            return kv
+        # kernel-prefix exports: rows < base were never gathered into
+        # the slot cache — assemble them from the shared pool pages at
+        # serialization time (a host copy of exactly the prefix pages,
+        # the unavoidable wire copy; int8 pages + scales ship as-is,
+        # never densified to fp32)
+        ids = np.asarray(adm.page_ids[:k], np.int64)
+        for name, payload in list(kv.items()):
+            if not payload.flags.writeable:
+                payload = kv[name] = payload.copy()
+            pages = np.asarray(self._pool[name][:, ids])
+            payload[:, :adm.base] = pages.reshape(
+                pages.shape[0], adm.base, *pages.shape[3:])
+        return kv
 
     def _complete_storage(self, adm: _Admission):
         k = adm.base // self.page_size
@@ -667,12 +778,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     # paged-only cumulative stats mirrored to mlt_llm_events_total
     _COUNTER_STATS = ContinuousBatchingEngine._COUNTER_STATS + (
-        "attn_kernel_ticks", "attn_gather_ticks", "attn_hbm_bytes_avoided")
+        "attn_kernel_ticks", "attn_gather_ticks", "attn_hbm_bytes_avoided",
+        "prefill_kernel_chunks", "prefill_gather_admissions")
 
     @property
     def stats(self) -> dict:
         out = ContinuousBatchingEngine.stats.fget(self)
         out["decode_attn_impl"] = self.attn_impl
+        out["paged_prefill_impl"] = self.paged_prefill_impl
         out["free_pages"] = len(self._free_pages)
         if self._prefix is not None:
             queries = self._prefix.queries

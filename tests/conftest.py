@@ -43,7 +43,8 @@ _COMPILE_CACHE_DIR = None
 _COMPILE_CACHED_MODULES = {
     "test_serving_prefix", "test_serving_fleet", "test_serving_adapters",
     "test_serving_resilience", "test_llm_continuous", "test_llm_paged",
-    "test_llm_engine", "test_paged_attention", "test_speculative",
+    "test_llm_engine", "test_paged_attention", "test_paged_prefill",
+    "test_speculative",
     "test_observability", "test_obs_control_plane",
     "test_continuous_tuning", "test_request_forensics",
     # trainer-path exception to the engines-only rule: the elastic suite

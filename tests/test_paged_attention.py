@@ -125,17 +125,33 @@ def test_dispatcher_reference_on_cpu_unless_interpret_forced(monkeypatch):
     monkeypatch.delenv("MLT_ATTN_INTERPRET", raising=False)
     assert pattn.resolve_paged_impl("auto") == "reference"
     assert resolve_prefill_impl("auto") == "dense"
-    # explicit opt-ins stay explicit
+    # explicit opt-ins stay explicit; "kernel" is the FULL kernel stack
+    # (paged decode + flash/paged prefill — a prefix-hit admission must
+    # never fall back to the dense gather)
     assert pattn.resolve_paged_impl("flash") == "kernel"
     assert pattn.resolve_paged_impl("kernel") == "kernel"
     assert resolve_prefill_impl("flash") == "flash"
-    # "kernel" isolates the decode kernel; prefill stays dense
-    assert resolve_prefill_impl("kernel") == "dense"
+    assert resolve_prefill_impl("kernel") == "flash"
     monkeypatch.setenv("MLT_ATTN_INTERPRET", "1")
     assert pattn.resolve_paged_impl("auto") == "kernel"
     assert resolve_prefill_impl("auto") == "flash"
     with pytest.raises(ValueError):
         pattn.resolve_paged_impl("bogus")
+
+
+def test_explicit_kernel_request_raises_typed_without_pallas(monkeypatch):
+    """The silent int8/impl downgrade class is gone: an explicit kernel
+    request that cannot be honored raises the typed ValueError subclass
+    at resolve (hence engine-construction) time; auto still falls
+    back."""
+    monkeypatch.setattr(pattn, "_PALLAS_OK", False)
+    with pytest.raises(pattn.KernelUnavailableError):
+        pattn.resolve_paged_impl("kernel")
+    with pytest.raises(pattn.KernelUnavailableError):
+        pattn.resolve_paged_impl("flash")
+    assert issubclass(pattn.KernelUnavailableError, ValueError)
+    monkeypatch.setattr(pattn, "_warned_auto_fallback", False)
+    assert pattn.resolve_paged_impl("auto") == "reference"
 
 
 def test_tuned_block_sizes_clamped_to_seq():
@@ -185,9 +201,15 @@ def test_kernel_engine_tokens_match_reference_engine(setup):
     assert stats["reference"]["attn_gather_ticks"] > 0
 
 
-def test_flash_engine_cold_vs_hit_bit_equality(setup):
-    """Full kernel path (flash prefill + paged-decode kernel): a prefix
-    cache hit must replay the cold run's tokens bit-for-bit."""
+def test_flash_engine_cold_vs_hit_parity(setup):
+    """Full kernel path (flash prefill + paged prefill kernel +
+    paged-decode kernel): a prefix-cache hit replays the cold run's
+    greedy tokens within the tolerance-parity contract (docs/serving.md
+    "Attention kernels" — the hit path LSE-merges per-layer partial
+    softmax states, so k-block accumulation order differs from the cold
+    monolithic flash; the numeric gap is f32-round-off-sized and the
+    greedy token stream agrees). The hit never gathers the cached KV
+    densely: prefill_gather_admissions stays 0."""
     cfg, params = setup
     eng = PagedContinuousBatchingEngine(
         cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
@@ -206,6 +228,10 @@ def test_flash_engine_cold_vs_hit_bit_equality(setup):
     assert stats["prefix_hits"] >= 1
     assert stats["attn_gather_ticks"] == 0
     assert stats["prefill_impl"] == "flash"
+    assert stats["paged_prefill_impl"] == "kernel"
+    # the acceptance stat: no hit admission seeded via the dense gather
+    assert stats["prefill_gather_admissions"] == 0
+    assert stats["prefill_kernel_chunks"] > 0
     assert len(branch) == 6
     # decode-tick latency percentiles ride the stats for obs
     assert stats["decode_tick_p50_s"] > 0

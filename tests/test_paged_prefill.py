@@ -1,0 +1,401 @@
+"""Multi-token paged prefill kernel + int8 KV pages (docs/serving.md
+"Attention kernels"): op-level parity of the merged prefix-in-place
+prefill and the int8 decode/prefill kernels, the no-dense-gather
+acceptance contract on prefix-hit admissions
+(``prefill_gather_admissions`` stays 0 under ``attention_impl=
+"kernel"``), int8 end-to-end parity on the paged engine (cold,
+prefix-hit, and through a fleet ``KVHandoff``), the ~2x
+pages-at-equal-bytes capacity claim, the typed
+``KernelUnavailableError`` at engine construction, and the
+``make bench-prefill`` smoke. CPU-only (Pallas interpret mode),
+tier-1-fast.
+
+Tolerance contract: the hit path LSE-merges per-layer partial softmax
+states (prefix pages via the paged prefill kernel, suffix rows via the
+bounded local attention), so its k-block accumulation order differs
+from the cold monolithic pass — outputs agree to f32 round-off
+(op-level bound 2e-6 on unit-scale data) rather than bit-for-bit, and
+greedy token streams agree (asserted). int8 adds the per-vector
+symmetric quantization error (|x|_max / 254 per element; op-level
+attention-output bound 2e-2 on 0.3-scale data, asserted) — kernel vs
+reference on the SAME quantized pool stays at f32 round-off.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import importlib
+
+from mlrun_tpu.models import init_params, tiny_llama
+from mlrun_tpu.ops import paged_attention as pattn
+
+# the ops package re-exports the `attention` FUNCTION under the
+# submodule's name, so `import mlrun_tpu.ops.attention as m` binds the
+# function — resolve the module itself for monkeypatching
+attn_mod = importlib.import_module("mlrun_tpu.ops.attention")
+from mlrun_tpu.ops.attention import _repeat_kv, attention_reference
+from mlrun_tpu.serving.llm import _quantize_kv
+from mlrun_tpu.serving.paged import (
+    PagedContinuousBatchingEngine,
+    init_paged_pool,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("page_size", 8)
+    eng = PagedContinuousBatchingEngine(cfg, params, **kw)
+    eng.start()
+    return eng
+
+
+PROMPT = [1, 7, 3, 9, 2, 4, 6, 8, 5, 3, 1, 2]  # one full block at ps=8
+
+
+# -- op level -----------------------------------------------------------------
+def _prefix_setup(key, n_pages, ps, hkv, d, scale=0.3):
+    kk, kv = jax.random.split(key)
+    k_pages = jax.random.normal(
+        kk, (n_pages + 1, ps, hkv, d), jnp.float32) * scale
+    v_pages = jax.random.normal(
+        kv, (n_pages + 1, ps, hkv, d), jnp.float32) * scale
+    return k_pages, v_pages
+
+
+def test_paged_prefill_kernel_matches_dense_reference():
+    """Merged prefix-in-place prefill (paged prefill kernel LSE-merged
+    with the bounded local flash) vs plain causal attention over the
+    densely concatenated [prefix; suffix] KV — the f32 round-off bound
+    of the tolerance-parity contract."""
+    key = jax.random.PRNGKey(0)
+    S, H, hkv, d, ps, pps = 6, 4, 2, 32, 8, 4
+    n_rep = H // hkv
+    base = 2 * ps
+    k_pages, v_pages = _prefix_setup(key, 10, ps, hkv, d)
+    ids = np.full((pps,), -1, np.int32)
+    ids[:2] = [3, 7]
+    q = jax.random.normal(jax.random.fold_in(key, 1),
+                          (1, S, H, d), jnp.float32) * 0.5
+    M = 32
+    kc, vc = jax.random.split(jax.random.fold_in(key, 2))
+    k_loc = jax.random.normal(kc, (1, M, hkv, d), jnp.float32) * 0.3
+    v_loc = jax.random.normal(vc, (1, M, hkv, d), jnp.float32) * 0.3
+    live = (jnp.arange(M) >= base) & (jnp.arange(M) < base + S)
+    k_loc = k_loc * live[None, :, None, None]
+    v_loc = v_loc * live[None, :, None, None]
+
+    out = pattn.paged_prefill_attention(
+        q, _repeat_kv(k_loc, n_rep), _repeat_kv(v_loc, n_rep),
+        jnp.int32(base), k_pages, v_pages, jnp.asarray(ids),
+        jnp.int32(base), page_size=ps, interpret=True)
+
+    k_pre = jnp.concatenate([k_pages[3], k_pages[7]], axis=0)[None]
+    v_pre = jnp.concatenate([v_pages[3], v_pages[7]], axis=0)[None]
+    k_full = jnp.concatenate([k_pre, k_loc[:, base:base + S]], axis=1)
+    v_full = jnp.concatenate([v_pre, v_loc[:, base:base + S]], axis=1)
+    ref = attention_reference(q, k_full, v_full, causal=True,
+                              positions_q=base + jnp.arange(S),
+                              positions_k=jnp.arange(base + S))
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-6
+
+
+def test_int8_decode_kernel_matches_dequant_reference():
+    """int8 decode kernel (in-register per-vector dequant) vs the
+    dequant+gather reference on the SAME quantized pool: both read
+    identical int8 values, so parity is f32 round-off — the
+    quantization bound applies between pools, not between impls."""
+    key = jax.random.PRNGKey(0)
+    slots, pps, ps, hkv, d, h = 3, 4, 8, 2, 32, 4
+    k_pages, v_pages = _prefix_setup(key, 10, ps, hkv, d)
+    k8, ks = _quantize_kv(k_pages)
+    v8, vs = _quantize_kv(v_pages)
+    q = jax.random.normal(jax.random.fold_in(key, 1),
+                          (slots, h, d), jnp.float32) * 0.5
+    table = np.full((slots, pps), -1, np.int32)
+    table[0, :2] = [3, 7]
+    table[1, :4] = [0, 1, 2, 8]
+    table[2, :1] = [9]
+    pos = jnp.asarray([11, 31, 0], jnp.int32)
+    out_k = pattn._paged_decode_call(q, k8, v8, jnp.asarray(table), pos,
+                                     ps, k_scale=ks, v_scale=vs,
+                                     interpret=True)
+    out_r = pattn.paged_decode_reference(q, k8, v8, jnp.asarray(table),
+                                         pos, ps, k_scale=ks, v_scale=vs)
+    assert float(jnp.max(jnp.abs(out_k - out_r))) < 2e-6
+    # and the quantization bound itself vs the native pool: per-element
+    # error <= |x|_max/254, attention output within 2e-2 on this data
+    out_native = pattn.paged_decode_reference(
+        q, k_pages, v_pages, jnp.asarray(table), pos, ps)
+    assert float(jnp.max(jnp.abs(out_k - out_native))) < 2e-2
+
+
+def test_int8_prefill_kernel_matches_dequant_reference():
+    """The paged prefill kernel over int8 pages + scales matches the
+    dense dequantized reference to f32 round-off."""
+    key = jax.random.PRNGKey(4)
+    S, H, hkv, d, ps, pps = 5, 4, 2, 32, 8, 4
+    n_rep = H // hkv
+    base = 2 * ps
+    k_pages, v_pages = _prefix_setup(key, 10, ps, hkv, d)
+    k8, ks = _quantize_kv(k_pages)
+    v8, vs = _quantize_kv(v_pages)
+    ids = np.full((pps,), -1, np.int32)
+    ids[:2] = [1, 6]
+    q = jax.random.normal(jax.random.fold_in(key, 1),
+                          (1, S, H, d), jnp.float32) * 0.5
+    M = 32
+    kc, vc = jax.random.split(jax.random.fold_in(key, 2))
+    k_loc = jax.random.normal(kc, (1, M, hkv, d), jnp.float32) * 0.3
+    v_loc = jax.random.normal(vc, (1, M, hkv, d), jnp.float32) * 0.3
+    live = (jnp.arange(M) >= base) & (jnp.arange(M) < base + S)
+    k_loc = k_loc * live[None, :, None, None]
+    v_loc = v_loc * live[None, :, None, None]
+
+    out = pattn.paged_prefill_attention(
+        q, _repeat_kv(k_loc, n_rep), _repeat_kv(v_loc, n_rep),
+        jnp.int32(base), k8, v8, jnp.asarray(ids), jnp.int32(base),
+        page_size=ps, k_scale=ks, v_scale=vs, interpret=True)
+
+    kd = k8.astype(jnp.float32) * ks[..., None]
+    vd = v8.astype(jnp.float32) * vs[..., None]
+    k_pre = jnp.concatenate([kd[1], kd[6]], axis=0)[None]
+    v_pre = jnp.concatenate([vd[1], vd[6]], axis=0)[None]
+    k_full = jnp.concatenate([k_pre, k_loc[:, base:base + S]], axis=1)
+    v_full = jnp.concatenate([v_pre, v_loc[:, base:base + S]], axis=1)
+    ref = attention_reference(q, k_full, v_full, causal=True,
+                              positions_q=base + jnp.arange(S),
+                              positions_k=jnp.arange(base + S))
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-6
+
+
+# -- engine level -------------------------------------------------------------
+def test_kernel_prefix_hit_never_gathers(setup):
+    """ACCEPTANCE: with ``attention_impl="kernel"`` a prefix-hit
+    admission runs the in-place merged prefill — no dense gather ever
+    (``prefill_gather_admissions`` stays 0), and cold-vs-hit greedy
+    outputs agree (the token-level instantiation of the tolerance
+    bound)."""
+    cfg, params = setup
+    eng = _engine(cfg, params, attention_impl="kernel")
+    try:
+        cold, _ = eng.generate(PROMPT, max_new_tokens=6)
+        warm, _ = eng.generate(PROMPT, max_new_tokens=6)
+        stats = eng.stats
+    finally:
+        eng.stop()
+    assert stats["prefix_hits"] >= 1
+    assert stats["paged_prefill_impl"] == "kernel"
+    assert stats["prefill_gather_admissions"] == 0
+    assert stats["prefill_kernel_chunks"] > 0
+    assert warm == cold
+    # the reference arm of the same workload gathers once per hit
+    eng = _engine(cfg, params, attention_impl="reference")
+    try:
+        ref_cold, _ = eng.generate(PROMPT, max_new_tokens=6)
+        ref_warm, _ = eng.generate(PROMPT, max_new_tokens=6)
+        ref_stats = eng.stats
+    finally:
+        eng.stop()
+    assert ref_stats["paged_prefill_impl"] == "gather"
+    assert ref_stats["prefill_gather_admissions"] == 1
+    assert ref_stats["prefill_kernel_chunks"] == 0
+    # cross-impl parity: kernel and gather arms agree token-for-token
+    assert cold == ref_cold and warm == ref_warm
+
+
+def test_kernel_prefix_chunked_resume_parity(setup):
+    """A prefix-hit suffix longer than ``prefill_chunk`` resumes the
+    merged kernel dispatch across scheduler ticks (decode ticks
+    interleaved) — greedy output still matches the unchunked reference
+    engine, and every chunk ran in place (no gather)."""
+    cfg, params = setup
+    shared = list(range(1, 17))           # 2 full blocks at ps=8
+    branch = shared + list(range(40, 52))  # 12-token suffix, chunk=8
+    eng = _engine(cfg, params, prefill_buckets=(32,),
+                  attention_impl="reference")
+    try:
+        ref_seed, _ = eng.generate(shared, max_new_tokens=4)
+        ref, _ = eng.generate(branch, max_new_tokens=5)
+    finally:
+        eng.stop()
+    eng = _engine(cfg, params, prefill_buckets=(32,),
+                  attention_impl="kernel", prefill_chunk=8)
+    try:
+        seed, _ = eng.generate(shared, max_new_tokens=4)
+        out, _ = eng.generate(branch, max_new_tokens=5)
+        stats = eng.stats
+    finally:
+        eng.stop()
+    assert seed == ref_seed and out == ref
+    assert stats["prefill_gather_admissions"] == 0
+    # 12-token suffix at chunk 8 = two merged chunks + the replay
+    assert stats["prefill_kernel_chunks"] >= 3
+
+
+def test_int8_engine_kernel_parity_cold_and_hit(setup):
+    """int8 pools run the kernel path end to end: decode resolves to
+    the kernel (the old silent downgrade is gone), greedy tokens match
+    the int8 reference engine exactly (same quantized values both
+    ways), cold and through a prefix hit — and, on this model/prompt,
+    the native-pool tokens too (the quantization bound left greedy
+    argmaxes untouched)."""
+    cfg, params = setup
+    outs = {}
+    for impl in ("reference", "kernel"):
+        eng = _engine(cfg, params, kv_dtype="int8", attention_impl=impl)
+        try:
+            cold, _ = eng.generate(PROMPT, max_new_tokens=6)
+            warm, _ = eng.generate(PROMPT, max_new_tokens=6)
+            stats = eng.stats
+        finally:
+            eng.stop()
+        outs[impl] = (cold, warm)
+        assert stats["decode_attn_impl"] == impl
+        if impl == "kernel":
+            assert stats["prefill_gather_admissions"] == 0
+            assert stats["attn_gather_ticks"] == 0
+            assert stats["attn_kernel_ticks"] > 0
+    assert outs["kernel"][0] == outs["reference"][0]
+    assert outs["kernel"][1] == outs["kernel"][0]
+    eng = _engine(cfg, params, attention_impl="kernel")
+    try:
+        native, _ = eng.generate(PROMPT, max_new_tokens=6)
+    finally:
+        eng.stop()
+    assert outs["kernel"][0] == native
+
+
+def test_int8_handoff_parity_and_wire_format(setup):
+    """Disaggregated prefill→decode on quantized pools: the KVHandoff
+    ships int8 pages + f32 scales (never densified to fp32), decode
+    after import matches the single-engine int8 path — cold AND through
+    a prefill-side prefix hit (whose prefix rows are assembled from the
+    pool pages, not a gather). A dtype-mismatched import fails typed."""
+    cfg, params = setup
+    pre = _engine(cfg, params, kv_dtype="int8", attention_impl="kernel")
+    dec = _engine(cfg, params, kv_dtype="int8", attention_impl="kernel")
+    try:
+        # the decode engine's own cold generation is the single-engine
+        # reference (imported handoffs never touch its prefix cache, so
+        # this cannot contaminate the imports below)
+        expect, _ = dec.generate(PROMPT, max_new_tokens=6)
+        handoff = pre.submit_prefill(PROMPT).result(timeout=300)
+        assert handoff.kv_dtype == "int8"
+        assert handoff.kv["k"].dtype == np.int8
+        assert handoff.kv["k_scale"].dtype == np.float32
+        tokens, _ = dec.submit_prefilled(
+            handoff, max_new_tokens=6).result(timeout=300)
+        assert tokens == expect
+        # second prefill = prefix hit on the prefill pool; the handoff
+        # payload must still carry the full prompt KV (prefix rows come
+        # straight from the shared pool pages)
+        hit = pre.submit_prefill(PROMPT).result(timeout=300)
+        assert hit.cached_prefix > 0
+        assert pre.stats["prefill_gather_admissions"] == 0
+        # prefix rows ship straight from the shared pool pages — byte-
+        # identical to what the cold admission inserted there
+        base = hit.cached_prefix
+        np.testing.assert_array_equal(hit.kv["k"][:, :base],
+                                      handoff.kv["k"][:, :base])
+        np.testing.assert_array_equal(hit.kv["k_scale"][:, :base],
+                                      handoff.kv["k_scale"][:, :base])
+        # suffix rows were re-prefilled through the merged kernel path;
+        # deeper layers' KV sees the merge's f32 round-off, so int8
+        # values may flip one quantization step — the tolerance
+        # contract: dequantized agreement within 2 steps
+        for name in ("k", "v"):
+            dq_cold = (handoff.kv[name].astype(np.float32)
+                       * handoff.kv[f"{name}_scale"][..., None])
+            dq_hit = (hit.kv[name].astype(np.float32)
+                      * hit.kv[f"{name}_scale"][..., None])
+            atol = 2 * float(handoff.kv[f"{name}_scale"].max())
+            assert float(np.abs(dq_cold - dq_hit).max()) <= atol
+        tokens_hit, _ = dec.submit_prefilled(
+            hit, max_new_tokens=6).result(timeout=300)
+        assert tokens_hit == expect
+        # typed 400-class rejection on a quantization mismatch
+        native = _engine(cfg, params, attention_impl="kernel")
+        try:
+            with pytest.raises(ValueError, match="dtype mismatch"):
+                native.submit_prefilled(hit, max_new_tokens=6)
+        finally:
+            native.stop()
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+def test_int8_pool_capacity_doubles_at_equal_bytes():
+    """The capacity claim behind the whole int8 prong: at a fixed HBM
+    byte budget an int8 pool holds ~2x the resident pages of a native
+    bf16 pool (int8 values + f32 per-vector scales vs bf16 values; the
+    ratio approaches 2 as head_dim grows — 1.94 at the production
+    head_dim 128)."""
+    cfg = tiny_llama(head_dim=128)
+    page_bytes = {
+        dt: sum(a.nbytes for a in init_paged_pool(
+            cfg, 1, 128, dt).values())
+        for dt in ("native", "int8")}
+    ratio = page_bytes["native"] / page_bytes["int8"]
+    assert ratio >= 1.8
+    budget = 512 * page_bytes["native"]
+    pages_native = budget // page_bytes["native"]
+    pages_int8 = budget // page_bytes["int8"]
+    assert pages_int8 >= 1.8 * pages_native
+
+
+def test_explicit_kernel_engine_raises_typed_without_pallas(
+        setup, monkeypatch):
+    """Engine construction with an explicit kernel request that cannot
+    be honored raises the typed ValueError subclass instead of the old
+    silent downgrade; auto still constructs (reference, warn-once)."""
+    cfg, params = setup
+    monkeypatch.setattr(attn_mod, "_PALLAS_OK", False)
+    monkeypatch.setattr(pattn, "_PALLAS_OK", False)
+    with pytest.raises(pattn.KernelUnavailableError):
+        PagedContinuousBatchingEngine(
+            cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
+            page_size=8, kv_dtype="int8", attention_impl="kernel")
+    monkeypatch.setattr(pattn, "_warned_auto_fallback", False)
+    eng = PagedContinuousBatchingEngine(
+        cfg, params, max_len=64, slots=2, prefill_buckets=(16,),
+        page_size=8, attention_impl="auto")
+    assert eng.attn_impl == "reference"
+    assert eng.paged_prefill_impl == "gather"
+
+
+def test_bench_prefill_smoke():
+    """`make bench-prefill` stays runnable and its acceptance fields
+    hold: zero gather admissions on the kernel arm, parity on both
+    arms, and the int8 pool's ~2x page capacity at the fixed byte
+    budget."""
+    import bench_serve
+
+    result = bench_serve.run_prefill_kernel(
+        requests=4, prefix_tokens=48, suffix_tokens=4, max_new=4,
+        page_size=16, max_len=128, prefixes=3, requests_per_prefix=3,
+        warmup=False)
+    pk = result["prefill_kernel"]
+    assert pk["gather_admissions_on_kernel_arm"] == 0
+    assert pk["kernel"]["cold_vs_hit_parity_ok"]
+    assert pk["gather"]["cold_vs_hit_parity_ok"]
+    assert pk["kernel"]["prefill_kernel_chunks"] > 0
+    assert pk["gather"]["prefill_gather_admissions"] > 0
+    assert pk["hbm_bytes_per_hit_admission_gather"] > 0
+    i8 = result["int8_pool_bytes"]
+    assert i8["capacity_ratio"] >= 1.5  # tiny d=32; 1.94 at d=128
+    assert i8["int8"]["n_pages_at_budget"] \
+        > i8["native"]["n_pages_at_budget"]
+    assert i8["int8"]["prefix_hit_rate"] \
+        >= i8["native"]["prefix_hit_rate"]
