@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -65,6 +65,11 @@ bench-prefill:   ## paged prefill kernel + int8 KV pages A/B: prefix-hit TTFT ke
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --prefill-kernel > BENCH_r15.tmp \
 		&& tail -n 1 BENCH_r15.tmp > BENCH_r15.json \
 		&& rm BENCH_r15.tmp && cat BENCH_r15.json
+
+bench-fleet-elastic: ## pod-elasticity A/B: cold vs pre-warmed ring join p95 TTFT + SLO met/violated through a fake_k8s pod preemption (docs/serving.md "Engine fleet"); rewrites BENCH_r16.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --fleet-elastic > BENCH_r16.tmp \
+		&& tail -n 1 BENCH_r16.tmp > BENCH_r16.json \
+		&& rm BENCH_r16.tmp && cat BENCH_r16.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

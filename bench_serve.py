@@ -529,6 +529,211 @@ def run_fleet(replicas: int = 4, prefixes: int = 12,
     return out
 
 
+def run_fleet_elastic(prefixes: int = 8, requests_per_prefix: int = 3,
+                      prefix_tokens: int = 48, suffix_tokens: int = 8,
+                      max_new: int = 4, page_size: int = 8,
+                      max_len: int = 128, slots: int = 2, seed: int = 0,
+                      n_pages: int | None = None, warmup: bool = True,
+                      slo_factor: float = 8.0) -> dict:
+    """Closed-loop pod-elasticity bench (serving/podfleet.py), no
+    cluster needed — the JobSet lifecycle runs against tests/fake_k8s.
+
+    Phase A (join A/B): a pod joins a warmed single-replica fleet cold
+    (``prewarm_max_keys=0``) vs pre-warmed (reassigned hot keys replayed
+    as ``register_prefix`` imports before the ring join); the measured
+    number is p95 TTFT of the FIRST request per reassigned prefix on
+    the joining replica — the requests a cold join forces back through
+    full prefill.
+
+    Phase B (SLO through a preemption): an autoscaled two-replica fleet
+    takes a pod kill mid-stream; the SLO target derives from the
+    unloaded warm p50 (``slo_factor`` ×, machine-independent) and the
+    met/violated split is reported before, during (one replica,
+    reassigned keys cold on the survivor) and after recovery (the
+    replacement joined pre-warmed). Every admitted request must
+    complete — ``dropped_requests`` is the no-drop acceptance count."""
+    import sys
+
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs import REGISTRY
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+    from mlrun_tpu.serving.podfleet import ServingPodFleet
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+    from tests import fake_k8s
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(16, max_len), max_len}))
+    # unlike run_fleet's deliberately-starved pools, this A/B isolates
+    # JOIN warmth — the pool must hold the whole hot prefix set or LRU
+    # churn (not cold bring-up) dominates both arms
+    if n_pages is None:
+        chain = -(-(prefix_tokens + suffix_tokens + max_new) // page_size)
+        n_pages = max(32, prefixes * (chain + 2))
+
+    def make_factory(engines):
+        def factory(role):
+            engine = PagedContinuousBatchingEngine(
+                config, params, max_len=max_len, slots=slots,
+                page_size=page_size, n_pages=n_pages,
+                prefill_buckets=buckets)
+            if warmup:
+                engine.warmup()
+            engines.append(engine)
+            return engine
+
+        return factory
+
+    def prompt_of(length):
+        return rng.integers(0, config.vocab_size, length).tolist()
+
+    families = [prompt_of(prefix_tokens) for _ in range(prefixes)]
+
+    def workload():
+        out = []
+        for _ in range(requests_per_prefix):
+            for family in families:
+                out.append(family + prompt_of(suffix_tokens))
+        return out
+
+    dropped = 0
+    pod_names: list = []
+
+    def complete(fleet, prompts):
+        nonlocal dropped
+        ttfts = []
+        for prompt in prompts:
+            try:
+                _, stats = fleet.generate(prompt, max_new_tokens=max_new,
+                                          timeout=600)
+                ttfts.append(stats["ttft_s"])
+            except Exception:  # noqa: BLE001 - a drop is the finding
+                dropped += 1
+        return ttfts
+
+    def join_drill(provider, prewarm_keys):
+        """Warm a 1-replica fleet, join one pod (cold or pre-warmed),
+        then measure the first request per REASSIGNED prefix family."""
+        engines: list = []
+        factory = make_factory(engines)
+        fleet = EngineFleet(factory, replicas=1,
+                            route_block_tokens=page_size)
+        fleet.start()
+        pods = ServingPodFleet(fleet, provider, factory,
+                               prewarm_max_keys=prewarm_keys)
+        try:
+            complete(fleet, workload())  # owner cache + hot keys
+            pod_names.append(pods.scale_up("unified"))
+            for _ in range(3):  # pending -> warming -> ready -> joined
+                pods.tick()
+            rid = next(rec["rid"] for rec in pods._pods.values())
+            joiner = engines[-1]
+            moved = [family for family in families
+                     if fleet._ring.lookup(
+                         fleet.routing_key(family)) == rid]
+            hits_before = joiner.stats.get("prefix_hits", 0)
+            ttfts = complete(
+                fleet, [family + prompt_of(suffix_tokens)
+                        for family in moved])
+            hits = joiner.stats.get("prefix_hits", 0) - hits_before
+            return {
+                "reassigned_keys": len(moved),
+                "prefix_hit_rate": round(hits / len(moved), 3)
+                if moved else 0.0,
+                "p95_ttft_ms": round(
+                    _percentile(ttfts, 0.95) * 1000, 2),
+                "p50_ttft_ms": round(
+                    _percentile(ttfts, 0.50) * 1000, 2),
+            }
+        finally:
+            fleet.stop()
+            for rec in list(pods._pods.values()):
+                pods._retire(rec)
+
+    def preemption_drill(provider, cluster):
+        """Autoscaled fleet through a pod kill: SLO met/violated
+        before, during (one replica), and after recovery."""
+        engines: list = []
+        factory = make_factory(engines)
+        fleet = EngineFleet(factory, replicas=1,
+                            route_block_tokens=page_size)
+        fleet.start()
+        pods = ServingPodFleet(fleet, provider, factory)
+        scaler = FleetAutoscaler(
+            fleet, pods=pods, dry_run=False, min_replicas=2,
+            max_replicas=3, hysteresis_ticks=1, cooldown_up_s=0.0,
+            cooldown_down_s=1e9, drain_grace_s=5.0, queue_low=0.0,
+            queue_high=1e9)
+        try:
+            complete(fleet, workload())   # hot keys before the join
+            now = 0.0
+            for _ in range(4):            # scale_up + 3 lifecycle ticks
+                scaler.tick(now)
+                now += 1.0
+            pod = next(iter(pods.pods()))
+            pod_names.append(pod)
+            before = complete(fleet, workload())
+            slo_s = slo_factor * _percentile(before, 0.50)
+            cluster.kill_pod(pod)
+            scaler.tick(now)              # preempt + replacement submit
+            now += 1.0
+            during = complete(fleet, workload())
+            for _ in range(3):            # replacement warms and joins
+                scaler.tick(now)
+                now += 1.0
+            pod_names.extend(name for name in pods.pods()
+                             if name not in pod_names)
+            after = complete(fleet, workload())
+
+            def split(ttfts):
+                met = sum(1 for t in ttfts if t <= slo_s)
+                return {"met": met, "violated": len(ttfts) - met,
+                        "p95_ttft_ms": round(
+                            _percentile(ttfts, 0.95) * 1000, 2)}
+
+            return {"slo_target_ms": round(slo_s * 1000, 2),
+                    "before": split(before), "during": split(during),
+                    "after": split(after)}
+        finally:
+            fleet.stop()
+            for rec in list(pods._pods.values()):
+                pods._retire(rec)
+
+    # the fake cluster stands in for the kubernetes module for the whole
+    # bench (the provider seam is identical either way)
+    saved = sys.modules.get("kubernetes")
+    cluster = fake_k8s.FakeCluster()
+    sys.modules["kubernetes"] = fake_k8s.make_fake_kubernetes(cluster)
+    try:
+        from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+        provider = KubernetesProvider(namespace="bench")
+        cold = join_drill(provider, prewarm_keys=0)
+        prewarmed = join_drill(provider, prewarm_keys=64)
+        preemption = preemption_drill(provider, cluster)
+    finally:
+        if saved is None:
+            sys.modules.pop("kubernetes", None)
+        else:
+            sys.modules["kubernetes"] = saved
+    rendered = REGISTRY.render()
+    leaked = sum(1 for name in pod_names if name in rendered)
+    out = {"prefixes": prefixes, "prefix_tokens": prefix_tokens,
+           "page_size": page_size, "n_pages": n_pages, "model": "tiny",
+           "cold_join": cold, "prewarmed_join": prewarmed,
+           "preemption": preemption,
+           "dropped_requests": dropped, "leaked_series": leaked}
+    out["p95_ttft_speedup"] = round(
+        cold["p95_ttft_ms"] / prewarmed["p95_ttft_ms"], 2) \
+        if prewarmed["p95_ttft_ms"] > 0 else None
+    return out
+
+
 def run_autoscale(min_replicas: int = 1, max_replicas: int = 4,
                   slots: int = 2, page_size: int = 32, max_len: int = 128,
                   prompt_tokens: int = 48, max_new: int = 4,
@@ -1053,6 +1258,10 @@ def main(argv=None):
     parser.add_argument("--prefill-kernel", action="store_true",
                         help="run the paged prefill kernel + int8 KV "
                              "pages A/B instead")
+    parser.add_argument("--fleet-elastic", action="store_true",
+                        help="run the pod-elasticity bench (cold vs "
+                             "pre-warmed join, SLO through a "
+                             "preemption) instead")
     parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
     # the prefix-cache bench stresses ONE engine with long prompts,
@@ -1074,7 +1283,13 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.prefill_kernel:
+    if args.fleet_elastic:
+        result = run_fleet_elastic(
+            prefixes=args.prefixes,
+            requests_per_prefix=args.requests_per_prefix,
+            **overrides(prefix_tokens=48, suffix_tokens=8, max_new=4,
+                        page_size=8, max_len=128))
+    elif args.prefill_kernel:
         result = run_prefill_kernel(
             requests=args.requests, prefixes=args.prefixes,
             requests_per_prefix=args.requests_per_prefix,

@@ -47,6 +47,24 @@ class FaultPoints:
     # custom-object patch (JobSet suspend/resume, slice replacement) —
     # fired by the fake cluster's patch verb like the verbs above
     k8s_patch = "k8s.patch"
+    # out-of-band pod eviction (tests/fake_k8s.py kill_pod) — the
+    # serving-pod preemption drill's entry point: the pod record
+    # vanishes, the next liveness probe 404s
+    k8s_pod_kill = "k8s.pod_kill"
+    # serving-pod lifecycle (serving/podfleet.py ServingPodFleet):
+    # one /readyz probe of a warming pod — an error models a readiness
+    # flap (the probe fails, the pod stays out of the ring)
+    fleet_pod_ready = "fleet.pod_ready"
+    # one pod pre-warm pass (adapter working set + compile cache +
+    # reassigned-prefix KV replay) — a delay() models a slow warm-up,
+    # an error a failed pre-warm (the pod still joins, cold)
+    fleet_prewarm = "fleet.prewarm"
+    # one ring join of a ready pod replica — a delay() models a slow
+    # join (keys keep routing to survivors meanwhile)
+    fleet_join = "fleet.join"
+    # one pod drain start (scale-down / preemption) — an error models a
+    # drain endpoint that cannot be reached before deletion
+    fleet_drain = "fleet.drain"
     # execution-resource providers (service/providers.py)
     provider_create = "provider.create"
     provider_state = "provider.state"
@@ -122,6 +140,9 @@ class FaultPoints:
         return [
             FaultPoints.k8s_create, FaultPoints.k8s_read,
             FaultPoints.k8s_delete, FaultPoints.k8s_patch,
+            FaultPoints.k8s_pod_kill,
+            FaultPoints.fleet_pod_ready, FaultPoints.fleet_prewarm,
+            FaultPoints.fleet_join, FaultPoints.fleet_drain,
             FaultPoints.provider_create,
             FaultPoints.provider_state, FaultPoints.provider_delete,
             FaultPoints.provider_replace_slice,

@@ -15,9 +15,15 @@ from __future__ import annotations
 
 import math
 
+from ..common.runtimes_constants import COMPILE_CACHE_ENV
 from ..config import mlconf
 
 JOBSET_API_VERSION = "jobset.x-k8s.io/v1alpha2"
+
+# marks a JobSet as a SERVING pod-slice (serving/podfleet.py): the fake
+# cluster auto-materializes its pods on create, and the pod fleet's
+# lifecycle (prewarm -> readyz -> ring join -> drain -> delete) applies
+SERVING_ANNOTATION = "mlrun-tpu/serving"
 
 
 class TopologyError(ValueError):
@@ -166,3 +172,55 @@ def build_jobset(name: str, namespace: str, pod_spec: dict, *,
             ],
         },
     }
+
+
+def build_serving_jobset(name: str, namespace: str, pod_spec: dict, *,
+                         accelerator: str, topology: str,
+                         chips_per_host: int | None = None,
+                         compile_cache_dir: str | None = None,
+                         serve_port: int = 8080,
+                         labels: dict | None = None,
+                         annotations: dict | None = None) -> dict:
+    """Build the JobSet for ONE serving pod-slice (serving/podfleet.py).
+
+    A serving replica is a single-slice JobSet (one engine per
+    pod-slice, scaled by submitting/deleting whole JobSets — the
+    autoscaler's unit of elasticity), differing from a training JobSet
+    in its lifecycle contract:
+
+    - ``SERVING_ANNOTATION`` marks it for the pod fleet's state machine
+      (and the fake cluster's pod auto-materialization in tests);
+    - the readiness probe hits ``/readyz``, which gates on WARMTH
+      (engine warmup + adapter prefetch done — serving/server.py), so
+      k8s never routes to a cold pod and the ring join waits for it;
+    - a ``preStop`` hook POSTs ``/__drain__`` so an eviction runs the
+      graceful drain (in-flight requests finish or re-dispatch) before
+      the kubelet sends SIGTERM;
+    - ``compile_cache_dir`` rides in as ``COMPILE_CACHE_ENV`` so the
+      replacement pod loads its executables from the shared cache
+      instead of recompiling (the PR 5 warm-start path).
+    """
+    annotations = dict(annotations or {})
+    annotations[SERVING_ANNOTATION] = "true"
+    spec = build_jobset(name, namespace, pod_spec,
+                        accelerator=accelerator, topology=topology,
+                        num_slices=1, chips_per_host=chips_per_host,
+                        labels=labels, annotations=annotations)
+    pod = (spec["spec"]["replicatedJobs"][0]["template"]["spec"]
+           ["template"]["spec"])
+    containers = pod.get("containers", [])
+    if containers:
+        main = containers[0]
+        if compile_cache_dir:
+            env = main.setdefault("env", [])
+            env.append({"name": COMPILE_CACHE_ENV,
+                        "value": str(compile_cache_dir)})
+        main["readinessProbe"] = {
+            "httpGet": {"path": "/readyz", "port": serve_port},
+            "periodSeconds": 2,
+            "failureThreshold": 3,
+        }
+        main.setdefault("lifecycle", {})["preStop"] = {
+            "httpGet": {"path": "/__drain__", "port": serve_port},
+        }
+    return spec

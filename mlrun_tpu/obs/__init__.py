@@ -192,6 +192,22 @@ FLEET_HANDOFF_LATENCY = REGISTRY.histogram(
 FLEET_REPLICAS = REGISTRY.gauge(
     "mlt_fleet_replicas", "Live fleet replicas by role",
     labels=("role",), overflow="drop")
+FLEET_POD_EVENTS = REGISTRY.counter(
+    "mlt_fleet_pod_events_total",
+    "Serving-pod lifecycle transitions (serving/podfleet.py): scale_up /"
+    " prewarm / ready / join / kill / redispatch / drain / delete",
+    labels=("pod", "event"), max_label_sets=512, overflow="drop")
+FLEET_POD_PHASE = REGISTRY.gauge(
+    "mlt_fleet_pod_phase",
+    "Serving-pod state-machine phase (0 pending, 1 warming, 2 ready, "
+    "3 joined, 4 draining; the series is retired on delete)",
+    labels=("pod",), max_label_sets=512, overflow="drop")
+FLEET_POD_PREWARM_SECONDS = REGISTRY.histogram(
+    "mlt_fleet_pod_prewarm_seconds",
+    "Pod pre-warm wall (adapter working set + engine warmup + "
+    "reassigned-prefix KV replay) before the ring join",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0))
 
 # -- model monitoring / continuous tuning (model_monitoring/,
 # serving/canary.py — docs/continuous_tuning.md) -----------------------------

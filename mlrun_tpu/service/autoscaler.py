@@ -59,7 +59,8 @@ class FleetAutoscaler:
     """
 
     def __init__(self, fleet, store=None, aggregator=None,
-                 slo=None, ttft_window: float = 60.0, **overrides):
+                 slo=None, ttft_window: float = 60.0, pods=None,
+                 **overrides):
         conf = mlconf.serving.autoscale
         def knob(name, cast=float):
             if name in overrides:
@@ -69,6 +70,11 @@ class FleetAutoscaler:
         self.fleet = fleet
         self.store = store
         self.aggregator = aggregator
+        # cross-process elasticity (serving/podfleet.ServingPodFleet):
+        # when set, scale actions submit/drain serving JobSets instead
+        # of building in-process replicas, and every tick advances the
+        # pod lifecycle state machine
+        self.pods = pods
         self.dry_run = knob("dry_run", bool)
         self.min_replicas = knob("min_replicas", int)
         self.max_replicas = knob("max_replicas", int)
@@ -176,7 +182,7 @@ class FleetAutoscaler:
         bad = max(0, (counts["failed"] - last["failed"])
                   + (counts["no_replica"] - last["no_replica"]))
         total = max(0, sum(counts.values()) - sum(last.values()))
-        return {
+        out = {
             "replicas": count,
             "draining": len(self._draining),
             "load_total": load_total,
@@ -185,11 +191,24 @@ class FleetAutoscaler:
             "ttft_p95_s": ttft_p95,
             "dispatch_failure_rate": bad / total if total else 0.0,
         }
+        if self.pods is not None:
+            # capacity already on its way into the ring — a pod takes
+            # ticks to warm and join, and the loop must not stack
+            # scale-ups while one is in flight
+            out["pods_pending"] = self.pods.pending_count()
+        return out
 
     # -- decision loop -------------------------------------------------------
     def _evaluate(self, sig: dict) -> tuple[str, str]:
         """Raw (action, reason) from thresholds — before hysteresis,
         cooldown, and bounds."""
+        # capacity repair: a preempted pod dropped the fleet below its
+        # floor — replace it regardless of load (tick() treats this as
+        # forced: hysteresis and cooldown are for demand decisions, not
+        # for repairing paid-for minimum capacity)
+        if sig["replicas"] + sig.get("pods_pending", 0) \
+                < self.min_replicas:
+            return "up", "below_min"
         reasons = []
         if sig["load_per_replica"] > self.queue_high:
             reasons.append("queue_depth")
@@ -225,12 +244,17 @@ class FleetAutoscaler:
         advance draining replicas toward removal. Deterministic — no
         internal clock reads, no sleeps."""
         with self._lock:
+            if self.pods is not None:
+                # advance the pod lifecycle FIRST so the signals below
+                # see fresh ring membership (a preempted pod is already
+                # out, a warmed pod already joined)
+                self.pods.tick(now)
             sig = self.signals(now, advance=True)
             action, reason = self._evaluate(sig)
             box = {"action": action, "reason": reason, "force": False}
             fire(FaultPoints.obs_autoscale, box=box, signals=sig, now=now)
             action, reason = box["action"], box["reason"]
-            forced = bool(box["force"])
+            forced = bool(box["force"]) or reason == "below_min"
 
             if action == "up":
                 self._up_streak += 1
@@ -242,12 +266,14 @@ class FleetAutoscaler:
                 self._up_streak = self._down_streak = 0
 
             current = sig["replicas"]
+            pending = sig.get("pods_pending", 0)
             streak = (self._up_streak if action == "up"
                       else self._down_streak)
             recommended = action != "hold" and (
                 forced or streak >= self.hysteresis_ticks)
             bounded = recommended and (
-                (action == "up" and current < self.max_replicas)
+                (action == "up"
+                 and current + pending < self.max_replicas)
                 or (action == "down" and current > self.min_replicas))
             desired = current
             if bounded:
@@ -270,6 +296,15 @@ class FleetAutoscaler:
 
     def _act(self, action: str, now: float) -> Optional[dict]:
         if action == "up":
+            if self.pods is not None:
+                # cross-process: submit a serving JobSet; the pod joins
+                # the ring ticks later, after pre-warm + readiness
+                pod = self.pods.scale_up(self._worker_role(), now)
+                AUTOSCALER_ACTIONS.inc(action="add")
+                self._last_action_at = now
+                self._up_streak = 0
+                logger.info("autoscaler submitted serving pod", pod=pod)
+                return {"action": "add", "pod": pod}
             rid = self.fleet.add_replica(self._worker_role())
             AUTOSCALER_ACTIONS.inc(action="add")
             self._last_action_at = now
@@ -279,7 +314,12 @@ class FleetAutoscaler:
         victim = self._scale_down_victim()
         if victim is None:
             return None
-        self.fleet.drain_replica(victim.id)
+        if self.pods is not None and self.pods.owns(victim.id):
+            # drain-before-delete through the pod's /__drain__ path;
+            # the sweep deletes the JobSet once in-flight work drains
+            self.pods.drain(victim.id, now)
+        else:
+            self.fleet.drain_replica(victim.id)
         self._draining[victim.id] = now
         AUTOSCALER_ACTIONS.inc(action="drain")
         self._last_action_at = now
@@ -322,6 +362,9 @@ class FleetAutoscaler:
             if busy and now - since < self.drain_grace_s:
                 continue
             self.fleet.remove_replica(rid)
+            if self.pods is not None:
+                # delete the drained pod's JobSet + retire its series
+                self.pods.on_replica_removed(rid)
             if self.store is not None:
                 # the engine retires its registry series on stop; the
                 # windowed store keeps its own rings, so retire the

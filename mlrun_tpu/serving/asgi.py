@@ -62,17 +62,22 @@ def build_serving_app(server: GraphServer) -> web.Application:
         app["latencies"].append(elapsed)
         if len(app["latencies"]) > 10000:
             del app["latencies"][:5000]
+        headers = None
         if isinstance(result, Response):
             payload = result.body
             status = result.status_code
+            # thread server-set response headers through (e.g. the 503
+            # drain rejection's Retry-After backoff hint)
+            headers = {str(k): str(v)
+                       for k, v in (result.headers or {}).items()} or None
         else:
             payload = result
             status = 200
         if isinstance(payload, (bytes, str)):
             return web.Response(
                 body=payload if isinstance(payload, bytes)
-                else payload.encode(), status=status)
-        return web.json_response(payload, status=status,
+                else payload.encode(), status=status, headers=headers)
+        return web.json_response(payload, status=status, headers=headers,
                                  dumps=lambda d: json.dumps(d, default=str))
 
     # probe/scrape endpoints count themselves on one dedicated low-cost
@@ -100,11 +105,19 @@ def build_serving_app(server: GraphServer) -> web.Application:
 
     async def readyz(request):
         # readiness: flips 503 the moment drain starts so the load
-        # balancer stops routing before in-flight events finish
+        # balancer stops routing before in-flight events finish — and
+        # stays 503 while the replica warms (ready means warm; the
+        # fleet's ring join gates on this). The 503 carries a
+        # Retry-After hint so the prober backs off on schedule.
         _probe("/readyz")
         payload = server.readyz()
+        if payload["ready"]:
+            return web.json_response(payload)
+        from .resilience import retry_after_hint
+
         return web.json_response(
-            payload, status=200 if payload["ready"] else 503)
+            payload, status=503,
+            headers={"Retry-After": f"{retry_after_hint():.3f}"})
 
     async def drain(request):
         # operational drain hook (the preemption path uses
@@ -251,6 +264,15 @@ def serve(function=None, spec: dict | None = None, host: str = "0.0.0.0",
 
     guard = PreemptionGuard().install()
     server.drain_on_preemption(guard)
+    # ready-means-warm: /readyz answers 503 until the warmup pass
+    # (engine compile-or-cache-load + adapter prefetch) finishes in the
+    # background — the pod serves probes immediately but takes traffic
+    # only warm (docs/serving.md "Engine fleet")
+    server.begin_warmup()
+    import threading
+
+    threading.Thread(target=server.warmup, name="serving-warmup",
+                     daemon=True).start()
     logger.info("serving graph gateway starting", host=host, port=port)
     # handle_signals=False: run_app would otherwise re-register SIGTERM
     # (loop.add_signal_handler -> GracefulExit) over the guard's handler

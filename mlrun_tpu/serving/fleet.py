@@ -44,9 +44,11 @@ import hashlib
 import random
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Callable, Optional
 
+from ..chaos import FaultPoints, fire
 from ..common.retry import RetryPolicy, compute_backoff
 from ..config import mlconf
 from ..obs import (
@@ -178,12 +180,16 @@ class EngineReplica:
         self.engine = engine
         self.role = role
         self.draining = False
+        # deferred ring join (serving/podfleet.py): a joining replica is
+        # registered (visible in stats, warm-able) but takes NO ring
+        # traffic until join_replica() flips this — ready means warm
+        self.joining = False
         # stamp the replica label BEFORE the engine registers metrics
         engine.replica = replica_id
 
     @property
     def healthy(self) -> bool:
-        return not self.draining and not getattr(
+        return not self.draining and not self.joining and not getattr(
             self.engine, "_stopped", False)
 
     def load(self) -> int:
@@ -258,6 +264,12 @@ class EngineFleet:
                        "no_replica": 0, "handoffs": 0, "handoff_bytes": 0}
         self._ttft_ring: list = []            # end-to-end, bounded below
         self._ttft_ring_max = 512
+        # hot routing keys (bounded LRU): key -> (prompt, route_adapter).
+        # A joining pod replays its REASSIGNED slice of these as pre-warm
+        # prefills (serving/podfleet.py) so its first real request on a
+        # moved key is a prefix-cache hit
+        self._hot_keys: OrderedDict = OrderedDict()
+        self._hot_keys_max = 256
         # pools: unified fleets route over _workers; disaggregated fleets
         # affinity-route prefills over _prefill and place decodes
         # least-loaded over _workers
@@ -286,16 +298,18 @@ class EngineFleet:
         return self._prefill if self._prefill else self._workers
 
     def _sync_ring(self):
-        """Ring membership == non-draining routing-pool membership.
-        Caller holds the lock. Adding the first prefill replica flips the
-        routing pool from workers to prefill; the sweep keeps the ring
-        consistent through that flip and through drains."""
+        """Ring membership == non-draining, non-joining routing-pool
+        membership. Caller holds the lock. Adding the first prefill
+        replica flips the routing pool from workers to prefill; the sweep
+        keeps the ring consistent through that flip, through drains, and
+        through deferred pod joins."""
         route = self._route_pool()
         for node in list(self._ring.nodes()):
-            if node not in route or route[node].draining:
+            if node not in route or route[node].draining \
+                    or route[node].joining:
                 self._ring.remove(node)
         for rid, replica in route.items():
-            if not replica.draining:
+            if not replica.draining and not replica.joining:
                 self._ring.add(rid)
 
     @property
@@ -304,15 +318,23 @@ class EngineFleet:
             return list(self._workers.values()) + list(
                 self._prefill.values())
 
-    def add_replica(self, role: str = "unified") -> str:
-        """Scale up: build + ring-join one replica (keys move ~1/N)."""
+    def add_replica(self, role: str = "unified", engine=None,
+                    joined: bool = True) -> str:
+        """Scale up: build + ring-join one replica (keys move ~1/N).
+        ``engine`` adopts an externally built engine (a pod-backed
+        client, serving/podfleet.py) instead of calling the factory;
+        ``joined=False`` registers the replica WITHOUT ring membership —
+        it takes no traffic until :meth:`join_replica`, so a pod can
+        pre-warm behind the ring."""
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(f"unknown replica role '{role}'")
         with self._lock:
             rid = f"{self._fleet_id}-{role[0]}{self._replica_seq}"
             self._replica_seq += 1
-            engine = self._factory(role)
+            if engine is None:
+                engine = self._factory(role)
             replica = EngineReplica(rid, engine, role)
+            replica.joining = not joined
             pool = self._prefill if role == "prefill" else self._workers
             pool[rid] = replica
             self._sync_ring()
@@ -321,8 +343,25 @@ class EngineFleet:
             if self._started:
                 engine.start()
         logger.info("fleet replica added", replica=rid, role=role,
-                    fleet=self._fleet_id)
+                    fleet=self._fleet_id, joined=joined)
         return rid
+
+    def join_replica(self, replica_id: str):
+        """Flip a deferred-join replica into the ring (its ~1/N keyspace
+        slice moves here). Fires ``fleet.join`` first: an injected delay
+        models a slow join (keys keep routing to survivors meanwhile),
+        an injected error keeps the replica out of the ring."""
+        fire(FaultPoints.fleet_join, replica=replica_id,
+             fleet=self._fleet_id)
+        with self._lock:
+            for pool in (self._workers, self._prefill):
+                if replica_id in pool:
+                    pool[replica_id].joining = False
+                    self._sync_ring()
+                    logger.info("fleet replica joined ring",
+                                replica=replica_id, fleet=self._fleet_id)
+                    return
+        raise KeyError(f"unknown replica '{replica_id}'")
 
     def remove_replica(self, replica_id: str):
         """Scale down: ring-leave (only this replica's keys move), stop
@@ -390,6 +429,24 @@ class EngineFleet:
         return block_chain_key(prompt_tokens, self.route_block_tokens,
                                max_blocks=self.route_blocks,
                                adapter=adapter)
+
+    def reassigned_hot_keys(self, candidate: str) -> list:
+        """The hot keys whose ring owner WOULD become ``candidate`` if it
+        joined now — exactly the prefix working set a joining pod takes
+        over, so pre-warm (serving/podfleet.py) replays these and nothing
+        else. Returns ``[(key, prompt, adapter), ...]`` hottest-last
+        (LRU order)."""
+        with self._lock:
+            probe = ConsistentHashRing(vnodes=self._ring.vnodes)
+            for node in self._ring.nodes():
+                probe.add(node)
+            probe.add(candidate)
+            items = list(self._hot_keys.items())
+        out = []
+        for key, (prompt, adapter) in items:
+            if probe.lookup(key) == candidate:
+                out.append((key, prompt, adapter))
+        return out
 
     def _pick(self, pool: dict, key: int, tried: list,
               affinity: bool) -> Optional[EngineReplica]:
@@ -468,6 +525,12 @@ class EngineFleet:
             "trace": ((span.trace_id, span.span_id)
                       if span is not None else None),
         }
+        with self._lock:
+            self._hot_keys[state["key"]] = (state["prompt"],
+                                            state["adapter"])
+            self._hot_keys.move_to_end(state["key"])
+            while len(self._hot_keys) > self._hot_keys_max:
+                self._hot_keys.popitem(last=False)
         if self._prefill:
             self._dispatch_prefill(out, state)
         else:
@@ -541,14 +604,20 @@ class EngineFleet:
         timing["attribution_closed"] = True
         stats["timing"] = timing
 
-    def _retry_later(self, out: Future, state: dict, redo: Callable):
+    def _retry_later(self, out: Future, state: dict, redo: Callable,
+                     exc: Exception | None = None):
         """Deterministic-jitter backoff off-thread: the done-callback
         runs on a replica's scheduler thread, which must never sleep.
-        The delay is remembered so the final timing attributes it to
-        the ``redispatch_backoff`` phase (obs/reqledger.py)."""
+        A server-supplied ``Retry-After`` hint riding the failure
+        (``exc.retry_after_s``) wins over the local schedule — the
+        replica knows its own drain/recovery timeline better than the
+        client's blind exponential. The delay is remembered so the final
+        timing attributes it to the ``redispatch_backoff`` phase
+        (obs/reqledger.py)."""
         with self._lock:
             self._stats["redispatches"] += 1
-        delay = compute_backoff(
+        hint = getattr(exc, "retry_after_s", None)
+        delay = float(hint) if hint is not None else compute_backoff(
             state["attempts"] - 1, self._retry_policy,
             seed=f"fleet:{state['key']}")
         state["backoff_s"] = state.get("backoff_s", 0.0) + delay
@@ -560,10 +629,17 @@ class EngineFleet:
         with self._lock:
             self._stats["no_replica"] += 1
         FLEET_DISPATCHES.inc(replica="", outcome="no_replica")
+        # a jitter-free Retry-After derived from the same schedule the
+        # fleet retries on: an upstream honoring it lands just after
+        # capacity could have returned, instead of hammering blind
+        hint = compute_backoff(
+            min(state["attempts"], self.max_dispatch_attempts - 1),
+            self._retry_policy, seed="retry-after")
         self._fail(out, state, ReplicaUnavailableError(
             f"no healthy {pool} replica left after "
             f"{state['attempts']} attempt(s) "
-            f"(tried {state['tried'] or state['tried_decode']})"))
+            f"(tried {state['tried'] or state['tried_decode']})",
+            retry_after_s=hint))
 
     def _budget_left(self, out: Future, state: dict,
                      exc: Exception) -> bool:
@@ -612,8 +688,69 @@ class EngineFleet:
                            replica=replica.id, error=str(exc),
                            attempt=state["attempts"] + 1)
             if self._budget_left(out, state, exc):
+                # a preempted replica may have exported the decode state
+                # (ReplicaPreemptedError.handoff): resume it on a
+                # survivor via submit_prefilled instead of re-prefilling
+                handoff = getattr(exc, "handoff", None)
+                if handoff is not None:
+                    state["handoff"] = handoff
+                    redo = lambda: self._dispatch_handoff(out, state)  # noqa: E731
+                else:
+                    redo = lambda: self._dispatch_unified(out, state)  # noqa: E731
+                self._retry_later(out, state, redo, exc=exc)
+            return
+        FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._fail(out, state, exc)
+
+    def _dispatch_handoff(self, out: Future, state: dict):
+        """Resume a preempted request on a survivor: the dying replica
+        exported the decode state as a :class:`KVHandoff` (riding the
+        :class:`ReplicaPreemptedError`), so the survivor imports it and
+        decodes — no re-prefill, no dropped admitted request."""
+        try:
+            replica = self._pick(self._workers, state["key"],
+                                 state["tried"], affinity=True)
+            if replica is None:
+                self._no_replica(out, state, "fleet")
+                return
+            state["tried"].append(replica.id)
+            handoff = state["handoff"]
+            with self._lock:
+                self._stats["handoffs"] += 1
+                self._stats["handoff_bytes"] += handoff.nbytes()
+            FLEET_HANDOFF_BYTES.inc(handoff.nbytes())
+            inner = replica.engine.submit_prefilled(
+                handoff, max_new_tokens=state["max_new"],
+                eos_id=state["eos_id"], max_wait=state["max_wait"],
+                _trace=state["trace"])
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            self._fail(out, state, exc)
+            return
+        inner.add_done_callback(
+            lambda fut: self._handoff_done(out, state, replica, fut))
+
+    def _handoff_done(self, out: Future, state: dict,
+                      replica: EngineReplica, fut: Future):
+        exc = fut.exception()
+        if exc is None:
+            tokens, stats = fut.result()
+            stats = dict(stats)
+            handoff = state["handoff"]
+            FLEET_HANDOFF_LATENCY.observe(stats.get("ttft_s", 0.0))
+            stats["handoff_bytes"] = handoff.nbytes()
+            stats["cached_prefix"] = handoff.cached_prefix
+            stats["resumed_via_handoff"] = True
+            self._finalize(out, state, replica, tokens, stats)
+            return
+        if redispatchable(exc):
+            FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            newer = getattr(exc, "handoff", None)
+            if newer is not None:
+                state["handoff"] = newer
+            if self._budget_left(out, state, exc):
                 self._retry_later(
-                    out, state, lambda: self._dispatch_unified(out, state))
+                    out, state,
+                    lambda: self._dispatch_handoff(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
         self._fail(out, state, exc)
@@ -655,7 +792,8 @@ class EngineFleet:
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
             if self._budget_left(out, state, exc):
                 self._retry_later(
-                    out, state, lambda: self._dispatch_prefill(out, state))
+                    out, state,
+                    lambda: self._dispatch_prefill(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
         self._fail(out, state, exc)
@@ -700,11 +838,16 @@ class EngineFleet:
             return
         if redispatchable(exc):
             # the handoff is plain host data — replayable on the next
-            # decode replica without touching the prefill pool again
+            # decode replica without touching the prefill pool again; a
+            # preempted decode replica may ship back a FRESHER handoff
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            newer = getattr(exc, "handoff", None)
+            if newer is not None:
+                state["handoff"] = newer
             if self._budget_left(out, state, exc):
                 self._retry_later(
-                    out, state, lambda: self._dispatch_decode(out, state))
+                    out, state,
+                    lambda: self._dispatch_decode(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
         self._fail(out, state, exc)
@@ -762,6 +905,7 @@ class EngineFleet:
             per[replica.id] = {
                 "role": replica.role,
                 "draining": replica.draining,
+                "joining": replica.joining,
                 "requests": stats.get("requests", 0),
                 "completed": stats.get("completed", 0),
                 "queue_depth": stats.get("queue_depth", 0),

@@ -24,7 +24,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dataclass_replace
 from typing import Optional
 
 import jax
@@ -218,6 +218,13 @@ class KVHandoff:
     timing: Optional[dict] = None  # prefill-side phase-ledger summary
     #                              (obs/reqledger.py) the fleet merges
     #                              into the request's end-to-end timing
+    prewarm: bool = False        # pre-warm replay (serving/podfleet.py):
+    #                              the importing engine REGISTERS the
+    #                              imported pages in its prefix index so
+    #                              the reassigned key's first real
+    #                              request is a cache hit (a plain
+    #                              decode-pool import never registers —
+    #                              that pool serves no prefills)
 
     def nbytes(self) -> int:
         return int(sum(arr.nbytes for arr in self.kv.values()))
@@ -267,6 +274,9 @@ class _Admission:
     # KV (imported handoff) and skips the prefill dispatch entirely
     export: bool = False
     prefilled: bool = False
+    # prewarm import: register the imported pages in the prefix index
+    # (see KVHandoff.prewarm)
+    register_import: bool = False
     # multi-tenant LoRA: the request's adapter name and its device bank
     # slot (resolved at admission by AdapterRegistry.ensure_loaded)
     adapter: str = ""
@@ -1059,13 +1069,17 @@ class ContinuousBatchingEngine:
                          max_new_tokens: int = 64,
                          eos_id: int | None = None,
                          max_wait: float | None = None,
+                         register_prefix: bool = False,
                          _trace=None) -> Future:
         """Admit an already-prefilled request: the handoff's KV is imported
         into the admission slot-cache and decode starts immediately — no
         prefill dispatch ever runs on this engine, so a decode pool's tick
         cadence is immune to fleet-wide long prompts. The handoff carries
         its adapter id: decode runs under the SAME adapter the KV was
-        computed with."""
+        computed with. ``register_prefix`` is the pre-warm replay path
+        (serving/podfleet.py): the imported prompt pages ALSO register in
+        this engine's prefix index, so a reassigned hot key's first real
+        request after a ring join is a cache hit."""
         expects_scales = self.kv_dtype == "int8"
         wire_dtype = getattr(handoff, "kv_dtype", None) or (
             "int8" if "k_scale" in handoff.kv else "native")
@@ -1077,6 +1091,8 @@ class ContinuousBatchingEngine:
                 f"payload — prefill and decode pools must quantize "
                 f"alike (docs/serving.md 'Engine fleet')")
         temperature, top_k, top_p = handoff.sampling
+        if register_prefix and not handoff.prewarm:
+            handoff = dataclass_replace(handoff, prewarm=True)
         return self.submit(handoff.prompt, max_new_tokens=max_new_tokens,
                            eos_id=eos_id, temperature=temperature,
                            top_k=top_k, top_p=top_p, max_wait=max_wait,
@@ -1464,6 +1480,7 @@ class ContinuousBatchingEngine:
             adm.offset = len(adm.prompt)
             adm.first_token = extra.first_token
             adm.prefilled = True
+            adm.register_import = bool(getattr(extra, "prewarm", False))
             with self._lock:
                 self._stats["handoffs_in"] += 1
                 self._stats["handoff_bytes_in"] += extra.nbytes()

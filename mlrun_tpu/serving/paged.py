@@ -733,8 +733,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         pages = list(adm.pages)
         # imported handoffs skip registration: a decode-pool replica never
         # serves prefills, so caching their blocks would only displace
-        # pages without ever producing a hit
-        if self._prefix is not None and not adm.prefilled:
+        # pages without ever producing a hit. Exception: a pre-warm
+        # replay (register_import, serving/podfleet.py) imports exactly
+        # to seed this engine's prefix index before it takes ring traffic
+        if self._prefix is not None and \
+                (not adm.prefilled or adm.register_import):
             # index this prompt's freshly written full blocks for future
             # reuse UNDER THE REQUEST'S ADAPTER ROOT; claimed pages
             # become cache-owned (not freed on release — they stay

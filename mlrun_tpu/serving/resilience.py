@@ -47,9 +47,18 @@ DEADLINE_HEADER = "x-mlt-deadline"
 # -- errors ------------------------------------------------------------------
 class ResilienceError(RuntimeError):
     """Base for fast-failure rejections. ``status_code`` maps the error to
-    an HTTP response class in ``GraphServer.run`` / the ASGI gateway."""
+    an HTTP response class in ``GraphServer.run`` / the ASGI gateway;
+    ``retry_after_s`` (optional) is the server's backoff hint — it rides
+    the error envelope and the ``Retry-After`` header so upstream
+    ``RemoteStep``/router clients back off on schedule instead of
+    retrying blind."""
 
     status_code = 503
+
+    def __init__(self, message: str = "",
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class AdmissionRejected(ResilienceError):
@@ -112,6 +121,40 @@ class ReplicaUnavailableError(ResilienceError):
     (serving/fleet.py)."""
 
     status_code = 503
+
+
+class ReplicaPreemptedError(ServerDrainingError):
+    """The pod hosting a replica was preempted mid-request. 503-class
+    via :class:`ServerDrainingError` so ``fleet.redispatchable()`` holds;
+    when the dying replica managed to export the decode state, ``handoff``
+    carries the int8 :class:`~.llm_batch.KVHandoff` so the fleet resumes
+    the request on a survivor via ``submit_prefilled`` instead of
+    re-prefilling from scratch."""
+
+    def __init__(self, message: str = "", handoff=None,
+                 retry_after_s: float | None = None):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.handoff = handoff
+
+
+def retry_after_hint(attempt: int = 0) -> float:
+    """Backoff hint (seconds) for 503-class rejections, derived from the
+    same ``mlconf.serving.fleet`` schedule the fleet router uses for its
+    own re-dispatch waits — so a client honoring ``Retry-After`` lands
+    just after the fleet would have retried internally. Jitter is zero:
+    the hint must be stable across replicas for the same attempt."""
+    from ..common.retry import RetryPolicy, compute_backoff
+    from ..config import mlconf
+
+    conf = mlconf.serving.fleet
+    policy = RetryPolicy(
+        max_retries=int(conf.max_dispatch_attempts),
+        backoff=float(conf.backoff),
+        backoff_factor=2.0,
+        backoff_max=1.0,
+        jitter=0.0,
+    )
+    return compute_backoff(attempt, policy, seed="retry-after")
 
 
 # -- deadline propagation ----------------------------------------------------

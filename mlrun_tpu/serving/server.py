@@ -36,6 +36,7 @@ from .resilience import (
     ServerDrainingError,
     deadline_from_headers,
     deadline_remaining,
+    retry_after_hint,
 )
 from .states import FlowStep, RootFlowStep, RouterStep, graph_root_setter
 
@@ -174,6 +175,12 @@ class GraphServer(ModelObj):
         self._inflight = 0
         self._state_lock = threading.Lock()
         self._draining = False
+        # ready-means-warm (docs/serving.md "Engine fleet"): True by
+        # default so embedded/test servers stay ready; the ASGI gateway
+        # calls begin_warmup() before its warmup pass, flipping readyz
+        # false until finish_warmup() — the ring never routes to a pod
+        # whose engines would compile/fetch on the first request
+        self._warm = True
         self.step_errors: dict[str, int] = {}
         # span factory (not serialized); assign a dedicated Tracer before
         # init_states to isolate this server's spans (tests do), else the
@@ -258,9 +265,16 @@ class GraphServer(ModelObj):
         if not admitted:
             self._incr_metric("server.draining_rejected")
             exc = ServerDrainingError("server is draining, not admitting "
-                                      "new events")
-            return Response(body={"error": str(exc)},
-                            status_code=exc.status_code)
+                                      "new events",
+                                      retry_after_s=retry_after_hint())
+            # the hint rides both the body and the Retry-After header so
+            # blind-retry clients and header-aware routers both back off
+            # on the fleet's schedule
+            return Response(body={"error": str(exc),
+                                  "retry_after_s": exc.retry_after_s},
+                            status_code=exc.status_code,
+                            headers={"Retry-After":
+                                     f"{exc.retry_after_s:.3f}"})
         SERVER_INFLIGHT.inc()
         # root span: an incoming X-MLT-Trace header joins the caller's
         # trace; otherwise a fresh trace starts here. Steps, remote calls,
@@ -291,8 +305,14 @@ class GraphServer(ModelObj):
                                error=str(exc), kind=type(exc).__name__,
                                event_id=getattr(event, "id", None),
                                trace_id=getattr(event, "trace_id", None))
+                envelope = self._error_envelope(exc, event)
+                headers = None
+                hint = getattr(exc, "retry_after_s", None)
+                if hint is not None:
+                    envelope["retry_after_s"] = hint
+                    headers = {"Retry-After": f"{hint:.3f}"}
                 return Response(
-                    body=self._error_envelope(exc, event),
+                    body=envelope, headers=headers,
                     status_code=exc.status_code)
             except Exception as exc:  # noqa: BLE001
                 span_status = "error"
@@ -421,11 +441,49 @@ class GraphServer(ModelObj):
 
     def readyz(self) -> dict:
         """Readiness: flips false the moment drain starts so the load
-        balancer stops routing before in-flight events finish."""
+        balancer stops routing before in-flight events finish — and
+        stays false until WARMTH (engine warmup + adapter working-set
+        prefetch) completes, so ready means warm, not merely alive
+        (the fleet's ring join gates on this probe)."""
         ready = (self.graph is not None and self.context is not None
-                 and not self._draining)
+                 and not self._draining and self._warm)
         return {"ready": ready, "draining": self._draining,
-                "inflight": self.inflight}
+                "warm": self._warm, "inflight": self.inflight}
+
+    def begin_warmup(self):
+        """Flip readyz false until :meth:`finish_warmup`: the gateway
+        calls this before its warmup pass so a cold replica is never
+        routed to."""
+        self._warm = False
+
+    def finish_warmup(self):
+        self._warm = True
+
+    def warmup(self):
+        """Warm every graph step that supports it (engine compile +
+        first-dispatch, adapter prefetch), then flip ready. One failed
+        step logs and continues — a partially warm replica still beats a
+        replica that never reports ready (the pre-warm contract:
+        failures degrade to cold, never strand capacity)."""
+        graph = self.graph
+        steps = []
+        if graph is not None:
+            steps.extend((getattr(graph, "routes", {}) or {}).values())
+            for step in (getattr(graph, "steps", {}) or {}).values():
+                steps.append(step)
+                steps.extend((getattr(step, "routes", {}) or {}).values())
+        for step in steps:
+            target = getattr(step, "_object", None) or step
+            warm = getattr(target, "warmup", None)
+            if not callable(warm):
+                continue
+            try:
+                warm()
+            except Exception as exc:  # noqa: BLE001 - degrade to cold
+                logger.warning("step warmup failed",
+                               step=getattr(step, "name", ""),
+                               error=str(exc))
+        self.finish_warmup()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: stop admission (readyz → not ready), wait for

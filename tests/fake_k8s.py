@@ -99,11 +99,48 @@ class FakeCluster:
             int(slice_index))
 
     def kill_pod(self, name: str):
-        """Simulate an out-of-band pod kill (preemption)."""
+        """Simulate an out-of-band pod kill (preemption): the record
+        vanishes, the next liveness probe 404s. Fires ``k8s.pod_kill``
+        so chaos drills can observe/perturb the eviction itself."""
+        chaos_fire("k8s.pod_kill", name=name)
         self.pods.pop(name, None)
         self.pod_phases.pop(name, None)
         self.pod_scripts.pop(name, None)
         self.events.append(("kill", "pod", name))
+
+    # -- serving pods (mlrun_tpu/serving/podfleet.py) ----------------------
+    def _materialize_jobset_pods(self, manifest: dict):
+        """A SERVING JobSet's pods appear when the JobSet is created —
+        the fake controller's shortcut so the pod-fleet lifecycle
+        (readiness probe -> ring join -> drain -> delete) runs without a
+        cluster. Gated on the ``mlrun-tpu/serving`` annotation so every
+        existing (training) jobset test is untouched."""
+        meta = manifest.get("metadata", {})
+        if (meta.get("annotations") or {}).get(
+                "mlrun-tpu/serving") != "true":
+            return
+        name = meta["name"]
+        for job in manifest.get("spec", {}).get("replicatedJobs", []):
+            replicas = int(job.get("replicas", 1) or 1)
+            parallelism = int(job.get("template", {}).get(
+                "spec", {}).get("parallelism", 1) or 1)
+            for j in range(replicas):
+                for i in range(parallelism):
+                    pod_name = f"{name}-{job.get('name', 'slice')}-{j}-{i}"
+                    self.pods[pod_name] = {"metadata": {
+                        "name": pod_name,
+                        "labels": {
+                            "jobset.sigs.k8s.io/jobset-name": name}}}
+                    self.pod_phases.setdefault(pod_name, "Running")
+                    self.events.append(("create", "pod", pod_name))
+
+    def _remove_jobset_pods(self, name: str):
+        for pod_name in [p for p in self.pods
+                         if p.startswith(f"{name}-")]:
+            self.pods.pop(pod_name, None)
+            self.pod_phases.pop(pod_name, None)
+            self.pod_scripts.pop(pod_name, None)
+            self.events.append(("delete", "pod", pod_name))
 
     @property
     def jobsets(self) -> dict:
@@ -259,6 +296,8 @@ def make_fake_kubernetes(cluster: FakeCluster):
                 raise ApiException(409, f"{plural}/{name} exists")
             bucket[name] = manifest
             cluster.events.append(("create", plural[:-1], name))
+            if plural == "jobsets":
+                cluster._materialize_jobset_pods(manifest)
 
         def get_namespaced_custom_object(self, group, version, ns, plural,
                                          name):
@@ -299,8 +338,13 @@ def make_fake_kubernetes(cluster: FakeCluster):
             chaos_fire("k8s.delete", kind=plural[:-1], name=name)
             if name not in bucket:
                 raise ApiException(404, f"{plural}/{name}")
+            was_serving = (bucket[name].get("metadata", {})
+                           .get("annotations") or {}).get(
+                "mlrun-tpu/serving") == "true"
             del bucket[name]
             cluster.events.append(("delete", plural[:-1], name))
+            if plural == "jobsets" and was_serving:
+                cluster._remove_jobset_pods(name)
 
         def list_namespaced_custom_object(self, group, version, ns, plural,
                                           label_selector="", limit=0,

@@ -1,0 +1,617 @@
+"""Cross-process fleet elasticity (serving/podfleet.py): deferred ring
+join + ``join_replica``, reassigned-hot-key pre-warm, Retry-After hints
+on the 503-class surfaces, handoff-carrying preemption re-dispatch, the
+ready-means-warm ``/readyz`` gate, and the full pod lifecycle drill
+against the fake cluster — scale-up → prewarm → join →
+preempt-mid-decode → handoff re-dispatch → drain → delete, with zero
+dropped admitted requests and zero leaked per-pod metric series.
+CPU-only; everything but the prewarm-register parity test runs on
+jax-free fake engines."""
+
+import importlib.util
+import pathlib
+from concurrent.futures import Future
+
+import pytest
+
+from mlrun_tpu.chaos import always, chaos, fail_first
+from mlrun_tpu.obs import REGISTRY
+from mlrun_tpu.obs.flight import get_flight_recorder
+from mlrun_tpu.serving.fleet import EngineFleet
+from mlrun_tpu.serving.resilience import (
+    ReplicaPreemptedError,
+    ReplicaUnavailableError,
+    ServerDrainingError,
+    retry_after_hint,
+)
+
+from . import fake_k8s
+
+
+# -- fakes -------------------------------------------------------------------
+class _FakeHandoff:
+    """Host-data stand-in for llm_batch.KVHandoff: just enough surface
+    for the fleet's handoff dispatch and the pod client's re-export."""
+
+    def __init__(self, prompt, adapter="", sampling=(0.0, 0, 1.0),
+                 cached_prefix=0, replica=""):
+        self.prompt = list(prompt)
+        self.adapter = adapter
+        self.sampling = sampling
+        self.cached_prefix = cached_prefix
+        self.replica = replica
+        self.prefill_s = 0.001
+        self.timing = None
+
+    def nbytes(self):
+        return len(self.prompt) * 8
+
+
+class _FakeEngine:
+    """Duck-typed engine for the pod lifecycle: instant futures, a
+    prefix index fed by ``register_prefix`` imports (so the pre-warm
+    replay is assertable), and a ``hang_decode`` switch that parks
+    decode futures unresolved — the in-flight state a preemption must
+    re-dispatch, not drop."""
+
+    page_size = 8
+
+    def __init__(self):
+        self.replica = ""
+        self._stopped = False
+        self._slot_state = ()
+        self.prompts = []
+        self.registered = set()   # prefix index (tuple(prompt) keys)
+        self.imported = 0         # submit_prefilled calls
+        self.hang_decode = False
+        self.hung = []            # parked (future, prompt) pairs
+        self.sources = {}
+
+    def _queue_depth(self):
+        return len(self.hung)
+
+    def start(self):
+        pass
+
+    def warmup(self):
+        pass
+
+    def stop(self, timeout=10.0):
+        self._stopped = True
+
+    def add_adapter_source(self, name, source):
+        self.sources[name] = source
+
+    def retire_adapter(self, name, keep_source=False):
+        self.sources.pop(name, None)
+
+    def _hit(self, prompt):
+        return len(prompt) if tuple(prompt) in self.registered else 0
+
+    def submit(self, prompt, adapter="", **kwargs):
+        future = Future()
+        self.prompts.append(list(prompt))
+        if self.hang_decode:
+            self.hung.append((future, list(prompt)))
+            return future
+        future.set_result((list(prompt)[:1], {
+            "ttft_s": 0.001, "cached_prefix": self._hit(prompt)}))
+        # a completed request's blocks land in the prefix index (the
+        # radix-cache behavior the grace-window export relies on)
+        self.registered.add(tuple(prompt))
+        return future
+
+    def submit_prefill(self, prompt, adapter="", **kwargs):
+        future = Future()
+        future.set_result(_FakeHandoff(
+            prompt, adapter=adapter, cached_prefix=self._hit(prompt),
+            replica=self.replica))
+        self.registered.add(tuple(prompt))
+        return future
+
+    def submit_prefilled(self, handoff, max_new_tokens=64, eos_id=None,
+                         max_wait=None, register_prefix=False,
+                         _trace=None):
+        future = Future()
+        self.imported += 1
+        if register_prefix:
+            self.registered.add(tuple(handoff.prompt))
+        future.set_result((list(handoff.prompt)[:1], {
+            "ttft_s": 0.001, "cached_prefix": handoff.cached_prefix}))
+        return future
+
+    @property
+    def stats(self):
+        return {"requests": len(self.prompts), "completed": 0,
+                "queue_depth": len(self.hung)}
+
+
+def _fleet_with_factory(replicas=1, **kwargs):
+    created = []
+
+    def factory(role):
+        engine = _FakeEngine()
+        created.append(engine)
+        return engine
+
+    fleet = EngineFleet(factory, replicas=replicas,
+                        route_block_tokens=8, backoff=0.001, **kwargs)
+    return fleet, factory, created
+
+
+def _podfleet(fleet, provider, factory, **kwargs):
+    from mlrun_tpu.serving.podfleet import ServingPodFleet
+
+    return ServingPodFleet(fleet, provider, factory,
+                           topology="1x1", **kwargs)
+
+
+def _scaler(fleet, pods, **overrides):
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+
+    defaults = dict(dry_run=False, min_replicas=2, max_replicas=4,
+                    hysteresis_ticks=1, cooldown_up_s=0.0,
+                    cooldown_down_s=0.0, drain_grace_s=5.0,
+                    queue_low=0.0, queue_high=1e9)
+    defaults.update(overrides)
+    return FleetAutoscaler(fleet, pods=pods, **defaults)
+
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    return fake_k8s.install(monkeypatch)
+
+
+@pytest.fixture()
+def provider(cluster):
+    from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+    return KubernetesProvider(namespace="testns")
+
+
+# -- deferred join (no jax) --------------------------------------------------
+def test_deferred_join_keeps_replica_out_of_ring():
+    fleet, factory, created = _fleet_with_factory(replicas=2)
+    before = set(fleet._ring.nodes())
+    rid = fleet.add_replica("unified", joined=False)
+    # registered (visible to stats) but NOT routable: no ring points,
+    # unhealthy to the picker, and flagged in the per-replica view
+    assert set(fleet._ring.nodes()) == before
+    assert fleet.stats["per_replica"][rid]["joining"] is True
+    for i in range(8):
+        _, stats = fleet.submit([i] * 16).result(timeout=10)
+        assert stats["replica"] != rid
+    fleet.join_replica(rid)
+    assert rid in fleet._ring.nodes()
+    assert fleet.stats["per_replica"][rid]["joining"] is False
+    with pytest.raises(KeyError):
+        fleet.join_replica("nope")
+
+
+@pytest.mark.chaos
+def test_join_chaos_error_keeps_replica_out():
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    rid = fleet.add_replica("unified", joined=False)
+    with chaos.inject("fleet.join", fail_first(1),
+                      error=RuntimeError("join torn")):
+        with pytest.raises(RuntimeError, match="join torn"):
+            fleet.join_replica(rid)
+        assert rid not in fleet._ring.nodes()
+        # transient: the next attempt (next lifecycle tick) joins
+        fleet.join_replica(rid)
+    assert rid in fleet._ring.nodes()
+
+
+def test_reassigned_hot_keys_tracks_ring_movement():
+    fleet, factory, created = _fleet_with_factory(replicas=2)
+    prompts = [list(range(i, i + 24)) for i in range(0, 320, 10)]
+    for prompt in prompts:
+        fleet.submit(prompt).result(timeout=10)
+    candidate = "candidate-x"
+    moved = fleet.reassigned_hot_keys(candidate)
+    # a joining 3rd replica takes over a non-trivial minority slice
+    assert 0 < len(moved) < len(prompts)
+    # every reassigned key's owner WOULD be the candidate post-join,
+    # verified against a probe ring built the same way
+    from mlrun_tpu.serving.fleet import ConsistentHashRing
+
+    probe = ConsistentHashRing(vnodes=fleet._ring.vnodes)
+    for node in fleet._ring.nodes():
+        probe.add(node)
+    probe.add(candidate)
+    for key, prompt, adapter in moved:
+        assert probe.lookup(key) == candidate
+        assert fleet.routing_key(prompt, adapter=adapter) == key
+    # keys that stay put are NOT replayed
+    moved_keys = {key for key, _, _ in moved}
+    for prompt in prompts:
+        key = fleet.routing_key(prompt)
+        if key not in moved_keys:
+            assert probe.lookup(key) != candidate
+
+
+# -- Retry-After hints (no jax) ----------------------------------------------
+def test_retry_after_rides_no_replica_and_drain_errors():
+    # the hint follows the fleet's own backoff schedule, jitter-free
+    assert retry_after_hint(0) == pytest.approx(0.05)
+    assert retry_after_hint(1) == pytest.approx(0.1)
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    fleet.drain_replica(fleet.replicas[0].id)
+    try:
+        fleet.submit([1] * 16).result(timeout=10)
+        raise AssertionError("expected ReplicaUnavailableError")
+    except ReplicaUnavailableError as exc:
+        assert exc.retry_after_s is not None and exc.retry_after_s > 0
+    # the preemption error is 503-class (drains through the same
+    # redispatch machinery) and carries the handoff + hint
+    err = ReplicaPreemptedError("gone", handoff="H", retry_after_s=0.2)
+    assert isinstance(err, ServerDrainingError)
+    assert err.handoff == "H" and err.retry_after_s == 0.2
+
+
+def test_server_drain_rejection_carries_retry_after_header():
+    import mlrun_tpu
+    from mlrun_tpu.serving.server import MockEvent
+
+    fn = mlrun_tpu.new_function("drainer", kind="serving")
+    graph = fn.set_topology("flow", engine="sync")
+    graph.to(name="echo", handler=lambda event: event).respond()
+    server = fn.to_mock_server()
+    server._draining = True
+    response = server.run(MockEvent(body={"x": 1}), get_body=False)
+    assert response.status_code == 503
+    assert "Retry-After" in response.headers
+    assert float(response.headers["Retry-After"]) > 0
+    assert response.body["retry_after_s"] > 0
+
+
+def test_readyz_gates_on_warmth():
+    import mlrun_tpu
+
+    fn = mlrun_tpu.new_function("warmer", kind="serving")
+    graph = fn.set_topology("flow", engine="sync")
+    graph.to(name="echo", handler=lambda event: event).respond()
+    server = fn.to_mock_server()
+    assert server.readyz()["ready"] is True  # embedded default: warm
+    server.begin_warmup()
+    payload = server.readyz()
+    assert payload["ready"] is False and payload["warm"] is False
+    server.warmup()  # walks the graph, then finish_warmup()
+    payload = server.readyz()
+    assert payload["ready"] is True and payload["warm"] is True
+
+
+# -- preemption re-dispatch on fakes (no jax) --------------------------------
+def test_fleet_resumes_preempted_decode_via_handoff():
+    fleet, factory, created = _fleet_with_factory(replicas=2)
+    prompt = list(range(32))
+    primary_id = fleet._ring.lookup(fleet.routing_key(prompt))
+    primary = next(r.engine for r in fleet.replicas if r.id == primary_id)
+
+    handoff = _FakeHandoff(prompt, cached_prefix=24, replica=primary_id)
+
+    def preempted_submit(p, **kwargs):
+        future = Future()
+        future.set_exception(ReplicaPreemptedError(
+            "pod preempted", handoff=handoff,
+            retry_after_s=retry_after_hint()))
+        return future
+
+    primary.submit = preempted_submit
+    tokens, stats = fleet.submit(prompt).result(timeout=10)
+    # resumed on the survivor FROM the handoff: no re-prefill, the
+    # exported KV's prefix rode along, and the stats say so
+    assert tokens == prompt[:1]
+    assert stats["replica"] != primary_id
+    assert stats["resumed_via_handoff"] is True
+    assert stats["cached_prefix"] == 24
+    assert stats["handoff_bytes"] == handoff.nbytes()
+    survivor = next(r.engine for r in fleet.replicas
+                    if r.id == stats["replica"])
+    assert survivor.imported == 1
+    assert fleet.stats["handoffs"] == 1
+
+
+# -- the full pod lifecycle drill (chaos, no cluster, no jax) ----------------
+@pytest.mark.chaos
+def test_pod_lifecycle_drill_scale_prewarm_join_preempt_drain(
+        cluster, provider):
+    """ISSUE acceptance drill: deterministic chaos run with no cluster —
+    pod preemption mid-decode, every admitted request completes, the
+    autoscaler replaces the pod, the replacement joins pre-warmed (its
+    first reassigned-prefix request is a cache hit), and the flight
+    recorder holds the ordered causal chain."""
+    get_flight_recorder().clear()
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    pods = _podfleet(fleet, provider, factory)
+    scaler = _scaler(fleet, pods, min_replicas=2)
+    seed_rid = fleet.replicas[0].id
+
+    # tick 0: below the floor -> forced scale-up submits a JobSet; the
+    # fake controller materializes its pod Running
+    decision = scaler.tick(now=0.0)
+    assert decision["reason"] == "below_min" and decision["forced"]
+    pod1 = decision["acted"]["pod"]
+    assert cluster.pod_phases[pod1] == "Running"
+    assert pods.pods()[pod1] == "pending"
+    assert ("create", "jobset", pod1.rsplit("-slice", 1)[0]) \
+        in cluster.events
+
+    # ticks 1-3: pending -> warming -> ready -> joined, one transition
+    # per tick; the replica takes NO traffic until the join
+    scaler.tick(now=1.0)
+    assert pods.pods()[pod1] == "warming"
+    rid1 = next(rec["rid"] for rec in pods._pods.values())
+    assert rid1 not in fleet._ring.nodes()
+    scaler.tick(now=2.0)
+    assert pods.pods()[pod1] == "ready"
+    scaler.tick(now=3.0)
+    assert pods.pods()[pod1] == "joined"
+    assert rid1 in fleet._ring.nodes()
+    assert pods.pending_count() == 0
+
+    # traffic: distinct prefixes spread over both replicas; all complete
+    prompts = [list(range(i, i + 24)) for i in range(0, 400, 10)]
+    for prompt in prompts:
+        tokens, _ = fleet.submit(prompt).result(timeout=10)
+        assert tokens == prompt[:1]
+
+    # park one decode IN FLIGHT on the pod, then preempt the pod
+    pod1_engine = created[1]  # factory call #2 (seed replica was #1)
+    victim_prompt = next(p for p in prompts
+                         if fleet._ring.lookup(fleet.routing_key(p))
+                         == rid1)
+    pod1_engine.hang_decode = True
+    inflight = fleet.submit(victim_prompt)
+    assert not inflight.done()
+    pod1_engine.hang_decode = False
+    cluster.kill_pod(pod1)  # fires the k8s.pod_kill chaos point
+
+    # tick 4: liveness 404 -> preempt: the in-flight decode re-dispatches
+    # to the survivor AS A HANDOFF (exported in the grace window) and the
+    # autoscaler repairs the floor with a replacement pod in the same tick
+    decision = scaler.tick(now=4.0)
+    tokens, stats = inflight.result(timeout=10)
+    assert tokens == victim_prompt[:1]          # zero dropped requests
+    assert stats["replica"] == seed_rid
+    assert stats["resumed_via_handoff"] is True
+    assert stats["cached_prefix"] == len(victim_prompt)  # exported KV
+    assert rid1 not in fleet._ring.nodes()
+    assert decision["reason"] == "below_min"
+    pod2 = decision["acted"]["pod"]
+    assert pod2 != pod1
+
+    # ticks 5-7: the replacement warms BEHIND the ring — its reassigned
+    # hot-key slice replays as register_prefix imports — then joins
+    scaler.tick(now=5.0)
+    scaler.tick(now=6.0)
+    scaler.tick(now=7.0)
+    assert pods.pods() == {pod2: "joined"}
+    pod2_engine = created[2]
+    rid2 = next(rec["rid"] for rec in pods._pods.values())
+    assert pod2_engine.imported > 0  # the pre-warm replay ran
+    join_event = get_flight_recorder().events(kind="pod.join")[-1]
+    assert join_event["prewarmed"] is True
+
+    # the acceptance assertion: the first request on a reassigned prefix
+    # is a cache hit on the pre-warmed replacement
+    warmed_prompt = next(
+        p for p in prompts
+        if fleet._ring.lookup(fleet.routing_key(p)) == rid2
+        and tuple(p) in pod2_engine.registered)
+    _, stats = fleet.submit(warmed_prompt).result(timeout=10)
+    assert stats["replica"] == rid2
+    assert stats["cached_prefix"] == len(warmed_prompt)
+
+    # scale-down: grow to 3 first (forced up -> a third pod joins), then
+    # a forced down drains the least-loaded replica through the pod
+    # drain path; the sweep deletes its JobSet once in-flight hits zero
+    def force_up(point, context):
+        context["box"].update(action="up", reason="injected", force=True)
+
+    def force_down(point, context):
+        context["box"].update(action="down", reason="injected",
+                              force=True)
+
+    with chaos.inject("obs.autoscale", always(), action=force_up):
+        decision = scaler.tick(now=8.0)
+    pod3 = decision["acted"]["pod"]
+    for now in (9.0, 10.0, 11.0):
+        scaler.tick(now=now)
+    assert pods.pods() == {pod2: "joined", pod3: "joined"}
+    # pin load so the least-loaded victim is pod3's replica AND it is
+    # busy at drain time — the draining phase must hold across ticks
+    # while in-flight work finishes, not collapse into the same tick
+    sentinel = (Future(), [])
+    created[0].hung.extend([sentinel, sentinel])
+    created[2].hung.extend([sentinel, sentinel])
+    created[3].hung.append(sentinel)
+    with chaos.inject("obs.autoscale", always(), action=force_down):
+        decision = scaler.tick(now=12.0)
+    assert decision["acted"]["action"] == "drain"
+    drained_rid = decision["acted"]["replica"]
+    assert pods.owns(drained_rid)  # drained through the pod /__drain__
+    drained_pod = next(rec["name"] for rec in pods._pods.values()
+                       if rec["rid"] == drained_rid)
+    assert pods.pods()[drained_pod] == "draining"
+    assert drained_rid not in fleet._ring.nodes()
+    # still busy within grace: the sweep leaves it alone
+    assert scaler.tick(now=13.0)["removed"] == []
+    for engine in created:
+        engine.hung.clear()   # in-flight work drains to zero
+    decision = scaler.tick(now=14.0)
+    assert decision["removed"] == [drained_rid]
+    assert drained_pod not in pods.pods()
+    assert drained_pod not in cluster.pods
+    drain_kinds = [e["kind"] for e in get_flight_recorder().events(
+        kind="pod.*") if e.get("pod") == drained_pod]
+    assert drain_kinds[-2:] == ["pod.drain", "pod.delete"]
+
+    # flight recorder: the ordered causal chain of the preemption story
+    kinds = [e["kind"] for e in get_flight_recorder().events(
+        kind="pod.*")]
+    chain = ["pod.kill", "pod.redispatch", "pod.scale_up",
+             "pod.prewarm", "pod.join"]
+    positions = []
+    cursor = 0
+    for kind in chain:
+        cursor = kinds.index(kind, cursor)
+        positions.append(cursor)
+    assert positions == sorted(positions)
+
+    # zero leaked per-pod series: every retired pod's label sets are
+    # gone from the registry (and the removed replica's fleet series)
+    rendered = REGISTRY.render()
+    assert pod1 not in rendered
+    assert drained_pod not in rendered
+    assert rid1 not in rendered
+    fleet.stop()
+
+
+@pytest.mark.chaos
+def test_readiness_flap_delays_join(cluster, provider):
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    pods = _podfleet(fleet, provider, factory)
+    pod = pods.scale_up("unified")
+    pods.tick()  # pending -> warming
+    pods.tick()  # warming -> ready
+    rid = next(rec["rid"] for rec in pods._pods.values())
+    with chaos.inject("fleet.pod_ready", fail_first(2),
+                      error=RuntimeError("probe timeout")):
+        pods.tick()
+        pods.tick()
+        # two flaps: still ready, still OUT of the ring
+        assert pods.pods()[pod] == "ready"
+        assert rid not in fleet._ring.nodes()
+        pods.tick()  # probe recovers -> join
+    assert pods.pods()[pod] == "joined"
+    assert rid in fleet._ring.nodes()
+    fleet.stop()
+
+
+@pytest.mark.chaos
+def test_prewarm_fault_joins_cold(cluster, provider):
+    get_flight_recorder().clear()
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    for i in range(0, 200, 10):
+        fleet.submit(list(range(i, i + 24))).result(timeout=10)
+    pods = _podfleet(fleet, provider, factory)
+    pods.scale_up("unified")
+    pods.tick()  # pending -> warming
+    with chaos.inject("fleet.prewarm", always(),
+                      error=RuntimeError("registry unreachable")):
+        pods.tick()  # warming -> ready, but COLD
+    pods.tick()      # ready -> joined
+    join_event = get_flight_recorder().events(kind="pod.join")[-1]
+    assert join_event["prewarmed"] is False
+    prewarm_event = get_flight_recorder().events(kind="pod.prewarm")[-1]
+    assert prewarm_event["warm"] is False
+    assert prewarm_event["replayed_keys"] == 0
+    # cold but serving: a failed pre-warm never strands capacity
+    rid = next(rec["rid"] for rec in pods._pods.values())
+    assert rid in fleet._ring.nodes()
+    fleet.stop()
+
+
+@pytest.mark.chaos
+def test_drain_endpoint_unreachable_escalates_to_preemption(
+        cluster, provider):
+    get_flight_recorder().clear()
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    pods = _podfleet(fleet, provider, factory)
+    pod = pods.scale_up("unified")
+    for _ in range(3):
+        pods.tick()
+    rid = next(rec["rid"] for rec in pods._pods.values())
+    pod_engine = created[1]
+    pod_engine.hang_decode = True
+    prompt = next(list(range(i, i + 24)) for i in range(200)
+                  if fleet._ring.lookup(
+                      fleet.routing_key(list(range(i, i + 24)))) == rid)
+    inflight = fleet.submit(prompt)
+    pod_engine.hang_decode = False
+    with chaos.inject("fleet.drain", always(),
+                      error=RuntimeError("connection refused")):
+        pods.drain(rid)
+    # the drain endpoint was unreachable -> the pod is deleted anyway,
+    # so in-flight work re-dispatched as handoffs instead of stranding
+    tokens, stats = inflight.result(timeout=10)
+    assert tokens == prompt[:1]
+    assert stats["resumed_via_handoff"] is True
+    assert pods.pods() == {}
+    assert pod not in cluster.pods
+    kinds = [e["kind"] for e in get_flight_recorder().events(
+        kind="pod.*")]
+    assert "pod.redispatch" in kinds and "pod.drain" not in kinds
+    fleet.stop()
+
+
+# -- prewarm register parity on real engines ---------------------------------
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    cfg = tiny_llama(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_prewarm_register_prefix_makes_first_request_hit(setup):
+    """The pre-warm contract end-to-end on real paged engines: owner
+    prefill -> handoff import with register_prefix=True on the joining
+    engine -> the first REAL request there prefix-hits."""
+    cfg, params = setup
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = dict(max_len=64, slots=2, prefill_buckets=(16,), page_size=8)
+    owner = PagedContinuousBatchingEngine(cfg, params, **config)
+    joiner = PagedContinuousBatchingEngine(cfg, params, **config)
+    owner.start()
+    joiner.start()
+    prompt = [1, 7, 3, 9, 2, 4, 6, 8, 5, 3, 1, 2, 9, 9, 1, 4]
+    try:
+        ref, _ = owner.generate(prompt, max_new_tokens=4)
+        handoff = owner.submit_prefill(prompt).result(timeout=300)
+        assert handoff.cached_prefix >= 8  # owner-side prefix hit
+        # the prewarm replay: import + index the pages on the joiner
+        joiner.submit_prefilled(
+            handoff, max_new_tokens=1,
+            register_prefix=True).result(timeout=300)
+        # first real touch of the prefix on the joiner: a cache hit
+        # (the probe prefill reuses the imported pages), and decoding
+        # from it is token-identical to the owner's generation
+        probe = joiner.submit_prefill(prompt).result(timeout=300)
+        assert probe.cached_prefix >= 8
+        tokens, _ = joiner.generate(prompt, max_new_tokens=4)
+        assert tokens == ref
+        # a plain (non-prewarm) import still does NOT register: the
+        # decode-side of a disaggregated dispatch must not double-index
+        prompt2 = [5, 5, 5, 5, 1, 2, 3, 4, 9, 8, 7, 6, 2, 2, 3, 3]
+        handoff2 = owner.submit_prefill(prompt2).result(timeout=300)
+        joiner.submit_prefilled(
+            handoff2, max_new_tokens=1).result(timeout=300)
+        probe2 = joiner.submit_prefill(prompt2).result(timeout=300)
+        assert probe2.cached_prefix == 0
+    finally:
+        owner.stop()
+        joiner.stop()
+
+
+# -- bench smoke (slow: the tier-1 wall has no headroom for it) --------------
+@pytest.mark.slow
+def test_bench_fleet_elastic_smoke():
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench_serve.py"
+    spec = importlib.util.spec_from_file_location("bench_serve", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_fleet_elastic(prefixes=8, requests_per_prefix=2,
+                                prefix_tokens=24, suffix_tokens=4,
+                                max_new=4)
+    assert out["dropped_requests"] == 0
+    assert out["cold_join"]["p95_ttft_ms"] > 0
+    assert out["prewarmed_join"]["p95_ttft_ms"] > 0
+    assert out["prewarmed_join"]["prefix_hit_rate"] > \
+        out["cold_join"]["prefix_hit_rate"]
+    assert out["leaked_series"] == 0
