@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -70,6 +70,11 @@ bench-fleet-elastic: ## pod-elasticity A/B: cold vs pre-warmed ring join p95 TTF
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --fleet-elastic > BENCH_r16.tmp \
 		&& tail -n 1 BENCH_r16.tmp > BENCH_r16.json \
 		&& rm BENCH_r16.tmp && cat BENCH_r16.json
+
+bench-reconcile: ## control-plane crash-recovery A/B: journaled reconcile vs cold below-min rebuild — recovery wall, ticks, orphaned JobSets, dropped requests (docs/fault_tolerance.md "Control-plane crash recovery"); rewrites BENCH_r17.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --reconcile > BENCH_r17.tmp \
+		&& tail -n 1 BENCH_r17.tmp > BENCH_r17.json \
+		&& rm BENCH_r17.tmp && cat BENCH_r17.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

@@ -734,6 +734,214 @@ def run_fleet_elastic(prefixes: int = 8, requests_per_prefix: int = 3,
     return out
 
 
+def run_reconcile(pods: int = 2, prefixes: int = 24,
+                  requests_per_prefix: int = 2, prefix_tokens: int = 48,
+                  suffix_tokens: int = 8, max_new: int = 4,
+                  page_size: int = 8, max_len: int = 128, slots: int = 2,
+                  seed: int = 0, n_pages: int | None = None,
+                  warmup: bool = True) -> dict:
+    """Control-plane crash-recovery A/B (docs/fault_tolerance.md
+    "Control-plane crash recovery"), no cluster needed.
+
+    Both arms run the same pre-crash story — a seed replica plus
+    ``pods`` serving pods brought to ``joined`` and warmed with the hot
+    prefix workload — then the control plane dies (``controller_crash``)
+    and a fresh one recovers:
+
+    - **journal**: the restarted ``ServingPodFleet`` replays its intent
+      journal, adopts the still-Running pods at the ready probe phase,
+      and rejoins them in ONE tick — no JobSet churn, no pre-warm
+      replay.
+    - **cold**: no journal survived — the orphaned JobSets are invisible
+      to the new plane, and the autoscaler's below-min repair rebuilds
+      capacity from scratch: new JobSets, full pre-warm replay, one pod
+      lifecycle each, with the old JobSets left leaking.
+
+    Reported per arm: the recovery wall (restart start → every pod
+    joined), control-plane ticks to converge, orphaned JobSets left on
+    the cluster, and ``dropped_requests`` across the whole arm (the
+    no-drop acceptance count — must be 0 on both sides)."""
+    import os
+    import sys
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.common.journal import IntentJournal
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+    from mlrun_tpu.serving.podfleet import (
+        ServingPodFleet,
+        controller_crash,
+    )
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+    from tests import fake_k8s
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(16, max_len), max_len}))
+    if n_pages is None:
+        chain = -(-(prefix_tokens + suffix_tokens + max_new) // page_size)
+        n_pages = max(32, prefixes * (chain + 2))
+
+    def make_factory(engines, warm=lambda idx: True):
+        def factory(role):
+            engine = PagedContinuousBatchingEngine(
+                config, params, max_len=max_len, slots=slots,
+                page_size=page_size, n_pages=n_pages,
+                prefill_buckets=buckets)
+            if warmup and warm(len(engines)):
+                engine.warmup()
+            engines.append(engine)
+            return engine
+
+        return factory
+
+    def prompt_of(length):
+        return rng.integers(0, config.vocab_size, length).tolist()
+
+    families = [prompt_of(prefix_tokens) for _ in range(prefixes)]
+
+    def workload():
+        out = []
+        for _ in range(requests_per_prefix):
+            for family in families:
+                out.append(family + prompt_of(suffix_tokens))
+        return out
+
+    def arm(journal_path):
+        """One full crash/recovery cycle on a fresh fake cluster."""
+        cluster = fake_k8s.FakeCluster()
+        sys.modules["kubernetes"] = fake_k8s.make_fake_kubernetes(cluster)
+        from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+        provider = KubernetesProvider(namespace="bench")
+        dropped = 0
+
+        def complete(fleet, prompts):
+            nonlocal dropped
+            ttfts = []
+            for prompt in prompts:
+                try:
+                    _, stats = fleet.generate(
+                        prompt, max_new_tokens=max_new, timeout=600)
+                    ttfts.append(stats["ttft_s"])
+                except Exception:  # noqa: BLE001 - a drop is the finding
+                    dropped += 1
+            return ttfts
+
+        # pre-crash: seed replica + `pods` serving pods joined + warmed
+        engines1: list = []
+        factory1 = make_factory(engines1)
+        fleet1 = EngineFleet(factory1, replicas=1,
+                             route_block_tokens=page_size)
+        fleet1.start()
+        journal = IntentJournal(journal_path) if journal_path else None
+        podfleet1 = ServingPodFleet(fleet1, provider, factory1,
+                                    journal=journal)
+        for _ in range(pods):
+            podfleet1.scale_up("unified")
+        for _ in range(3):  # pending -> warming -> ready -> joined
+            podfleet1.tick()
+        complete(fleet1, workload())
+        controller_crash(bench="reconcile",
+                         arm="journal" if journal_path else "cold")
+        if journal is not None:
+            journal.close()
+        fleet1.stop()
+        for rec in list(podfleet1._pods.values()):
+            podfleet1._retire(rec)
+
+        # recovery: a fresh control plane over the same cluster
+        t0 = time.perf_counter()
+        engines2: list = []
+        factory2 = make_factory(
+            engines2,
+            warm=(lambda idx: idx == 0) if journal_path
+            else (lambda idx: True))
+        fleet2 = EngineFleet(factory2, replicas=1,
+                             route_block_tokens=page_size)
+        fleet2.start()
+        ticks = 0
+        if journal_path:
+            # adopted pods are still Running and warm — the restarted
+            # plane reconnects at the ready probe phase, it does NOT
+            # re-run warmup. Only the in-process seed replica (engine
+            # index 0, rebuilt by fleet2.start() above) warms. The
+            # cold arm's brand-new pods warm from scratch — that
+            # bring-up is exactly what the journal makes avoidable.
+            podfleet2 = ServingPodFleet(
+                fleet2, provider, factory2,
+                journal=IntentJournal(journal_path))
+            while ticks < 4 * (pods + 2) and (
+                    not podfleet2.pods()
+                    or set(podfleet2.pods().values()) != {"joined"}):
+                podfleet2.tick()
+                ticks += 1
+        else:
+            podfleet2 = ServingPodFleet(fleet2, provider, factory2)
+            scaler = FleetAutoscaler(
+                fleet2, pods=podfleet2, dry_run=False,
+                min_replicas=1 + pods, max_replicas=2 + pods,
+                hysteresis_ticks=1, cooldown_up_s=0.0,
+                cooldown_down_s=1e9, drain_grace_s=5.0,
+                queue_low=0.0, queue_high=1e9)
+            now = 0.0
+            while ticks < 8 * (pods + 2) and sum(
+                    1 for phase in podfleet2.pods().values()
+                    if phase == "joined") < pods:
+                scaler.tick(now)
+                now += 1.0
+                ticks += 1
+        recovery_s = time.perf_counter() - t0
+        joined = [name for name, phase in podfleet2.pods().items()
+                  if phase == "joined"]
+        ttfts = complete(fleet2, workload())
+        orphaned = len(cluster.jobsets) - len(podfleet2.pods())
+        fleet2.stop()
+        for rec in list(podfleet2._pods.values()):
+            podfleet2._retire(rec)
+        return {
+            "recovery_s": round(recovery_s, 4),
+            "recovery_ticks": ticks,
+            "joined_pods": len(joined),
+            "orphaned_jobsets": orphaned,
+            "dropped_requests": dropped,
+            "post_recovery_p95_ttft_ms": round(
+                _percentile(ttfts, 0.95) * 1000, 2) if ttfts else None,
+        }
+
+    saved = sys.modules.get("kubernetes")
+    from mlrun_tpu.utils import compile_cache
+
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            # shared persistent compile cache: every engine after the
+            # first loads its executables from disk, so the timed
+            # recovery wall measures control-plane work (prewarm
+            # replay, tick count) — not 6x the same XLA compile
+            compile_cache.configure(os.path.join(tmp, "xla-cache"))
+            journal_arm = arm(os.path.join(tmp, "podfleet.jsonl"))
+            cold_arm = arm(None)
+    finally:
+        compile_cache.disable()
+        if saved is None:
+            sys.modules.pop("kubernetes", None)
+        else:
+            sys.modules["kubernetes"] = saved
+    out = {"pods": pods, "prefixes": prefixes,
+           "prefix_tokens": prefix_tokens, "page_size": page_size,
+           "n_pages": n_pages, "model": "tiny",
+           "journal": journal_arm, "cold": cold_arm}
+    out["recovery_speedup"] = round(
+        cold_arm["recovery_s"] / journal_arm["recovery_s"], 2) \
+        if journal_arm["recovery_s"] > 0 else None
+    return out
+
+
 def run_autoscale(min_replicas: int = 1, max_replicas: int = 4,
                   slots: int = 2, page_size: int = 32, max_len: int = 128,
                   prompt_tokens: int = 48, max_new: int = 4,
@@ -1262,6 +1470,11 @@ def main(argv=None):
                         help="run the pod-elasticity bench (cold vs "
                              "pre-warmed join, SLO through a "
                              "preemption) instead")
+    parser.add_argument("--reconcile", action="store_true",
+                        help="run the control-plane crash-recovery A/B "
+                             "(journaled reconcile vs cold rebuild) "
+                             "instead")
+    parser.add_argument("--pods", type=int, default=2)
     parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
     # the prefix-cache bench stresses ONE engine with long prompts,
@@ -1283,7 +1496,13 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.fleet_elastic:
+    if args.reconcile:
+        result = run_reconcile(
+            pods=args.pods, prefixes=args.prefixes,
+            requests_per_prefix=args.requests_per_prefix,
+            **overrides(prefix_tokens=48, suffix_tokens=8, max_new=4,
+                        page_size=8, max_len=128))
+    elif args.fleet_elastic:
         result = run_fleet_elastic(
             prefixes=args.prefixes,
             requests_per_prefix=args.requests_per_prefix,

@@ -41,12 +41,28 @@ CONTROL_LOOP_MODULES = {
         "CanaryRouter: deterministic hash split, no time dependence",
     "mlrun_tpu/training/elastic.py":
         "ElasticGuard.poll — chaos-driven slice failures, fake-clock",
+    "mlrun_tpu/common/journal.py":
+        "intent journal: records carry caller-provided times only — a "
+        "journal-stamped wall clock would diverge from the fake clock "
+        "the recovery drills replay under",
+    "mlrun_tpu/serving/podfleet.py":
+        "ServingPodFleet.tick(now)/reconcile(now) — fake-clock restart "
+        "and preemption drills",
 }
 
 #: (module, function qualname) -> rationale for a legitimate
 #: wall-clock read inside a clock-disciplined module. Entrypoints that
 #: SOURCE the clock belong here; tick/evaluate bodies never do.
 ALLOWLIST: dict[tuple[str, str], str] = {
+    ("mlrun_tpu/serving/podfleet.py",
+     "ServingPodFleet._advance_warming"):
+        "perf_counter measures the REAL pre-warm wall (compile + KV "
+        "replay work) for the prewarm histogram — real work, not "
+        "control-loop scheduling, so the fake clock must not apply",
+    ("mlrun_tpu/serving/podfleet.py", "ServingPodFleet.reconcile"):
+        "perf_counter measures the real recovery wall (journal replay "
+        "+ world listing + adoption) for mlt_reconcile_seconds — same "
+        "real-work rule as _advance_warming",
 }
 
 _CLOCK_CALLS = {

@@ -65,6 +65,17 @@ class FaultPoints:
     # one pod drain start (scale-down / preemption) — an error models a
     # drain endpoint that cannot be reached before deletion
     fleet_drain = "fleet.drain"
+    # one intent-journal record write (common/journal.py IntentJournal
+    # .append) — fires with a mutable ``box`` carrying the serialized
+    # line; an action() may truncate box["line"] to model a torn write
+    # (partial last line on disk), an error models a failed write (the
+    # journal degrades, the control loop NEVER sees the exception)
+    journal_write = "journal.write"
+    # control-plane crash (serving/podfleet.py controller_crash) — the
+    # restart drill's entry point: tests fire it, tear down the fleet /
+    # autoscaler / tuning controller objects without graceful shutdown,
+    # and construct fresh ones over the same cluster + journal
+    fleet_controller_crash = "fleet.controller_crash"
     # execution-resource providers (service/providers.py)
     provider_create = "provider.create"
     provider_state = "provider.state"
@@ -143,6 +154,8 @@ class FaultPoints:
             FaultPoints.k8s_pod_kill,
             FaultPoints.fleet_pod_ready, FaultPoints.fleet_prewarm,
             FaultPoints.fleet_join, FaultPoints.fleet_drain,
+            FaultPoints.journal_write,
+            FaultPoints.fleet_controller_crash,
             FaultPoints.provider_create,
             FaultPoints.provider_state, FaultPoints.provider_delete,
             FaultPoints.provider_replace_slice,

@@ -225,6 +225,10 @@ default_config: dict[str, Any] = {
             # first re-dispatch backoff, seconds (deterministic jitter
             # via common/retry.compute_backoff)
             "backoff": 0.05,
+            # control-plane intent-journal directory (docs/
+            # fault_tolerance.md "Control-plane crash recovery"); empty
+            # disables journaling + restart reconciliation entirely
+            "journal_dir": "",
         },
         # metrics-driven fleet autoscaling (docs/observability.md
         # "Autoscaler"); FleetAutoscaler class args override these

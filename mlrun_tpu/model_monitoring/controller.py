@@ -11,6 +11,7 @@ from typing import Optional
 
 import pandas as pd
 
+from ..common.journal import open_journal
 from ..config import mlconf
 from ..utils import logger, now_iso
 from .applications import (
@@ -244,6 +245,41 @@ class _TenantState:
         self.last_drift_stats: dict = {}
 
 
+def _version_of(canary_id: str) -> int:
+    """The version a loop-managed ``<tenant>@v<n>`` id encodes (0 for
+    anything else) — journal replay restores the per-tenant version
+    counter from these so a restarted loop never re-mints an id."""
+    _, sep, ver = (canary_id or "").partition("@v")
+    if not sep:
+        return 0
+    try:
+        return int(ver)
+    except ValueError:
+        return 0
+
+
+class _AdoptedRun:
+    """Run handle rebuilt from the run DB by uid after a controller
+    restart — duck-types the one method the poll loop uses, so the ONE
+    submitted retrain keeps its identity across the crash (no
+    double-submit). A uid the DB no longer knows reads as ``error``:
+    the poll concludes it and frees the tenant's debounce."""
+
+    def __init__(self, db, project: str, uid: str):
+        self._db = db
+        self._project = project
+        self.uid = uid
+
+    def state(self) -> str:
+        from ..model import RunStates
+
+        run = self._db.read_run(self.uid, self._project)
+        if not run:
+            return RunStates.error
+        return (run.get("status") or {}).get("state") \
+            or RunStates.running
+
+
 class ContinuousTuningController:
     """The closed MLOps loop: serving traffic → drift → LoRA fine-tune →
     canary → promote/rollback, with no human in the loop.
@@ -292,7 +328,7 @@ class ContinuousTuningController:
 
     def __init__(self, serving, project: str = "", db=None,
                  store=None, aggregator=None, router=None, monitor=None,
-                 ring=None, submit_fn=None, **overrides):
+                 ring=None, submit_fn=None, journal=None, **overrides):
         conf = mlconf.model_monitoring.continuous
 
         def knob(section, name, cast=float, key=None):
@@ -355,6 +391,13 @@ class ContinuousTuningController:
         self._stat_labels: dict[str, set] = {}
         self._observer = None
         self._started = False
+        # durable canary journal + restart recovery (docs/
+        # fault_tolerance.md "Control-plane crash recovery"); None =
+        # journaling off (the default — zero behavior change)
+        self._journal = journal if journal is not None \
+            else open_journal("canary")
+        if self._journal is not None:
+            self._recover_from_journal()
 
     @property
     def db(self):
@@ -405,6 +448,140 @@ class ContinuousTuningController:
     def __exit__(self, *exc_info):
         self.stop()
         return False
+
+    # -- durable intent + crash recovery -------------------------------------
+    def _journal_append(self, **fields):
+        if self._journal is None:
+            return
+        from ..obs import JOURNAL_WRITES
+
+        ok = self._journal.append("canary", **fields)
+        JOURNAL_WRITES.inc(journal="canary",
+                           outcome="ok" if ok else "failed")
+
+    def _journal_snapshot(self) -> list[dict]:
+        """Compaction view: per tenant, the promoted alias (so stable
+        resolution survives further restarts), the in-flight retrain,
+        and the live canary — everything replay needs, nothing more."""
+        records: list[dict] = []
+        for tenant, state in self._tenants.items():
+            alias = self.router.stable_id(tenant)
+            if alias != tenant:
+                records.append({"kind": "canary", "op": "promote",
+                                "tenant": tenant, "canary_id": alias,
+                                "at": state.last_concluded_at or 0.0})
+            elif state.last_concluded_at is not None:
+                records.append({"kind": "canary", "op": "concluded",
+                                "tenant": tenant,
+                                "at": state.last_concluded_at})
+            if state.inflight is not None:
+                records.append({
+                    "kind": "canary", "op": "retrain", "tenant": tenant,
+                    "uid": state.inflight.get("uid", ""),
+                    "canary_id": state.inflight.get("canary_id", ""),
+                    "output_path": state.inflight.get("output_path", ""),
+                    "version": state.version,
+                    "at": state.inflight.get("submitted_at", 0.0)})
+            if state.canary is not None:
+                records.append({
+                    "kind": "canary", "op": "canary", "tenant": tenant,
+                    "canary_id": state.canary["id"],
+                    "fraction": state.canary.get("fraction",
+                                                 self.fraction),
+                    "output_path": state.canary.get("output_path", ""),
+                    "started": state.canary["started"]})
+        return records
+
+    def _recover_from_journal(self):
+        """Rebuild the closed loop from the intent journal — preserving
+        the debounce (in-flight retrain / live canary / cooldown), the
+        version counter, and the canary's START time (so ``max_age_s``
+        still concludes it — no canary pinned forever). The run DB is
+        not touched here: the adopted retrain re-attaches by uid lazily
+        on the first poll tick. A re-installed split is hash-identical
+        by construction: ``CanaryRouter.bucket`` is a pure sha256 of
+        (tenant, request key), and the canary id + fraction come back
+        from the journal."""
+        from ..obs import CANARY_STATE, RECONCILE_ACTIONS, flight_record
+
+        records = [r for r in self._journal.replay()
+                   if r.get("kind") == "canary" and r.get("tenant")]
+        if not records:
+            return
+        for rec in records:
+            tenant = rec["tenant"]
+            state = self._tenants.setdefault(tenant, _TenantState())
+            state.version = max(
+                state.version, int(rec.get("version", 0) or 0),
+                _version_of(rec.get("canary_id", "")))
+            op = rec.get("op")
+            if op == "retrain":
+                state.inflight = {
+                    "run": None,  # re-attached by uid on the first poll
+                    "uid": rec.get("uid", ""),
+                    "canary_id": rec.get("canary_id", ""),
+                    "output_path": rec.get("output_path", ""),
+                    "submitted_at": rec.get("at", 0.0)}
+            elif op == "canary":
+                state.inflight = None
+                state.canary = {
+                    "id": rec.get("canary_id", ""),
+                    "started": rec.get("started", 0.0),
+                    "fraction": float(rec.get("fraction",
+                                              self.fraction)),
+                    "output_path": rec.get("output_path", ""),
+                    "evaluator": None, "better": 0, "worse": 0}
+            elif op == "promote":
+                if state.canary is not None \
+                        and state.canary["id"] == rec.get("canary_id"):
+                    state.canary = None
+                self.router.set_alias(tenant, rec.get("canary_id", ""))
+                state.last_concluded_at = rec.get("at")
+            elif op in ("rollback", "concluded"):
+                state.canary = None
+                state.inflight = None
+                state.last_concluded_at = rec.get("at")
+        splits = retrains = 0
+        for tenant, state in self._tenants.items():
+            if state.canary is not None:
+                canary_id = state.canary["id"]
+                if state.canary.get("output_path"):
+                    try:
+                        self.serving.add_adapter_source(
+                            canary_id, state.canary["output_path"])
+                    except Exception as exc:  # noqa: BLE001 - the split
+                        # still installs; a missing artifact surfaces as
+                        # per-request adapter errors, not a dead loop
+                        logger.warning("adopted canary source failed",
+                                       tenant=tenant, canary=canary_id,
+                                       error=str(exc))
+                self.router.set_split(tenant, canary_id,
+                                      state.canary["fraction"])
+                # burn counters restart clean: a verdict needs fresh
+                # consecutive windows on this side of the restart
+                state.canary["evaluator"] = self._canary_evaluator(
+                    tenant, canary_id)
+                CANARY_STATE.set(1, adapter=tenant)
+                splits += 1
+                RECONCILE_ACTIONS.inc(controller="canary",
+                                      action="adopt_split")
+                flight_record("reconcile.adopt", tenant=tenant,
+                              canary=canary_id,
+                              fraction=state.canary["fraction"],
+                              what="canary_split")
+            if state.inflight is not None:
+                retrains += 1
+                RECONCILE_ACTIONS.inc(controller="canary",
+                                      action="adopt_retrain")
+                flight_record("reconcile.resume", tenant=tenant,
+                              uid=state.inflight["uid"],
+                              what="retrain_run")
+        flight_record("reconcile.converged", controller="canary",
+                      splits=splits, retrains=retrains)
+        logger.info("continuous-tuning loop recovered from journal",
+                    tenants=len(self._tenants), splits=splits,
+                    retrains=retrains)
+        self._journal.compact(self._journal_snapshot())
 
     # -- the tick ------------------------------------------------------------
     def tick(self, now: float) -> dict:
@@ -543,11 +720,16 @@ class ContinuousTuningController:
             logger.warning("continuous-tuning retrain submit failed",
                            tenant=tenant, error=str(exc))
             state.last_concluded_at = now
+            self._journal_append(op="concluded", tenant=tenant, at=now)
             return
         uid = getattr(getattr(run, "metadata", None), "uid", "")
         state.inflight = {"run": run, "uid": uid, "canary_id": canary_id,
                           "output_path": request["output_path"],
                           "submitted_at": now}
+        self._journal_append(op="retrain", tenant=tenant, uid=uid,
+                             canary_id=canary_id,
+                             output_path=request["output_path"],
+                             version=state.version, at=now)
         DRIFT_EVENTS.inc(adapter=tenant, event="retrain_submitted")
         flight_record("tune.submitted", adapter=tenant, canary=canary_id,
                       uid=uid, at=now)
@@ -577,6 +759,11 @@ class ContinuousTuningController:
         from ..utils import logger
 
         run = state.inflight["run"]
+        if run is None:
+            # adopted from the journal after a restart: re-attach to the
+            # ONE submitted run by uid — never resubmit
+            run = state.inflight["run"] = _AdoptedRun(
+                self.db, self.project, state.inflight["uid"])
         try:
             run_state = run.state()
         except Exception:  # noqa: BLE001 - a flaky DB read is not a
@@ -589,6 +776,7 @@ class ContinuousTuningController:
             flight_record("tune.failed", adapter=tenant,
                           uid=info["uid"], state=run_state, at=now)
             state.last_concluded_at = now
+            self._journal_append(op="concluded", tenant=tenant, at=now)
             return
         try:
             from ..serving.adapters import load_adapter
@@ -603,6 +791,7 @@ class ContinuousTuningController:
                            tenant=tenant, path=info["output_path"],
                            error=str(exc))
             state.last_concluded_at = now
+            self._journal_append(op="concluded", tenant=tenant, at=now)
             return
         flight_record("tune.completed", adapter=tenant, uid=info["uid"],
                       canary=info["canary_id"], at=now)
@@ -619,9 +808,16 @@ class ContinuousTuningController:
         CANARY_STATE.set(1, adapter=tenant)
         CANARY_DECISIONS.inc(adapter=tenant, decision="start")
         state.canary = {"id": canary_id, "started": now,
+                        "fraction": self.fraction,
+                        "output_path": info["output_path"],
                         "evaluator": self._canary_evaluator(tenant,
                                                             canary_id),
                         "better": 0, "worse": 0}
+        self._journal_append(op="canary", tenant=tenant,
+                             canary_id=canary_id,
+                             fraction=self.fraction,
+                             output_path=info["output_path"],
+                             started=now)
         flight_record("canary.start", adapter=tenant, canary=canary_id,
                       fraction=self.fraction, at=now)
         out["actions"].append({"action": "canary_start",
@@ -724,6 +920,8 @@ class ContinuousTuningController:
         state.canary = None
         state.drift_streak = 0
         state.last_concluded_at = now
+        self._journal_append(op="promote", tenant=tenant,
+                             canary_id=promoted, at=now)
         flight_record("canary.promote", adapter=tenant, canary=promoted,
                       displaced=old_stable, at=now)
         logger.info("canary promoted", tenant=tenant, adapter=promoted,
@@ -750,6 +948,8 @@ class ContinuousTuningController:
         self._retire_series(canary_id)
         CANARY_STATE.set(-1, adapter=tenant)
         CANARY_DECISIONS.inc(adapter=tenant, decision="rollback")
+        self._journal_append(op="rollback", tenant=tenant,
+                             canary_id=canary_id, at=now)
         flight_record("canary.rollback", adapter=tenant,
                       canary=canary_id, reason=reason, at=now)
         # the post-mortem: the ring already carries the causal chain —

@@ -209,6 +209,27 @@ FLEET_POD_PREWARM_SECONDS = REGISTRY.histogram(
     buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
              30.0))
 
+# -- control-plane crash recovery (common/journal.py + per-controller
+# reconcile — docs/fault_tolerance.md "Control-plane crash recovery") --------
+RECONCILE_ACTIONS = REGISTRY.counter(
+    "mlt_reconcile_actions_total",
+    "Intent-vs-world convergence actions taken by a restarted controller"
+    " (podfleet: adopt / resume_drain / orphan_deleted / orphan_vanished"
+    " / skip_unknown; autoscaler: cooldown_armed / adopt_drain; canary: "
+    "adopt_split / adopt_retrain)",
+    labels=("controller", "action"), max_label_sets=64, overflow="drop")
+RECONCILE_SECONDS = REGISTRY.histogram(
+    "mlt_reconcile_seconds",
+    "Wall time of one reconcile() pass (journal replay + world listing "
+    "+ convergence) on controller restart",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0))
+JOURNAL_WRITES = REGISTRY.counter(
+    "mlt_journal_writes_total",
+    "Intent-journal appends by outcome (ok / failed — a failed append "
+    "degrades recovery fidelity, never the control loop)",
+    labels=("journal", "outcome"), max_label_sets=64, overflow="drop")
+
 # -- model monitoring / continuous tuning (model_monitoring/,
 # serving/canary.py — docs/continuous_tuning.md) -----------------------------
 DRIFT_STAT = REGISTRY.gauge(

@@ -36,12 +36,16 @@ import threading
 from typing import Optional
 
 from ..chaos import FaultPoints, fire
+from ..common.journal import open_journal
 from ..config import mlconf
 from ..obs import (
     AUTOSCALER_ACTIONS,
     AUTOSCALER_DESIRED,
     AUTOSCALER_RECOMMENDATIONS,
+    JOURNAL_WRITES,
+    RECONCILE_ACTIONS,
 )
+from ..obs.flight import record as flight_record
 from ..utils import logger
 
 _WORKER_ROLES = ("unified", "decode")
@@ -60,7 +64,7 @@ class FleetAutoscaler:
 
     def __init__(self, fleet, store=None, aggregator=None,
                  slo=None, ttft_window: float = 60.0, pods=None,
-                 **overrides):
+                 journal=None, **overrides):
         conf = mlconf.serving.autoscale
         def knob(name, cast=float):
             if name in overrides:
@@ -104,6 +108,30 @@ class FleetAutoscaler:
         self._last_action_at: Optional[float] = None
         self._draining: dict[str, float] = {}   # replica id -> drain t0
         self._last_dispatch_counts: Optional[dict] = None
+        # durable journal + conservative restart (docs/fault_tolerance.md
+        # "Control-plane crash recovery"): a prior incarnation's journal
+        # arms BOTH cooldowns on the first tick, so a reboot can never
+        # cause a scale flap. Streaks restart at zero above; the
+        # below_min floor repair stays forced, so it is never delayed.
+        self._journal = journal if journal is not None \
+            else open_journal("autoscaler")
+        self._boot_cooldown_pending = False
+        if self._journal is not None:
+            prior = [r for r in self._journal.replay()
+                     if r.get("kind") == "autoscaler"]
+            if prior:
+                self._boot_cooldown_pending = True
+                last_mode = next(
+                    (bool(r["dry_run"]) for r in reversed(prior)
+                     if "dry_run" in r), None)
+                if last_mode is not None and last_mode != self.dry_run:
+                    logger.warning(
+                        "autoscaler dry-run mode changed across restart",
+                        was_dry_run=last_mode, now_dry_run=self.dry_run)
+            # one boot record per incarnation is all recovery needs —
+            # compact the applied-action history away at boot
+            self._journal.compact([{"kind": "autoscaler", "op": "boot",
+                                    "dry_run": self.dry_run}])
 
     # -- signal plane --------------------------------------------------------
     def _workers(self):
@@ -244,11 +272,30 @@ class FleetAutoscaler:
         advance draining replicas toward removal. Deterministic — no
         internal clock reads, no sleeps."""
         with self._lock:
+            if self._boot_cooldown_pending:
+                # conservative-restart contract: cooldowns are assumed
+                # ACTIVE at boot and anchor to the first post-restart
+                # tick (the clock arrives here, not in __init__)
+                self._boot_cooldown_pending = False
+                self._last_action_at = now
+                RECONCILE_ACTIONS.inc(controller="autoscaler",
+                                      action="cooldown_armed")
+                flight_record("reconcile.autoscaler",
+                              action="cooldown_armed", at=now)
             if self.pods is not None:
                 # advance the pod lifecycle FIRST so the signals below
                 # see fresh ring membership (a preempted pod is already
                 # out, a warmed pod already joined)
                 self.pods.tick(now)
+                # level-triggered drain adoption: the draining set is
+                # re-derived from the pod fleet every tick, so a
+                # restarted autoscaler resumes interrupted drains
+                # through its normal sweep instead of replaying them
+                for rid in self.pods.draining_rids():
+                    if rid not in self._draining:
+                        self._draining[rid] = now
+                        RECONCILE_ACTIONS.inc(controller="autoscaler",
+                                              action="adopt_drain")
             sig = self.signals(now, advance=True)
             action, reason = self._evaluate(sig)
             box = {"action": action, "reason": reason, "force": False}
@@ -303,12 +350,16 @@ class FleetAutoscaler:
                 AUTOSCALER_ACTIONS.inc(action="add")
                 self._last_action_at = now
                 self._up_streak = 0
+                self._journal_append(op="act", action="add", pod=pod,
+                                     at=now)
                 logger.info("autoscaler submitted serving pod", pod=pod)
                 return {"action": "add", "pod": pod}
             rid = self.fleet.add_replica(self._worker_role())
             AUTOSCALER_ACTIONS.inc(action="add")
             self._last_action_at = now
             self._up_streak = 0
+            self._journal_append(op="act", action="add", replica=rid,
+                                 at=now)
             logger.info("autoscaler added replica", replica=rid)
             return {"action": "add", "replica": rid}
         victim = self._scale_down_victim()
@@ -324,8 +375,17 @@ class FleetAutoscaler:
         AUTOSCALER_ACTIONS.inc(action="drain")
         self._last_action_at = now
         self._down_streak = 0
+        self._journal_append(op="act", action="drain",
+                             replica=victim.id, at=now)
         logger.info("autoscaler draining replica", replica=victim.id)
         return {"action": "drain", "replica": victim.id}
+
+    def _journal_append(self, **fields):
+        if self._journal is None:
+            return
+        ok = self._journal.append("autoscaler", **fields)
+        JOURNAL_WRITES.inc(journal="autoscaler",
+                           outcome="ok" if ok else "failed")
 
     def _scale_down_victim(self):
         """Least-loaded non-draining worker — the cheapest replica to

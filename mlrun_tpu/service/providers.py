@@ -465,4 +465,32 @@ class KubernetesProvider(Provider):
                     break
         return [f for f in found if f[1]]
 
+    def list_serving_jobsets(self) -> dict[str, dict]:
+        """The observed world for control-plane reconciliation: every
+        serving JobSet (``mlrun-tpu/serving`` annotation) actually on the
+        cluster, name → manifest. A restarted ``ServingPodFleet`` diffs
+        this against its replayed intent journal (docs/fault_tolerance.md
+        "Control-plane crash recovery"). Paginated like
+        :meth:`list_resources`."""
+        from ..k8s.jobset import SERVING_ANNOTATION
+
+        group, version, plural = _CRD_BY_LOWER["jobset"]
+        found: dict[str, dict] = {}
+        token = None
+        while True:
+            objs = self._custom.list_namespaced_custom_object(
+                group, version, self.namespace, plural,
+                limit=500, **({"_continue": token} if token else {}))
+            for obj in objs.get("items", []):
+                meta = obj.get("metadata", {})
+                annotations = meta.get("annotations", {}) or {}
+                if annotations.get(SERVING_ANNOTATION) != "true":
+                    continue
+                found[meta.get("name", "")] = obj
+            token = objs.get("metadata", {}).get("continue")
+            if not token:
+                break
+        found.pop("", None)
+        return found
+
 

@@ -127,6 +127,28 @@ class CanaryRouter:
             self._aliases[tenant] = split.canary
             return split.canary
 
+    def export_state(self) -> dict:
+        """Serializable view of the whole routing table (aliases +
+        splits) — what a restarted continuous-tuning controller rebuilds
+        from its intent journal. ``bucket()`` is a pure sha256 of
+        ``(tenant, request key)``, so once the canary id and fraction
+        are restored the split is hash-identical by construction: every
+        request key resolves to the same side it did before the crash."""
+        with self._lock:
+            return {
+                "aliases": dict(self._aliases),
+                "splits": {t: {"canary": s.canary, "fraction": s.fraction}
+                           for t, s in self._splits.items()},
+            }
+
+    def restore_state(self, state: dict):
+        """Install an :meth:`export_state` view, validating every entry
+        through the normal setters."""
+        for tenant, versioned in (state.get("aliases") or {}).items():
+            self.set_alias(tenant, versioned)
+        for tenant, split in (state.get("splits") or {}).items():
+            self.set_split(tenant, split["canary"], split["fraction"])
+
     @staticmethod
     def is_managed(name: str) -> bool:
         """True for loop-managed versioned/canary ids (never client

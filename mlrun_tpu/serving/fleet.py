@@ -233,8 +233,12 @@ class EngineFleet:
         if routing not in self.ROUTING:
             raise ValueError(
                 f"unknown routing '{routing}' (one of {self.ROUTING})")
-        if replicas < 1:
-            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        # replicas=0 is valid for pod-backed fleets: a (re)started
+        # control plane whose membership is owned entirely by
+        # ServingPodFleet (scale-up / crash-recovery adoption) must not
+        # fabricate an in-process seed replica the cluster never had
+        if replicas < 0:
+            raise ValueError(f"replicas must be >= 0, got {replicas}")
         if prefill_replicas < 0:
             raise ValueError(
                 f"prefill_replicas must be >= 0, got {prefill_replicas}")
@@ -286,8 +290,15 @@ class EngineFleet:
         # routing-key block size: align with the engines' page size so
         # the routing identity IS the radix index's block identity
         if route_block_tokens is None:
-            first = next(iter(self._route_pool().values()))
-            route_block_tokens = getattr(first.engine, "page_size", 64)
+            pool = self._route_pool()
+            if pool:
+                first = next(iter(pool.values()))
+                route_block_tokens = getattr(first.engine, "page_size",
+                                             64)
+            else:
+                route_block_tokens = 64  # empty fleet: engines arrive
+                # later via add_replica; the page-size alignment is the
+                # caller's job then (pass route_block_tokens explicitly)
         self.route_block_tokens = int(route_block_tokens)
 
     # -- topology ------------------------------------------------------------

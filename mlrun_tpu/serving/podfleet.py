@@ -52,12 +52,17 @@ import time
 from concurrent.futures import Future
 
 from ..chaos import FaultPoints, fire
+from ..common.journal import open_journal
 from ..config import mlconf
 from ..k8s.jobset import build_serving_jobset
 from ..obs import (
+    FLEET_DISPATCHES,
     FLEET_POD_EVENTS,
     FLEET_POD_PHASE,
     FLEET_POD_PREWARM_SECONDS,
+    JOURNAL_WRITES,
+    RECONCILE_ACTIONS,
+    RECONCILE_SECONDS,
 )
 from ..obs.flight import record as flight_record
 from ..utils import logger
@@ -70,6 +75,24 @@ _PHASES = {"pending": 0, "warming": 1, "ready": 2, "joined": 3,
 # bound on the per-request export/replay waits inside a tick — the
 # lifecycle must never hang the autoscaler loop on one stuck future
 _TICK_WAIT_S = 30.0
+
+# journal snapshot op per live phase (the compacted record a restarted
+# controller replays; phases left of "joined" re-enter conservatively)
+_PHASE_OP = {"pending": "scale_up", "warming": "prewarm",
+             "ready": "prewarm", "joined": "joined", "draining": "drain"}
+
+
+def controller_crash(**context):
+    """Entry point of the control-plane restart drill. Fires the
+    declared ``fleet.controller_crash`` chaos point and stamps the
+    flight recorder; the caller (a test or drill harness) then drops the
+    fleet/autoscaler/tuning-controller objects WITHOUT graceful shutdown
+    and constructs fresh ones over the same cluster + journal — recovery
+    is asserted on the causal chain that follows
+    (``fleet.crash → reconcile.adopt/orphan/resume → reconcile.converged``,
+    docs/fault_tolerance.md "Control-plane crash recovery")."""
+    flight_record("fleet.crash", **context)
+    fire(FaultPoints.fleet_controller_crash, **context)
 
 
 class PodReplicaClient:
@@ -273,7 +296,8 @@ class ServingPodFleet:
                  topology: str = "1x1",
                  pod_spec: dict | None = None,
                  compile_cache_dir: str | None = None,
-                 prewarm_max_keys: int = 32):
+                 prewarm_max_keys: int = 32,
+                 journal=None, reconcile_now: float = 0.0):
         self.fleet = fleet
         self.provider = provider
         self._factory = engine_factory
@@ -293,6 +317,13 @@ class ServingPodFleet:
         # adapter working set replayed into every joining pod (the
         # registry host cache makes the N-th replay a local copy)
         self._adapter_sources: dict[str, object] = {}
+        # durable intent journal + restart reconciliation (docs/
+        # fault_tolerance.md "Control-plane crash recovery"); None =
+        # journaling off (the default — zero behavior change)
+        self._journal = journal if journal is not None else open_journal(
+            "podfleet", snapshot=self._journal_snapshot)
+        if self._journal is not None:
+            self.reconcile(reconcile_now)
 
     # -- introspection -------------------------------------------------------
     def pods(self) -> dict[str, str]:
@@ -351,11 +382,18 @@ class ServingPodFleet:
             name, self.namespace, dict(self._pod_spec),
             accelerator=self.accelerator, topology=self.topology,
             compile_cache_dir=self.compile_cache_dir)
-        resource_id = self.provider.create(spec, run_uid=name)
         pod_name = f"{name}-slice-0-0"
         rec = {"name": pod_name, "jobset": name,
-               "resource_id": resource_id, "role": role,
+               "resource_id": f"jobset/{name}", "role": role,
                "rid": None, "client": None, "prewarmed": False}
+        # write-ahead: the intent lands in the journal BEFORE the
+        # cluster call, so a crash in between still leaves a record
+        # reconcile() can match against the (possibly created) JobSet
+        self._journal_pod(rec, "scale_up")
+        resource_id = self.provider.create(spec, run_uid=name)
+        if resource_id != rec["resource_id"]:
+            rec["resource_id"] = resource_id
+            self._journal_pod(rec, "scale_up")
         with self._lock:
             self._pods[pod_name] = rec
         self._set_phase(rec, "pending")
@@ -379,6 +417,7 @@ class ServingPodFleet:
         rec = self._by_rid(replica_id)
         if rec is None:
             raise KeyError(f"no pod backs replica '{replica_id}'")
+        self._journal_pod(rec, "drain")
         try:
             fire(FaultPoints.fleet_drain, pod=rec["name"],
                  replica=replica_id)
@@ -399,6 +438,7 @@ class ServingPodFleet:
         rec = self._by_rid(replica_id)
         if rec is None:
             return
+        self._journal_pod(rec, "delete")
         try:
             self.provider.delete(rec["resource_id"])
         except Exception as exc:  # noqa: BLE001 - already-gone is fine
@@ -443,6 +483,7 @@ class ServingPodFleet:
                            pod=rec["name"])
             self._event(rec, "kill")
             flight_record("pod.kill", pod=rec["name"], joined=False)
+            self._journal_pod(rec, "delete")
             try:
                 self.provider.delete(rec["resource_id"])
             except Exception:  # noqa: BLE001 - already gone
@@ -459,6 +500,7 @@ class ServingPodFleet:
         rec["rid"] = self.fleet.add_replica(
             rec["role"], engine=client, joined=False)
         self._set_phase(rec, "warming")
+        self._journal_pod(rec, "prewarm")
 
     def _advance_warming(self, rec: dict):
         t0 = time.perf_counter()
@@ -520,6 +562,7 @@ class ServingPodFleet:
         self.fleet.join_replica(rec["rid"])
         self._set_phase(rec, "joined")
         self._event(rec, "join")
+        self._journal_pod(rec, "joined")
         flight_record("pod.join", pod=rec["name"], replica=rec["rid"],
                       prewarmed=rec["prewarmed"])
 
@@ -547,6 +590,7 @@ class ServingPodFleet:
                 self.fleet.remove_replica(rec["rid"])
             except KeyError:
                 pass  # the drain sweep already removed it
+        self._journal_pod(rec, "delete")
         try:
             self.provider.delete(rec["resource_id"])
         except Exception:  # noqa: BLE001 - the JobSet record may have
@@ -602,3 +646,196 @@ class ServingPodFleet:
         FLEET_POD_PHASE.remove(pod=rec["name"])
         with self._lock:
             self._pods.pop(rec["name"], None)
+
+    # -- durable intent + crash recovery -------------------------------------
+    def draining_rids(self) -> list[str]:
+        """Replica ids currently mid-drain — the autoscaler re-derives
+        its drain sweep from this, level-triggered, instead of trusting
+        its own (possibly restarted-away) ``_draining`` dict."""
+        with self._lock:
+            return [rec["rid"] for rec in self._pods.values()
+                    if rec["phase"] == "draining" and rec.get("rid")]
+
+    def _journal_pod(self, rec: dict, op: str):
+        if self._journal is None:
+            return
+        ok = self._journal.append(
+            "pod", op=op, pod=rec["name"], jobset=rec["jobset"],
+            resource_id=rec["resource_id"], role=rec["role"],
+            rid=rec.get("rid"), prewarmed=bool(rec.get("prewarmed")))
+        JOURNAL_WRITES.inc(journal="podfleet",
+                           outcome="ok" if ok else "failed")
+
+    def _journal_snapshot(self) -> list[dict]:
+        """Compaction view: one full-state record per live pod (each
+        append carries full state, so the latest record per pod IS the
+        intent — deleted pods simply drop out)."""
+        with self._lock:
+            records = list(self._pods.values())
+        return [{"kind": "pod", "op": _PHASE_OP[rec["phase"]],
+                 "pod": rec["name"], "jobset": rec["jobset"],
+                 "resource_id": rec["resource_id"], "role": rec["role"],
+                 "rid": rec.get("rid"),
+                 "prewarmed": bool(rec.get("prewarmed"))}
+                for rec in records]
+
+    def reconcile(self, now: float = 0.0) -> dict:
+        """Converge journaled intent vs. the observed world, LEVEL-
+        triggered (docs/fault_tolerance.md "Control-plane crash
+        recovery"). Runs on construction whenever a journal is
+        configured; idempotent afterwards.
+
+        - **adopt**: a Running pod whose last intent was scale_up /
+          prewarm / joined re-enters the state machine at the ``ready``
+          probe phase (a still-scheduling pod re-enters at ``pending``);
+          the normal tick re-probes and rejoins the ring via
+          ``join_replica``.
+        - **resume**: a pod mid-drain re-enters at ``draining`` with its
+          ring points pulled again; the autoscaler's normal drain/delete
+          sweep finishes the removal.
+        - **orphan**: a JobSet whose intent already said ``delete`` is
+          deleted now; a journaled pod with no world presence only has
+          its stale series retired. Desired capacity is NEVER replayed
+          from stale scale-ups — the autoscaler re-derives it from live
+          signals and its below-min repair resubmits what is actually
+          missing.
+        """
+        empty = {"adopted": [], "resumed": [], "orphaned": [],
+                 "unknown": []}
+        if self._journal is None:
+            return empty
+        lister = getattr(self.provider, "list_serving_jobsets", None)
+        if lister is None:
+            logger.warning("provider cannot list serving jobsets — "
+                           "journal replayed but world not reconciled",
+                           provider=type(self.provider).__name__)
+            return empty
+        t0 = time.perf_counter()
+        intent: dict[str, dict] = {}
+        for record in self._journal.replay():
+            if record.get("kind") == "pod" and record.get("pod"):
+                intent[record["pod"]] = record
+        world = lister()
+        adopted: list = []
+        resumed: list = []
+        orphaned: list = []
+        unknown: list = []
+        handled = set()
+        with self._lock:
+            known = set(self._pods)
+        for pod, record in intent.items():
+            handled.add(record.get("jobset"))
+            if pod in known:
+                continue  # already tracked — nothing crashed in between
+            self._reconcile_pod(pod, record, world,
+                                adopted, resumed, orphaned)
+        for name in world:
+            if name not in handled:
+                # not ours (another fleet sharing the namespace) — a
+                # level-triggered pass only acts on intent it owns
+                unknown.append(name)
+                RECONCILE_ACTIONS.inc(controller="podfleet",
+                                      action="skip_unknown")
+                logger.warning("serving jobset unknown to the intent "
+                               "journal — left alone", jobset=name)
+        wall = time.perf_counter() - t0
+        RECONCILE_SECONDS.observe(wall)
+        flight_record("reconcile.converged", controller="podfleet",
+                      adopted=len(adopted), resumed=len(resumed),
+                      orphaned=len(orphaned), unknown=len(unknown),
+                      wall_s=wall)
+        if intent:
+            logger.info("pod fleet reconciled", adopted=len(adopted),
+                        resumed=len(resumed), orphaned=len(orphaned),
+                        unknown=len(unknown))
+        self._journal.compact(self._journal_snapshot())
+        return {"adopted": adopted, "resumed": resumed,
+                "orphaned": orphaned, "unknown": unknown}
+
+    def _reconcile_pod(self, pod: str, record: dict, world: dict,
+                       adopted: list, resumed: list, orphaned: list):
+        op = record.get("op", "scale_up")
+        jobset = record.get("jobset", "")
+        resource_id = record.get("resource_id", f"jobset/{jobset}")
+        alive = jobset in world
+        phase = self._read_pod_phase(pod) if alive else None
+        if op == "delete" or phase is None \
+                or (op == "drain" and phase != "Running"):
+            # removal intent already decided, or the world moved on
+            # (pod/JobSet gone) — finish the delete; capacity is NOT
+            # resubmitted here, the autoscaler re-derives desired count
+            if alive:
+                try:
+                    self.provider.delete(resource_id)
+                except Exception:  # noqa: BLE001 - going away anyway
+                    pass
+            orphaned.append(pod)
+            reason = "intent_deleted" if op == "delete" else "vanished"
+            RECONCILE_ACTIONS.inc(
+                controller="podfleet",
+                action="orphan_deleted" if op == "delete"
+                else "orphan_vanished")
+            flight_record("reconcile.orphan", pod=pod, jobset=jobset,
+                          reason=reason)
+            self._retire_journaled(record)
+            return
+        rec = {"name": pod, "jobset": jobset,
+               "resource_id": resource_id,
+               "role": record.get("role") or "unified", "rid": None,
+               "client": None,
+               "prewarmed": bool(record.get("prewarmed"))}
+        if phase != "Running":
+            # still scheduling — re-enter at pending, the normal tick
+            # advances it exactly like a fresh scale-up
+            with self._lock:
+                self._pods[pod] = rec
+            self._set_phase(rec, "pending")
+            adopted.append(pod)
+            RECONCILE_ACTIONS.inc(controller="podfleet", action="adopt")
+            flight_record("reconcile.adopt", pod=pod, phase="pending")
+            self._retire_old_rid(record)
+            self._journal_pod(rec, "scale_up")
+            return
+        client = PodReplicaClient(pod, self._factory(rec["role"]))
+        rec["client"] = client
+        # registered OUT of the ring, same as a fresh bring-up — the
+        # re-probe (ready) or drain sweep decides what happens next
+        rec["rid"] = self.fleet.add_replica(
+            rec["role"], engine=client, joined=False)
+        with self._lock:
+            self._pods[pod] = rec
+        if op == "drain":
+            self.fleet.drain_replica(rec["rid"])
+            self._set_phase(rec, "draining")
+            resumed.append(pod)
+            RECONCILE_ACTIONS.inc(controller="podfleet",
+                                  action="resume_drain")
+            flight_record("reconcile.resume", pod=pod,
+                          replica=rec["rid"])
+            self._journal_pod(rec, "drain")
+        else:
+            self._set_phase(rec, "ready")
+            adopted.append(pod)
+            RECONCILE_ACTIONS.inc(controller="podfleet", action="adopt")
+            flight_record("reconcile.adopt", pod=pod,
+                          replica=rec["rid"],
+                          prewarmed=rec["prewarmed"])
+            self._journal_pod(rec, "prewarm")
+        self._retire_old_rid(record)
+
+    def _retire_journaled(self, record: dict):
+        """Series cleanup for a journaled pod that did not survive into
+        this incarnation — the crash skipped the normal ``_retire``
+        path, so its label sets would otherwise leak forever."""
+        self._retire({"name": record.get("pod", "")})
+        self._retire_old_rid(record)
+
+    @staticmethod
+    def _retire_old_rid(record: dict):
+        """The previous incarnation's replica id is gone for good (ids
+        are process-unique): drop its dispatch series."""
+        rid = record.get("rid")
+        if not rid:
+            return
+        for outcome in ("ok", "redispatch", "failed"):
+            FLEET_DISPATCHES.remove(replica=rid, outcome=outcome)

@@ -62,9 +62,20 @@ class ResilienceError(RuntimeError):
 
 
 class AdmissionRejected(ResilienceError):
-    """Rate/concurrency admission denied — retry later."""
+    """Rate/concurrency admission denied — retry later. 429-class
+    rejections always carry a ``Retry-After`` hint: admission pressure is
+    transient by definition, so a client backing off on the fleet's own
+    schedule (:func:`retry_after_hint`) is strictly better than one
+    retrying blind. Subclasses (queue-full, adapter capacity/rate-limit)
+    inherit the default through this one constructor."""
 
     status_code = 429
+
+    def __init__(self, message: str = "",
+                 retry_after_s: float | None = None):
+        if retry_after_s is None:
+            retry_after_s = retry_after_hint()
+        super().__init__(message, retry_after_s=retry_after_s)
 
 
 class QueueFullError(AdmissionRejected):

@@ -42,7 +42,7 @@ _COMPILE_CACHE_DIR = None
 # training modules run exactly as before.
 _COMPILE_CACHED_MODULES = {
     "test_serving_prefix", "test_serving_fleet", "test_serving_adapters",
-    "test_fleet_elastic",
+    "test_fleet_elastic", "test_control_recovery",
     "test_serving_resilience", "test_llm_continuous", "test_llm_paged",
     "test_llm_engine", "test_paged_attention", "test_paged_prefill",
     "test_speculative",

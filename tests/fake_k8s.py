@@ -349,10 +349,15 @@ def make_fake_kubernetes(cluster: FakeCluster):
         def list_namespaced_custom_object(self, group, version, ns, plural,
                                           label_selector="", limit=0,
                                           **kwargs):
-            key, _, value = label_selector.partition("=")
-            items = [m for m in self._bucket(plural).values()
-                     if m.get("metadata", {}).get("labels", {}).get(
-                         key) == value]
+            if not label_selector:
+                # real k8s semantics: no selector lists everything — the
+                # reconcile world-listing path depends on this
+                items = list(self._bucket(plural).values())
+            else:
+                key, _, value = label_selector.partition("=")
+                items = [m for m in self._bucket(plural).values()
+                         if m.get("metadata", {}).get("labels", {}).get(
+                             key) == value]
             return {"items": items, "metadata": {}}
 
     class BatchV1Api:

@@ -1,0 +1,543 @@
+"""Control-plane crash recovery (common/journal.py IntentJournal +
+level-triggered reconciliation across serving/podfleet.py,
+service/autoscaler.py, and the continuous-tuning controller): torn-tail
+journal replay, deterministic torn/failed-write injection via the
+``journal.write`` chaos box, restart drills killed mid scale-up /
+mid-drain / mid-canary via ``fleet.controller_crash`` — the restarted
+plane converges with zero orphaned JobSets, zero dropped admitted
+requests, a hash-identical canary split, and zero leaked metric series
+— plus the conservative-cooldown autoscaler boot, the Retry-After hint
+on 429 admission rejections, and the bench smoke. CPU-only, runs on the
+jax-free fake engines of test_fleet_elastic."""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from mlrun_tpu.chaos import FaultPoints, always, chaos, fail_first
+from mlrun_tpu.common.journal import IntentJournal, open_journal
+from mlrun_tpu.obs import REGISTRY, get_flight_recorder
+from mlrun_tpu.serving.podfleet import controller_crash
+from mlrun_tpu.serving.resilience import (
+    AdmissionRejected,
+    QueueFullError,
+    retry_after_hint,
+)
+
+from . import fake_k8s
+from .test_fleet_elastic import (
+    _fleet_with_factory,
+    _podfleet,
+    _scaler,
+)
+
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    return fake_k8s.install(monkeypatch)
+
+
+@pytest.fixture()
+def provider(cluster):
+    from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+    return KubernetesProvider(namespace="testns")
+
+
+def _chain_ordered(kinds, chain):
+    """Assert ``chain`` appears in ``kinds`` in order (gaps allowed)."""
+    cursor = 0
+    for kind in chain:
+        cursor = kinds.index(kind, cursor) + 1
+
+
+# -- the journal itself (no cluster, no jax) ---------------------------------
+def test_journal_roundtrip_and_compaction(tmp_path):
+    journal = IntentJournal(str(tmp_path / "j.jsonl"), fsync_every=2)
+    assert journal.replay() == []            # missing file: cold start
+    journal.append("pod", op="scale_up", pod="p1", rid=None)
+    journal.append("pod", op="joined", pod="p1", rid="f1-u1")
+    journal.append("pod", op="scale_up", pod="p2", rid=None)
+    records = journal.replay()
+    assert [r["op"] for r in records] == ["scale_up", "joined",
+                                          "scale_up"]
+    # full-state records: the latest per pod IS the intent
+    latest = {r["pod"]: r for r in records}
+    assert latest["p1"]["op"] == "joined"
+    # compaction rewrites to exactly the snapshot, atomically
+    journal.compact([latest["p1"]])
+    assert journal.replay() == [latest["p1"]]
+    assert journal.stats["compactions"] == 1
+    # an unserializable record degrades, never raises
+    assert journal.append("pod", op="bad", obj=object()) is False
+    assert journal.stats["write_failures"] == 1
+    journal.close()
+
+
+def test_journal_auto_compaction_via_snapshot(tmp_path):
+    snap = [{"kind": "pod", "op": "joined", "pod": "p1"}]
+    journal = IntentJournal(str(tmp_path / "j.jsonl"),
+                            compact_threshold=4, snapshot=lambda: snap)
+    for i in range(9):
+        journal.append("pod", op="scale_up", pod="p1", seq=i)
+    # two threshold crossings -> two compactions; the file stays bounded
+    assert journal.stats["compactions"] == 2
+    assert len(journal.replay()) <= 4 + len(snap)
+    journal.close()
+
+
+def test_journal_torn_tail_dropped_mid_file_skipped(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    journal = IntentJournal(path)
+    journal.append("pod", op="scale_up", pod="p1")
+    journal.append("pod", op="joined", pod="p1")
+    journal.close()
+    # a crash mid-write tears the FINAL line: dropped silently, the
+    # intact prefix replays in full
+    with open(path, "a", encoding="utf-8") as fp:
+        fp.write('{"kind":"pod","op":"dra')
+    recovered = IntentJournal(path)
+    assert [r["op"] for r in recovered.replay()] == ["scale_up",
+                                                     "joined"]
+    assert recovered.stats["torn_tail_dropped"] == 1
+    assert recovered.stats["corrupt_skipped"] == 0
+    # corruption MID-file (bit rot, not a torn write) skips + counts,
+    # and the records around it still replay
+    lines = open(path, encoding="utf-8").readlines()
+    lines[1] = "NOT JSON AT ALL\n"
+    open(path, "w", encoding="utf-8").writelines(lines)
+    recovered = IntentJournal(path)
+    assert [r["op"] for r in recovered.replay()] == ["scale_up"]
+    assert recovered.stats["corrupt_skipped"] == 1
+
+
+@pytest.mark.chaos
+def test_journal_write_chaos_torn_and_failed(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    journal = IntentJournal(path)
+
+    def tear(point, ctx):
+        # the mutable box exposes the serialized line pre-write: cutting
+        # it IS the torn write a mid-line crash would leave
+        ctx["box"]["line"] = ctx["box"]["line"][:7]
+
+    journal.append("pod", op="scale_up", pod="p1")
+    with chaos.inject(FaultPoints.journal_write, fail_first(1),
+                      action=tear):
+        assert journal.append("pod", op="drain", pod="p1") is True
+    journal.close()
+    # the torn drain record is dropped; intent regresses to the last
+    # intact line instead of poisoning replay
+    recovered = IntentJournal(path)
+    assert [r["op"] for r in recovered.replay()] == ["scale_up"]
+    assert recovered.stats["torn_tail_dropped"] == 1
+    # a FAILED write (disk error) degrades: False, counted, no raise,
+    # and the journal keeps accepting appends afterwards
+    with chaos.inject(FaultPoints.journal_write, always(),
+                      error=OSError("disk gone")):
+        assert recovered.append("pod", op="delete", pod="p1") is False
+    assert recovered.stats["write_failures"] == 1
+    assert recovered.append("pod", op="delete", pod="p1") is True
+    assert recovered.replay()[-1]["op"] == "delete"
+    recovered.close()
+
+
+def test_open_journal_gated_on_config(tmp_path):
+    from mlrun_tpu.config import mlconf
+
+    # journaling is OFF by default — every control loop sees None and
+    # behaves exactly as before
+    assert str(mlconf.serving.fleet.journal_dir or "") == ""
+    assert open_journal("podfleet") is None
+    mlconf.serving.fleet.journal_dir = str(tmp_path / "journals")
+    try:
+        journal = open_journal("podfleet")
+        assert journal is not None
+        journal.append("pod", op="scale_up", pod="p1")
+        assert journal.path.endswith("podfleet.jsonl")
+        journal.close()
+    finally:
+        mlconf.serving.fleet.journal_dir = ""
+
+
+# -- Retry-After on the 429 surfaces -----------------------------------------
+def test_retry_after_rides_admission_rejections():
+    # every 429-class rejection carries the backoff-schedule hint by
+    # default — clients back off on the same schedule the fleet retries
+    from mlrun_tpu.serving.adapters import (
+        AdapterCapacityError,
+        AdapterRateLimitError,
+    )
+
+    for exc in (AdmissionRejected("full"), QueueFullError("queue"),
+                AdapterCapacityError("bank"),
+                AdapterRateLimitError("limit")):
+        assert exc.status_code == 429
+        assert exc.retry_after_s == pytest.approx(retry_after_hint())
+    # an explicit hint is preserved, not overwritten
+    assert QueueFullError("q", retry_after_s=2.5).retry_after_s == 2.5
+
+
+def test_server_429_admission_rejection_carries_retry_after_header():
+    import mlrun_tpu
+    from mlrun_tpu.serving.server import MockEvent
+
+    def shedding(event):
+        raise QueueFullError("admission queue full")
+
+    fn = mlrun_tpu.new_function("shedder", kind="serving")
+    graph = fn.set_topology("flow", engine="sync")
+    graph.to(name="shed", handler=shedding).respond()
+    server = fn.to_mock_server()
+    response = server.run(MockEvent(body={"x": 1}), get_body=False)
+    assert response.status_code == 429
+    assert float(response.headers["Retry-After"]) > 0
+    assert response.body["retry_after_s"] > 0
+
+
+# -- restart drills (chaos, fake cluster, fake engines) ----------------------
+@pytest.mark.chaos
+def test_restart_mid_scale_up_adopts_running_pod(cluster, provider,
+                                                 tmp_path):
+    """Kill the controller between the JobSet create and the first
+    lifecycle tick: the restarted plane adopts the Running pod back
+    through ready -> joined, no duplicate JobSet, no dropped request,
+    and the flight recorder holds the causal chain."""
+    get_flight_recorder().clear()
+    path = str(tmp_path / "podfleet.jsonl")
+    fleet1, factory1, created1 = _fleet_with_factory(replicas=1)
+    pods1 = _podfleet(fleet1, provider, factory1,
+                      journal=IntentJournal(path))
+    pod = pods1.scale_up("unified")
+    jobset = pod.rsplit("-slice", 1)[0]
+    assert cluster.pod_phases[pod] == "Running"
+    # the crash: the armed fleet.controller_crash point kills the
+    # control plane before it ever ticks the pending pod forward
+    with chaos.inject(FaultPoints.fleet_controller_crash, always(),
+                      error=RuntimeError("controller killed")):
+        with pytest.raises(RuntimeError, match="controller killed"):
+            controller_crash(drill="mid_scale_up")
+    pods1._journal.close()
+    fleet1.stop()
+
+    # restart: a fresh process — new fleet, new pod fleet, SAME journal
+    # + cluster. reconcile() runs on construction and adopts the pod at
+    # the ready probe phase (it was Running; re-probe + rejoin follow)
+    fleet2, factory2, created2 = _fleet_with_factory(replicas=1)
+    pods2 = _podfleet(fleet2, provider, factory2,
+                      journal=IntentJournal(path))
+    assert pods2.pods() == {pod: "ready"}
+    # idempotent afterwards: a second level-triggered pass finds the
+    # world already converged
+    again = pods2.reconcile()
+    assert again == {"adopted": [], "resumed": [], "orphaned": [],
+                     "unknown": []}
+    pods2.tick()  # ready -> joined via the NORMAL probe + ring join
+    rid = next(rec["rid"] for rec in pods2._pods.values())
+    assert pods2.pods() == {pod: "joined"}
+    assert rid in fleet2._ring.nodes()
+    # exactly the one JobSet the crashed incarnation created — adoption
+    # never resubmits, so nothing is orphaned and nothing duplicated
+    assert set(cluster.jobsets) == {jobset}
+    # zero dropped admitted requests: traffic lands on both replicas
+    for i in range(0, 200, 10):
+        prompt = list(range(i, i + 24))
+        tokens, _ = fleet2.submit(prompt).result(timeout=10)
+        assert tokens == prompt[:1]
+    kinds = [e["kind"] for e in get_flight_recorder().events()]
+    _chain_ordered(kinds, ["fleet.crash", "reconcile.adopt",
+                           "reconcile.converged"])
+    fleet2.stop()
+
+
+@pytest.mark.chaos
+def test_restart_mid_drain_resumes_through_normal_sweep(cluster,
+                                                        provider,
+                                                        tmp_path):
+    """Kill the controller after the drain intent landed: the restarted
+    plane re-enters the pod at ``draining`` and the autoscaler's normal
+    level-triggered sweep finishes the delete — no stranded JobSet, no
+    leaked series from either incarnation."""
+    get_flight_recorder().clear()
+    path = str(tmp_path / "podfleet.jsonl")
+    fleet1, factory1, created1 = _fleet_with_factory(replicas=1)
+    pods1 = _podfleet(fleet1, provider, factory1,
+                      journal=IntentJournal(path))
+    pod = pods1.scale_up("unified")
+    jobset = pod.rsplit("-slice", 1)[0]
+    for _ in range(3):
+        pods1.tick()
+    old_rid = next(rec["rid"] for rec in pods1._pods.values())
+    pods1.drain(old_rid)              # intent journaled, ring points out
+    assert pods1.pods() == {pod: "draining"}
+    controller_crash(drill="mid_drain")
+    pods1._journal.close()
+    fleet1.stop()
+
+    fleet2, factory2, created2 = _fleet_with_factory(replicas=1)
+    pods2 = _podfleet(fleet2, provider, factory2,
+                      journal=IntentJournal(path))
+    assert pods2.pods() == {pod: "draining"}
+    new_rid = next(rec["rid"] for rec in pods2._pods.values())
+    assert new_rid not in fleet2._ring.nodes()  # still out of rotation
+    # the restarted autoscaler re-derives the draining set from the pod
+    # fleet (level-triggered) and its normal sweep deletes the JobSet
+    scaler = _scaler(fleet2, pods2, min_replicas=1)
+    decision = scaler.tick(now=100.0)
+    assert decision["removed"] == [new_rid]
+    assert pods2.pods() == {}
+    assert jobset not in cluster.jobsets
+    kinds = [e["kind"] for e in get_flight_recorder().events()]
+    _chain_ordered(kinds, ["fleet.crash", "reconcile.resume",
+                           "reconcile.converged", "pod.delete"])
+    # zero leaked series across BOTH incarnations of the pod
+    rendered = REGISTRY.render()
+    assert pod not in rendered
+    assert old_rid not in rendered and new_rid not in rendered
+    fleet2.stop()
+
+
+@pytest.mark.chaos
+def test_restart_finishes_interrupted_delete(cluster, provider,
+                                             tmp_path):
+    """The delete intent landed but the cluster call failed and the
+    controller died: the restarted plane finds the journaled ``delete``
+    and finishes it — the orphan path, with capacity re-derivation left
+    to the autoscaler (never replayed from stale scale-ups)."""
+    get_flight_recorder().clear()
+    path = str(tmp_path / "podfleet.jsonl")
+    fleet1, factory1, created1 = _fleet_with_factory(replicas=1)
+    pods1 = _podfleet(fleet1, provider, factory1,
+                      journal=IntentJournal(path))
+    pod = pods1.scale_up("unified")
+    jobset = pod.rsplit("-slice", 1)[0]
+    for _ in range(3):
+        pods1.tick()
+    rid = next(rec["rid"] for rec in pods1._pods.values())
+    pods1.drain(rid)
+    fleet1.remove_replica(rid)
+    with chaos.inject("k8s.delete", always(),
+                      error=RuntimeError("apiserver down")):
+        pods1.on_replica_removed(rid)   # intent journaled, delete FAILS
+    assert jobset in cluster.jobsets    # the world kept the orphan
+    controller_crash(drill="mid_delete")
+    pods1._journal.close()
+    fleet1.stop()
+
+    fleet2, factory2, created2 = _fleet_with_factory(replicas=1)
+    pods2 = _podfleet(fleet2, provider, factory2,
+                      journal=IntentJournal(path))
+    # reconcile finished the delete; the pod was never re-adopted
+    assert pods2.pods() == {}
+    assert jobset not in cluster.jobsets
+    orphan = get_flight_recorder().events(kind="reconcile.orphan")[-1]
+    assert orphan["pod"] == pod
+    assert orphan["reason"] == "intent_deleted"
+    fleet2.stop()
+
+
+@pytest.mark.chaos
+def test_unknown_jobsets_left_alone(cluster, provider, tmp_path):
+    """A serving JobSet the journal never heard of (another fleet
+    sharing the namespace) is skipped, not adopted and not deleted."""
+    from mlrun_tpu.k8s.jobset import build_serving_jobset
+
+    foreign = build_serving_jobset(
+        "serve-foreign-1", "testns",
+        {"containers": [{"name": "engine", "image": "x"}]},
+        accelerator="v5litepod-8", topology="1x1")
+    provider.create(foreign, run_uid="serve-foreign-1")
+    fleet, factory, created = _fleet_with_factory(replicas=1)
+    pods = _podfleet(fleet, provider, factory,
+                     journal=IntentJournal(str(tmp_path / "j.jsonl")))
+    result = pods.reconcile()
+    assert result["unknown"] == ["serve-foreign-1"]
+    assert "serve-foreign-1" in cluster.jobsets
+    assert pods.pods() == {}
+    fleet.stop()
+
+
+# -- conservative autoscaler restart -----------------------------------------
+@pytest.mark.chaos
+def test_autoscaler_restart_arms_cooldown(tmp_path):
+    path = str(tmp_path / "autoscaler.jsonl")
+    fleet, factory, created = _fleet_with_factory(replicas=2)
+    scaler1 = _scaler(fleet, None, journal=IntentJournal(path),
+                      min_replicas=1, cooldown_up_s=100.0)
+
+    def push_up(point, context):
+        context["box"].update(action="up", reason="injected")
+
+    with chaos.inject("obs.autoscale", always(), action=push_up):
+        decision = scaler1.tick(now=0.0)
+    assert decision["acted"] is not None
+    assert decision["acted"]["action"] == "add"
+    scaler1._journal.close()
+
+    # restart: prior records arm the cooldown AT THE FIRST TICK, so a
+    # reboot right after (or long after) an action can never flap —
+    # the restarted scaler has no _last_action_at to reason from
+    scaler2 = _scaler(fleet, None, journal=IntentJournal(path),
+                      min_replicas=1, cooldown_up_s=100.0)
+    # boot compacted the applied-action history to one boot record
+    assert [r["op"] for r in scaler2._journal.replay()] == ["boot"]
+    with chaos.inject("obs.autoscale", always(), action=push_up):
+        first = scaler2.tick(now=1000.0)
+        held = scaler2.tick(now=1050.0)
+        released = scaler2.tick(now=1101.0)
+    assert first["recommended"] and first["acted"] is None
+    assert held["acted"] is None
+    assert released["acted"] is not None     # cooldown elapsed: normal
+    fleet.stop()
+
+
+@pytest.mark.chaos
+def test_autoscaler_restart_below_min_repair_stays_forced(tmp_path):
+    path = str(tmp_path / "autoscaler.jsonl")
+    fleet1, factory1, created1 = _fleet_with_factory(replicas=2)
+    scaler1 = _scaler(fleet1, None, journal=IntentJournal(path),
+                      min_replicas=1, cooldown_up_s=1e9)
+    scaler1.tick(now=0.0)
+    scaler1._journal.close()
+    fleet1.stop()
+    # the restarted plane is UNDER the floor: the repair is forced and
+    # bypasses the boot cooldown — conservatism never strands capacity
+    fleet2, factory2, created2 = _fleet_with_factory(replicas=1)
+    scaler2 = _scaler(fleet2, None, journal=IntentJournal(path),
+                      min_replicas=2, cooldown_up_s=1e9)
+    decision = scaler2.tick(now=5.0)
+    assert decision["reason"] == "below_min" and decision["forced"]
+    assert decision["acted"]["action"] == "add"
+    fleet2.stop()
+
+
+# -- canary loop restart -----------------------------------------------------
+class _FakeServing:
+    def __init__(self):
+        self.added = []
+        self.retired = []
+
+    def add_adapter_source(self, name, source):
+        self.added.append(name)
+
+    def retire_adapter(self, name, keep_source=False):
+        self.retired.append(name)
+
+
+def _canary_controller(journal, serving=None, **overrides):
+    from mlrun_tpu.model_monitoring import ContinuousTuningController
+
+    kwargs = dict(project="ct", warmup_s=0.0, max_age_s=50.0,
+                  cooldown_s=120.0, fraction=0.5, reference_min=2,
+                  window_min=2, vocab_size=64)
+    kwargs.update(overrides)
+    return ContinuousTuningController(serving or _FakeServing(),
+                                      journal=journal, **kwargs)
+
+
+@pytest.mark.chaos
+def test_restart_mid_canary_split_hash_identical(tmp_path):
+    """Kill the loop while a canary split is live: the restarted
+    controller re-installs the split hash-identically (same keys, same
+    sides), preserves the canary's START time so ``max_age_s`` still
+    concludes it, and preserves the version counter so the next retrain
+    never re-mints a used id."""
+    from mlrun_tpu.model_monitoring.controller import _TenantState
+
+    get_flight_recorder().clear()
+    path = str(tmp_path / "canary.jsonl")
+    c1 = _canary_controller(IntentJournal(path))
+    state = c1._tenants.setdefault("tx", _TenantState())
+    state.version = 3
+    c1._start_canary("tx", state,
+                     {"canary_id": "tx@v3", "output_path": "path-v3"},
+                     10.0, {"actions": []})
+    keys = [f"key-{i}" for i in range(64)]
+    sides_before = {k: c1.router.resolve("tx", k) for k in keys}
+    assert {s for _, s in sides_before.values()} == {"canary", "stable"}
+    controller_crash(drill="mid_canary")
+    c1._journal.close()
+
+    serving2 = _FakeServing()
+    c2 = _canary_controller(IntentJournal(path), serving=serving2)
+    split = c2.router.split("tx")
+    assert split is not None
+    assert split.canary == "tx@v3" and split.fraction == 0.5
+    assert "tx@v3" in serving2.added     # adapter source re-attached
+    assert c2._tenants["tx"].version == 3
+    # hash-identical: every key resolves to the SAME side it did before
+    # the crash (bucket() is a pure sha256 of tenant + key)
+    assert {k: c2.router.resolve("tx", k)
+            for k in keys} == sides_before
+    kinds = [e["kind"] for e in get_flight_recorder().events()]
+    _chain_ordered(kinds, ["fleet.crash", "reconcile.adopt",
+                           "reconcile.converged"])
+    # started=10.0 survived: the canary still AGES OUT instead of being
+    # pinned forever by a restart that forgot its clock
+    out = c2.tick(61.0)
+    rollback = [a for a in out["actions"] if a["action"] == "rollback"]
+    assert rollback and "aged out" in rollback[0]["reason"]
+    assert c2.router.split("tx") is None
+
+
+@pytest.mark.chaos
+def test_restart_mid_retrain_adopts_by_uid_no_double_submit(
+        tmp_path, rundb_mock):
+    from mlrun_tpu.model_monitoring.controller import _TenantState
+
+    path = str(tmp_path / "canary.jsonl")
+    submits = []
+
+    class _Run:
+        class metadata:
+            uid = "uid-1"
+
+    def submit_fn(request):
+        submits.append(request)
+        return _Run()
+
+    c1 = _canary_controller(IntentJournal(path), submit_fn=submit_fn)
+    state = c1._tenants.setdefault("ty", _TenantState())
+    c1._submit_retrain("ty", state, {"token_psi": 0.5}, 0.0,
+                       {"actions": []})
+    assert len(submits) == 1
+    assert state.inflight["uid"] == "uid-1"
+    controller_crash(drill="mid_retrain")
+    c1._journal.close()
+
+    rundb_mock.store_run({"status": {"state": "running"}}, "uid-1",
+                         project="ct")
+    c2 = _canary_controller(IntentJournal(path), submit_fn=submit_fn)
+    adopted = c2._tenants["ty"].inflight
+    assert adopted is not None and adopted["uid"] == "uid-1"
+    assert adopted["run"] is None        # re-attached lazily by uid
+    # polling the adopted run goes to the run DB — it never resubmits
+    c2.tick(10.0)
+    assert len(submits) == 1
+    assert c2._tenants["ty"].inflight is not None
+    # the run concludes (unusable artifact -> retrain_failed) and the
+    # debounce survives: cooldown holds, still no second submission
+    rundb_mock.store_run({"status": {"state": "completed"}}, "uid-1",
+                         project="ct")
+    c2.tick(20.0)
+    assert c2._tenants["ty"].inflight is None
+    assert c2._tenants["ty"].last_concluded_at == 20.0
+    assert len(submits) == 1
+
+
+# -- bench smoke (slow: the tier-1 wall has no headroom for it) --------------
+@pytest.mark.slow
+def test_bench_reconcile_smoke():
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench_serve.py"
+    spec = importlib.util.spec_from_file_location("bench_serve", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_reconcile(pods=2, prefixes=8, prefix_tokens=24,
+                            suffix_tokens=4)
+    assert out["journal"]["dropped_requests"] == 0
+    assert out["cold"]["dropped_requests"] == 0
+    assert out["journal"]["recovery_ticks"] < out["cold"]["recovery_ticks"]
+    assert out["journal"]["recovery_s"] > 0
+    assert json.dumps(out)  # BENCH_r17.json serializability
