@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile bench-kv-tier obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -75,6 +75,12 @@ bench-reconcile: ## control-plane crash-recovery A/B: journaled reconcile vs col
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --reconcile > BENCH_r17.tmp \
 		&& tail -n 1 BENCH_r17.tmp > BENCH_r17.json \
 		&& rm BENCH_r17.tmp && cat BENCH_r17.json
+
+bench-kv-tier:   ## hierarchical KV cache A/B: host-tier hit rate at fixed device bytes + ring-reassignment fetch vs re-prefill first-request TTFT (docs/serving.md "Hierarchical KV"); rewrites BENCH_r18.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --kv-tier --prefixes 6 \
+		--requests-per-prefix 2 > BENCH_r18.tmp \
+		&& tail -n 1 BENCH_r18.tmp > BENCH_r18.json \
+		&& rm BENCH_r18.tmp && cat BENCH_r18.json
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

@@ -117,6 +117,21 @@ class FaultPoints:
     # a corrupt/unreachable adapter artifact (fails ONE request, never
     # the engine)
     llm_adapter_load = "llm.adapter_load"
+    # one prefix-chain demotion into the host KV tier (serving/paged.py
+    # _reclaim_pages): fires per demoted chain node with key/page_id
+    # context BEFORE the host copy — an error models a failed demote
+    # (the page is still reclaimed; the chain is simply lost to the tier)
+    llm_kv_demote = "llm.kv_demote"
+    # one host-tier promote during admission (serving/paged.py
+    # _prepare_admission): fires per promoted chain node before its
+    # pages re-enter the device pool — an error falls the request back
+    # to plain token prefill, NEVER a client error
+    llm_kv_promote = "llm.kv_promote"
+    # one cross-replica prefix-page fetch (serving/fleet.py dispatch +
+    # serving/podfleet.py pre-warm): fires before the previous ring
+    # owner's pages are pulled over the KVHandoff wire — a delay()
+    # models a slow fetch, an error falls back to re-prefill from tokens
+    llm_kv_fetch = "llm.kv_fetch"
     # one autoscaler evaluation (service/autoscaler.py tick) — fires
     # with a mutable ``box`` carrying the computed decision; an
     # action() may overwrite box["action"]/box["reason"] for
@@ -165,6 +180,8 @@ class FaultPoints:
             FaultPoints.serving_queue, FaultPoints.llm_submit,
             FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
             FaultPoints.llm_adapter_load,
+            FaultPoints.llm_kv_demote, FaultPoints.llm_kv_promote,
+            FaultPoints.llm_kv_fetch,
             FaultPoints.obs_autoscale, FaultPoints.monitor_drift,
             FaultPoints.train_prefetch, FaultPoints.train_slice_fail,
         ]

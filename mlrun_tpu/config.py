@@ -205,6 +205,16 @@ default_config: dict[str, Any] = {
                 "rate": 0.0,
                 "burst": 8.0,
             },
+            # host-RAM KV tier under the device page pool (docs/
+            # serving.md "Hierarchical KV"): evicted prefix chains
+            # demote to host memory and promote back on admission
+            # instead of re-prefilling from tokens. Off by default —
+            # the paged engine's kv_tier ctor arg overrides
+            "kv_tier": {
+                "enabled": False,
+                # host-store byte budget for demoted pages + scales
+                "host_bytes": 64 << 20,
+            },
         },
         # engine replica fleet (docs/serving.md "Engine fleet");
         # EngineFleet / LLMModelServer class args override these
@@ -229,6 +239,11 @@ default_config: dict[str, Any] = {
             # fault_tolerance.md "Control-plane crash recovery"); empty
             # disables journaling + restart reconciliation entirely
             "journal_dir": "",
+            # cross-replica prefix-page fetch (docs/serving.md
+            # "Hierarchical KV"): when a hot chain's ring owner changed,
+            # pull its cached pages from the previous owner over the
+            # KVHandoff wire instead of re-prefilling from tokens
+            "prefix_fetch": True,
         },
         # metrics-driven fleet autoscaling (docs/observability.md
         # "Autoscaler"); FleetAutoscaler class args override these

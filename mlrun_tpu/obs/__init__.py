@@ -157,6 +157,28 @@ LLM_EVENTS = REGISTRY.counter(
     "shed, expired, prefix_hits, prefix_evictions, ...)",
     labels=("engine", "replica", "event"), max_label_sets=1024,
     overflow="drop")
+# hierarchical KV cache (serving/kv_tier.py, docs/serving.md
+# "Hierarchical KV"): fed event-side from the paged engine, removed on
+# engine stop like the rest of the per-replica families
+KV_TIER_BYTES = REGISTRY.gauge(
+    "mlt_kv_tier_bytes",
+    "Host-KV-tier bytes resident (demoted int8 pages + scales) per "
+    "paged engine",
+    labels=("engine", "replica"), overflow="drop")
+KV_TIER_HITS = REGISTRY.counter(
+    "mlt_kv_tier_hits_total",
+    "Prefix-block admissions served by cache tier: device (page-pool "
+    "radix hit), host (promote from the host tier), remote "
+    "(cross-replica page fetch)",
+    labels=("engine", "replica", "tier"), max_label_sets=512,
+    overflow="drop")
+KV_TIER_EVENTS = REGISTRY.counter(
+    "mlt_kv_tier_events_total",
+    "Hierarchical-KV movement by op (demote / promote / fetch) and "
+    "outcome (ok / miss / fallback / error) — error and fallback "
+    "outcomes degrade to plain token prefill, never a client error",
+    labels=("engine", "replica", "op", "outcome"), max_label_sets=512,
+    overflow="drop")
 
 # -- multi-tenant adapters (serving/adapters.py) -----------------------------
 ADAPTER_LIVE = REGISTRY.gauge(

@@ -19,6 +19,10 @@ Phases (docs/observability.md has the table):
                          (paged: including head-of-line page waits)
 - ``adapter_load_wait``  materializing the tenant's LoRA factors in the
                          device bank at admission
+- ``promote``            importing host-KV-tier pages back into the
+                         device pool at admission instead of prefilling
+                         the covered blocks (docs/serving.md
+                         "Hierarchical KV")
 - ``prefill``            first prefill dispatch → first token (chunked:
                          spans every chunk tick, decode ticks between
                          chunks included — that IS the request's prefill
@@ -31,6 +35,9 @@ Phases (docs/observability.md has the table):
                          something else (admission work, other ticks)
 - ``redispatch_backoff`` fleet re-dispatch backoff timers (attributed
                          out-of-band by ``EngineFleet``)
+- ``fetch``              pulling a reassigned hot prefix's pages from
+                         the previous ring owner before dispatch
+                         (attributed out-of-band by ``EngineFleet``)
 - ``network``            dispatch/transfer remainder at the fleet or
                          RemoteStep boundary: hop wall minus the
                          server-side attributed time
@@ -50,14 +57,16 @@ from .metrics import REGISTRY
 
 # canonical phase names (anything else folds into "other" at export)
 PHASES = ("admission", "rate_limit_wait", "queue_wait",
-          "adapter_load_wait", "prefill", "handoff", "decode_active",
-          "decode_stall", "redispatch_backoff", "network", "other")
+          "adapter_load_wait", "promote", "prefill", "handoff",
+          "decode_active", "decode_stall", "redispatch_backoff",
+          "fetch", "network", "other")
 
 REQUEST_PHASE_SECONDS = REGISTRY.histogram(
     "mlt_request_phase_seconds",
     "Per-request wall seconds by ledger phase (admission, "
-    "rate_limit_wait, queue_wait, adapter_load_wait, prefill, handoff, "
-    "decode_active, decode_stall, redispatch_backoff, network, other); "
+    "rate_limit_wait, queue_wait, adapter_load_wait, promote, prefill, "
+    "handoff, decode_active, decode_stall, redispatch_backoff, fetch, "
+    "network, other); "
     "phases sum to the request wall by construction. Bounded adapter "
     "label like the TTFT family (docs/serving.md \"Multi-tenant LoRA\")",
     labels=("phase", "adapter"), max_label_sets=1024, overflow="drop",

@@ -256,6 +256,10 @@ class EngineFleet:
         if attempts < 1:
             raise ValueError("max_dispatch_attempts must be >= 1")
         self.max_dispatch_attempts = attempts
+        # cross-replica prefix fetch (docs/serving.md "Hierarchical KV"):
+        # when a hot key's ring owner changes, pull the cached pages from
+        # the previous owner instead of re-prefilling on the new one
+        self._prefix_fetch = bool(fleet_conf.get("prefix_fetch", True))
         self._retry_policy = RetryPolicy(
             max_retries=attempts,
             backoff=(float(backoff) if backoff is not None
@@ -265,13 +269,16 @@ class EngineFleet:
         self._stopped = False
         self._replica_seq = 0
         self._stats = {"dispatches": 0, "redispatches": 0, "failed": 0,
-                       "no_replica": 0, "handoffs": 0, "handoff_bytes": 0}
+                       "no_replica": 0, "handoffs": 0, "handoff_bytes": 0,
+                       "prefix_fetches": 0, "prefix_fetch_fallbacks": 0}
         self._ttft_ring: list = []            # end-to-end, bounded below
         self._ttft_ring_max = 512
-        # hot routing keys (bounded LRU): key -> (prompt, route_adapter).
-        # A joining pod replays its REASSIGNED slice of these as pre-warm
-        # prefills (serving/podfleet.py) so its first real request on a
-        # moved key is a prefix-cache hit
+        # hot routing keys (bounded LRU):
+        # key -> (prompt, route_adapter, last_owner_rid).  A joining pod
+        # replays/fetches its REASSIGNED slice of these as pre-warm
+        # (serving/podfleet.py) so its first real request on a moved key
+        # is a prefix-cache hit; the last owner is where a ring-moved
+        # key's pages still live — the cross-replica fetch source
         self._hot_keys: OrderedDict = OrderedDict()
         self._hot_keys_max = 256
         # pools: unified fleets route over _workers; disaggregated fleets
@@ -454,10 +461,18 @@ class EngineFleet:
             probe.add(candidate)
             items = list(self._hot_keys.items())
         out = []
-        for key, (prompt, adapter) in items:
+        for key, (prompt, adapter, _owner) in items:
             if probe.lookup(key) == candidate:
                 out.append((key, prompt, adapter))
         return out
+
+    def hot_key_owner(self, key: int) -> Optional[str]:
+        """The replica that last SERVED a hot key — where its prefix
+        pages still live after a ring reassignment moves the key (the
+        cross-replica fetch source, docs/serving.md "Hierarchical KV")."""
+        with self._lock:
+            entry = self._hot_keys.get(key)
+            return entry[2] if entry is not None else None
 
     def _pick(self, pool: dict, key: int, tried: list,
               affinity: bool) -> Optional[EngineReplica]:
@@ -537,8 +552,10 @@ class EngineFleet:
                       if span is not None else None),
         }
         with self._lock:
-            self._hot_keys[state["key"]] = (state["prompt"],
-                                            state["adapter"])
+            prev = self._hot_keys.get(state["key"])
+            self._hot_keys[state["key"]] = (
+                state["prompt"], state["adapter"],
+                prev[2] if prev is not None else None)
             self._hot_keys.move_to_end(state["key"])
             while len(self._hot_keys) > self._hot_keys_max:
                 self._hot_keys.popitem(last=False)
@@ -606,6 +623,9 @@ class EngineFleet:
         if backoff > 0:
             phases["redispatch_backoff"] = \
                 phases.get("redispatch_backoff", 0.0) + backoff
+        fetch = state.get("fetch_s", 0.0)
+        if fetch > 0:
+            phases["fetch"] = phases.get("fetch", 0.0) + fetch
         wall = time.perf_counter() - state["t0"]
         attributed = sum(phases.values())
         gap = wall - attributed
@@ -660,6 +680,95 @@ class EngineFleet:
         self._fail(out, state, exc)
         return False
 
+    # -- cross-replica prefix fetch (docs/serving.md "Hierarchical KV") ------
+    def _fetch_source(self, state: dict,
+                      target: EngineReplica) -> Optional[EngineReplica]:
+        """The replica worth pulling this key's cached pages from before
+        dispatching to ``target``: the key's LAST owner, when it is a
+        different, healthy replica and both ends speak the fetch
+        protocol. One attempt per request — fetch is a warm-up, not a
+        retry loop — and only on the first dispatch (a re-dispatch means
+        replicas are failing; don't add hops). Affinity routing only: a
+        moved key there means the RING moved (scale event), a one-time
+        migration worth a hop; under random routing every request lands
+        off-owner and the hop would re-ship pages per request."""
+        if not self._prefix_fetch or self.routing != "affinity" \
+                or state.get("fetch_tried") or state["attempts"]:
+            return None
+        with self._lock:
+            entry = self._hot_keys.get(state["key"])
+            owner_id = entry[2] if entry is not None else None
+            if owner_id is None or owner_id == target.id:
+                return None
+            owner = self._workers.get(owner_id) \
+                or self._prefill.get(owner_id)
+        if owner is None or not owner.healthy:
+            return None
+        if not hasattr(owner.engine, "fetch_prefix") \
+                or not hasattr(target.engine, "import_prefix"):
+            return None
+        return owner
+
+    def _fetch_then(self, state: dict, owner: EngineReplica,
+                    target: EngineReplica, resume: Callable):
+        """Pull the request's cached prefix pages out of ``owner`` and
+        import them into ``target``, then ``resume()`` the dispatch —
+        the request's prefill on the new owner becomes a prefix-cache
+        hit instead of a cold re-prefill. ANY failure (chaos-armed
+        ``llm.kv_fetch``, a miss on the owner, a stopped engine, an
+        import error) falls through to the plain dispatch: fetch is an
+        optimization, never a gate on the hot path. The elapsed seconds
+        land on the ``fetch`` ledger phase via :meth:`_merge_timing`."""
+        state["fetch_tried"] = True
+        t0 = time.perf_counter()
+
+        def finish(fetched: bool):
+            state["fetch_s"] = state.get("fetch_s", 0.0) \
+                + (time.perf_counter() - t0)
+            with self._lock:
+                self._stats["prefix_fetches" if fetched
+                            else "prefix_fetch_fallbacks"] += 1
+            if fetched:
+                logger.info("fleet prefix fetch", key=state["key"],
+                            owner=owner.id, target=target.id)
+            resume()
+
+        def on_import(fut: Future):
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 - fall back to plain dispatch
+                finish(False)
+                return
+            finish(True)
+
+        def on_fetch(fut: Future):
+            try:
+                payload = fut.result()
+            except Exception:  # noqa: BLE001 - miss/stopped owner
+                payload = None
+            if payload is None:
+                finish(False)
+                return
+            try:
+                with self._lock:
+                    self._stats["handoff_bytes"] += payload.nbytes()
+                FLEET_HANDOFF_BYTES.inc(payload.nbytes())
+                target.engine.import_prefix(payload) \
+                    .add_done_callback(on_import)
+            except Exception:  # noqa: BLE001 - fall back
+                finish(False)
+
+        try:
+            # an armed error here models a dead fetch path; an armed
+            # delay models a slow pull — both degrade to re-prefill
+            fire(FaultPoints.llm_kv_fetch, key=state["key"],
+                 owner=owner.id, target=target.id)
+            owner.engine.fetch_prefix(
+                state["prompt"], adapter=state["adapter"]) \
+                .add_done_callback(on_fetch)
+        except Exception:  # noqa: BLE001 - fall back to plain dispatch
+            finish(False)
+
     # unified fleet: one replica runs prefill AND decode
     def _dispatch_unified(self, out: Future, state: dict):
         # dispatch runs on done-callback / Timer threads, where an
@@ -673,6 +782,20 @@ class EngineFleet:
             if replica is None:
                 self._no_replica(out, state, "fleet")
                 return
+            owner = self._fetch_source(state, replica)
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            self._fail(out, state, exc)
+            return
+        if owner is not None:
+            self._fetch_then(state, owner, replica,
+                             lambda: self._submit_unified(
+                                 out, state, replica))
+            return
+        self._submit_unified(out, state, replica)
+
+    def _submit_unified(self, out: Future, state: dict,
+                        replica: EngineReplica):
+        try:
             state["tried"].append(replica.id)
             inner = replica.engine.submit(
                 state["prompt"], max_new_tokens=state["max_new"],
@@ -774,6 +897,20 @@ class EngineFleet:
             if replica is None:
                 self._no_replica(out, state, "prefill")
                 return
+            owner = self._fetch_source(state, replica)
+        except Exception as exc:  # noqa: BLE001 - routed to the client
+            self._fail(out, state, exc)
+            return
+        if owner is not None:
+            self._fetch_then(state, owner, replica,
+                             lambda: self._submit_prefill(
+                                 out, state, replica))
+            return
+        self._submit_prefill(out, state, replica)
+
+    def _submit_prefill(self, out: Future, state: dict,
+                        replica: EngineReplica):
+        try:
             state["tried"].append(replica.id)
             inner = replica.engine.submit_prefill(
                 state["prompt"], eos_id=state["eos_id"],
@@ -872,6 +1009,15 @@ class EngineFleet:
         self._merge_timing(state, stats)
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="ok")
         with self._lock:
+            # remember WHERE this key's pages now live: the fetch source
+            # after the ring moves the key to a different replica.
+            # Disaggregated fleets cache on the PREFILL replica, not the
+            # decode replica finalizing here
+            entry = self._hot_keys.get(state["key"])
+            if entry is not None:
+                owner_rid = stats.get("prefill_replica") or replica.id
+                self._hot_keys[state["key"]] = (entry[0], entry[1],
+                                                owner_rid)
             self._stats["dispatches"] += 1
             self._ttft_ring.append(stats.get("ttft_s", 0.0))
             if len(self._ttft_ring) > self._ttft_ring_max:

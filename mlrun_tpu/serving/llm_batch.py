@@ -1719,6 +1719,14 @@ class ContinuousBatchingEngine:
             for item in keep:  # FIFO order preserved
                 self._queue.put(item)
 
+    def _control_tick(self):
+        """Scheduler-thread hook for out-of-band control work that must
+        not race device dispatch (the paged engine drains its
+        fetch_prefix/import_prefix control deque here — its page pool is
+        donated through every decode dispatch, so off-thread access is
+        unsafe by construction; docs/serving.md "Hierarchical KV").
+        Base engine: nothing."""
+
     def _loop(self, epoch: int = 0):
         try:
             while self._running:
@@ -1731,6 +1739,7 @@ class ContinuousBatchingEngine:
                 # advances an armed capture — one global check when dark
                 profiler_tick(self._obs_name)
                 self._expire_queued()
+                self._control_tick()
                 self._admission_tick()
                 if not any(s.active for s in self._slot_state):
                     if self._admission is None:

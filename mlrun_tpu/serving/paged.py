@@ -47,6 +47,7 @@ import functools
 import queue
 import time
 from collections import deque
+from concurrent.futures import Future
 
 import jax
 import jax.numpy as jnp
@@ -55,10 +56,12 @@ import numpy as np
 from ..chaos import FaultPoints, fire
 from ..config import mlconf
 from ..models.llama import LlamaConfig
+from ..obs import KV_TIER_BYTES, KV_TIER_EVENTS, KV_TIER_HITS
 from ..utils import logger
+from .kv_tier import HostKVTier
 from .llm import _forward_with_cache, init_kv_cache
 from .llm_batch import ContinuousBatchingEngine, KVHandoff, _Admission
-from .prefix import PrefixCache
+from .prefix import PrefixCache, block_chain_key
 
 
 def init_paged_pool(config: LlamaConfig, n_pages: int, page_size: int,
@@ -352,7 +355,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  adapters=None, max_live_adapters: int | None = None,
                  adapter_rate: float | None = None,
                  adapter_burst: float | None = None,
-                 request_ledger: bool | None = None):
+                 request_ledger: bool | None = None,
+                 kv_tier=None):
         from ..ops.paged_attention import resolve_paged_impl
 
         if max_len % page_size:
@@ -371,6 +375,26 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._prefix = PrefixCache(page_size) if prefix_cache else None
         # trie nodes each slot holds a refcount on (matched + registered)
         self._slot_prefix_nodes: dict[int, list] = {}
+        # host-RAM KV tier (docs/serving.md "Hierarchical KV"): evicted
+        # prefix chains demote host-side and promote back on admission.
+        # ``kv_tier`` accepts True/False, a config-style dict, or None
+        # (mlconf.serving.llm.kv_tier decides); needs the prefix cache
+        conf = mlconf.serving.llm.get("kv_tier")
+        tier_conf = dict(conf.to_dict()) if conf is not None else {}
+        if isinstance(kv_tier, dict):
+            # an explicit dict arg opts in unless it says otherwise
+            tier_conf.update(kv_tier)
+            kv_tier = kv_tier.get("enabled", True)
+        elif kv_tier is None:
+            kv_tier = tier_conf.get("enabled", False)
+        self._kv_tier = (
+            HostKVTier(int(tier_conf.get("host_bytes", 64 << 20)))
+            if kv_tier and self._prefix is not None else None)
+        # fetch_prefix/import_prefix control ops queue here and run on
+        # the scheduler thread between ticks (_control_tick): the page
+        # pool is donated through every decode dispatch, so off-thread
+        # pool access is unsafe by construction
+        self._control: deque = deque()
         super().__init__(config, params, max_len=max_len, slots=slots,
                          prefill_buckets=prefill_buckets, seed=seed,
                          kv_dtype=kv_dtype, max_queue_size=max_queue_size,
@@ -414,7 +438,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._stats.update({"attn_kernel_ticks": 0, "attn_gather_ticks": 0,
                             "attn_hbm_bytes_avoided": 0,
                             "prefill_kernel_chunks": 0,
-                            "prefill_gather_admissions": 0})
+                            "prefill_gather_admissions": 0,
+                            "kv_demotes": 0, "kv_demoted_pages": 0,
+                            "kv_promotes": 0, "kv_promoted_pages": 0,
+                            "kv_fetches": 0, "kv_fetched_pages": 0,
+                            "kv_imports": 0, "kv_imported_pages": 0})
         # the paged engine's prefill carries the pool page size so a
         # prefix-hit dispatch can attend pool pages in place
         # (prefix_kv= — see _prefill_dispatch)
@@ -534,17 +562,285 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _reclaim_pages(self, needed: int):
         """Evict LRU refcount-0 cached prefix pages until the free list
         covers ``needed`` pages. Fires the ``llm.prefix_evict`` chaos
-        point per evicted page."""
+        point per evicted page. With the host KV tier enabled each
+        victim demotes host-side first (docs/serving.md "Hierarchical
+        KV") — a failed demote loses the chain to the tier but never
+        blocks the reclaim."""
         if self._prefix is None or len(self._free_pages) >= needed:
             return
+        tier = self._kv_tier
+        # _Node doesn't know its adapter — recover it from which
+        # per-adapter root the victim's chain hangs off (one map per
+        # reclaim, not per victim)
+        root_adapters = {id(root): name for name, root
+                         in self._prefix._roots.items()} \
+            if tier is not None else None
 
         def on_evict(node):
             fire(FaultPoints.llm_prefix_evict, page_id=node.page_id,
                  refcount=node.refcount, last_used=node.last_used)
+            if tier is None:
+                return
+            try:
+                self._demote_node(node, root_adapters)
+            except Exception:  # noqa: BLE001 - demote is best-effort:
+                # the page is reclaimed either way, the chain is simply
+                # lost to the tier
+                with self._lock:
+                    self._stats["kv_demotes"] += 1
+                KV_TIER_EVENTS.inc(engine=self._obs_name,
+                                   replica=self.replica, op="demote",
+                                   outcome="error")
 
         freed = self._prefix.evict(needed - len(self._free_pages),
                                    on_evict)
         self._free_pages.extend(freed)
+
+    def _demote_node(self, node, root_adapters: dict):
+        """Copy one eviction victim's page host-side into the KV tier,
+        keyed by its block-chain identity (chaos ``llm.kv_demote``).
+        Eviction is leaf-first, so a chain demotes child-before-parent;
+        the tier's ancestors-outlive-descendants eviction keeps promote
+        probes hole-free regardless."""
+        blocks = []
+        cur = node
+        while cur.parent is not None:
+            blocks.append(cur.block)
+            cur = cur.parent
+        adapter = root_adapters.get(id(cur), "")
+        blocks.reverse()
+        flat = [t for block in blocks for t in block]
+        key = block_chain_key(flat, self.page_size, adapter=adapter)
+        parent_key = block_chain_key(
+            flat[:-self.page_size], self.page_size, adapter=adapter) \
+            if len(blocks) > 1 else None
+        fire(FaultPoints.llm_kv_demote, key=key, page_id=node.page_id,
+             blocks=len(blocks), adapter=adapter)
+        pages = {name: np.asarray(self._pool[name][:, node.page_id])
+                 for name in self._pool}
+        stored = self._kv_tier.put(key, parent_key, pages)
+        with self._lock:
+            self._stats["kv_demotes"] += 1
+            if stored:
+                self._stats["kv_demoted_pages"] += 1
+        KV_TIER_EVENTS.inc(engine=self._obs_name, replica=self.replica,
+                           op="demote",
+                           outcome="ok" if stored else "fallback")
+        KV_TIER_BYTES.set(self._kv_tier.bytes_used,
+                          engine=self._obs_name, replica=self.replica)
+
+    def _tier_probe(self, prompt, adapter: str, k: int) -> list:
+        """Consecutive host-tier payloads for the blocks just past the
+        first ``k`` device-matched ones, probed root-down and stopped at
+        the first miss (the tier's ancestors-outlive-descendants
+        invariant makes deeper probes pointless). Same cap as
+        ``PrefixCache.match``: at least one suffix token always remains
+        to prefill."""
+        limit = max(0, (len(prompt) - 1) // self.page_size)
+        hits: list = []
+        for i in range(k, limit):
+            payload = self._kv_tier.get(block_chain_key(
+                prompt[:(i + 1) * self.page_size], self.page_size,
+                adapter=adapter))
+            if payload is None:
+                break
+            hits.append(payload)
+        return hits
+
+    def _tier_import(self, hits: list, ids, k: int) -> int:
+        """Write probed host-tier payloads into the admission's already
+        reserved fresh pages — the ``gather_prefix_pages``-inverse
+        import: host rows land at the pool pages the slot's page table
+        already points at, bit-identical to what was demoted (chaos
+        ``llm.kv_promote``). Returns the number of promoted blocks."""
+        fire(FaultPoints.llm_kv_promote, blocks=len(hits), base_blocks=k)
+        pids = jnp.asarray(np.asarray(ids[k:k + len(hits)], np.int32))
+        for name in self._pool:
+            rows = jnp.asarray(np.stack([h[name] for h in hits], axis=1))
+            self._pool[name] = self._pool[name].at[:, pids].set(
+                rows.astype(self._pool[name].dtype))
+        with self._lock:
+            self._stats["kv_promotes"] += 1
+            self._stats["kv_promoted_pages"] += len(hits)
+        KV_TIER_EVENTS.inc(engine=self._obs_name, replica=self.replica,
+                           op="promote", outcome="ok")
+        KV_TIER_HITS.inc(len(hits), engine=self._obs_name,
+                         replica=self.replica, tier="host")
+        return len(hits)
+
+    # -- hierarchical KV: cross-replica page fetch ---------------------------
+    def fetch_prefix(self, prompt_tokens, adapter: str = "") -> Future:
+        """Assemble this engine's cached KV for ``prompt_tokens``'s
+        leading full blocks into a prefix-only :class:`KVHandoff`
+        (device pages first, extended through the host tier) — the wire
+        payload a reassigned key's new ring owner imports via
+        :meth:`import_prefix` instead of re-prefilling (docs/serving.md
+        "Hierarchical KV"). Resolves to None when nothing is cached.
+        The op runs on the scheduler thread between ticks
+        (``_control_tick``): the page pool is donated through every
+        decode dispatch, so off-thread pool reads are unsafe."""
+        future: Future = Future()
+        self._control.append(("fetch", (list(prompt_tokens), adapter),
+                              future))
+        if not self._running:
+            self.start()
+        return future
+
+    def import_prefix(self, handoff: KVHandoff) -> Future:
+        """Import a :meth:`fetch_prefix` payload's full blocks into the
+        page pool + prefix index without admitting a request — the
+        receiving side of the fetch hop. Resolves to the number of newly
+        cached pages (0 = already cached, or no pages free)."""
+        expects_scales = self.kv_dtype == "int8"
+        wire_dtype = getattr(handoff, "kv_dtype", None) or (
+            "int8" if "k_scale" in handoff.kv else "native")
+        if wire_dtype != self.kv_dtype or \
+                ("k_scale" in handoff.kv) != expects_scales:
+            raise ValueError(
+                f"KV handoff dtype mismatch: engine kv_dtype="
+                f"'{self.kv_dtype}' cannot import a '{wire_dtype}' "
+                f"payload — fetch and import pools must quantize alike "
+                f"(docs/serving.md 'Engine fleet')")
+        future: Future = Future()
+        self._control.append(("import", (handoff,), future))
+        if not self._running:
+            self.start()
+        return future
+
+    def _control_tick(self):
+        while self._control:
+            kind, args, future = self._control.popleft()
+            if future.done():
+                continue
+            try:
+                if kind == "fetch":
+                    future.set_result(self._do_fetch_prefix(*args))
+                else:
+                    future.set_result(self._do_import_prefix(*args))
+            except Exception as exc:  # noqa: BLE001 - a control op must
+                # fail its own future, never the scheduler
+                future.set_exception(exc)
+
+    def _do_fetch_prefix(self, prompt, adapter: str):
+        if self._prefix is None:
+            return None
+        matched_pages, nodes = self._prefix.match(prompt, adapter=adapter)
+        k = len(matched_pages)
+        try:
+            kv: dict = {}
+            if k:
+                pids = np.asarray(matched_pages, np.int64)
+                for name in self._pool:
+                    rows = np.asarray(self._pool[name][:, pids])
+                    kv[name] = rows.reshape(
+                        rows.shape[0], k * self.page_size,
+                        *rows.shape[3:])
+            tier_rows = [] if self._kv_tier is None \
+                else self._tier_probe(prompt, adapter, k)
+            if tier_rows:
+                for name in self._pool:
+                    stacked = np.stack([h[name] for h in tier_rows],
+                                       axis=1)
+                    rows = stacked.reshape(
+                        stacked.shape[0],
+                        len(tier_rows) * self.page_size,
+                        *stacked.shape[3:])
+                    kv[name] = np.concatenate([kv[name], rows], axis=1) \
+                        if name in kv else rows
+        finally:
+            self._prefix.release(nodes)
+        total = k + len(tier_rows)
+        if not total:
+            KV_TIER_EVENTS.inc(engine=self._obs_name,
+                               replica=self.replica, op="fetch",
+                               outcome="miss")
+            return None
+        rows_tok = total * self.page_size
+        handoff = KVHandoff(
+            prompt=list(prompt[:rows_tok]), first_token=-1, kv=kv,
+            prompt_len=rows_tok, kv_dtype=self.kv_dtype,
+            cached_prefix=rows_tok, replica=self.replica,
+            adapter=adapter, prewarm=True)
+        with self._lock:
+            self._stats["kv_fetches"] += 1
+            self._stats["kv_fetched_pages"] += total
+        KV_TIER_EVENTS.inc(engine=self._obs_name, replica=self.replica,
+                           op="fetch", outcome="ok")
+        return handoff
+
+    def _do_import_prefix(self, handoff: KVHandoff) -> int:
+        if self._prefix is None:
+            return 0
+        prompt = list(handoff.prompt)
+        full = min(len(prompt), handoff.prompt_len) // self.page_size
+        full = min(full, self.pages_per_slot)
+        if full <= 0:
+            return 0
+        adapter = handoff.adapter
+        # a fetch payload is EXACTLY full blocks; match() always leaves
+        # one suffix token unmatched, so probe with a sentinel token to
+        # see every already-cached block (the sentinel is never indexed)
+        _, nodes = self._prefix.match(prompt + [0], adapter=adapter)
+        k = len(nodes)
+        fresh: list = []
+        try:
+            need = full - k
+            if need > 0:
+                self._reclaim_pages(need)
+            if need > len(self._free_pages):
+                # partial import stays contiguous root-down, so the
+                # chain invariant holds for whatever fits
+                need = len(self._free_pages)
+                full = k + need
+            if need <= 0:
+                return 0
+            fresh = [self._free_pages.popleft() for _ in range(need)]
+            ids = np.full((self.pages_per_slot,), -1, np.int32)
+            ids[k:full] = fresh
+            pids = jnp.asarray(np.asarray(fresh, np.int32))
+            for name in self._pool:
+                payload = np.asarray(handoff.kv[name][
+                    :, k * self.page_size:full * self.page_size])
+                payload = payload.reshape(
+                    payload.shape[0], need, self.page_size,
+                    *payload.shape[2:])
+                self._pool[name] = self._pool[name].at[:, pids].set(
+                    jnp.asarray(payload).astype(self._pool[name].dtype))
+            new_nodes, claimed = self._prefix.register(
+                prompt[:full * self.page_size], ids, nodes,
+                adapter=adapter)
+            claimed_set = set(claimed)
+            self._free_pages.extend(
+                p for p in fresh if p not in claimed_set)
+            fresh = []
+            nodes = nodes + new_nodes
+            with self._lock:
+                self._stats["kv_imports"] += 1
+                self._stats["kv_imported_pages"] += len(claimed)
+            KV_TIER_HITS.inc(len(claimed), engine=self._obs_name,
+                             replica=self.replica, tier="remote")
+            return len(claimed)
+        except Exception:
+            self._free_pages.extend(fresh)
+            raise
+        finally:
+            self._prefix.release(nodes)
+
+    def _remove_kv_tier_series(self):
+        """Drop this engine's hierarchical-KV series on stop — the same
+        series-lifecycle contract the stats-mirror families follow
+        (scale-down must not leak series)."""
+        labels = {"engine": self._obs_name, "replica": self.replica}
+        KV_TIER_BYTES.remove(**labels)
+        for tier in ("device", "host", "remote"):
+            KV_TIER_HITS.remove(tier=tier, **labels)
+        for op in ("demote", "promote", "fetch"):
+            for outcome in ("ok", "miss", "fallback", "error"):
+                KV_TIER_EVENTS.remove(op=op, outcome=outcome, **labels)
+
+    def _unregister_metrics(self):
+        super()._unregister_metrics()
+        self._remove_kv_tier_series()
 
     def _prepare_admission(self) -> _Admission | None:
         free = next((i for i, s in enumerate(self._slot_state)
@@ -628,12 +924,47 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     if k:
                         self._prefix.hits += 1
                         self._prefix.cached_tokens += k * self.page_size
+                        if self._kv_tier is not None:
+                            KV_TIER_HITS.inc(
+                                k, engine=self._obs_name,
+                                replica=self.replica, tier="device")
                 self._reclaim_pages(fresh_needed)
                 fresh = [self._free_pages.popleft()
                          for _ in range(fresh_needed)]
                 ids = np.full((self.pages_per_slot,), -1, np.int32)
                 ids[:k] = matched_pages
                 ids[k:needed] = fresh
+                # host-tier promote (docs/serving.md "Hierarchical KV"):
+                # blocks just past the device match that are resident in
+                # the host tier import into their already-reserved fresh
+                # pages instead of prefilling from tokens. A failed
+                # promote degrades to plain token prefill — the fresh
+                # pages are simply prefilled over — never a client error
+                if self._kv_tier is not None and k < needed \
+                        and not isinstance(extra, KVHandoff):
+                    hits = self._tier_probe(prompt, adapter, k)
+                    if hits:
+                        if ledger is not None:
+                            ledger.enter("promote")
+                        try:
+                            promoted = self._tier_import(hits, ids, k)
+                            new_nodes, claimed = self._prefix.register(
+                                prompt[:(k + promoted) * self.page_size],
+                                ids, matched_nodes, adapter=adapter)
+                            matched_nodes = matched_nodes + new_nodes
+                            if claimed:
+                                claimed_set = set(claimed)
+                                fresh = [p for p in fresh
+                                         if p not in claimed_set]
+                            k += promoted
+                        except Exception:  # noqa: BLE001 - fall back
+                            # to prefilling the suffix from tokens
+                            KV_TIER_EVENTS.inc(
+                                engine=self._obs_name,
+                                replica=self.replica, op="promote",
+                                outcome="error")
+                        if ledger is not None:
+                            ledger.enter("admission")
                 adm = _Admission(
                     slot=free, request_id=request_id, prompt=prompt,
                     max_new=max_new, eos_id=eos_id, future=future,
@@ -766,6 +1097,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             future = self._pending.popleft()[4]
             if not future.done():
                 future.set_exception(exc)
+        # queued fetch/import control ops fail the same way — a fetch
+        # hop waiting on a stopping replica must not hang
+        while self._control:
+            future = self._control.popleft()[2]
+            if not future.done():
+                future.set_exception(exc)
         super()._fail_pending(exc)
 
     def _release_slot_storage(self, index: int):
@@ -782,7 +1119,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     # paged-only cumulative stats mirrored to mlt_llm_events_total
     _COUNTER_STATS = ContinuousBatchingEngine._COUNTER_STATS + (
         "attn_kernel_ticks", "attn_gather_ticks", "attn_hbm_bytes_avoided",
-        "prefill_kernel_chunks", "prefill_gather_admissions")
+        "prefill_kernel_chunks", "prefill_gather_admissions",
+        "kv_demotes", "kv_demoted_pages", "kv_promotes",
+        "kv_promoted_pages", "kv_fetches", "kv_fetched_pages",
+        "kv_imports", "kv_imported_pages")
 
     @property
     def stats(self) -> dict:
@@ -799,6 +1139,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             out["prefix_cached_tokens"] = self._prefix.cached_tokens
             out["prefix_evictions"] = self._prefix.evictions
             out["prefix_cached_pages"] = self._prefix.cached_pages()
+        if self._kv_tier is not None:
+            out["kv_tier"] = self._kv_tier.stats()
         return out
 
     def _decode_tick(self) -> int:

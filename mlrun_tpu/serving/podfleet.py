@@ -236,6 +236,21 @@ class PodReplicaClient:
             _trace=_trace)
         return self._track(req, inner)
 
+    # -- cross-replica prefix fetch (docs/serving.md "Hierarchical KV") ------
+    def fetch_prefix(self, prompt_tokens, adapter: str = "") -> Future:
+        """Serve this pod's cached pages for a prompt as a page-payload
+        handoff — the fetch SOURCE side. A control op, not a tracked
+        request: the engine fails its own control futures on stop, so a
+        preempted pod cannot strand the caller."""
+        self._check_alive()
+        return self._engine.fetch_prefix(prompt_tokens, adapter=adapter)
+
+    def import_prefix(self, handoff) -> Future:
+        """Index a fetched page payload into this pod's pool — the
+        fetch TARGET side (pre-warm and the fleet's dispatch-time hop)."""
+        self._check_alive()
+        return self._engine.import_prefix(handoff)
+
     # -- preemption ----------------------------------------------------------
     def preempt(self, grace: bool = True) -> list[dict]:
         """The pod is going away NOW. Fail every in-flight outer future
@@ -506,6 +521,7 @@ class ServingPodFleet:
         t0 = time.perf_counter()
         client = rec["client"]
         replayed = 0
+        fetched = 0
         try:
             fire(FaultPoints.fleet_prewarm, pod=rec["name"],
                  replica=rec["rid"])
@@ -514,15 +530,29 @@ class ServingPodFleet:
             for name, source in sources.items():
                 client.add_adapter_source(name, source)
             client.warmup()
-            # replay the ring slice this replica will own: each
-            # reassigned hot key is prefilled on its CURRENT owner (a
-            # prefix hit there) and imported here with
-            # register_prefix=True, seeding this engine's prefix index
+            # seed the ring slice this replica will own, FETCH-first:
+            # each reassigned hot key's pages are pulled straight out of
+            # the CURRENT owner's pool (a page gather, no prefill
+            # compute — docs/serving.md "Hierarchical KV") and imported
+            # here; keys the owner no longer holds fall back to the
+            # replay path (prefill on the owner, a prefix hit there,
+            # imported via submit_prefilled with register_prefix=True).
             # [-0:] would be the WHOLE list — 0 must mean "replay none"
             keys = (self.fleet.reassigned_hot_keys(rec["rid"])
                     [-self.prewarm_max_keys:]
                     if self.prewarm_max_keys > 0 else [])
             for key, prompt, adapter in keys:
+                payload = self._owner_fetch(key, prompt, adapter)
+                if payload is not None:
+                    try:
+                        client.import_prefix(payload).result(
+                            timeout=_TICK_WAIT_S)
+                        fetched += 1
+                        continue
+                    except Exception as exc:  # noqa: BLE001 - replay
+                        logger.warning("prewarm page import failed; "
+                                       "replaying", pod=rec["name"],
+                                       error=str(exc))
                 handoff = self._owner_prefill(key, prompt, adapter)
                 if handoff is None:
                     continue
@@ -540,6 +570,7 @@ class ServingPodFleet:
         self._event(rec, "prewarm")
         flight_record("pod.prewarm", pod=rec["name"],
                       replica=rec["rid"], replayed_keys=replayed,
+                      fetched_keys=fetched,
                       warm=rec["prewarmed"], wall_s=wall)
         self._set_phase(rec, "ready")
 
@@ -615,6 +646,45 @@ class ServingPodFleet:
                 return None
             raise
         return pod.status.phase
+
+    def _owner_fetch(self, key: int, prompt: list, adapter: str):
+        """Pull one hot prompt's cached pages from its CURRENT ring
+        owner as a page-payload handoff (docs/serving.md "Hierarchical
+        KV") — the cheap pre-warm seed: a pool gather on the owner, no
+        prefill compute. None when fetch is disabled, no owner speaks
+        the protocol, or nobody holds the pages (the caller replays via
+        :meth:`_owner_prefill` instead)."""
+        fleet = self.fleet
+        if not getattr(fleet, "_prefix_fetch", False):
+            return None
+        try:
+            # an armed error models a dead fetch path (degrade to the
+            # replay prefill); an armed delay models a slow pull
+            fire(FaultPoints.llm_kv_fetch, key=key, target="prewarm")
+        except Exception as exc:  # noqa: BLE001 - injected fault
+            logger.warning("prewarm prefix fetch faulted; replaying",
+                           key=key, error=str(exc))
+            return None
+        with fleet._lock:
+            pool = dict(fleet._route_pool())
+            order = fleet._ring.preference(key)
+        for rid in order:
+            replica = pool.get(rid)
+            if replica is None or not replica.healthy:
+                continue
+            fetcher = getattr(replica.engine, "fetch_prefix", None)
+            if fetcher is None:
+                continue
+            try:
+                payload = fetcher(prompt, adapter=adapter).result(
+                    timeout=_TICK_WAIT_S)
+            except Exception as exc:  # noqa: BLE001 - next owner
+                logger.warning("prewarm prefix fetch failed",
+                               replica=rid, error=str(exc))
+                continue
+            if payload is not None:
+                return payload
+        return None
 
     def _owner_prefill(self, key: int, prompt: list, adapter: str):
         """Prefill one hot prompt on its CURRENT ring owner (a prefix
