@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile bench-kv-tier obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile bench-kv-tier bench-failslow bench-index obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -81,6 +81,14 @@ bench-kv-tier:   ## hierarchical KV cache A/B: host-tier hit rate at fixed devic
 		--requests-per-prefix 2 > BENCH_r18.tmp \
 		&& tail -n 1 BENCH_r18.tmp > BENCH_r18.json \
 		&& rm BENCH_r18.tmp && cat BENCH_r18.json
+
+bench-failslow:  ## fail-slow detection A/B: one chaos-degraded replica, detection off vs on — p95 TTFT, zero drops, zero error-path redispatches (docs/observability.md "Replica health & fail-slow detection"); rewrites BENCH_r19.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --failslow > BENCH_r19.tmp \
+		&& tail -n 1 BENCH_r19.tmp > BENCH_r19.json \
+		&& rm BENCH_r19.tmp && cat BENCH_r19.json
+
+bench-index:     ## aggregate all BENCH_r*.json into the BENCH_INDEX.md trajectory table
+	$(PYTHON) scripts/bench_index.py
 
 bench-train:     ## hot-loop pipelining A-B: prefetch on/off + compile cache, CPU-runnable (one JSON line)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --train

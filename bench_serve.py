@@ -736,6 +736,176 @@ def run_fleet(replicas: int = 4, prefixes: int = 12,
     return out
 
 
+def run_failslow(replicas: int = 4, prefixes: int = 12,
+                 detect_rounds: int = 2, measure_rounds: int = 4,
+                 prefix_tokens: int = 48, suffix_tokens: int = 8,
+                 max_new: int = 4, page_size: int = 16,
+                 max_len: int = 128, slots: int = 2, seed: int = 0,
+                 degrade_delay: float = 0.06, warmup: bool = True) -> dict:
+    """Fail-slow detection A/B (docs/observability.md "Replica health &
+    fail-slow detection").
+
+    One replica of a ``replicas``-wide fleet is chaos-degraded with
+    ``fleet.degrade`` (a per-scheduler-iteration delay — every request
+    still succeeds, just late; it NEVER errors, so the error-path
+    machinery is structurally blind to it). Both sides run the identical
+    hot-prefix workload: ``detect_rounds`` sweeps where detection is
+    allowed to converge (excluded from measurement on BOTH sides), then
+    ``measure_rounds`` measured sweeps.
+
+    - **detection off**: affinity routing keeps pinning the degraded
+      replica's prefix families to it, round after round.
+    - **detection on**: a ``ReplicaHealthScorer`` + acting
+      ``FleetAutoscaler`` tick after every request on a logical clock —
+      suspect → probation (ring de-weight) → persistent-probation
+      drain-and-replace through the normal below-min repair, so the
+      measured phase runs on a clean fleet.
+
+    Reports p95 TTFT per side, the speedup, zero-drop / zero-redispatch
+    accounting, detection latency in ticks, and the leaked-series check
+    (the replaced replica must retire its dispatch + health series)."""
+    import re
+
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.chaos import FaultPoints, chaos
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs import REGISTRY
+    from mlrun_tpu.obs.health import ReplicaHealthScorer
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+    from mlrun_tpu.serving.prefix import block_chain_key
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(seed)
+    buckets = tuple(sorted({min(16, max_len), min(64, max_len), max_len}))
+
+    def prompt_of(length):
+        return rng.integers(0, config.vocab_size, length).tolist()
+
+    families = [prompt_of(prefix_tokens) for _ in range(prefixes)]
+    rounds = detect_rounds + measure_rounds
+    # one prompt list shared by both sides — the A/B must differ only
+    # in whether detection acts
+    sweeps = [[family + prompt_of(suffix_tokens) for family in families]
+              for _ in range(rounds)]
+
+    def factory(role):
+        engine = PagedContinuousBatchingEngine(
+            config, params, max_len=max_len, slots=slots,
+            page_size=page_size, prefill_buckets=buckets)
+        if warmup:
+            # warm in the factory, not on the fleet: the autoscaler's
+            # replacement replica must arrive compiled, or its cold
+            # first dispatch pollutes the measured window
+            engine.warmup()
+        return engine
+
+    def degraded_rid(fleet):
+        """The replica owning the MOST prefix families — degrading it
+        maximizes the traffic share affinity keeps pinning wrong."""
+        owners = {}
+        for family in families:
+            key = block_chain_key(family, fleet.route_block_tokens,
+                                  fleet.route_blocks)
+            rid = fleet._ring.lookup(key)
+            owners[rid] = owners.get(rid, 0) + 1
+        return max(sorted(owners), key=lambda r: owners[r]), owners
+
+    def drive(detection: bool):
+        fleet = EngineFleet(factory, replicas=replicas,
+                            routing="affinity", seed=seed)
+        fleet.start()
+        injection = None
+        try:
+            # warm pass: every family cached + a fast-TTFT baseline on
+            # every ring owner before the degradation begins
+            for family in families:
+                fleet.generate(family + [1], max_new_tokens=max_new)
+            rid, owners = degraded_rid(fleet)
+            scaler = None
+            scorer = None
+            if detection:
+                scorer = ReplicaHealthScorer(
+                    fleet, ewma_alpha=1.0, suspect_ticks=1,
+                    probation_ticks=1, recover_ticks=10,
+                    probation_weight=0.05, replace_after_ticks=2)
+                scaler = FleetAutoscaler(
+                    fleet, scorer=scorer, dry_run=False,
+                    min_replicas=replicas, max_replicas=replicas + 1,
+                    hysteresis_ticks=1, cooldown_up_s=0.0,
+                    cooldown_down_s=0.0, drain_grace_s=30.0,
+                    queue_high=1e9, queue_low=0.0,
+                    ttft_p95_high_s=-1.0, failure_rate_high=1.0)
+            injection = chaos.inject(
+                FaultPoints.fleet_degrade, delay=degrade_delay,
+                match=lambda ctx: ctx["replica"] == rid)
+            now = 0.0
+            probation_tick = None
+            detect_ttfts, measured = [], []
+            for rnd, sweep in enumerate(sweeps):
+                bucket = detect_ttfts if rnd < detect_rounds else measured
+                for prompt in sweep:
+                    _, stats = fleet.generate(prompt,
+                                              max_new_tokens=max_new)
+                    bucket.append(stats["ttft_s"])
+                    if scaler is not None:
+                        now += 1.0
+                        scaler.tick(now)
+                        if probation_tick is None and scorer.state(
+                                rid) == "probation":
+                            probation_tick = now
+            stats = fleet.stats
+            live = {r.id for r in fleet.replicas}
+            leaked = sorted(
+                r for r in set(re.findall(r'replica="([^"]+)"',
+                                          REGISTRY.render()))
+                if r.startswith(fleet._fleet_id + "-") and r not in live)
+            return {
+                "degraded_replica": rid,
+                "degraded_families": owners[rid],
+                "p95_ttft_ms": round(
+                    _percentile(measured, 0.95) * 1000, 2),
+                "p50_ttft_ms": round(
+                    _percentile(measured, 0.50) * 1000, 2),
+                "detect_p95_ttft_ms": round(
+                    _percentile(detect_ttfts, 0.95) * 1000, 2),
+                "dropped_requests": 0,  # every generate() returned
+                "redispatches": stats["redispatches"],
+                "failed": stats["failed"],
+                "replaced": rid not in live,
+                "probation_tick": probation_tick,
+                "leaked_series": leaked,
+            }
+        finally:
+            if injection is not None:
+                injection.remove()
+            fleet.stop()
+
+    off = drive(detection=False)
+    on = drive(detection=True)
+    p95_off = off["p95_ttft_ms"]
+    p95_on = on["p95_ttft_ms"]
+    return {
+        "model": "tiny", "replicas": replicas, "prefixes": prefixes,
+        "degrade_delay_ms": round(degrade_delay * 1000, 1),
+        "detect_rounds": detect_rounds, "measure_rounds": measure_rounds,
+        "requests_measured": measure_rounds * prefixes,
+        "detection_off": off, "detection_on": on,
+        "p95_ttft_speedup": round(p95_off / p95_on, 2)
+        if p95_on > 0 else 0.0,
+        "zero_dropped": off["dropped_requests"] == 0
+        and on["dropped_requests"] == 0,
+        "zero_degraded_redispatches": off["redispatches"] == 0
+        and on["redispatches"] == 0,
+        "zero_leaked_series": not off["leaked_series"]
+        and not on["leaked_series"],
+    }
+
+
 def run_fleet_elastic(prefixes: int = 8, requests_per_prefix: int = 3,
                       prefix_tokens: int = 48, suffix_tokens: int = 8,
                       max_new: int = 4, page_size: int = 8,
@@ -1681,6 +1851,10 @@ def main(argv=None):
                         help="run the control-plane crash-recovery A/B "
                              "(journaled reconcile vs cold rebuild) "
                              "instead")
+    parser.add_argument("--failslow", action="store_true",
+                        help="run the fail-slow replica detection A/B "
+                             "(one chaos-degraded replica, detection "
+                             "off vs on) instead")
     parser.add_argument("--kv-tier", action="store_true",
                         help="run the hierarchical KV cache A/B (host "
                              "tier at fixed device bytes + ring-"
@@ -1707,7 +1881,12 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.kv_tier:
+    if args.failslow:
+        result = run_failslow(
+            replicas=args.replicas, prefixes=args.prefixes,
+            **overrides(prefix_tokens=48, suffix_tokens=8, max_new=4,
+                        page_size=16, max_len=128))
+    elif args.kv_tier:
         result = run_kv_tier(
             prefixes=args.prefixes,
             requests_per_prefix=args.requests_per_prefix,

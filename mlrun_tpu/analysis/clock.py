@@ -33,6 +33,9 @@ CONTROL_LOOP_MODULES = {
         "ContinuousTuningController.tick(now) — fake-clock closed loop",
     "mlrun_tpu/model_monitoring/stream_processing.py":
         "AdapterTrafficMonitor.evaluate(adapter, now) — drift windows",
+    "mlrun_tpu/obs/health.py":
+        "ReplicaHealthScorer.tick(now) — fake-clock fail-slow "
+        "detection drills",
     "mlrun_tpu/obs/slo.py":
         "SLOEvaluator.evaluate(at) — burn-rate window arithmetic",
     "mlrun_tpu/obs/timeseries.py":

@@ -65,6 +65,12 @@ class FaultPoints:
     # one pod drain start (scale-down / preemption) — an error models a
     # drain endpoint that cannot be reached before deletion
     fleet_drain = "fleet.drain"
+    # one engine scheduler iteration on a live replica (serving/
+    # llm_batch.py _loop) — a delay() narrowed by match= to one replica
+    # makes that replica fail-SLOW: every request still succeeds, just
+    # late. The grey-failure class the error-path machinery (circuit
+    # breaker, redispatch) is blind to and ReplicaHealthScorer exists for
+    fleet_degrade = "fleet.degrade"
     # one intent-journal record write (common/journal.py IntentJournal
     # .append) — fires with a mutable ``box`` carrying the serialized
     # line; an action() may truncate box["line"] to model a torn write
@@ -169,6 +175,7 @@ class FaultPoints:
             FaultPoints.k8s_pod_kill,
             FaultPoints.fleet_pod_ready, FaultPoints.fleet_prewarm,
             FaultPoints.fleet_join, FaultPoints.fleet_drain,
+            FaultPoints.fleet_degrade,
             FaultPoints.journal_write,
             FaultPoints.fleet_controller_crash,
             FaultPoints.provider_create,

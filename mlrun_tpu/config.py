@@ -275,6 +275,36 @@ default_config: dict[str, Any] = {
             # every scale-up signal clear
             "queue_low": 1.0,
         },
+        # fail-slow replica detection (docs/observability.md "Replica
+        # health & fail-slow detection"); ReplicaHealthScorer class args
+        # override these
+        "health": {
+            "enabled": True,
+            # EWMA smoothing weight on the per-tick raw score (1.0 =
+            # no smoothing; lower = slower to react, harder to fool)
+            "ewma_alpha": 0.5,
+            # robust-z thresholds: a replica whose smoothed score holds
+            # at/above suspect_z is an outlier; recovery requires
+            # falling below recover_z (the gap is the hysteresis band)
+            "suspect_z": 3.0,
+            "recover_z": 1.5,
+            # consecutive bad ticks before healthy -> suspect, further
+            # bad ticks before suspect -> probation, and consecutive
+            # good ticks before any sick state -> healthy
+            "suspect_ticks": 2,
+            "probation_ticks": 2,
+            "recover_ticks": 2,
+            # ring vnode weight applied on probation (fraction of the
+            # replica's keyspace it keeps; traffic shifts gradually,
+            # only the shed slice of keys moves)
+            "probation_weight": 0.25,
+            # probation ticks before the replica becomes a
+            # drain-and-replace candidate for the autoscaler
+            "replace_after_ticks": 20,
+            # a signal participates in scoring only when this many
+            # replicas report it (no meaningful median below that)
+            "min_peers": 3,
+        },
     },
     "observability": {
         # unified telemetry (docs/observability.md): the metrics registry

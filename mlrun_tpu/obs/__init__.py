@@ -230,6 +230,22 @@ FLEET_POD_PREWARM_SECONDS = REGISTRY.histogram(
     "reassigned-prefix KV replay) before the ring join",
     buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
              30.0))
+REPLICA_HEALTH_SCORE = REGISTRY.gauge(
+    "mlt_replica_health_score",
+    "EWMA-smoothed peer-relative badness (robust z over the fleet "
+    "median; obs/health.py ReplicaHealthScorer) — 0 is median-healthy, "
+    "above suspect_z the replica is a fail-slow outlier",
+    labels=("replica",), max_label_sets=512, overflow="drop")
+REPLICA_HEALTH_STATE = REGISTRY.gauge(
+    "mlt_replica_health_state",
+    "Replica health state machine position (0 healthy, 1 suspect, "
+    "2 probation; retired with the replica's other series on stop)",
+    labels=("replica",), max_label_sets=512, overflow="drop")
+HEALTH_TRANSITIONS = REGISTRY.counter(
+    "mlt_health_transitions_total",
+    "Health state-machine transitions per replica, labeled by the state "
+    "entered (suspect / probation / healthy)",
+    labels=("replica", "to"), max_label_sets=512, overflow="drop")
 
 # -- control-plane crash recovery (common/journal.py + per-controller
 # reconcile — docs/fault_tolerance.md "Control-plane crash recovery") --------

@@ -64,7 +64,7 @@ class FleetAutoscaler:
 
     def __init__(self, fleet, store=None, aggregator=None,
                  slo=None, ttft_window: float = 60.0, pods=None,
-                 journal=None, **overrides):
+                 journal=None, scorer=None, **overrides):
         conf = mlconf.serving.autoscale
         def knob(name, cast=float):
             if name in overrides:
@@ -74,6 +74,11 @@ class FleetAutoscaler:
         self.fleet = fleet
         self.store = store
         self.aggregator = aggregator
+        # fail-slow detection (obs/health.py ReplicaHealthScorer): when
+        # set, the scorer ticks on this loop's clock, probated replicas
+        # are preferred scale-down victims, and persistent probation
+        # triggers a drain-and-replace through the normal lifecycle
+        self.scorer = scorer
         # cross-process elasticity (serving/podfleet.ServingPodFleet):
         # when set, scale actions submit/drain serving JobSets instead
         # of building in-process replicas, and every tick advances the
@@ -296,6 +301,10 @@ class FleetAutoscaler:
                         self._draining[rid] = now
                         RECONCILE_ACTIONS.inc(controller="autoscaler",
                                               action="adopt_drain")
+            if self.scorer is not None:
+                # score BEFORE signals: a probated replica's ring weight
+                # drops here, so this tick's routing already shifts
+                self.scorer.tick(now)
             sig = self.signals(now, advance=True)
             action, reason = self._evaluate(sig)
             box = {"action": action, "reason": reason, "force": False}
@@ -336,10 +345,44 @@ class FleetAutoscaler:
                     forced or self._cooled(action, now)):
                 acted = self._act(action, now)
             removed = self._sweep_draining(now)
+            if acted is None:
+                replaced = self._replace_degraded(now)
+                if replaced is not None:
+                    acted = replaced
         return {"action": action, "reason": reason, "recommended":
                 recommended, "desired": desired, "current": current,
                 "acted": acted, "removed": removed, "forced": forced,
                 "signals": sig, "dry_run": self.dry_run}
+
+    def _replace_degraded(self, now: float) -> Optional[dict]:
+        """Drain one persistently-probated replica (fail-slow
+        replacement, obs/health.py). Deliberately a *repair*, not a
+        demand decision: it runs regardless of cooldown, one replica at
+        a time, and never while another drain is in flight. Removal
+        drops the fleet to (or below) its floor momentarily — the
+        forced ``below_min`` path resubmits the replacement capacity on
+        the next tick, which pre-warm makes cheap."""
+        if self.scorer is None or self.dry_run or self._draining:
+            return None
+        rid = self.scorer.pop_replace_due()
+        if rid is None:
+            return None
+        if not any(r.id == rid for r in self.fleet.replicas):
+            return None  # probated replica already left the fleet
+        # the decision is recorded BEFORE the drain so the flight chain
+        # reads causally: health.probation -> health.replace -> pod.drain
+        flight_record("health.replace", replica=rid, at=now)
+        if self.pods is not None and self.pods.owns(rid):
+            self.pods.drain(rid, now)
+        else:
+            self.fleet.drain_replica(rid)
+        self._draining[rid] = now
+        AUTOSCALER_ACTIONS.inc(action="drain")
+        self._journal_append(op="act", action="replace_degraded",
+                             replica=rid, at=now)
+        logger.warning("autoscaler replacing degraded replica",
+                       replica=rid)
+        return {"action": "replace_degraded", "replica": rid}
 
     def _act(self, action: str, now: float) -> Optional[dict]:
         if action == "up":
@@ -390,7 +433,9 @@ class FleetAutoscaler:
     def _scale_down_victim(self):
         """Least-loaded non-draining worker — the cheapest replica to
         take out of rotation (its keyspace moves to ring neighbors; its
-        few in-flight requests finish during the drain)."""
+        few in-flight requests finish during the drain). A probated
+        (fail-slow) replica is preferred over ANY load ordering: if the
+        fleet is shedding capacity anyway, shed the sick capacity."""
         workers = self._workers()
         if len(workers) <= self.min_replicas:
             return None
@@ -401,7 +446,12 @@ class FleetAutoscaler:
             except Exception:  # noqa: BLE001
                 return 0
 
-        return min(workers, key=lambda r: (load_of(r), r.id))
+        def probated(replica):
+            return getattr(replica, "health_state",
+                           "healthy") == "probation"
+
+        return min(workers, key=lambda r: (0 if probated(r) else 1,
+                                           load_of(r), r.id))
 
     def _sweep_draining(self, now: float) -> list[str]:
         """Remove drained replicas whose in-flight work hit zero (or

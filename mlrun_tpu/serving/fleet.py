@@ -44,7 +44,7 @@ import hashlib
 import random
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Callable, Optional
 
@@ -56,6 +56,9 @@ from ..obs import (
     FLEET_HANDOFF_BYTES,
     FLEET_HANDOFF_LATENCY,
     FLEET_REPLICAS,
+    HEALTH_TRANSITIONS,
+    REPLICA_HEALTH_SCORE,
+    REPLICA_HEALTH_STATE,
     get_tracer,
 )
 from ..utils import logger
@@ -111,6 +114,7 @@ class ConsistentHashRing:
         self._points: list[int] = []      # sorted ring positions
         self._owners: list[str] = []      # owner node per position
         self._nodes: set[str] = set()
+        self._weights: dict[str, float] = {}
 
     @staticmethod
     def _point(data: str) -> int:
@@ -123,20 +127,35 @@ class ConsistentHashRing:
     def nodes(self) -> list[str]:
         return sorted(self._nodes)
 
-    def add(self, node: str):
+    def add(self, node: str, weight: float = 1.0):
+        """Add (or re-weight) a node. ``weight`` in (0, 1] scales the
+        node's vnode count: a de-weighted node keeps the FIRST
+        ``round(vnodes * weight)`` of its deterministic points, so
+        probation sheds only the keys owned by the dropped points —
+        restoring weight 1.0 restores the identical ownership map, and
+        keys on the kept points never move at all."""
+        weight = min(1.0, max(0.0, float(weight)))
         if node in self._nodes:
-            return
+            if self._weights.get(node, 1.0) == weight:
+                return
+            self.remove(node)
         self._nodes.add(node)
-        for i in range(self.vnodes):
+        self._weights[node] = weight
+        count = max(1, round(self.vnodes * weight))
+        for i in range(count):
             point = self._point(f"{node}#{i}")
             idx = bisect.bisect(self._points, point)
             self._points.insert(idx, point)
             self._owners.insert(idx, node)
 
+    def weight(self, node: str) -> float:
+        return self._weights.get(node, 1.0) if node in self._nodes else 0.0
+
     def remove(self, node: str):
         if node not in self._nodes:
             return
         self._nodes.discard(node)
+        self._weights.pop(node, None)
         keep = [(p, o) for p, o in zip(self._points, self._owners)
                 if o != node]
         self._points = [p for p, _ in keep]
@@ -184,6 +203,11 @@ class EngineReplica:
         # registered (visible in stats, warm-able) but takes NO ring
         # traffic until join_replica() flips this — ready means warm
         self.joining = False
+        # fail-slow probation (obs/health.py ReplicaHealthScorer): the
+        # scorer de-weights a probated replica's ring vnodes instead of
+        # draining it — correct-but-slow deserves less traffic, not death
+        self.weight = 1.0
+        self.health_state = "healthy"
         # stamp the replica label BEFORE the engine registers metrics
         engine.replica = replica_id
 
@@ -271,6 +295,12 @@ class EngineFleet:
         self._stats = {"dispatches": 0, "redispatches": 0, "failed": 0,
                        "no_replica": 0, "handoffs": 0, "handoff_bytes": 0,
                        "prefix_fetches": 0, "prefix_fetch_fallbacks": 0}
+        # per-replica sliding outcome windows (rid -> deque of 0/1):
+        # rates, not lifetime counters — a replica that failed an hour
+        # ago and recovered reads 0.0, which is what the health scorer
+        # (obs/health.py) and operators actually want to see
+        self._dispatch_outcomes: dict[str, deque] = {}
+        self._fetch_outcomes: dict[str, deque] = {}
         self._ttft_ring: list = []            # end-to-end, bounded below
         self._ttft_ring_max = 512
         # hot routing keys (bounded LRU):
@@ -328,7 +358,7 @@ class EngineFleet:
                 self._ring.remove(node)
         for rid, replica in route.items():
             if not replica.draining and not replica.joining:
-                self._ring.add(rid)
+                self._ring.add(rid, weight=replica.weight)
 
     @property
     def replicas(self) -> list[EngineReplica]:
@@ -402,8 +432,34 @@ class EngineFleet:
         # pins dead replicas until the family's cardinality bound bites
         for outcome in ("ok", "redispatch", "failed"):
             FLEET_DISPATCHES.remove(replica=replica_id, outcome=outcome)
+        # health telemetry rides the same lifecycle: scorer series and
+        # outcome windows die with the replica (remove() is a no-op for
+        # series the scorer never wrote)
+        REPLICA_HEALTH_SCORE.remove(replica=replica_id)
+        REPLICA_HEALTH_STATE.remove(replica=replica_id)
+        for to in ("healthy", "suspect", "probation"):
+            HEALTH_TRANSITIONS.remove(replica=replica_id, to=to)
+        with self._lock:
+            self._dispatch_outcomes.pop(replica_id, None)
+            self._fetch_outcomes.pop(replica_id, None)
         logger.info("fleet replica removed", replica=replica_id,
                     fleet=self._fleet_id)
+
+    def set_replica_weight(self, replica_id: str, weight: float):
+        """Scale a replica's share of the ring keyspace (probation
+        actuation, obs/health.py). Weight in (0, 1] keeps a deterministic
+        prefix of its vnode points, so only the shed slice of keys moves
+        to neighbors and restoring 1.0 restores identical ownership.
+        Drain/joining state is untouched — a de-weighted replica still
+        serves the keys it keeps and all in-flight work."""
+        with self._lock:
+            for pool in (self._workers, self._prefill):
+                if replica_id in pool:
+                    pool[replica_id].weight = min(
+                        1.0, max(0.0, float(weight)))
+                    self._sync_ring()
+                    return
+        raise KeyError(f"unknown replica '{replica_id}'")
 
     def drain_replica(self, replica_id: str):
         """Stop routing NEW work to a replica (in-flight work finishes);
@@ -728,6 +784,7 @@ class EngineFleet:
             with self._lock:
                 self._stats["prefix_fetches" if fetched
                             else "prefix_fetch_fallbacks"] += 1
+            self._note_fetch(target.id, fetched)
             if fetched:
                 logger.info("fleet prefix fetch", key=state["key"],
                             owner=owner.id, target=target.id)
@@ -768,6 +825,19 @@ class EngineFleet:
                 .add_done_callback(on_fetch)
         except Exception:  # noqa: BLE001 - fall back to plain dispatch
             finish(False)
+
+    def _note_dispatch(self, replica_id: str, ok: bool):
+        """Append one outcome to the replica's sliding window (ok=False
+        covers both redispatch and terminal failure — either way the
+        replica didn't complete work it was handed)."""
+        with self._lock:
+            self._dispatch_outcomes.setdefault(
+                replica_id, deque(maxlen=64)).append(0 if ok else 1)
+
+    def _note_fetch(self, replica_id: str, fetched: bool):
+        with self._lock:
+            self._fetch_outcomes.setdefault(
+                replica_id, deque(maxlen=64)).append(0 if fetched else 1)
 
     # unified fleet: one replica runs prefill AND decode
     def _dispatch_unified(self, out: Future, state: dict):
@@ -818,6 +888,7 @@ class EngineFleet:
             return
         if redispatchable(exc):
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            self._note_dispatch(replica.id, ok=False)
             logger.warning("fleet re-dispatching request",
                            replica=replica.id, error=str(exc),
                            attempt=state["attempts"] + 1)
@@ -834,6 +905,7 @@ class EngineFleet:
                 self._retry_later(out, state, redo, exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._note_dispatch(replica.id, ok=False)
         self._fail(out, state, exc)
 
     def _dispatch_handoff(self, out: Future, state: dict):
@@ -878,6 +950,7 @@ class EngineFleet:
             return
         if redispatchable(exc):
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            self._note_dispatch(replica.id, ok=False)
             newer = getattr(exc, "handoff", None)
             if newer is not None:
                 state["handoff"] = newer
@@ -887,6 +960,7 @@ class EngineFleet:
                     lambda: self._dispatch_handoff(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._note_dispatch(replica.id, ok=False)
         self._fail(out, state, exc)
 
     # disaggregated fleet: prefill pool → KV handoff → decode pool
@@ -938,12 +1012,14 @@ class EngineFleet:
             return
         if redispatchable(exc):
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            self._note_dispatch(replica.id, ok=False)
             if self._budget_left(out, state, exc):
                 self._retry_later(
                     out, state,
                     lambda: self._dispatch_prefill(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._note_dispatch(replica.id, ok=False)
         self._fail(out, state, exc)
 
     def _dispatch_decode(self, out: Future, state: dict):
@@ -989,6 +1065,7 @@ class EngineFleet:
             # decode replica without touching the prefill pool again; a
             # preempted decode replica may ship back a FRESHER handoff
             FLEET_DISPATCHES.inc(replica=replica.id, outcome="redispatch")
+            self._note_dispatch(replica.id, ok=False)
             newer = getattr(exc, "handoff", None)
             if newer is not None:
                 state["handoff"] = newer
@@ -998,6 +1075,7 @@ class EngineFleet:
                     lambda: self._dispatch_decode(out, state), exc=exc)
             return
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="failed")
+        self._note_dispatch(replica.id, ok=False)
         self._fail(out, state, exc)
 
     def _finalize(self, out: Future, state: dict,
@@ -1008,6 +1086,7 @@ class EngineFleet:
             stats["adapter"] = state["adapter"]
         self._merge_timing(state, stats)
         FLEET_DISPATCHES.inc(replica=replica.id, outcome="ok")
+        self._note_dispatch(replica.id, ok=True)
         with self._lock:
             # remember WHERE this key's pages now live: the fetch source
             # after the ring moves the key to a different replica.
@@ -1039,6 +1118,10 @@ class EngineFleet:
             out = dict(self._stats)
             ttfts = sorted(self._ttft_ring)
             replicas = self.replicas
+            dispatch_windows = {rid: list(win) for rid, win
+                                in self._dispatch_outcomes.items()}
+            fetch_windows = {rid: list(win) for rid, win
+                             in self._fetch_outcomes.items()}
         out["routing"] = self.routing
         out["replicas"] = len(replicas)
         out["prefill_replicas"] = sum(
@@ -1059,10 +1142,20 @@ class EngineFleet:
                 load = replica.load()
             except Exception:  # noqa: BLE001 - a stopping replica's
                 load = 0       # queue may already be torn down
+            d_win = dispatch_windows.get(replica.id, ())
+            f_win = fetch_windows.get(replica.id, ())
             per[replica.id] = {
                 "role": replica.role,
                 "draining": replica.draining,
                 "joining": replica.joining,
+                "weight": replica.weight,
+                "health_state": replica.health_state,
+                # windowed rates (last 64 outcomes), not lifetime
+                # counters — what the health scorer and operators read
+                "dispatch_failure_rate": (
+                    sum(d_win) / len(d_win) if d_win else 0.0),
+                "fetch_fallback_rate": (
+                    sum(f_win) / len(f_win) if f_win else 0.0),
                 "requests": stats.get("requests", 0),
                 "completed": stats.get("completed", 0),
                 "queue_depth": stats.get("queue_depth", 0),

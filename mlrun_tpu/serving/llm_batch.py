@@ -1738,6 +1738,11 @@ class ContinuousBatchingEngine:
                 # on-demand profiling (POST /debug/profile): claims or
                 # advances an armed capture — one global check when dark
                 profiler_tick(self._obs_name)
+                # fail-slow injection seam: an armed delay() narrowed to
+                # one replica stretches every scheduler iteration there —
+                # TTFT and ITL rise, nothing ever errors
+                fire(FaultPoints.fleet_degrade, replica=self.replica,
+                     engine=self._obs_name)
                 self._expire_queued()
                 self._control_tick()
                 self._admission_tick()

@@ -16,6 +16,10 @@ across re-scrapes. Then the canary leg: the continuous-tuning closed
 loop (drift injected via ``monitor.drift``) driven to an automatic
 promotion, with the ``mlt_canary_*`` / drift-stat families carrying
 bounded samples over HTTP and the promotion event in the flight ring.
+Then the fail-slow leg: one replica of a live 3-replica fleet is
+chaos-degraded (correct, just slow) and the peer-relative health
+scorer must flip ``mlt_replica_health_state`` to probation on the
+``/metrics`` scrape with the transition in ``/debug/flight``.
 Finally the training leg: a tiny ``Trainer.fit``
 with a forced preemption — the ``mlt_goodput_*`` families must carry
 samples, the attribution must sum to wall time, and the flight ring
@@ -454,6 +458,111 @@ def _canary_leg(base: str):
         engine.stop()
 
 
+def _failslow_leg(base: str):
+    """Fail-slow smoke (docs/observability.md "Replica health &
+    fail-slow detection"): one replica of a live 3-replica fleet is
+    chaos-degraded — correct answers, injected latency — and the
+    peer-relative scorer must flip its health state to probation on the
+    HTTP ``/metrics`` surface with the transition in ``/debug/flight``.
+    The scorer runs on a logical clock; the only wall time spent is the
+    injected delay itself."""
+    import jax
+    import requests
+
+    from mlrun_tpu.chaos import FaultPoints, chaos
+    from mlrun_tpu.models import init_params, tiny_llama
+    from mlrun_tpu.obs.health import ReplicaHealthScorer
+    from mlrun_tpu.serving.fleet import EngineFleet
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = tiny_llama(attention_impl="reference")
+    params = init_params(config, jax.random.PRNGKey(0))
+
+    def factory(role):
+        # short latency window: the warm pass flushes the cold-compile
+        # TTFT outlier, so the peer-relative baseline is steady-state
+        # latency, not compile noise
+        return PagedContinuousBatchingEngine(
+            config, params, max_len=64, slots=2, page_size=16,
+            prefill_buckets=(64,), latency_window=8)
+
+    fleet = EngineFleet(factory, replicas=3)
+    fleet.start()
+    injection = None
+    try:
+        # two prompts per ring owner, so every replica reports TTFT
+        # each round (the scorer's min_peers gate needs all three)
+        per_owner = {r.id: [] for r in fleet.replicas}
+        probe = 0
+        while any(len(v) < 2 for v in per_owner.values()) and probe < 5000:
+            candidate = [(probe + 5 * j) % 97 + 1 for j in range(8)]
+            owner = fleet._ring.lookup(fleet.routing_key(candidate))
+            if len(per_owner[owner]) < 2:
+                per_owner[owner].append(candidate)
+            probe += 1
+        if any(len(v) < 2 for v in per_owner.values()):
+            _fail("could not spread smoke prompts over all 3 replicas")
+        prompts = [p for plist in per_owner.values() for p in plist]
+        for _ in range(4):  # warm until compile TTFTs leave the window
+            for prompt in prompts:
+                fleet.generate(prompt, max_new_tokens=2)
+
+        rid = fleet.replicas[0].id
+        scorer = ReplicaHealthScorer(
+            fleet, ewma_alpha=1.0, suspect_ticks=1, probation_ticks=1,
+            recover_ticks=100, probation_weight=0.25,
+            replace_after_ticks=1000, min_peers=3)
+        injection = chaos.inject(
+            FaultPoints.fleet_degrade, delay=0.05,
+            match=lambda ctx: ctx["replica"] == rid)
+        now = 0.0
+        for _ in range(8):
+            for prompt in prompts:
+                fleet.generate(prompt, max_new_tokens=2)
+            now += 1.0
+            scorer.tick(now)
+            if scorer.state(rid) == "probation":
+                break
+        if scorer.state(rid) != "probation":
+            _fail(f"degraded replica never probated: state "
+                  f"{scorer.state(rid)}, score {scorer.score(rid):.2f}")
+        if fleet._ring.weight(rid) != 0.25:
+            _fail(f"probation did not de-weight the ring: "
+                  f"{fleet._ring.weight(rid)}")
+
+        # the state flip is on the HTTP metrics surface
+        resp = requests.get(base + "/metrics", timeout=10)
+        if resp.status_code != 200:
+            _fail(f"/metrics returned {resp.status_code} on "
+                  f"fail-slow leg")
+        sample = next(
+            (line for line in resp.text.splitlines()
+             if line.startswith("mlt_replica_health_state{")
+             and f'replica="{rid}"' in line), None)
+        if sample is None:
+            _fail("mlt_replica_health_state missing from /metrics")
+        if float(sample.rsplit(" ", 1)[1]) != 2.0:
+            _fail(f"health state did not flip to probation: {sample}")
+
+        # and the transition is in the flight ring over HTTP
+        flight = requests.get(base + "/debug/flight",
+                              params={"kind": "health.*"},
+                              timeout=10).json()
+        if not any(e["kind"] == "health.probation"
+                   and e.get("replica") == rid
+                   for e in flight["events"]):
+            _fail("health.probation transition missing from "
+                  "/debug/flight")
+        return {
+            "failslow_replica": rid,
+            "failslow_score": round(scorer.score(rid), 2),
+        }
+    finally:
+        if injection is not None:
+            injection.remove()
+        fleet.stop()
+
+
 def _training_leg(base: str):
     """Goodput / flight-recorder smoke (docs/observability.md "Goodput &
     badput"): run a tiny ``Trainer.fit`` with a forced preemption
@@ -643,6 +752,7 @@ def main() -> int:
         fleet_summary.update(_forensics_leg(base))
         fleet_summary.update(_adapter_leg(base))
         fleet_summary.update(_canary_leg(base))
+        fleet_summary.update(_failslow_leg(base))
         fleet_summary.update(_training_leg(base))
     finally:
         box["stop"] = True

@@ -1,0 +1,548 @@
+"""Fail-slow replica detection (docs/observability.md "Replica health &
+fail-slow detection").
+
+Three layers, all on fake clocks (MLT003 — the scorer takes ``now``):
+
+- scorer units against a duck-typed fleet: MAD outlier scoring, EWMA +
+  hysteresis streaks, probation weight actuation, recovery, the
+  min-peers gate, and health-series retirement when a replica vanishes;
+- ring-weight units: de-weighting moves ONLY keys the de-weighted node
+  owned, and restoring weight 1.0 restores the exact original ownership;
+- drills (slow): a chaos-degraded REAL paged engine rides
+  healthy -> suspect -> probation -> ring de-weight -> recovery with
+  greedy outputs unchanged and zero drops; and a persistently-degraded
+  pod replica is replaced through fake_k8s (drain -> delete ->
+  below-min repair) with the ordered flight chain to prove causality.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+
+import pytest
+
+from mlrun_tpu.chaos import FaultPoints, chaos
+from mlrun_tpu.obs import (
+    HEALTH_TRANSITIONS,
+    REGISTRY,
+    REPLICA_HEALTH_SCORE,
+    REPLICA_HEALTH_STATE,
+)
+from mlrun_tpu.obs.flight import get_flight_recorder
+from mlrun_tpu.obs.health import ReplicaHealthScorer
+from mlrun_tpu.serving.fleet import ConsistentHashRing, EngineFleet
+
+from . import fake_k8s
+
+
+# -- scorer units against a duck-typed fleet ---------------------------------
+class _Replica:
+    def __init__(self, rid):
+        self.id = rid
+        self.weight = 1.0
+        self.health_state = "healthy"
+
+
+class _StatsFleet:
+    """Duck-typed EngineFleet surface the scorer consumes: ``stats``
+    with a per_replica breakdown, ``replicas``, and the weight setter."""
+
+    def __init__(self, rids):
+        self.replicas = [_Replica(rid) for rid in rids]
+        self.rows = {rid: {"draining": False, "joining": False,
+                           "ttft_p95_s": 0.010} for rid in rids}
+        self.weights = {}   # actuation log: rid -> [weights set]
+
+    @property
+    def stats(self):
+        return {"per_replica": {rid: dict(row)
+                                for rid, row in self.rows.items()}}
+
+    def set_replica_weight(self, rid, weight):
+        if not any(r.id == rid for r in self.replicas):
+            raise KeyError(rid)
+        self.weights.setdefault(rid, []).append(weight)
+        for replica in self.replicas:
+            if replica.id == rid:
+                replica.weight = weight
+
+
+def _scorer(fleet, **overrides):
+    defaults = dict(ewma_alpha=1.0, suspect_z=3.0, recover_z=1.5,
+                    suspect_ticks=2, probation_ticks=1, recover_ticks=2,
+                    probation_weight=0.25, replace_after_ticks=4,
+                    min_peers=3)
+    defaults.update(overrides)
+    return ReplicaHealthScorer(fleet, **defaults)
+
+
+def test_mad_outlier_walks_to_probation_and_deweights():
+    """A persistent TTFT outlier walks healthy -> suspect -> probation
+    on the configured streaks, the probation tick de-weights its ring
+    vnodes, and every hop lands in the transitions counter + flight."""
+    get_flight_recorder().clear()
+    fleet = _StatsFleet(["hr0", "hr1", "hr2", "hr3"])
+    scorer = _scorer(fleet)
+    fleet.rows["hr3"]["ttft_p95_s"] = 0.200  # 20x its peers
+    before = HEALTH_TRANSITIONS.value(replica="hr3", to="probation")
+
+    snap = scorer.tick(now=1.0)
+    assert snap["hr3"]["score"] >= 3.0      # robust z over the floor
+    assert snap["hr0"]["score"] == 0.0      # median peers score zero
+    assert scorer.state("hr3") == "healthy"  # 1 bad tick < suspect_ticks
+    scorer.tick(now=2.0)
+    assert scorer.state("hr3") == "suspect"
+    assert fleet.weights == {}               # suspect = observe only
+    scorer.tick(now=3.0)
+    assert scorer.state("hr3") == "probation"
+    assert fleet.weights == {"hr3": [0.25]}
+    assert fleet.replicas[3].health_state == "probation"
+    assert REPLICA_HEALTH_STATE.value(replica="hr3") == 2
+    assert HEALTH_TRANSITIONS.value(replica="hr3", to="probation") \
+        == before + 1
+    kinds = [e["kind"] for e in get_flight_recorder().events(
+        kind="health.*") if e.get("replica") == "hr3"]
+    assert kinds == ["health.suspect", "health.probation"]
+
+
+def test_one_tick_blip_never_leaves_healthy():
+    """Hysteresis: a single slow tick (GC pause, compile stall) resets
+    once the replica rejoins the pack — no transition, no actuation."""
+    fleet = _StatsFleet(["hb0", "hb1", "hb2", "hb3"])
+    scorer = _scorer(fleet)
+    fleet.rows["hb3"]["ttft_p95_s"] = 0.200
+    scorer.tick(now=1.0)
+    fleet.rows["hb3"]["ttft_p95_s"] = 0.010  # blip over
+    scorer.tick(now=2.0)
+    scorer.tick(now=3.0)
+    assert scorer.state("hb3") == "healthy"
+    assert fleet.weights == {}
+    assert HEALTH_TRANSITIONS.value(replica="hb3", to="suspect") == 0
+
+
+def test_recovery_restores_weight_and_clears_replace_flag():
+    """A probated replica that re-converges with its peers recovers:
+    weight 1.0 re-actuated, replace candidacy withdrawn, flight event."""
+    get_flight_recorder().clear()
+    fleet = _StatsFleet(["hc0", "hc1", "hc2", "hc3"])
+    scorer = _scorer(fleet, replace_after_ticks=1)
+    fleet.rows["hc3"]["ttft_p95_s"] = 0.200
+    for now in (1.0, 2.0, 3.0):
+        scorer.tick(now=now)
+    assert scorer.state("hc3") == "probation"
+    fleet.rows["hc3"]["ttft_p95_s"] = 0.010  # healed
+    scorer.tick(now=4.0)
+    scorer.tick(now=5.0)
+    assert scorer.state("hc3") == "healthy"
+    assert fleet.weights["hc3"] == [0.25, 1.0]
+    assert scorer.pop_replace_due() is None  # candidacy withdrawn
+    assert [e["kind"] for e in get_flight_recorder().events(
+        kind="health.*") if e.get("replica") == "hc3"] == \
+        ["health.suspect", "health.probation", "health.recovered"]
+
+
+def test_persistent_probation_flags_replacement_exactly_once():
+    fleet = _StatsFleet(["hd0", "hd1", "hd2", "hd3"])
+    scorer = _scorer(fleet, replace_after_ticks=2)
+    fleet.rows["hd3"]["ttft_p95_s"] = 0.200
+    for now in range(1, 7):
+        scorer.tick(now=float(now))
+    assert scorer.state("hd3") == "probation"
+    assert scorer.pop_replace_due() == "hd3"
+    assert scorer.pop_replace_due() is None  # handed out exactly once
+    scorer.tick(now=7.0)                     # still probated: no re-add
+    assert scorer.pop_replace_due() is None
+
+
+def test_min_peers_gates_every_signal():
+    """Two replicas have no meaningful median — a grotesque outlier in
+    a too-small population must score zero, not condemn itself."""
+    fleet = _StatsFleet(["he0", "he1"])
+    scorer = _scorer(fleet)
+    fleet.rows["he1"]["ttft_p95_s"] = 5.0
+    snap = scorer.tick(now=1.0)
+    assert snap["he1"]["score"] == 0.0
+    assert scorer.state("he1") == "healthy"
+
+
+def test_draining_and_joining_replicas_are_not_scored():
+    """Lifecycle is not sickness: a draining victim or warming joiner
+    is excluded from the population on BOTH sides (not scored, and not
+    smearing the peers' median)."""
+    fleet = _StatsFleet(["hf0", "hf1", "hf2", "hf3"])
+    fleet.rows["hf3"]["ttft_p95_s"] = 0.200
+    fleet.rows["hf3"]["draining"] = True
+    scorer = _scorer(fleet)
+    snap = scorer.tick(now=1.0)
+    assert "hf3" not in snap
+    assert scorer.state("hf3") == "healthy"
+
+
+def test_vanished_replica_retires_health_series():
+    """Scorer memory and gauge series follow the replica out: after it
+    leaves the population, no mlt_replica_health_* series leaks."""
+    fleet = _StatsFleet(["hg0", "hg1", "hg2", "hg3"])
+    scorer = _scorer(fleet)
+    scorer.tick(now=1.0)
+    assert REPLICA_HEALTH_STATE.value(replica="hg3") == 0
+    del fleet.rows["hg3"]
+    fleet.replicas = [r for r in fleet.replicas if r.id != "hg3"]
+    scorer.tick(now=2.0)
+    rendered = REGISTRY.render()
+    assert 'mlt_replica_health_state{replica="hg3"}' not in rendered
+    assert 'mlt_replica_health_score{replica="hg3"}' not in rendered
+    assert 'mlt_replica_health_state{replica="hg0"}' in rendered
+
+
+def test_knob_validation():
+    fleet = _StatsFleet(["hv0", "hv1", "hv2"])
+    with pytest.raises(ValueError, match="unknown health scorer knobs"):
+        ReplicaHealthScorer(fleet, not_a_knob=1)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        ReplicaHealthScorer(fleet, ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="recover_z"):
+        ReplicaHealthScorer(fleet, suspect_z=2.0, recover_z=3.0)
+    with pytest.raises(ValueError, match="probation_weight"):
+        ReplicaHealthScorer(fleet, probation_weight=0.0)
+
+
+# -- weighted ring ------------------------------------------------------------
+def _ownership(ring, keys):
+    return {key: ring.lookup(key) for key in keys}
+
+
+def test_ring_deweight_moves_only_victim_keys_and_restores_exactly():
+    ring = ConsistentHashRing(vnodes=64)
+    for node in ("w0", "w1", "w2", "w3"):
+        ring.add(node)
+    keys = list(range(0, 2 ** 63, 2 ** 63 // 512))
+    before = _ownership(ring, keys)
+
+    ring.add("w2", weight=0.25)
+    assert ring.weight("w2") == 0.25
+    during = _ownership(ring, keys)
+    moved = [k for k in keys if during[k] != before[k]]
+    assert moved  # the de-weight actually sheds keyspace
+    # minimal movement: every moved key left the de-weighted node, and
+    # none moved ONTO it — peers' slices are untouched
+    assert all(before[k] == "w2" for k in moved)
+    assert all(during[k] != "w2" for k in moved)
+
+    ring.add("w2", weight=1.0)
+    assert _ownership(ring, keys) == before  # exact restoration
+
+
+def test_ring_weight_keeps_at_least_one_vnode():
+    ring = ConsistentHashRing(vnodes=8)
+    ring.add("x0")
+    ring.add("x1", weight=0.001)  # clamps to >= 1 point, stays routable
+    assert "x1" in ring.nodes()
+    assert ring.lookup(ring._point("x1#0")) in ("x0", "x1")
+
+
+# -- fleet plumbing: windowed failure rates + scale-down preference ----------
+class _InstantEngine:
+    page_size = 8
+
+    def __init__(self):
+        self.replica = ""
+        self._slot_state = ()
+        self.depth = 0
+
+    def _queue_depth(self):
+        return self.depth
+
+    def start(self):
+        pass
+
+    def stop(self, timeout=10.0):
+        pass
+
+    def submit(self, prompt, adapter="", **kwargs):
+        future = Future()
+        future.set_result((list(prompt)[:1], {"ttft_s": 0.001,
+                                              "cached_prefix": 0}))
+        return future
+
+    @property
+    def stats(self):
+        return {"requests": 0, "completed": 0, "queue_depth": self.depth}
+
+
+def test_per_replica_rates_are_windowed_not_lifetime():
+    """dispatch_failure_rate / fetch_fallback_rate are rates over the
+    last-64 outcome window: old failures age out as successes arrive."""
+    fleet = EngineFleet(lambda role: _InstantEngine(), replicas=1,
+                        route_block_tokens=8)
+    try:
+        rid = fleet.replicas[0].id
+        fleet._note_dispatch(rid, ok=False)
+        fleet._note_dispatch(rid, ok=True)
+        fleet._note_fetch(rid, fetched=True)
+        fleet._note_fetch(rid, fetched=False)
+        row = fleet.stats["per_replica"][rid]
+        assert row["dispatch_failure_rate"] == 0.5
+        assert row["fetch_fallback_rate"] == 0.5
+        for _ in range(64):  # the failure ages out of the window
+            fleet._note_dispatch(rid, ok=True)
+        row = fleet.stats["per_replica"][rid]
+        assert row["dispatch_failure_rate"] == 0.0
+    finally:
+        fleet.stop()
+
+
+def test_scale_down_prefers_probated_replica():
+    """If the fleet sheds capacity anyway, it sheds the sick replica —
+    probation beats ANY load ordering in victim selection."""
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+
+    engines = []
+
+    def factory(role):
+        engine = _InstantEngine()
+        engines.append(engine)
+        return engine
+
+    fleet = EngineFleet(factory, replicas=3, route_block_tokens=8)
+    try:
+        scaler = FleetAutoscaler(fleet, dry_run=True, min_replicas=1,
+                                 max_replicas=4)
+        # the probated replica is the BUSIEST — load alone would spare it
+        fleet.replicas[2].health_state = "probation"
+        engines[2].depth = 50
+        victim = scaler._scale_down_victim()
+        assert victim.id == fleet.replicas[2].id
+        fleet.replicas[2].health_state = "healthy"
+        victim = scaler._scale_down_victim()  # load order reasserts
+        assert victim.id != fleet.replicas[2].id
+    finally:
+        fleet.stop()
+
+
+# -- drill: real engines, chaos-degraded replica, probation + recovery -------
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from mlrun_tpu.models import init_params, tiny_llama
+
+    cfg = tiny_llama(attention_impl="reference")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_failslow_drill_degrade_probation_recovery(setup):
+    """End-to-end on REAL paged engines: chaos makes one replica
+    fail-SLOW (correct, late), the scorer probates it off its peers'
+    TTFT median, the ring de-weights it, and once the chaos lifts the
+    replica recovers to weight 1.0 with the EXACT pre-degrade ring
+    ownership. Greedy outputs never change; nothing drops."""
+    cfg, params = setup
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    get_flight_recorder().clear()
+    config = dict(max_len=64, slots=2, prefill_buckets=(16,),
+                  page_size=8, latency_window=8)
+    fleet = EngineFleet(
+        lambda role: PagedContinuousBatchingEngine(cfg, params, **config),
+        replicas=4, route_block_tokens=8)
+    prompts = [[(7 * i + j) % 89 + 1 for j in range(16)]
+               for i in range(8)]
+    try:
+        expected = {}
+        for prompt in prompts:  # warm pass doubles as greedy baseline
+            tokens, _ = fleet.generate(prompt, max_new_tokens=4)
+            expected[tuple(prompt)] = tokens
+        rid = fleet._ring.lookup(fleet.routing_key(prompts[0]))
+        probe_keys = [fleet.routing_key(p) for p in prompts]
+        before = {k: fleet._ring.lookup(k) for k in probe_keys}
+        scorer = ReplicaHealthScorer(
+            fleet, ewma_alpha=1.0, suspect_ticks=1, probation_ticks=1,
+            recover_ticks=2, probation_weight=0.25,
+            replace_after_ticks=1000, min_peers=3)
+
+        now = 0.0
+        with chaos.inject(FaultPoints.fleet_degrade, delay=0.05,
+                          match=lambda ctx: ctx["replica"] == rid):
+            for _ in range(6):
+                for prompt in prompts:
+                    tokens, _ = fleet.generate(prompt, max_new_tokens=4)
+                    assert tokens == expected[tuple(prompt)]
+                now += 1.0
+                scorer.tick(now)
+                if scorer.state(rid) == "probation":
+                    break
+            assert scorer.state(rid) == "probation"
+            assert fleet._ring.weight(rid) == 0.25
+
+        # recovery: the degraded replica kept ~25% of its vnodes, so
+        # fresh FAST requests routed there flush its 8-deep TTFT window
+        still_owned = []
+        probe = 0
+        while len(still_owned) < 10 and probe < 4000:
+            candidate = [(probe + 3 * j) % 97 + 1 for j in range(16)]
+            if fleet._ring.lookup(fleet.routing_key(candidate)) == rid:
+                still_owned.append(candidate)
+            probe += 1
+        assert len(still_owned) == 10
+        for _ in range(6):
+            for prompt in still_owned:
+                fleet.generate(prompt, max_new_tokens=2)
+            now += 1.0
+            scorer.tick(now)
+            if scorer.state(rid) == "healthy":
+                break
+        assert scorer.state(rid) == "healthy"
+        assert fleet._ring.weight(rid) == 1.0
+        assert {k: fleet._ring.lookup(k) for k in probe_keys} == before
+        for prompt in prompts:  # ownership AND outputs fully restored
+            tokens, _ = fleet.generate(prompt, max_new_tokens=4)
+            assert tokens == expected[tuple(prompt)]
+        kinds = [e["kind"] for e in get_flight_recorder().events(
+            kind="health.*") if e.get("replica") == rid]
+        assert kinds == ["health.suspect", "health.probation",
+                         "health.recovered"]
+    finally:
+        fleet.stop()
+
+
+# -- drill: persistently-degraded pod replaced through fake_k8s --------------
+class _DepthEngine(_InstantEngine):
+    """Queue-depth is the outlier signal here: hung sentinels fake a
+    stalled-but-alive pod the same way the elastic drill does."""
+
+    def __init__(self):
+        super().__init__()
+        self.hung = []
+
+    def _queue_depth(self):
+        return len(self.hung)
+
+    def warmup(self):
+        pass
+
+    def submit_prefilled(self, handoff, **kwargs):
+        future = Future()
+        future.set_result((list(handoff.prompt)[:1], {
+            "ttft_s": 0.001, "cached_prefix": handoff.cached_prefix}))
+        return future
+
+    @property
+    def stats(self):
+        return {"requests": 0, "completed": 0,
+                "queue_depth": len(self.hung)}
+
+
+@pytest.fixture()
+def cluster(monkeypatch):
+    return fake_k8s.install(monkeypatch)
+
+
+@pytest.fixture()
+def provider(cluster):
+    from mlrun_tpu.service.runtime_handlers import KubernetesProvider
+
+    return KubernetesProvider(namespace="testns")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_degraded_pod_replaced_via_drain_delete_repair(cluster, provider):
+    """ISSUE acceptance drill: a persistently-probated POD replica is
+    replaced through the normal lifecycle — autoscaler pops the replace
+    candidate, drains the pod, the sweep deletes its JobSet at load
+    zero, and below-min repair brings up a fresh pod. The flight chain
+    health.suspect -> health.probation -> health.replace -> pod.drain
+    -> pod.delete is strictly seq-ordered, and no health series leaks."""
+    from mlrun_tpu.serving.podfleet import ServingPodFleet
+    from mlrun_tpu.service.autoscaler import FleetAutoscaler
+
+    get_flight_recorder().clear()
+    created = []
+
+    def factory(role):
+        engine = _DepthEngine()
+        created.append(engine)
+        return engine
+
+    fleet = EngineFleet(factory, replicas=2, route_block_tokens=8,
+                        backoff=0.001)
+    pods = ServingPodFleet(fleet, provider, factory, topology="1x1")
+    scorer = ReplicaHealthScorer(
+        fleet, ewma_alpha=1.0, suspect_ticks=1, probation_ticks=1,
+        recover_ticks=100, probation_weight=0.25, replace_after_ticks=1,
+        min_peers=3)
+    scaler = FleetAutoscaler(
+        fleet, pods=pods, scorer=scorer, dry_run=False, min_replicas=3,
+        max_replicas=4, hysteresis_ticks=1, cooldown_up_s=0.0,
+        cooldown_down_s=0.0, drain_grace_s=5.0, queue_low=0.0,
+        queue_high=1e9)
+    try:
+        # ticks 0-3: below_min boots pod1 through pending -> warming ->
+        # ready -> joined (scoring idles: only 2 candidates < min_peers)
+        decision = scaler.tick(now=0.0)
+        assert decision["reason"] == "below_min"
+        pod1 = decision["acted"]["pod"]
+        for now in (1.0, 2.0, 3.0):
+            scaler.tick(now=now)
+        assert pods.pods() == {pod1: "joined"}
+        pod_rid = next(rec["rid"] for rec in pods._pods.values())
+        sentinel = (Future(), [])
+
+        # the pod replica stalls: depth 24 vs peers at 0 -> robust z
+        # blows past suspect_z on the queue_depth floor
+        created[2].hung.extend([sentinel] * 24)
+        scaler.tick(now=4.0)                 # -> suspect
+        assert scorer.state(pod_rid) == "suspect"
+        decision = scaler.tick(now=5.0)      # -> probation + replace
+        assert scorer.state(pod_rid) == "probation"
+        assert decision["acted"] == {"action": "replace_degraded",
+                                     "replica": pod_rid}
+        assert pods.pods()[pod1] == "draining"
+        assert pod_rid not in fleet._ring.nodes()
+
+        # busy within grace: the sweep must wait for in-flight work.
+        # Meanwhile below-min repair already submits the replacement —
+        # the draining victim no longer counts as a worker, so the fresh
+        # capacity overlaps the drain instead of waiting for it
+        decision = scaler.tick(now=6.0)
+        assert decision["removed"] == []
+        assert decision["reason"] == "below_min"
+        pod2 = decision["acted"]["pod"]
+        assert pod2 != pod1
+        created[2].hung.clear()
+        decision = scaler.tick(now=7.0)
+        assert decision["removed"] == [pod_rid]
+        assert pod1 not in pods.pods()
+        assert pod1 not in cluster.pods
+
+        # the replacement pod walks to joined on the following ticks
+        for now in (8.0, 9.0, 10.0):
+            scaler.tick(now=now)
+        assert pods.pods() == {pod2: "joined"}
+        assert len(fleet.replicas) == 3
+
+        # ordered causal chain, stitched across health + pod events
+        events = [e for e in get_flight_recorder().events()
+                  if (e["kind"].startswith("health.")
+                      and e.get("replica") == pod_rid)
+                  or (e["kind"] in ("pod.drain", "pod.delete")
+                      and e.get("pod") == pod1)]
+        kinds = [e["kind"] for e in sorted(events,
+                                           key=lambda e: e["seq"])]
+        chain = ["health.suspect", "health.probation", "health.replace",
+                 "pod.drain", "pod.delete"]
+        cursor = 0
+        for kind in chain:
+            cursor = kinds.index(kind, cursor)
+
+        # the replaced replica's health series are retired with it
+        rendered = REGISTRY.render()
+        assert f'mlt_replica_health_state{{replica="{pod_rid}"}}' \
+            not in rendered
+        assert f'mlt_replica_health_score{{replica="{pod_rid}"}}' \
+            not in rendered
+    finally:
+        fleet.stop()
