@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile bench-kv-tier bench-failslow bench-index obs-smoke dryrun clean
+.PHONY: help test test-fast chaos lint-invariants native bench bench-serving bench-serve bench-fleet bench-train bench-attn bench-autoscale bench-lora bench-canary bench-goodput bench-reqtrace bench-elastic bench-prefill bench-fleet-elastic bench-reconcile bench-kv-tier bench-failslow bench-spec bench-index obs-smoke dryrun clean
 
 help:            ## list targets with their one-line descriptions
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | \
@@ -86,6 +86,11 @@ bench-failslow:  ## fail-slow detection A/B: one chaos-degraded replica, detecti
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --failslow > BENCH_r19.tmp \
 		&& tail -n 1 BENCH_r19.tmp > BENCH_r19.json \
 		&& rm BENCH_r19.tmp && cat BENCH_r19.json
+
+bench-spec:      ## in-engine speculative decoding A/B: spec-off vs spec-on vs adversarial draft on the paged engine — decode tokens/s, acceptance, exact-parity booleans (docs/serving.md "Speculative decoding"); rewrites BENCH_r20.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serve.py --spec > BENCH_r20.tmp \
+		&& tail -n 1 BENCH_r20.tmp > BENCH_r20.json \
+		&& rm BENCH_r20.tmp && cat BENCH_r20.json
 
 bench-index:     ## aggregate all BENCH_r*.json into the BENCH_INDEX.md trajectory table
 	$(PYTHON) scripts/bench_index.py

@@ -1661,6 +1661,131 @@ def run_lora(tenants: int = 4, requests_per_tenant: int = 6,
     }
 
 
+def run_spec(requests: int = 8, prompt_tokens: int = 24, max_new: int = 32,
+             k: int = 4, page_size: int = 16, max_len: int = 128,
+             slots: int = 4, tick_cost_s: float = 0.15,
+             overlap: float = 0.85, seed: int = 0,
+             warmup: bool = True) -> dict:
+    """In-engine speculative decoding A/B on the paged engine
+    (docs/serving.md "Speculative decoding"): the identical workload —
+    half the rows under a LoRA tenant — served spec-off, spec-on with a
+    partial-agreement draft, and spec-on with an adversarial draft
+    (near-zero acceptance: the per-row gate must park, not regress).
+
+    Deterministic permutation models (``init_permutation_params``) make
+    acceptance a controlled dial (``overlap``) AND make greedy parity a
+    hard token-identity assertion in every arm. A per-scheduler-tick
+    ``fleet.degrade`` delay injection models the fixed device cost one
+    dispatch costs a real accelerator at production model scale — the
+    quantity speculation amortizes: a spec tick pays it once for
+    k-plus-one-token verify, a plain tick pays it per token. The
+    default (150 ms) is sized so it dominates this CPU harness's python
+    scheduling overhead the way a large-model forward dominates the
+    host loop on a TPU. Reports tokens/s per arm,
+    ``speedup`` (spec-on over spec-off), ``adversarial_ratio`` (must
+    stay ~1: parked speculation may not tax the fleet), and the parity
+    booleans."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from mlrun_tpu.chaos import FaultPoints, always, chaos
+    from mlrun_tpu.models import (
+        init_lora_nonzero,
+        init_permutation_params,
+        permutation_pair,
+        tiny_llama,
+    )
+    from mlrun_tpu.serving.paged import PagedContinuousBatchingEngine
+
+    config = dataclasses.replace(tiny_llama(attention_impl="reference"),
+                                 vocab_size=64, tie_embeddings=False)
+    target_perm, draft_perm = permutation_pair(config.vocab_size, overlap,
+                                               seed=seed)
+    target = init_permutation_params(config, target_perm)
+    draft = init_permutation_params(config, draft_perm)
+    adversarial = init_permutation_params(
+        config, np.roll(np.asarray(target_perm), 7), seed=3)
+    # tiny delta: exercises the adapter-bearing dispatch without leaving
+    # the permutation model's argmax-stability regime (parity stays a
+    # token-identity claim)
+    lora = init_lora_nonzero(config, jax.random.PRNGKey(5), rank=2,
+                             alpha=0.1, b_scale=0.001)
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, config.vocab_size, prompt_tokens).tolist()
+               for _ in range(requests)]
+    buckets = tuple(sorted({min(64, max_len), max_len}))
+
+    def drive(spec_conf):
+        engine = PagedContinuousBatchingEngine(
+            config, target, max_len=max_len, slots=slots,
+            page_size=page_size, prefill_buckets=buckets,
+            adapters={"tenant-0": lora}, speculative=spec_conf,
+            # the queue backlog is the offered load, not pressure — keep
+            # the ladder parked at level 0 so the A/B measures the spec
+            # path, not the ladder's fleet-wide park
+            degradation={"queue_depth": requests + slots})
+        if warmup:
+            engine.warmup()
+        engine.start()
+        try:
+            with chaos.inject(FaultPoints.fleet_degrade, always(),
+                              delay=tick_cost_s):
+                started = time.perf_counter()
+                futures = [engine.submit(
+                    prompt, max_new_tokens=max_new,
+                    adapter="tenant-0" if i % 2 else None)
+                    for i, prompt in enumerate(prompts)]
+                results = [f.result(timeout=600) for f in futures]
+                wall = time.perf_counter() - started
+            stats = engine.stats
+        finally:
+            engine.stop()
+        streams = [tokens for tokens, _ in results]
+        tokens_total = sum(len(s) for s in streams)
+        tps = tokens_total / wall if wall > 0 else 0.0
+        return tps, stats, streams
+
+    spec_on_conf = {"enabled": True, "k": k, "draft_config": config,
+                    "draft_params": draft}
+    adv_conf = {"enabled": True, "k": k, "draft_config": config,
+                "draft_params": adversarial}
+    off_tps, off_stats, off_streams = drive(None)
+    on_tps, on_stats, on_streams = drive(spec_on_conf)
+    adv_tps, adv_stats, adv_streams = drive(adv_conf)
+
+    adapter_rows = [i for i in range(requests) if i % 2]
+
+    def arm(tps, stats):
+        return {
+            "tokens_per_sec": round(tps, 1),
+            "acceptance_rate": round(stats.get("acceptance_rate", 0.0), 3),
+            "spec_rounds": stats.get("spec_rounds", 0),
+            "spec_tokens_per_round": round(
+                stats.get("spec_tokens_per_round", 0.0), 2),
+        }
+
+    return {
+        "mode": "spec", "model": "tiny-perm", "requests": requests,
+        "prompt_tokens": prompt_tokens, "max_new": max_new, "k": k,
+        "slots": slots, "overlap": overlap,
+        "tick_cost_ms": round(tick_cost_s * 1000, 3),
+        "spec_off": arm(off_tps, off_stats),
+        "spec_on": arm(on_tps, on_stats),
+        "adversarial": arm(adv_tps, adv_stats),
+        "speedup": round(on_tps / off_tps, 2) if off_tps > 0 else 0.0,
+        "adversarial_ratio": round(adv_tps / off_tps, 2)
+        if off_tps > 0 else 0.0,
+        "greedy_parity": on_streams == off_streams
+        and adv_streams == off_streams,
+        "adapter_parity": all(on_streams[i] == off_streams[i]
+                              for i in adapter_rows),
+        "metrics": _metrics_snapshot(on_stats),
+    }
+
+
 def _canary_tune_handler(context, tenant="", output_path="", **kwargs):
     """The fine-tune job the canary bench's loop submits (local
     launcher): a deterministic 'retrained' adapter artifact."""
@@ -1859,6 +1984,10 @@ def main(argv=None):
                         help="run the hierarchical KV cache A/B (host "
                              "tier at fixed device bytes + ring-"
                              "reassignment fetch vs re-prefill) instead")
+    parser.add_argument("--spec", action="store_true",
+                        help="run the in-engine speculative decoding "
+                             "A/B (spec-off vs spec-on vs adversarial "
+                             "draft on the paged engine) instead")
     parser.add_argument("--pods", type=int, default=2)
     parser.add_argument("--tenants", type=int, default=4)
     # shared flags default to None so each mode keeps its own scale:
@@ -1881,7 +2010,11 @@ def main(argv=None):
             args, key) is None else getattr(args, key))
             for key, value in defaults.items()}
 
-    if args.failslow:
+    if args.spec:
+        result = run_spec(requests=args.requests,
+                          **overrides(max_new=32, page_size=16,
+                                      max_len=128))
+    elif args.failslow:
         result = run_failslow(
             replicas=args.replicas, prefixes=args.prefixes,
             **overrides(prefix_tokens=48, suffix_tokens=8, max_new=4,

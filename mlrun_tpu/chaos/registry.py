@@ -112,6 +112,11 @@ class FaultPoints:
     # — a delay()/action() here wedges the scheduler mid-dispatch, the
     # shape of hang the stop() epoch guard exists for
     llm_prefill = "llm.prefill"
+    # one speculative verify round (llm_batch._spec_decode_tick): fires
+    # BEFORE the draft steps and the multi-token verify dispatch — an
+    # armed error parks that tick to plain decode (never a client error;
+    # the stream stays exact-greedy), a delay() models a slow verify
+    llm_spec_verify = "llm.spec_verify"
     # prefix-cache page eviction (serving/paged.py _reclaim_pages) — fires
     # per evicted page with page_id/refcount context; an action() here
     # observes eviction order, an error models a poisoned reclaim
@@ -185,7 +190,8 @@ class FaultPoints:
             FaultPoints.httpdb_request, FaultPoints.execution_commit,
             FaultPoints.serving_step, FaultPoints.serving_remote,
             FaultPoints.serving_queue, FaultPoints.llm_submit,
-            FaultPoints.llm_prefill, FaultPoints.llm_prefix_evict,
+            FaultPoints.llm_prefill, FaultPoints.llm_spec_verify,
+            FaultPoints.llm_prefix_evict,
             FaultPoints.llm_adapter_load,
             FaultPoints.llm_kv_demote, FaultPoints.llm_kv_promote,
             FaultPoints.llm_kv_fetch,

@@ -215,6 +215,29 @@ default_config: dict[str, Any] = {
                 # host-store byte budget for demoted pages + scales
                 "host_bytes": 64 << 20,
             },
+            # in-engine speculative decoding (docs/serving.md
+            # "Speculative decoding"): a resident draft model proposes k
+            # tokens per scheduler tick and ONE multi-token verify
+            # dispatch commits the accepted prefix. Off by default —
+            # needs a draft model; LLMModelServer's ``speculative`` arg
+            # / the engines' ``speculative`` dict override these
+            "speculative": {
+                "enabled": False,
+                # max draft tokens proposed per row per round; per-row k
+                # adapts below this from the acceptance window
+                "k": 4,
+                # draft model preset name (models/llama MODEL_PRESETS)
+                # for LLMModelServer; engines take draft_config/
+                # draft_params directly
+                "draft": "",
+                # rows whose windowed acceptance rate falls below this
+                # park to plain decode (re-probed at k=1 periodically)
+                "min_acceptance": 0.35,
+                # per-adapter acceptance window (verify rounds)
+                "window": 32,
+                # parked adapters re-probe every N consulted rounds
+                "probe_every": 16,
+            },
         },
         # engine replica fleet (docs/serving.md "Engine fleet");
         # EngineFleet / LLMModelServer class args override these

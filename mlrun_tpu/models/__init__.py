@@ -2,11 +2,13 @@ from .llama import (  # noqa: F401
     LlamaConfig,
     forward,
     init_params,
+    init_permutation_params,
     llama3_1b,
     llama3_8b,
     llama3_70b,
     loss_fn,
     param_shapes,
+    permutation_pair,
     tiny_llama,
 )
 from .lora import (  # noqa: F401

@@ -149,6 +149,71 @@ def param_shapes(config: LlamaConfig) -> Params:
     return jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))
 
 
+def init_permutation_params(config: LlamaConfig, perm, scale: float = 50.0,
+                            seed: int = 0) -> Params:
+    """Deterministic "permutation-following" params: the greedy next
+    token after ``t`` is the unique ``v`` with ``perm[v] == t``. All
+    transformer weights are zero (the residual passes the embedding
+    through untouched) and the untied head is ``scale * E[perm]^T``, so
+    ``logits[v] = scale * <x, E[perm[v]]>`` peaks where ``perm[v]``
+    matches the current token with gaps of O(scale) — orders of
+    magnitude above jit-vs-eager float noise, which keeps argmax stable
+    across differently-shaped compiled forwards. The speculative
+    decoding tests and ``bench_serve.py --spec`` need exactly this
+    knob (draft quality = how much of the draft's permutation agrees
+    with the target's — :func:`permutation_pair`); one definition here,
+    not one per caller. Requires ``tie_embeddings=False``."""
+    if config.tie_embeddings:
+        raise ValueError("permutation params need an untied lm_head "
+                         "(tie_embeddings=False)")
+    params = init_params(config, jax.random.PRNGKey(seed))
+    params = jax.tree_util.tree_map(jnp.zeros_like, params)
+    emb = jax.random.normal(jax.random.PRNGKey(seed + 1),
+                            (config.vocab_size, config.embed_dim),
+                            jnp.float32)
+    emb = emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+    params["embedding"] = emb.astype(config.dtype)
+    # norms must stay identity-ish: rms_norm scales are multiplicative
+    params["layers"]["attn_norm_scale"] = jnp.ones_like(
+        params["layers"]["attn_norm_scale"])
+    params["layers"]["mlp_norm_scale"] = jnp.ones_like(
+        params["layers"]["mlp_norm_scale"])
+    params["final_norm_scale"] = jnp.ones_like(params["final_norm_scale"])
+    params["lm_head"] = (scale * emb[jnp.asarray(perm)].T).astype(
+        config.dtype)
+    return params
+
+
+def permutation_pair(vocab_size: int, overlap: float, seed: int = 0):
+    """A target permutation plus a draft permutation agreeing on
+    ``overlap`` of tokens — the controlled acceptance-rate dial for
+    :func:`init_permutation_params` model pairs (overlap 1.0 → every
+    draft proposal accepted; 0.0-ish → near-zero acceptance).
+
+    The target is one full-length cycle and the disagreements are
+    spaced evenly along it. A greedy stream walks exactly one cycle of
+    the permutation, so with random disagreement placement a row's
+    EFFECTIVE acceptance would be the luck of its cycle (some rows
+    near 1.0, others near 0 at the same ``overlap``) — evenly spaced
+    corruption on a single cycle makes ``overlap`` a uniform per-row
+    dial instead."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(vocab_size)           # cycle walk order
+    target = np.empty(vocab_size, dtype=order.dtype)
+    target[order] = np.roll(order, -1)            # single n-cycle
+    draft = target.copy()
+    n_diff = int(round(vocab_size * (1 - overlap)))
+    n_diff -= n_diff % 2                          # swaps corrupt in pairs
+    if n_diff >= 2:
+        pos = np.linspace(0, vocab_size, n_diff,
+                          endpoint=False).astype(np.int64)
+        a, b = order[pos[0::2]], order[pos[1::2]]
+        draft[a], draft[b] = target[b], target[a]
+    return target, draft
+
+
 # -- forward ----------------------------------------------------------------
 
 def _remat_policy(name: str):

@@ -157,6 +157,22 @@ LLM_EVENTS = REGISTRY.counter(
     "shed, expired, prefix_hits, prefix_evictions, ...)",
     labels=("engine", "replica", "event"), max_label_sets=1024,
     overflow="drop")
+# in-engine speculative decoding (docs/serving.md "Speculative
+# decoding"): fed from engine stats at scrape time, removed on engine
+# stop like the rest of the per-replica families
+LLM_SPEC_ROUNDS = REGISTRY.counter(
+    "mlt_llm_spec_rounds_total",
+    "Speculative verify rounds (one multi-token verify dispatch covers "
+    "every speculating row in the tick; each speculating row counts one "
+    "round)",
+    labels=("engine", "replica"), max_label_sets=512, overflow="drop")
+LLM_SPEC_TOKENS = REGISTRY.counter(
+    "mlt_llm_spec_tokens_total",
+    "Draft tokens by verify outcome: accepted (matched the target "
+    "argmax) vs rejected (rolled back on the KV by pos-rewind) — "
+    "accepted/(accepted+rejected) is the fleet acceptance rate",
+    labels=("engine", "replica", "outcome"), max_label_sets=512,
+    overflow="drop")
 # hierarchical KV cache (serving/kv_tier.py, docs/serving.md
 # "Hierarchical KV"): fed event-side from the paged engine, removed on
 # engine stop like the rest of the per-replica families

@@ -42,7 +42,15 @@ serving path (docs/serving.md "Attention kernels"):
   state ``(o, lse)`` that ``merge_softmax_states`` LSE-merges with the
   local causal flash over the suffix — the admission-time dense
   ``gather_prefix_pages`` copy becomes the CPU/reference fallback only.
-- **int8 KV pages**: both kernels take optional per-vector f32 dequant
+- **batched speculative verify** (``_paged_verify_call`` /
+  ``paged_verify_attention``): the in-engine speculative-decoding verify
+  dispatch (docs/serving.md "Speculative decoding") — every decode
+  slot's (k+1)-token chunk attends its own prefix pages in place
+  through the page table (per-row page ids AND per-row ``base`` on
+  scalar prefetch), merged with the chunk's local causal part. The
+  verify chunk is the prefill kernel's q-chunk form, batched per slot;
+  ``paged_verify_reference`` is the gather+dense fallback.
+- **int8 KV pages**: all kernels take optional per-vector f32 dequant
   scales riding the same page-table-indexed operands as the pages, so a
   ``kv_dtype="int8"`` pool (double the resident pages per HBM byte)
   runs the kernel path instead of downgrading to the reference.
@@ -476,6 +484,246 @@ def paged_prefill_attention(q, k_cache, v_cache, q_start, k_pages,
     o_pre, lse_pre = paged_prefix_part(
         q, k_pages, v_pages, page_ids, base, page_size=page_size,
         k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+    return merge_softmax_states(o_pre, lse_pre, o_loc, lse_loc)
+
+
+# ---------------------------------------------------------------------------
+# batched multi-row verify: a speculative chunk per slot over the page pool
+# ---------------------------------------------------------------------------
+
+def _paged_verify_kernel(ids_ref, base_ref, q_ref, k_ref, v_ref, *refs,
+                         page_size: int, pages_per_slot: int,
+                         kv_heads: int, scale: float, quantized: bool):
+    """Grid (slot x kv_head, q_block, page-slot) — the speculative-verify
+    form of :func:`_paged_prefill_kernel`: same per-page prefix update
+    (``_prefill_page_update``), but batched over every decode slot at
+    once, each row reading ITS OWN page ids and prefix bound from the
+    prefetched ``ids_ref [slots, pages_per_slot]`` / ``base_ref [slots]``
+    (the leading grid dim collapses slot and kv head so the q blocks stay
+    the prefill kernel's 2D row tiles). The chunk's own causal part is
+    NOT computed here — the caller LSE-merges it
+    (:func:`chunk_causal_part` + :func:`merge_softmax_states`), exactly
+    like the prefill hit path merges its local flash."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    g = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    base = base_ref[g // kv_heads]
+    block_rows = q_ref.shape[1]
+    live = p * page_size < base
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page_size, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        _prefill_page_update(q_ref, k, v, m_scr, l_scr, acc_scr,
+                             p=p, base=base, page_size=page_size,
+                             scale=scale)
+
+    @pl.when(p == pages_per_slot - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = acc_scr[:] / l
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
+                                      (block_rows, 8))
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def _paged_verify_call(q, k_pages, v_pages, page_table, base,
+                       page_size: int, k_scale=None, v_scale=None,
+                       interpret=None):
+    """q [slots, S, H, D] (every slot's speculative verify chunk) attends
+    each row's prefix tokens 0..base[r]-1 IN PLACE through the page table
+    — the batched form of :func:`_paged_prefill_call`. Returns
+    (o [slots, S, H, D] f32, lse [slots, H, S] f32) partial softmax
+    states in the flash lse layout, ready for
+    :func:`merge_softmax_states` with the chunk's local causal part."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    r_, s, h, d = q.shape
+    hkv = k_pages.shape[2]
+    n_rep = h // hkv
+    pages_per_slot = page_table.shape[1]
+    scale = d ** -0.5
+    scratch_page = k_pages.shape[0] - 1
+    safe_table = jnp.where(page_table >= 0, page_table,
+                           scratch_page).astype(jnp.int32)
+    base = base.astype(jnp.int32)
+    quantized = k_scale is not None
+
+    # rows grouped per (slot, kv head): [R, S, H, D] ->
+    # [R*Hkv, S*n_rep, D] so the q tiles are exactly the prefill
+    # kernel's shape class and the leading grid dim carries both ids
+    rows = s * n_rep
+    qg = q.reshape(r_, s, hkv, n_rep, d).transpose(
+        0, 2, 1, 3, 4).reshape(r_ * hkv, rows, d)
+    block_rows = _fit_block(rows, 256)
+    pad_rows = (-rows) % block_rows
+    if pad_rows:
+        qg = jnp.pad(qg, ((0, 0), (0, pad_rows), (0, 0)))
+    padded_rows = rows + pad_rows
+
+    kernel = functools.partial(
+        _paged_verify_kernel, page_size=page_size,
+        pages_per_slot=pages_per_slot, kv_heads=hkv, scale=scale,
+        quantized=quantized)
+
+    def q_map(g, qb, p, ids, b):
+        return (g, qb, 0)
+
+    def kv_map(g, qb, p, ids, b):
+        return (ids[g // hkv, p], 0, g % hkv, 0)
+
+    def sc_map(g, qb, p, ids, b):
+        return (ids[g // hkv, p], 0, g % hkv)
+
+    in_specs = [
+        pl.BlockSpec((1, block_rows, d), q_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+        pl.BlockSpec((1, page_size, 1, d), kv_map),
+    ]
+    operands = [safe_table, base, qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, 1), sc_map),
+                     pl.BlockSpec((1, page_size, 1), sc_map)]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r_ * hkv, padded_rows // block_rows, pages_per_slot),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_rows, d), q_map),
+            pl.BlockSpec((1, block_rows, 8), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_rows, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_rows, 1), jnp.float32),   # running denom
+            pltpu.VMEM((block_rows, d), jnp.float32),   # accumulator
+        ],
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((r_ * hkv, padded_rows, d),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((r_ * hkv, padded_rows, 8),
+                                 jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    o = o[:, :rows].reshape(r_, hkv, s, n_rep, d).transpose(
+        0, 2, 1, 3, 4).reshape(r_, s, h, d)
+    lse = lse[:, :rows, 0].reshape(r_, hkv, s, n_rep).transpose(
+        0, 1, 3, 2).reshape(r_, h, s)
+    return o, lse
+
+
+def chunk_causal_part(q, k, v):
+    """Closed-form causal partial softmax of a verify chunk over ITSELF:
+    q [B, S, H, D], k/v [B, S, Hkv, D] (the chunk's own just-computed
+    KV — for int8 pools the caller passes the quantize->dequantize
+    round-trip so the chunk attends exactly what the pool stores).
+    S is tiny (k draft tokens + 1), so a dense S x S pass beats a flash
+    instance. Returns (o [B, S, H, D] f32, lse [B, H, S] f32) for
+    :func:`merge_softmax_states` with the paged prefix part."""
+    b, s, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = _repeat_kv(k.astype(jnp.float32), n_rep)
+    v = _repeat_kv(v.astype(jnp.float32), n_rep)
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k,
+                        preferred_element_type=jnp.float32) * scale
+    i = jnp.arange(s)
+    causal = i[None, :] <= i[:, None]                  # [q, kv]
+    logits = jnp.where(causal[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                       # [B, H, S]
+    w = jnp.exp(logits - m[..., None])
+    l = jnp.maximum(jnp.sum(w, axis=-1), 1e-30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w / l[..., None], v)
+    return o, m + jnp.log(l)
+
+
+def paged_verify_reference(q, chunk_k, chunk_v, k_pages, v_pages,
+                           page_table, base, page_size: int,
+                           k_scale=None, v_scale=None):
+    """Dense-view verify reference: gather every slot's pages into
+    [slots, max_len] (the materialization the verify kernel avoids),
+    splice the chunk KV at positions ``base[r] + i``, and run one masked
+    softmax with the per-position causal bound ``k_pos <= base[r] + i``.
+    Chunk lanes past the view tail drop (see below); lanes past a row's
+    accepted length are computed-and-discarded garbage, exactly like the
+    kernel path."""
+    r_, s, h, d = q.shape
+    hkv = k_pages.shape[2]
+    n_rep = h // hkv
+    safe = jnp.maximum(page_table, 0)
+    kd = jnp.take(k_pages, safe, axis=0)     # [slots, pps, ps, hkv, d]
+    vd = jnp.take(v_pages, safe, axis=0)
+    s_, p_, ps_, hh, dd = kd.shape
+    m = p_ * ps_
+    kd = kd.reshape(s_, m, hh, dd).astype(jnp.float32)
+    vd = vd.reshape(s_, m, hh, dd).astype(jnp.float32)
+    if k_scale is not None:
+        ksc = jnp.take(k_scale, safe, axis=0).reshape(s_, m, hh)
+        vsc = jnp.take(v_scale, safe, axis=0).reshape(s_, m, hh)
+        kd = kd * ksc[..., None]
+        vd = vd * vsc[..., None]
+    positions = base[:, None] + jnp.arange(s)[None, :]   # [B, S]
+    rows = jnp.arange(r_)[:, None]
+    # mode="drop": a chunk lane past the view tail (row at the very end
+    # of its budget speculating fewer than S-1 tokens) must vanish, not
+    # clamp onto the row's real final entry
+    kd = kd.at[rows, positions].set(chunk_k.astype(jnp.float32),
+                                    mode="drop")
+    vd = vd.at[rows, positions].set(chunk_v.astype(jnp.float32),
+                                    mode="drop")
+    kd = _repeat_kv(kd, n_rep)
+    vd = _repeat_kv(vd, n_rep)
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kd,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(m)[None, None, :]
+    mask = k_pos <= positions[:, :, None]               # [B, S, M]
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, vd)
+
+
+def paged_verify_attention(q, chunk_k, chunk_v, k_pages, v_pages,
+                           page_table, base, *, page_size: int,
+                           impl: str = "auto", k_scale=None,
+                           v_scale=None, interpret=None):
+    """Speculative multi-token verify attention over the page pool: q
+    [slots, S, H, D] are each row's draft positions ``base[r]..base[r] +
+    S - 1`` (S = k + 1: the committed last token plus k draft tokens);
+    their KV (``chunk_k``/``chunk_v`` [slots, S, Hkv, D]) has already
+    been written into the pool. The kernel path attends the prefix pages
+    in place — the verify chunk is literally the prefill kernel's
+    q-chunk form, batched per slot — and LSE-merges the chunk's local
+    causal part; no dense gather, int8 pools included. Returns the
+    merged [slots, S, H, D] f32 output."""
+    impl = resolve_paged_impl(impl)
+    if impl == "reference":
+        return paged_verify_reference(
+            q, chunk_k, chunk_v, k_pages, v_pages, page_table, base,
+            page_size, k_scale=k_scale, v_scale=v_scale)
+    o_pre, lse_pre = _paged_verify_call(
+        q, k_pages, v_pages, page_table, base, page_size,
+        k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+    o_loc, lse_loc = chunk_causal_part(q, chunk_k, chunk_v)
     return merge_softmax_states(o_pre, lse_pre, o_loc, lse_loc)
 
 

@@ -257,6 +257,27 @@ class AdapterRegistry:
             targets = targets if targets is not None else inferred[1]
         self.bank = AdapterBank(config, max_live, rank, targets)
         self._free_slots = list(range(1, self.bank.n_slots))
+        # optional DRAFT-model adapter registry (in-engine speculative
+        # decoding, docs/serving.md "Speculative decoding"): per-tenant
+        # draft adapters live in their own bank sized for the draft
+        # config. None = every tenant drafts with the base draft model
+        # (verify still runs under the tenant's TARGET adapter, so the
+        # stream is the adapter's exact greedy output either way).
+        self.draft: Optional["AdapterRegistry"] = None
+
+    def attach_draft(self, draft_config, sources: Optional[dict] = None,
+                     rank: Optional[int] = None,
+                     targets: Optional[Sequence[str]] = None,
+                     max_live: Optional[int] = None) -> "AdapterRegistry":
+        """Attach per-tenant DRAFT adapters: a second registry whose bank
+        is shaped for the draft model. Tenant names should match the
+        target registry's so the engine can resolve a slot by the
+        request's adapter name; tenants absent here draft with the base
+        draft model (acceptance-rate cost only, never correctness)."""
+        self.draft = AdapterRegistry(draft_config, sources=sources,
+                                     max_live=max_live, rank=rank,
+                                     targets=targets, now_fn=self._now)
+        return self.draft
 
     def _infer_shape(self) -> tuple[int, tuple]:
         """Rank/targets from the first eagerly-available source (lazy

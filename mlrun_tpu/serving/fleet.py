@@ -1168,7 +1168,9 @@ class EngineFleet:
             for key in ("ttft_p50_s", "ttft_p95_s", "decode_tick_p50_s",
                         "decode_tick_p95_s", "prefill_chunks",
                         "prefill_kernel_chunks",
-                        "prefill_gather_admissions"):
+                        "prefill_gather_admissions",
+                        "spec_rounds", "spec_proposed", "spec_accepted",
+                        "acceptance_rate", "spec_tokens_per_round"):
                 if key in stats:
                     per[replica.id][key] = stats[key]
         out["completed"] = completed

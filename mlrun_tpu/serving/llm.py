@@ -742,7 +742,8 @@ class LLMModelServer:
                          max_live_adapters: int | None = None,
                          adapter_rate: float | None = None,
                          adapter_burst: float | None = None,
-                         request_ledger: bool | None = None, **kw):
+                         request_ledger: bool | None = None,
+                         speculative: dict | bool | None = None, **kw):
                 super().__init__(*a, **kw)
                 self.model_preset = model_preset
                 self.tokenizer_id = tokenizer
@@ -789,6 +790,11 @@ class LLMModelServer:
                 # per-request phase ledger (docs/observability.md
                 # "Request attribution"); None = mlconf default (on)
                 self.request_ledger = request_ledger
+                # in-engine speculative decoding (docs/serving.md
+                # "Speculative decoding"): True / {"k": ..., "draft":
+                # preset} enables a resident draft model; None = the
+                # mlconf.serving.llm.speculative defaults decide
+                self.speculative = speculative
                 self._tokenizer = None
                 self.engine = None
                 # predict→postprocess handover for the opt-in "timing"
@@ -818,6 +824,34 @@ class LLMModelServer:
 
                     self._tokenizer = AutoTokenizer.from_pretrained(
                         self.tokenizer_id)
+                # resolve the speculative class arg to the engines'
+                # draft-carrying dict: True / {"draft": preset} builds
+                # the named draft preset resident alongside the target
+                # (seeded differently — a real deployment loads trained
+                # draft weights the same way)
+                spec_conf = None
+                if self.continuous_batching:
+                    from ..config import mlconf
+
+                    node = mlconf.serving.llm.get("speculative")
+                    spec_conf = dict(node.to_dict()) if node is not None \
+                        else {}
+                    spec_arg = self.speculative
+                    if isinstance(spec_arg, bool):
+                        spec_arg = {"enabled": spec_arg}
+                    if isinstance(spec_arg, dict):
+                        spec_conf.update(spec_arg)
+                        spec_conf.setdefault("enabled", True)
+                    if (spec_conf.get("enabled")
+                            and spec_conf.get("draft")
+                            and "draft_config" not in spec_conf):
+                        draft_config = MODEL_PRESETS[spec_conf["draft"]]()
+                        spec_conf["draft_config"] = draft_config
+                        spec_conf["draft_params"] = init_params(
+                            draft_config, jax.random.PRNGKey(1))
+                    if not (spec_conf.get("enabled")
+                            and spec_conf.get("draft_config") is not None):
+                        spec_conf = None
                 if self.continuous_batching:
                     # slot-based scheduler: concurrent requests interleave
                     # on one decode batch; per-request sampling settings
@@ -843,7 +877,8 @@ class LLMModelServer:
                                 max_live_adapters=self.max_live_adapters,
                                 adapter_rate=self.adapter_rate,
                                 adapter_burst=self.adapter_burst,
-                                request_ledger=self.request_ledger)
+                                request_ledger=self.request_ledger,
+                                speculative=spec_conf)
                         from .llm_batch import ContinuousBatchingEngine
 
                         return ContinuousBatchingEngine(
@@ -858,7 +893,8 @@ class LLMModelServer:
                             max_live_adapters=self.max_live_adapters,
                             adapter_rate=self.adapter_rate,
                             adapter_burst=self.adapter_burst,
-                            request_ledger=self.request_ledger)
+                            request_ledger=self.request_ledger,
+                            speculative=spec_conf)
 
                     if self.replicas >= 2 or self.prefill_replicas:
                         # replica fleet: prefix-affinity routing across

@@ -42,6 +42,8 @@ from ..obs import (
     LLM_FREE_PAGE_FRAC,
     LLM_ITL,
     LLM_QUEUE_DEPTH,
+    LLM_SPEC_ROUNDS,
+    LLM_SPEC_TOKENS,
     LLM_TTFT,
     REGISTRY,
     RequestLedger,
@@ -164,6 +166,105 @@ def _decode_rowwise(config: LlamaConfig, params: Params, tokens: jax.Array,
         new_cache["k_scale"] = jnp.stack(new_ks)
         new_cache["v_scale"] = jnp.stack(new_vs)
     return next_token, new_cache
+
+
+def _verify_rowwise(config: LlamaConfig, params: Params, chunk: jax.Array,
+                    cache: dict, lora=None, adapter_ids: jax.Array = None):
+    """Batched multi-token speculative verify with PER-ROW positions
+    (docs/serving.md "Speculative decoding"). ``chunk``: [B, S] = each
+    row's committed last token followed by its k draft proposals, at
+    positions ``pos[r]..pos[r]+S-1``. ONE forward computes the target's
+    argmax at ALL S positions — the chunk attends the dense cache in
+    place under per-position causal masking, no ``all_logits`` dense
+    replay of the prefix.
+
+    Rollback contract (same as the batch=1 path's ``cache['pos']``
+    rewind): the chunk's KV is scattered at its positions BEFORE
+    attention reads, but ``pos`` is NOT advanced here — the host commits
+    it to the accepted length afterwards, so entries past the accepted
+    position are stale-but-unreadable and get overwritten before any
+    later query can attend them. Rows speculating fewer than S-1 tokens
+    simply have their trailing writes land past the committed position
+    (same stale-entry argument); writes past ``max_len`` drop
+    (``mode="drop"``) rather than clamp, so a row at the cache tail
+    never has a garbage lane collide with its real last entry."""
+    from .llm import _lora_delta
+
+    b, s = chunk.shape
+    start = cache["pos"]                               # [B]
+    positions = start[:, None] + jnp.arange(s)[None, :]  # [B, S]
+    rows = jnp.arange(b)[:, None]                      # [B, 1]
+    x = params["embedding"][chunk].astype(config.dtype)
+    cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
+
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for layer in range(config.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
+
+        def proj(h_in, w, t=None, _layer=layer):
+            out = jnp.einsum("bse,eh->bsh", h_in, w,
+                             preferred_element_type=jnp.float32)
+            if lora is not None and t is not None and t in lora:
+                out = out + _lora_delta(h_in, lora[t], _layer, adapter_ids)
+            return out.astype(x.dtype)
+
+        q = proj(h, lp["wq"], "wq").reshape(b, s, config.n_heads,
+                                            config.head_dim)
+        k = proj(h, lp["wk"], "wk").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
+        v = proj(h, lp["wv"], "wv").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        quantized = "k_scale" in cache
+        if quantized:
+            from .llm import _dequantize_kv, _quantize_kv
+
+            kq, ks = _quantize_kv(k)
+            vq, vs = _quantize_kv(v)
+            k_cache = cache["k"][layer].at[rows, positions].set(
+                kq, mode="drop")
+            v_cache = cache["v"][layer].at[rows, positions].set(
+                vq, mode="drop")
+            k_scale = cache["k_scale"][layer].at[rows, positions].set(
+                ks, mode="drop")
+            v_scale = cache["v_scale"][layer].at[rows, positions].set(
+                vs, mode="drop")
+            k_attn = _dequantize_kv(k_cache, k_scale, config.dtype)
+            v_attn = _dequantize_kv(v_cache, v_scale, config.dtype)
+            new_ks.append(k_scale)
+            new_vs.append(v_scale)
+        else:
+            k_cache = cache["k"][layer].at[rows, positions].set(
+                k.astype(cache["k"].dtype), mode="drop")
+            v_cache = cache["v"][layer].at[rows, positions].set(
+                v.astype(cache["v"].dtype), mode="drop")
+            k_attn, v_attn = k_cache, v_cache
+        attn = _cached_attention(config, q, k_attn, v_attn, positions,
+                                 cache["k"].shape[2])
+        attn = attn.reshape(b, s, config.qkv_dim)
+        x_mid = x + proj(attn, lp["wo"], "wo")
+        h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
+        gate = proj(h2, lp["w_gate"], "w_gate")
+        up = proj(h2, lp["w_up"], "w_up")
+        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    verified = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, S]
+    new_cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
+                 "pos": cache["pos"]}
+    if new_ks:
+        new_cache["k_scale"] = jnp.stack(new_ks)
+        new_cache["v_scale"] = jnp.stack(new_vs)
+    return verified, new_cache
 
 
 # distinct `engine` label per instance on the shared gauges/counters
@@ -341,7 +442,8 @@ class ContinuousBatchingEngine:
                  adapters=None, max_live_adapters: int | None = None,
                  adapter_rate: float | None = None,
                  adapter_burst: float | None = None,
-                 request_ledger: bool | None = None):
+                 request_ledger: bool | None = None,
+                 speculative: dict | None = None):
         from ..ops.attention import resolve_prefill_impl
         from .adapters import AdapterRegistry, TenantRateLimiter
 
@@ -507,6 +609,342 @@ class ContinuousBatchingEngine:
                        "handoffs_out": 0, "handoff_bytes_out": 0,
                        "handoffs_in": 0, "handoff_bytes_in": 0,
                        "adapter_rate_limited": 0}
+        # -- in-engine speculative decoding (docs/serving.md
+        # "Speculative decoding"): draft model resident alongside the
+        # target, per-row adaptive k, one multi-token verify dispatch per
+        # tick. Off unless a draft model is supplied.
+        self._init_speculative(speculative)
+
+    # -- speculative decoding (shared by the dense and paged engines) ----
+
+    def _init_speculative(self, speculative: dict | None):
+        conf_node = mlconf.serving.llm.get("speculative")
+        conf = dict(conf_node.to_dict()) if conf_node is not None else {}
+        draft_config = None
+        draft_params = None
+        enabled = bool(conf.get("enabled", False))
+        if isinstance(speculative, dict):
+            draft_config = speculative.get("draft_config")
+            draft_params = speculative.get("draft_params")
+            conf.update({k: v for k, v in speculative.items()
+                         if k not in ("draft_config", "draft_params")})
+            enabled = bool(conf.get("enabled", True))
+        self.spec_k = max(1, int(conf.get("k", 4) or 4))
+        self.spec_min_acceptance = float(conf.get("min_acceptance", 0.35))
+        self.spec_window = max(1, int(conf.get("window", 32) or 32))
+        self.spec_probe_every = max(1, int(conf.get("probe_every", 16)
+                                           or 16))
+        self.spec_enabled = bool(enabled and draft_config is not None
+                                 and draft_params is not None)
+        if not self.spec_enabled:
+            return
+        if draft_config.vocab_size != self.config.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        self._spec_draft_config = draft_config
+        self._spec_draft_params = draft_params
+        # draft KV is always the dense slot layout (tiny model — the page
+        # pool exists for the TARGET's HBM footprint, not the draft's)
+        self._spec_dcache = init_kv_cache(draft_config, self.slots,
+                                          self.max_len)
+        # entries BEHIND each slot's last committed token in the draft
+        # cache (same invariant as cache['pos'] on the target)
+        self._spec_dpos = np.zeros((self.slots,), np.int32)
+        # prompt tokens per slot — the draft resync source after plain
+        # (non-speculative) ticks advanced the target without the draft
+        self._spec_prompts: dict = {}
+        self._spec_stale: set = set()
+        # per-adapter bounded acceptance window: deque of
+        # (proposed, accepted) per verify round, plus probation counters
+        self._spec_windows: dict = {}
+        self._spec_probe: dict = {}
+        # adapters whose draft-bank load failed once — don't retry per tick
+        self._spec_draft_block: set = set()
+        self._stats.update({"spec_rounds": 0, "spec_proposed": 0,
+                            "spec_accepted": 0, "spec_rejected": 0,
+                            "spec_tokens": 0, "spec_parked_ticks": 0,
+                            "spec_resyncs": 0})
+        self._spec_draft_prefill = jax.jit(functools.partial(
+            _forward_with_cache, draft_config))
+        k_max = self.spec_k
+
+        def draft_steps(params, tokens, cache, lora=None, adapter_ids=None):
+            """k_max greedy draft steps over the full slot batch; returns
+            ([slots, k_max] proposals, cache)."""
+            def body(carry, _):
+                tok, c = carry
+                nxt, c = _decode_rowwise(draft_config, params, tok, c,
+                                         lora=lora, adapter_ids=adapter_ids)
+                return (nxt[:, None], c), nxt
+
+            (_, cache), proposals = jax.lax.scan(
+                body, (tokens, cache), None, length=k_max)
+            return proposals.T, cache
+
+        self._spec_draft_steps = jax.jit(draft_steps, donate_argnums=(2,))
+        # engine-specific multi-token verify program, built lazily on the
+        # first speculative tick (the paged subclass resolves its kernel
+        # impl after this base ctor runs)
+        self._spec_verify = None
+
+    def _make_verify_fn(self):
+        """Jitted (verified [B,S], new_cache) verify program (hook: the
+        paged engine swaps in the page-pool verify)."""
+        return jax.jit(functools.partial(_verify_rowwise, self.config),
+                       donate_argnums=(2,))
+
+    def _spec_verify_fn(self):
+        if self._spec_verify is None:
+            self._spec_verify = self._make_verify_fn()
+        return self._spec_verify
+
+    def _spec_lora_kwargs(self, adapter_ids) -> dict:
+        """Draft-bank LoRA kwargs for the draft dispatches (None when no
+        per-tenant draft adapters are attached → base draft model)."""
+        draft = (getattr(self._adapters, "draft", None)
+                 if self._adapters is not None else None)
+        if draft is None or adapter_ids is None:
+            return {}
+        return {"lora": draft.bank.tensors,
+                "adapter_ids": jnp.asarray(adapter_ids)}
+
+    def _spec_slot_draft_ids(self, active):
+        """Per-slot DRAFT bank slot ids (0 = base draft model). A tenant
+        without a registered draft adapter — or whose draft-load failed —
+        drafts with the base model; its verify still runs under the
+        tenant's TARGET adapter, so the stream stays the adapter's exact
+        greedy output either way (draft quality only buys speed)."""
+        draft = (getattr(self._adapters, "draft", None)
+                 if self._adapters is not None else None)
+        if draft is None:
+            return None
+        ids = np.zeros((self.slots,), np.int32)
+        for i in active:
+            adapter = self._slot_state[i].adapter
+            if not adapter or adapter in self._spec_draft_block:
+                continue
+            try:
+                ids[i] = draft.ensure_loaded(adapter)
+            except Exception as exc:  # noqa: BLE001 - missing/oversubscribed
+                # draft adapter degrades to the base draft, never the request
+                self._spec_draft_block.add(adapter)
+                logger.warning("draft adapter unavailable, using base draft",
+                               adapter=adapter, error=str(exc))
+        return ids
+
+    def _spec_prefill_slot(self, index: int, tokens_seq, adapter=None):
+        """(Re)build one slot's draft KV by prefilling ``tokens_seq``;
+        afterwards ``_spec_dpos[index] == len(tokens_seq)`` (the draft's
+        next proposal step attends exactly these entries)."""
+        total = len(tokens_seq)
+        if total <= 0 or total > self.max_len:
+            self._spec_dpos[index] = max(0, min(total, self.max_len))
+            return
+        small = init_kv_cache(self._spec_draft_config, 1, self.max_len)
+        pad_len = self._bucket_for(total)
+        padded = np.zeros((1, pad_len), np.int32)
+        padded[0, :total] = tokens_seq
+        draft_ids = None
+        draft = (getattr(self._adapters, "draft", None)
+                 if self._adapters is not None else None)
+        if (draft is not None and adapter
+                and adapter not in self._spec_draft_block):
+            try:
+                draft_ids = np.asarray([draft.ensure_loaded(adapter)],
+                                       np.int32)
+            except Exception:  # noqa: BLE001 - fall back to base draft
+                self._spec_draft_block.add(adapter)
+        lora_kw = self._spec_lora_kwargs(draft_ids)
+        _, small = self._spec_draft_prefill(
+            self._spec_draft_params, jnp.asarray(padded), small, **lora_kw)
+        # garbage KV at the padded tail is masked by position until real
+        # writes land there (same argument as the target's bucket pad)
+        self._spec_dcache = self._insert(self._spec_dcache, small, index,
+                                         total)
+        self._spec_dpos[index] = total
+
+    def _spec_admit_slot(self, adm: "_Admission"):
+        """Draft prefill for a fresh admission. The draft always ingests
+        the FULL prompt tokens regardless of how the target prefilled —
+        cold, prefix-cache hit, or imported ``KVHandoff`` — because the
+        draft has no prefix cache or handoff of its own; that one rule
+        keeps all three target paths speculation-ready."""
+        self._spec_prompts[adm.slot] = list(adm.prompt)
+        self._spec_stale.discard(adm.slot)
+        self._spec_prefill_slot(adm.slot, adm.prompt, adm.adapter)
+
+    def _spec_resync_row(self, index: int):
+        """Rebuild a stale draft cache row (plain ticks advanced the
+        target without the draft): re-prefill prompt + committed tokens
+        minus the last. Draft-side only — target output never depends on
+        draft KV contents, so a resync can't change the stream."""
+        slot = self._slot_state[index]
+        stream = list(self._spec_prompts.get(index, ())) + slot.tokens
+        if len(stream) > 1:
+            self._spec_prefill_slot(index, stream[:-1], slot.adapter)
+        else:
+            self._spec_dpos[index] = 0
+        self._spec_stale.discard(index)
+        with self._lock:
+            self._stats["spec_resyncs"] += 1
+
+    def _spec_release_slot(self, index: int):
+        if not getattr(self, "spec_enabled", False):
+            return
+        self._spec_prompts.pop(index, None)
+        self._spec_stale.discard(index)
+        self._spec_dpos[index] = 0
+
+    def _spec_row_k(self, adapter) -> int:
+        """Adaptive per-row proposal length from the adapter's bounded
+        acceptance window. Cold window → full k (optimistic); paying
+        window → k scaled to expected acceptance; under-threshold →
+        parked at 0 (plain decode) with a k=1 probe every
+        ``spec_probe_every`` consulted rounds so a recovered draft can
+        re-earn its budget. Round counters, never wall clock."""
+        state = self._spec_windows.get(adapter)
+        if state is None:
+            state = self._spec_windows[adapter] = deque(
+                maxlen=self.spec_window)
+        proposed = sum(p for p, _ in state)
+        if proposed < 8:
+            return self.spec_k
+        acc = sum(a for _, a in state) / proposed
+        if acc < self.spec_min_acceptance:
+            count = self._spec_probe.get(adapter, 0) + 1
+            self._spec_probe[adapter] = count
+            return 1 if count % self.spec_probe_every == 0 else 0
+        self._spec_probe.pop(adapter, None)
+        return max(1, min(self.spec_k,
+                          int(round(acc * (self.spec_k + 1)))))
+
+    def _spec_feed_window(self, adapter, proposed: int, accepted: int):
+        self._spec_windows[adapter].append((proposed, accepted))
+
+    def _spec_tick_viable(self, active) -> bool:
+        if not getattr(self, "spec_enabled", False):
+            return False
+        # fleet-wide park: the degradation ladder's existing flag still
+        # gates everything; per-row policy only runs under it
+        if not self.speculative_enabled:
+            return False
+        # mixed greedy/sampled batches tick plain: verify-chunk argmax
+        # equivalence is a greedy contract (docs/serving.md)
+        return all(self._slot_state[i].temperature == 0.0 for i in active)
+
+    def _spec_apply_positions(self, committed: dict):
+        """Commit accepted positions on the target KV (hook: the paged
+        engine writes its host-side ``_pos`` instead). Rewinding is the
+        whole rollback — rejected entries are overwritten before any
+        later query can attend them."""
+        pos = np.array(self._cache["pos"])   # copy: device views read-only
+        for index, value in committed.items():
+            pos[index] = value
+        self._cache["pos"] = jnp.asarray(pos)
+
+    def _spec_verify_dispatch(self, chunk, active):
+        """ONE multi-token verify forward over every slot (hook: the
+        paged engine dispatches the page-pool verify kernel)."""
+        lora_kw = (self._lora_kwargs(self._slot_adapter_ids())
+                   if self._adapters is not None else {})
+        verified, self._cache = self._spec_verify_fn()(
+            self.params, jnp.asarray(chunk), self._cache, **lora_kw)
+        return np.asarray(verified)
+
+    def _spec_decode_tick(self, active) -> Optional[int]:
+        """One speculative scheduler tick: k batched draft steps + ONE
+        multi-token verify dispatch, then per-row accept/rollback.
+        Returns None to fall through to the plain tick (chaos park, or
+        every row's gate parked this round)."""
+        from .speculative import accept_tokens
+
+        # chaos: an armed llm.spec_verify fault parks THIS tick to plain
+        # decode — never a client error; the stream stays exact-greedy
+        # because plain ticks emit the same target argmax
+        try:
+            fire(FaultPoints.llm_spec_verify, engine=self._obs_name,
+                 replica=self.replica, rows=len(active))
+        except Exception as exc:  # noqa: BLE001 - any armed error parks
+            with self._lock:
+                self._stats["spec_parked_ticks"] += 1
+            flight_record("engine.spec_park", engine=self._obs_name,
+                          replica=self.replica, error=str(exc))
+            return None
+
+        k_max = self.spec_k
+        k_effs = np.zeros((self.slots,), np.int32)
+        any_spec = False
+        for i in active:
+            slot = self._slot_state[i]
+            if slot.remaining < 1:
+                continue
+            # gate consult BEFORE resync: a parked row's stale draft
+            # cache is never read (its chunk lane is k_eff 0, its
+            # rollback discards the writes), so rebuilding it every
+            # tick would tax exactly the fleets whose drafts don't pay
+            k_row = min(self._spec_row_k(slot.adapter), slot.remaining,
+                        k_max)
+            k_effs[i] = max(0, k_row)
+            if k_row > 0:
+                any_spec = True
+                if i in self._spec_stale:
+                    self._spec_resync_row(i)
+        if not any_spec:
+            return None
+
+        last = np.zeros((self.slots, 1), np.int32)
+        for i in active:
+            last[i, 0] = self._slot_state[i].tokens[-1]
+        self._ledger_mark(active, "decode_active")
+        draft_lora_kw = self._spec_lora_kwargs(
+            self._spec_slot_draft_ids(active))
+        self._spec_dcache["pos"] = jnp.asarray(self._spec_dpos)
+        proposals, self._spec_dcache = self._spec_draft_steps(
+            self._spec_draft_params, jnp.asarray(last), self._spec_dcache,
+            **draft_lora_kw)
+        proposals_h = np.asarray(proposals)           # [slots, k_max]
+        chunk = np.zeros((self.slots, k_max + 1), np.int32)
+        chunk[:, 0] = last[:, 0]
+        chunk[:, 1:] = proposals_h
+        verified_h = self._spec_verify_dispatch(chunk, active)
+        self._ledger_mark(active, "decode_stall")
+
+        finished = []
+        committed = {}
+        rounds = proposed_total = accepted_total = tokens_total = 0
+        for i in active:
+            slot = self._slot_state[i]
+            k_eff = int(k_effs[i])
+            emitted, n_accept = accept_tokens(
+                proposals_h[i, :k_eff], verified_h[i], k_eff)
+            if k_eff > 0:
+                rounds += 1
+                proposed_total += k_eff
+                accepted_total += n_accept
+                self._spec_feed_window(slot.adapter, k_eff, n_accept)
+            if slot.eos_id is not None and slot.eos_id in emitted:
+                emitted = emitted[:emitted.index(slot.eos_id) + 1]
+            emitted = emitted[:max(0, slot.remaining)]
+            slot.tokens.extend(int(t) for t in emitted)
+            slot.remaining -= len(emitted)
+            if k_eff > 0:
+                tokens_total += len(emitted)
+            pos_i = slot.prompt_len + len(slot.tokens) - 1
+            committed[i] = pos_i
+            self._spec_dpos[i] = pos_i
+            capacity = slot.prompt_len + len(slot.tokens) >= self.max_len
+            if ((slot.eos_id is not None and slot.tokens[-1] == slot.eos_id)
+                    or slot.remaining <= 0 or capacity):
+                finished.append(i)
+        self._spec_apply_positions(committed)
+        with self._lock:
+            self._stats["spec_rounds"] += rounds
+            self._stats["spec_proposed"] += proposed_total
+            self._stats["spec_accepted"] += accepted_total
+            self._stats["spec_rejected"] += proposed_total - accepted_total
+            self._stats["spec_tokens"] += tokens_total
+        for i in finished:
+            self._finish(i)
+        return len(active)
 
     def _make_cache(self):
         """Slot KV storage (hook: the paged engine swaps in a page pool)."""
@@ -629,6 +1067,9 @@ class ContinuousBatchingEngine:
         # the fairness limiter exists independently of any registry —
         # its shed counter must be visible even on a base-model engine
         has_limiter = self._tenant_limiter is not None
+        # speculation telemetry only exists on spec-capable engines; the
+        # families are created lazily at first collect and retired here
+        has_spec = getattr(self, "spec_enabled", False)
 
         counter_stats = self._COUNTER_STATS
 
@@ -637,6 +1078,11 @@ class ContinuousBatchingEngine:
                 LLM_QUEUE_DEPTH.remove(engine=name, replica=replica,
                                        adapter=adapter)
             LLM_FREE_PAGE_FRAC.remove(engine=name, replica=replica)
+            if has_spec:
+                LLM_SPEC_ROUNDS.remove(engine=name, replica=replica)
+                for outcome in ("accepted", "rejected"):
+                    LLM_SPEC_TOKENS.remove(engine=name, replica=replica,
+                                           outcome=outcome)
             for key in counter_stats:
                 LLM_EVENTS.remove(engine=name, replica=replica, event=key)
             if has_adapters:
@@ -700,6 +1146,15 @@ class ContinuousBatchingEngine:
                 if key in stats:
                     LLM_EVENTS.set_total(stats[key], engine=name,
                                          replica=replica, event=key)
+            if has_spec:
+                LLM_SPEC_ROUNDS.set_total(stats.get("spec_rounds", 0),
+                                          engine=name, replica=replica)
+                LLM_SPEC_TOKENS.set_total(stats.get("spec_accepted", 0),
+                                          engine=name, replica=replica,
+                                          outcome="accepted")
+                LLM_SPEC_TOKENS.set_total(stats.get("spec_rejected", 0),
+                                          engine=name, replica=replica,
+                                          outcome="rejected")
             registry = engine._adapters if engine._owns_adapters else None
             if registry is not None:
                 ADAPTER_LIVE.set(registry.live(), engine=name,
@@ -812,10 +1267,44 @@ class ContinuousBatchingEngine:
             jnp.ones((self.slots,), jnp.float32), **decode_kw)
         float(jnp.sum(tok))
         self._cache["pos"] = jnp.zeros((self.slots,), jnp.int32)
+        self._spec_warmup()
         logger.info("continuous batching engine warm",
                     slots=self.slots,
                     buckets=list(self.prefill_buckets),
                     warmup_s=round(time.perf_counter() - started, 2))
+
+    def _spec_warmup(self):
+        """Compile the speculative programs — draft prefill buckets, the
+        k-step draft scan, and the engine's verify dispatch — so the
+        first speculative tick doesn't pay the compiles. Garbage KV the
+        warm dispatches write sits behind pos 0 / on the scratch page
+        and is overwritten before any read (the bucket-pad argument)."""
+        if not getattr(self, "spec_enabled", False):
+            return
+        ids = self._spec_slot_draft_ids(range(self.slots))
+        row_kw = self._spec_lora_kwargs(
+            None if ids is None else ids[:1])
+        for bucket in self.prefill_buckets:
+            small = init_kv_cache(self._spec_draft_config, 1, self.max_len)
+            self._spec_draft_prefill(
+                self._spec_draft_params, jnp.zeros((1, bucket), jnp.int32),
+                small, **row_kw)
+        step = jnp.zeros((self.slots, 1), jnp.int32)
+        _, self._spec_dcache = self._spec_draft_steps(
+            self._spec_draft_params, step, self._spec_dcache,
+            **self._spec_lora_kwargs(ids))
+        self._spec_dcache["pos"] = jnp.zeros((self.slots,), jnp.int32)
+        self._spec_warmup_verify()
+
+    def _spec_warmup_verify(self):
+        """Verify-program compile (hook: the paged engine warms its
+        page-pool verify against the scratch page instead)."""
+        chunk = jnp.zeros((self.slots, self.spec_k + 1), jnp.int32)
+        lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
+            if self._adapters is not None else {}
+        _, self._cache = self._spec_verify_fn()(
+            self.params, chunk, self._cache, **lora_kw)
+        self._cache["pos"] = jnp.zeros((self.slots,), jnp.int32)
 
     # -- API ----------------------------------------------------------------
     def _free_page_frac(self) -> Optional[float]:
@@ -1236,6 +1725,13 @@ class ContinuousBatchingEngine:
         out["queue_depth"] = self._queue_depth()
         out["pressure_level"] = self.pressure_level()
         out["speculative_enabled"] = self.speculative_enabled
+        if "spec_rounds" in out:
+            out["acceptance_rate"] = (
+                out["spec_accepted"] / out["spec_proposed"]
+                if out["spec_proposed"] else 0.0)
+            out["spec_tokens_per_round"] = (
+                out["spec_tokens"] / out["spec_rounds"]
+                if out["spec_rounds"] else 0.0)
         if self._adapters is not None and self._owns_adapters:
             out.update(self._adapters.stats)
             out["adapter_live"] = self._adapters.live()
@@ -1523,6 +2019,8 @@ class ContinuousBatchingEngine:
         if adm.export:
             self._export_admission(adm)
             return
+        if getattr(self, "spec_enabled", False):
+            self._spec_admit_slot(adm)
         self._activate_slot(adm.slot, adm.request_id, adm.first_token,
                             adm.max_new, adm.eos_id, adm.future,
                             adm.submitted, len(adm.prompt), adm.sampling,
@@ -1634,11 +2132,23 @@ class ContinuousBatchingEngine:
         # zero the freed row's position so decode writes land in its own
         # (now unused) region
         self._cache["pos"] = self._cache["pos"].at[index].set(0)
+        self._spec_release_slot(index)
 
     def _decode_tick(self) -> int:
         active = [i for i, s in enumerate(self._slot_state) if s.active]
         if not active:
             return 0
+        if self._spec_tick_viable(active):
+            done = self._spec_decode_tick(active)
+            if done is not None:
+                return done
+        if getattr(self, "spec_enabled", False):
+            # a plain tick advances the target without the draft: those
+            # rows' draft caches go stale and resync on the next spec tick
+            self._spec_stale.update(active)
+        return self._plain_decode_tick(active)
+
+    def _plain_decode_tick(self, active) -> int:
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]

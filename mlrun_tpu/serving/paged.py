@@ -331,6 +331,130 @@ def _decode_rowwise_paged(config: LlamaConfig, page_size: int,
     return next_token, new_pool, pos + 1
 
 
+def _verify_rowwise_paged(config: LlamaConfig, page_size: int,
+                          attn_impl: str, params, chunk: jax.Array,
+                          pool: dict, page_table: jax.Array,
+                          pos: jax.Array, lora=None,
+                          adapter_ids: jax.Array = None):
+    """Batched multi-token speculative verify against the page pool
+    (docs/serving.md "Speculative decoding"). ``chunk``: [slots, S] =
+    each slot's committed last token plus its k draft proposals at
+    absolute positions ``pos[r]..pos[r]+S-1``. ONE forward computes the
+    target argmax at all S positions per slot.
+
+    ``attn_impl="kernel"``: per layer, the chunk's KV scatters into the
+    pool through the page table FIRST (int8 pools quantize per vector on
+    the way in), then ``paged_verify_attention`` attends the prefix
+    pages IN PLACE — the verify chunk is the prefill kernel's q-chunk
+    form batched per slot, LSE-merged with the chunk's local causal
+    part. No dense gather, no ``all_logits`` dense forward.
+
+    ``attn_impl="reference"``: the gather+dense fallback
+    (``paged_verify_reference``), bit-consistent with the reference
+    decode path (raw chunk KV spliced into the dequantized view).
+
+    Rollback is the host's ``_pos`` rewind: chunk writes land inside the
+    slot's admission-reserved pages (``k_eff <= remaining`` keeps every
+    accepted lane under the reservation; over-reservation lanes of rows
+    speculating fewer than S-1 tokens route to the scratch page), and
+    entries past the accepted position are overwritten before any later
+    query can attend them — no page ever has to move back to the free
+    list mid-round. ``pos`` is NOT advanced here; the host commits it.
+
+    Returns (verified [slots, S] int32, new_pool).
+    """
+    from ..ops.norms import rms_norm
+    from ..ops.paged_attention import paged_verify_attention
+    from ..ops.rotary import apply_rope, rope_table
+    from .llm import _dequantize_kv, _lora_delta, _quantize_kv
+
+    b, s = chunk.shape
+    pps = page_table.shape[1]
+    positions = pos[:, None] + jnp.arange(s)[None, :]     # [slots, S]
+    x = params["embedding"][chunk].astype(config.dtype)
+    cos, sin = rope_table(positions, config.head_dim, config.rope_theta)
+    quantized = "k_scale" in pool
+    use_kernel = attn_impl == "kernel"
+    scratch = pool["k"].shape[1] - 1
+    page_idx = positions // page_size
+    offset = positions % page_size
+    pid = jnp.take_along_axis(page_table,
+                              jnp.minimum(page_idx, pps - 1), axis=1)
+    # lanes past the slot's mapped reservation (rows speculating fewer
+    # than S-1 tokens this round) route to the never-read scratch page;
+    # distinct in-reservation positions can never collide (one page id
+    # per page index, one offset per position)
+    pid_safe = jnp.where((pid >= 0) & (page_idx < pps), pid, scratch)
+    pool = dict(pool)
+
+    for layer in range(config.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+        h = rms_norm(x, lp["attn_norm_scale"], config.norm_eps)
+
+        def proj(h_in, w, t=None, _layer=layer):
+            out = jnp.einsum("bse,eh->bsh", h_in, w,
+                             preferred_element_type=jnp.float32)
+            if lora is not None and t is not None and t in lora:
+                out = out + _lora_delta(h_in, lora[t], _layer, adapter_ids)
+            return out.astype(x.dtype)
+
+        q = proj(h, lp["wq"], "wq").reshape(b, s, config.n_heads,
+                                            config.head_dim)
+        k = proj(h, lp["wk"], "wk").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
+        v = proj(h, lp["wv"], "wv").reshape(b, s, config.n_kv_heads,
+                                            config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        scales_kw = {}
+        if quantized:
+            kq_, ks_ = _quantize_kv(k)
+            vq_, vs_ = _quantize_kv(v)
+            pool["k"] = pool["k"].at[layer, pid_safe, offset].set(kq_)
+            pool["v"] = pool["v"].at[layer, pid_safe, offset].set(vq_)
+            pool["k_scale"] = pool["k_scale"].at[
+                layer, pid_safe, offset].set(ks_)
+            pool["v_scale"] = pool["v_scale"].at[
+                layer, pid_safe, offset].set(vs_)
+            scales_kw = {"k_scale": pool["k_scale"][layer],
+                         "v_scale": pool["v_scale"][layer]}
+            if use_kernel:
+                # the kernel's local chunk part must see the SAME bits a
+                # later decode tick reads back from the int8 pool
+                chunk_k = _dequantize_kv(kq_, ks_, config.dtype)
+                chunk_v = _dequantize_kv(vq_, vs_, config.dtype)
+            else:
+                # reference decode splices the RAW token KV into its
+                # dequantized view — the verify fallback matches it
+                chunk_k, chunk_v = k, v
+        else:
+            pool["k"] = pool["k"].at[layer, pid_safe, offset].set(
+                k.astype(pool["k"].dtype))
+            pool["v"] = pool["v"].at[layer, pid_safe, offset].set(
+                v.astype(pool["v"].dtype))
+            chunk_k, chunk_v = k, v
+        attn = paged_verify_attention(
+            q, chunk_k, chunk_v, pool["k"][layer], pool["v"][layer],
+            page_table, pos, page_size=page_size,
+            impl="kernel" if use_kernel else "reference", **scales_kw)
+        attn = attn.astype(x.dtype).reshape(b, s, config.qkv_dim)
+        x_mid = x + proj(attn, lp["wo"], "wo")
+        h2 = rms_norm(x_mid, lp["mlp_norm_scale"], config.norm_eps)
+        gate = proj(h2, lp["w_gate"], "w_gate")
+        up = proj(h2, lp["w_up"], "w_up")
+        x = x_mid + proj(jax.nn.silu(gate) * up, lp["w_down"], "w_down")
+
+    x = rms_norm(x, params["final_norm_scale"], config.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    verified = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return verified, pool
+
+
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     """Continuous batching over a paged KV pool.
 
@@ -356,7 +480,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  adapter_rate: float | None = None,
                  adapter_burst: float | None = None,
                  request_ledger: bool | None = None,
-                 kv_tier=None):
+                 kv_tier=None, speculative: dict | None = None):
         from ..ops.paged_attention import resolve_paged_impl
 
         if max_len % page_size:
@@ -406,7 +530,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                          max_live_adapters=max_live_adapters,
                          adapter_rate=adapter_rate,
                          adapter_burst=adapter_burst,
-                         request_ledger=request_ledger)
+                         request_ledger=request_ledger,
+                         speculative=speculative)
         # decode path: pallas paged kernel (page-table indexed) or the
         # gather+dense reference — resolved once, from the same knob the
         # base class resolved the prefill path from. int8 pools run the
@@ -529,9 +654,23 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             jnp.zeros((self.slots,), jnp.int32),
             jnp.ones((self.slots,), jnp.float32), **decode_kw)
         float(jnp.sum(tok))
+        self._spec_warmup()
         logger.info("paged engine warm", slots=self.slots,
                     pages=self.n_pages, page_size=self.page_size,
                     warmup_s=round(time.perf_counter() - started, 2))
+
+    def _spec_warmup_verify(self):
+        # all-(-1) table routes every chunk write to the scratch page
+        # and marks zero pages live; outputs are discarded. Called
+        # directly (not via _spec_verify_dispatch) so warmup doesn't
+        # count attention ticks.
+        chunk = jnp.zeros((self.slots, self.spec_k + 1), jnp.int32)
+        table = jnp.full((self.slots, self.pages_per_slot), -1, jnp.int32)
+        pos = jnp.zeros((self.slots,), jnp.int32)
+        lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
+            if self._adapters is not None else {}
+        _, self._pool = self._spec_verify_fn()(
+            self.params, chunk, self._pool, table, pos, **lora_kw)
 
     # -- resilience: page-pool pressure + pending-deque expiry ---------------
     def _free_page_frac(self) -> float:
@@ -1115,6 +1254,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._prefix.release(self._slot_prefix_nodes.pop(index, []))
         self._page_table[index] = -1
         self._pos[index] = 0
+        self._spec_release_slot(index)
 
     # paged-only cumulative stats mirrored to mlt_llm_events_total
     _COUNTER_STATS = ContinuousBatchingEngine._COUNTER_STATS + (
@@ -1143,10 +1283,46 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             out["kv_tier"] = self._kv_tier.stats()
         return out
 
-    def _decode_tick(self) -> int:
-        active = [i for i, s in enumerate(self._slot_state) if s.active]
-        if not active:
-            return 0
+    # -- speculative decoding (paged hooks; policy lives in the base) ----
+
+    def _make_verify_fn(self):
+        return jax.jit(
+            functools.partial(_verify_rowwise_paged, self.config,
+                              self.page_size, self.attn_impl),
+            donate_argnums=(2,))
+
+    def _spec_apply_positions(self, committed: dict):
+        # the pool-side rollback: rejected draft positions simply aren't
+        # committed — their pool entries are overwritten before any read
+        # (docs/serving.md "Speculative decoding"). Pages were reserved
+        # at admission for prompt+max_new, and k_eff <= remaining keeps
+        # every chunk write inside that reservation, so nothing moves on
+        # the free list and _free_page_frac stays honest by construction.
+        for index, value in committed.items():
+            self._pos[index] = value
+
+    def _spec_verify_dispatch(self, chunk, active):
+        table = jnp.asarray(self._page_table)
+        pos = jnp.asarray(self._pos)
+        lora_kw = self._lora_kwargs(self._slot_adapter_ids()) \
+            if self._adapters is not None else {}
+        verified, self._pool = self._spec_verify_fn()(
+            self.params, jnp.asarray(chunk), self._pool, table, pos,
+            **lora_kw)
+        with self._lock:
+            # a verify dispatch is one attention tick like any other: on
+            # the kernel path it never gathers a dense view
+            # (attn_gather_ticks stays 0) and the avoided HBM copy is
+            # accounted the same way as a decode tick
+            if self.attn_impl == "kernel":
+                self._stats["attn_kernel_ticks"] += 1
+                self._stats["attn_hbm_bytes_avoided"] += \
+                    self._gather_bytes_per_tick
+            else:
+                self._stats["attn_gather_ticks"] += 1
+        return np.asarray(verified)
+
+    def _plain_decode_tick(self, active) -> int:
         last = np.zeros((self.slots, 1), np.int32)
         for i in active:
             last[i, 0] = self._slot_state[i].tokens[-1]

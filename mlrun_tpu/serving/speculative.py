@@ -1,6 +1,16 @@
-"""Speculative decoding — draft-proposes, target-verifies, EXACT greedy
-output (no reference analog: the reference delegates inference entirely;
-this is TPU-native serving capability beyond parity).
+"""Batch=1 speculative decoding utilities — draft-proposes,
+target-verifies, EXACT greedy output (no reference analog: the reference
+delegates inference entirely; this is TPU-native serving capability
+beyond parity).
+
+This module is the standalone/utility layer: ``SpeculativeDecoder`` runs
+one stream against dense caches, and ``accept_tokens`` is the shared
+greedy acceptance rule. The PRIMARY speculation path is in-engine —
+``ContinuousBatchingEngine`` / ``PagedContinuousBatchingEngine`` run
+batched draft steps and ONE multi-token verify dispatch per scheduler
+tick (the paged engine through the verify kernel, no dense gather and no
+``all_logits`` forward), with per-row adaptive k and per-tenant LoRA
+drafts — see docs/serving.md "Speculative decoding".
 
 Why it fits TPU: single-token decode is memory-bound (one HBM sweep of
 the weights per token). Verifying k proposed tokens costs ONE target
@@ -40,6 +50,34 @@ from ..utils import logger
 from .llm import _forward_with_cache, init_kv_cache
 
 Params = dict
+
+
+def accept_tokens(proposals, verified, k_eff: int) -> tuple[list, int]:
+    """The greedy acceptance rule, shared by the batch=1 decoder and the
+    engines' per-row commit loop. ``proposals``: the row's ``k_eff``
+    draft tokens; ``verified``: the target's argmax at chunk positions
+    0..k_eff (position i = the target's next token after seeing
+    proposal i-1; position 0 follows the committed last token).
+
+    Accept while proposal == target argmax; the first mismatch is
+    replaced by the target's own argmax. Full acceptance emits the k_eff
+    proposals WITHOUT the bonus token at position k_eff — the draft
+    cache has no KV for it, so emitting it would leave an unwritten hole
+    later draft queries attend as zeros. ``k_eff == 0`` degenerates to
+    plain decode: emit the target argmax after the last token.
+
+    Returns (emitted tokens, n_accept).
+    """
+    n_accept = 0
+    while n_accept < k_eff and int(proposals[n_accept]) == int(
+            verified[n_accept]):
+        n_accept += 1
+    if n_accept == k_eff and k_eff > 0:
+        emitted = [int(t) for t in proposals]
+    else:
+        emitted = ([int(t) for t in proposals[:n_accept]]
+                   + [int(verified[n_accept])])
+    return emitted, n_accept
 
 
 @dataclasses.dataclass
@@ -187,19 +225,10 @@ class SpeculativeDecoder:
             proposals_h = jax.device_get(proposals)[0]
             verified_h = jax.device_get(verified)[0]
 
-            n_accept = 0
-            while (n_accept < self.k
-                   and proposals_h[n_accept] == verified_h[n_accept]):
-                n_accept += 1
-            if n_accept == self.k:
-                # full acceptance: skip the bonus token — the draft cache
-                # has no entry for p_k, so emitting the bonus would leave
-                # an unwritten hole at p_k's position that later queries
-                # attend as zeros. k tokens this round, still exact.
-                emitted = list(proposals_h)
-            else:
-                emitted = (list(proposals_h[:n_accept])
-                           + [verified_h[n_accept]])
+            # shared greedy acceptance rule (accept_tokens docstring has
+            # the full-acceptance bonus-token rationale)
+            emitted, n_accept = accept_tokens(proposals_h, verified_h,
+                                              self.k)
             if eos_id is not None and eos_id in emitted:
                 emitted = emitted[:emitted.index(eos_id) + 1]
             room = max_new_tokens - len(out)

@@ -77,6 +77,15 @@ def _extract(data: dict):
                 f"{data.get('overhead_ratio_p50_ttft')}",
                 "request forensics (phase ledger + exemplars) within "
                 "noise of off")
+    if data.get("mode") == "spec":
+        on = data.get("spec_on", {})
+        return ("spec",
+                f"{data.get('speedup')}x decode tokens/s at acceptance "
+                f"{on.get('acceptance_rate')} "
+                f"(adversarial {data.get('adversarial_ratio')}x)",
+                "in-engine speculative decoding on the paged kernel "
+                "path: exact greedy parity in every arm, parked gate "
+                "costs nothing")
     if "promoted" in data and "detection_wall_s" in data:
         return ("canary",
                 f"drift→promotion {data.get('detection_to_promotion_s')}"

@@ -18,31 +18,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mlrun_tpu.models import tiny_llama
-from mlrun_tpu.models.llama import init_params
+from mlrun_tpu.models import init_permutation_params, tiny_llama
 from mlrun_tpu.serving.llm import _forward_with_cache, init_kv_cache
 from mlrun_tpu.serving.speculative import SpeculativeDecoder
 
-
-def _perm_model(cfg, perm, scale=50.0, seed=0):
-    """Params whose greedy next-token after t is the unique v with
-    perm[v] == t (layers zeroed; head rows huge and well separated)."""
-    params = init_params(cfg, jax.random.PRNGKey(seed))
-    params = jax.tree_util.tree_map(jnp.zeros_like, params)
-    e = cfg.embed_dim
-    emb = jax.random.normal(jax.random.PRNGKey(seed + 1),
-                            (cfg.vocab_size, e), jnp.float32)
-    emb = emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
-    params["embedding"] = emb.astype(cfg.dtype)
-    # norms must stay identity-ish: rms_norm scales are multiplicative
-    params["layers"]["attn_norm_scale"] = jnp.ones_like(
-        params["layers"]["attn_norm_scale"])
-    params["layers"]["mlp_norm_scale"] = jnp.ones_like(
-        params["layers"]["mlp_norm_scale"])
-    params["final_norm_scale"] = jnp.ones_like(params["final_norm_scale"])
-    # logits[v] = scale * <x, E[perm[v]]>, maximized at perm[v] == t
-    params["lm_head"] = (scale * emb[np.asarray(perm)].T).astype(cfg.dtype)
-    return params
+# one definition for tests + bench: models/llama.init_permutation_params
+_perm_model = init_permutation_params
 
 
 @pytest.fixture(scope="module")
@@ -66,14 +47,9 @@ def _plain_greedy(config, params, prompt, max_new, max_len=256):
 
 def _perms(cfg, overlap: float):
     """Target perm + a draft perm agreeing on ``overlap`` of tokens."""
-    rng = np.random.default_rng(0)
-    target = rng.permutation(cfg.vocab_size)
-    draft = target.copy()
-    n_diff = int(cfg.vocab_size * (1 - overlap))
-    if n_diff >= 2:
-        idx = rng.choice(cfg.vocab_size, size=n_diff, replace=False)
-        draft[idx] = draft[np.roll(idx, 1)]
-    return target, draft
+    from mlrun_tpu.models import permutation_pair
+
+    return permutation_pair(cfg.vocab_size, overlap)
 
 
 def test_exact_parity_partial_draft(cfg):
